@@ -1,0 +1,25 @@
+"""jepsen_tpu — a TPU-native distributed-systems testing framework.
+
+A brand-new framework with the capabilities of Jepsen (reference:
+fbarotov/jepsen): orchestrate real database clusters over SSH, drive
+randomized concurrent workloads through pure-functional generators while a
+nemesis injects faults, record a timestamped operation history, and check
+that history against consistency models.
+
+The compute plane — history checking — runs on TPU via JAX: the
+Wing–Gong–Lowe linearizability search is implemented as a vmapped,
+lockstep frontier exploration over op/process/value tensors
+(see `jepsen_tpu.ops.wgl`), and per-key independent sub-histories are
+sharded across TPU cores (see `jepsen_tpu.parallel`).
+
+Layer map (mirrors SURVEY.md §1):
+  L0  control/        remote execution (ssh / docker / k8s / dummy)
+  L1  os_setup, db, net   environment automation
+  L2  client, nemesis, generator   workload execution runtime
+  L3  core            test orchestration (run())
+  L4  checker, independent, ops/   analysis — the TPU plane
+  L5  store, web      persistence & observability
+  L6  cli             entry points
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,427 @@
+"""Checkers: history -> results map analysis.
+
+Capability parity with jepsen.checker (jepsen/src/jepsen/checker.clj):
+the `Checker` protocol (`check(test, history, opts) -> {"valid?": ...}`,
+checker.clj:52-67), `check_safe` (:74-85), `compose` (:87-99) with
+`merge_valid` priority false > unknown > true (:29-50), and the built-in
+checkers (stats :166, linearizable :185, queue :218, set :240,
+total-queue :628, unique-ids :689, counter :737, set-full :294,
+unhandled-exceptions :124).
+
+The `linearizable` checker is where the TPU plane plugs in: exactly as the
+reference gates knossos behind `:algorithm` (checker.clj:199-202), this
+one gates the JAX WGL kernel behind `algorithm="tpu-wgl"`.
+"""
+
+from __future__ import annotations
+
+import threading
+import traceback
+from typing import Any, Callable, Iterable, Optional
+
+from ..history import History, Op
+from ..models import core as models
+from ..util import Multiset, bounded_pmap, integer_interval_set_str
+
+UNKNOWN = "unknown"
+
+
+def valid_priority(v) -> int:
+    """false > unknown > true (checker.clj:29-35)."""
+    if v is False:
+        return 0
+    if v == UNKNOWN or v is None:
+        return 1
+    return 2
+
+
+def merge_valid(valids: Iterable) -> Any:
+    """Merge a collection of :valid? values, preferring the worst
+    (checker.clj:36-50). Empty collection -> True."""
+    out = True
+    for v in valids:
+        if valid_priority(v) < valid_priority(out):
+            out = v
+    return out
+
+
+class Checker:
+    """Base checker protocol. Subclasses implement check()."""
+
+    def check(self, test: dict, history: History, opts: Optional[dict] = None
+              ) -> dict:
+        raise NotImplementedError
+
+    def __call__(self, test, history, opts=None):
+        return self.check(test, history, opts or {})
+
+
+class FnChecker(Checker):
+    def __init__(self, fn: Callable, name: str = "fn-checker"):
+        self.fn = fn
+        self.name = name
+
+    def check(self, test, history, opts=None):
+        return self.fn(test, history, opts or {})
+
+
+def check_safe(checker: Checker, test: dict, history: History,
+               opts: Optional[dict] = None) -> dict:
+    """Like check, but captures exceptions as {"valid?": "unknown"}
+    (checker.clj:74-85)."""
+    try:
+        return checker.check(test, history, opts or {})
+    except Exception:  # noqa: BLE001
+        return {"valid?": UNKNOWN, "error": traceback.format_exc()}
+
+
+class Compose(Checker):
+    """Map of name -> checker, evaluated in parallel; valid? is the merge
+    (checker.clj:87-99)."""
+
+    def __init__(self, checker_map: dict):
+        self.checker_map = dict(checker_map)
+
+    def check(self, test, history, opts=None):
+        names = list(self.checker_map)
+        results = bounded_pmap(
+            lambda n: check_safe(self.checker_map[n], test, history, opts),
+            names)
+        out = dict(zip(names, results))
+        return {"valid?": merge_valid(r.get("valid?") for r in results),
+                **out}
+
+
+def compose(checker_map: dict) -> Checker:
+    return Compose(checker_map)
+
+
+class ConcurrencyLimit(Checker):
+    """Bound concurrent executions of a memory-hungry checker
+    (checker.clj:101-116)."""
+
+    def __init__(self, limit: int, checker: Checker):
+        self.sem = threading.Semaphore(limit)
+        self.checker = checker
+
+    def check(self, test, history, opts=None):
+        with self.sem:
+            return self.checker.check(test, history, opts)
+
+
+def concurrency_limit(limit: int, checker: Checker) -> Checker:
+    return ConcurrencyLimit(limit, checker)
+
+
+class UnbridledOptimism(Checker):
+    """Everything is awesoooommmmme! (checker.clj:118-122)"""
+
+    def check(self, test, history, opts=None):
+        return {"valid?": True}
+
+
+def unbridled_optimism() -> Checker:
+    return UnbridledOptimism()
+
+
+noop = unbridled_optimism
+
+
+class UnhandledExceptions(Checker):
+    """Aggregate crashed ops by exception class (checker.clj:124-151)."""
+
+    def check(self, test, history, opts=None):
+        groups: dict = {}
+        for op in history:
+            if op.is_info and (op.error is not None
+                               or op.extra.get("exception") is not None):
+                cls = op.extra.get("exception") or op.error
+                key = cls if isinstance(cls, str) else str(type(cls).__name__ if
+                                                           not isinstance(cls, (list, tuple, dict)) else cls)
+                groups.setdefault(key, []).append(op)
+        if not groups:
+            return {"valid?": True}
+        exes = sorted(
+            ({"class": k, "count": len(v), "example": v[0].to_dict()}
+             for k, v in groups.items()),
+            key=lambda e: -e["count"])
+        return {"valid?": True, "exceptions": exes}
+
+
+def unhandled_exceptions() -> Checker:
+    return UnhandledExceptions()
+
+
+def _stats_for(ops: list) -> dict:
+    ok = sum(1 for o in ops if o.is_ok)
+    fail = sum(1 for o in ops if o.is_fail)
+    info = sum(1 for o in ops if o.is_info)
+    return {"valid?": ok > 0, "count": ok + fail + info,
+            "ok-count": ok, "fail-count": fail, "info-count": info}
+
+
+class Stats(Checker):
+    """ok/fail/info counts overall and by :f; valid only if every :f saw an
+    ok op (checker.clj:153-183)."""
+
+    def check(self, test, history, opts=None):
+        ops = [o for o in history
+               if not o.is_invoke and o.process != "nemesis"]
+        by_f: dict = {}
+        for o in ops:
+            by_f.setdefault(o.f, []).append(o)
+        groups = {f: _stats_for(v) for f, v in sorted(
+            by_f.items(), key=lambda kv: str(kv[0]))}
+        out = _stats_for(ops)
+        out["by-f"] = groups
+        out["valid?"] = merge_valid(g["valid?"] for g in groups.values())
+        return out
+
+
+def stats() -> Checker:
+    return Stats()
+
+
+class Linearizable(Checker):
+    """Linearizability via WGL search (checker.clj:185-216 gates knossos
+    behind :algorithm; this gates the TPU kernel behind "tpu-wgl").
+
+    algorithm:
+      "wgl"      — pure-Python DFS with memoization (the oracle)
+      "tpu-wgl"  — JAX lockstep-frontier search on TPU (the north star)
+      "competition" — try tpu-wgl, fall back to wgl on "unknown"
+    """
+
+    def __init__(self, model: models.Model, algorithm: str = "competition",
+                 time_limit: Optional[float] = None):
+        self.model = model
+        self.algorithm = algorithm
+        self.time_limit = time_limit
+
+    def check(self, test, history, opts=None):
+        from ..ops import wgl_ref
+        h = history.filter(lambda o: o.process != "nemesis")
+        algo = self.algorithm
+        res: dict
+        if algo == "wgl":
+            res = wgl_ref.check(self.model, h, time_limit=self.time_limit)
+        elif algo == "tpu-wgl":
+            from ..ops import wgl as wgl_tpu
+            res = wgl_tpu.check(self.model, h, time_limit=self.time_limit)
+        elif algo == "competition":
+            try:
+                from ..ops import wgl as wgl_tpu
+                res = wgl_tpu.check(self.model, h,
+                                    time_limit=self.time_limit)
+            except ImportError:
+                res = {"valid?": UNKNOWN}
+            if res.get("valid?") == UNKNOWN:
+                res = wgl_ref.check(self.model, h,
+                                    time_limit=self.time_limit)
+        else:
+            raise ValueError(f"unknown linearizability algorithm {algo!r}")
+        # Truncate expensive diagnostics (checker.clj:213-216).
+        for k in ("final_paths", "configs"):
+            if k in res and isinstance(res[k], list):
+                res[k] = res[k][:10]
+        res["algorithm"] = algo
+        return res
+
+
+def linearizable(model=None, algorithm: str = "competition",
+                 time_limit: Optional[float] = None) -> Checker:
+    if model is None:
+        model = models.cas_register()
+    return Linearizable(model, algorithm, time_limit)
+
+
+class QueueChecker(Checker):
+    """Every dequeue must come from somewhere: assume every non-failing
+    enqueue succeeded and only OK dequeues happened, then fold the model
+    over that sequence (checker.clj:218-238). Use with an unordered queue
+    model."""
+
+    def __init__(self, model: models.Model):
+        self.model = model
+
+    def check(self, test, history, opts=None):
+        m = self.model
+        for op in history:
+            take = (op.is_invoke if op.f == "enqueue"
+                    else op.is_ok if op.f == "dequeue" else False)
+            if take:
+                m = m.step(op)
+                if models.is_inconsistent(m):
+                    return {"valid?": False, "error": m.msg}
+        return {"valid?": True, "final-queue": m}
+
+
+def queue(model=None) -> Checker:
+    if model is None:
+        model = models.unordered_queue()
+    return QueueChecker(model)
+
+
+class SetChecker(Checker):
+    """Adds followed by a final read: every acknowledged add must be
+    present; nothing unexpected may appear (checker.clj:240-291)."""
+
+    def check(self, test, history, opts=None):
+        attempts = {o.value for o in history if o.is_invoke and o.f == "add"}
+        adds = {o.value for o in history if o.is_ok and o.f == "add"}
+        final_read = None
+        for o in history:
+            if o.is_ok and o.f == "read":
+                final_read = o.value
+        if final_read is None:
+            return {"valid?": UNKNOWN, "error": "set was never read"}
+        final = set(final_read)
+        ok = final & attempts
+        unexpected = final - attempts
+        lost = adds - final
+        recovered = ok - adds
+        return {
+            "valid?": not lost and not unexpected,
+            "attempt-count": len(attempts),
+            "acknowledged-count": len(adds),
+            "ok-count": len(ok),
+            "lost-count": len(lost),
+            "recovered-count": len(recovered),
+            "unexpected-count": len(unexpected),
+            "ok": integer_interval_set_str(ok),
+            "lost": integer_interval_set_str(lost),
+            "unexpected": integer_interval_set_str(unexpected),
+            "recovered": integer_interval_set_str(recovered),
+        }
+
+
+def set_checker() -> Checker:
+    return SetChecker()
+
+
+def expand_queue_drain_ops(history: History) -> History:
+    """Expand :drain ops (value = list of drained elements) into dequeue
+    invoke/ok pairs (checker.clj:594-627)."""
+    out = History()
+    for op in history:
+        if op.f != "drain":
+            out.append(op)
+        elif op.is_invoke or op.is_fail:
+            continue
+        elif op.is_ok:
+            for el in (op.value or []):
+                out.append(op.with_(type="invoke", f="dequeue", value=None))
+                out.append(op.with_(type="ok", f="dequeue", value=el))
+        else:
+            raise ValueError(f"can't handle crashed drain op {op!r}")
+    return out
+
+
+class TotalQueue(Checker):
+    """What goes in must come out (multiset accounting over
+    enqueues/dequeues, checker.clj:628-687)."""
+
+    def check(self, test, history, opts=None):
+        history = expand_queue_drain_ops(history)
+        attempts = Multiset(o.value for o in history
+                            if o.is_invoke and o.f == "enqueue")
+        enqueues = Multiset(o.value for o in history
+                            if o.is_ok and o.f == "enqueue")
+        dequeues = Multiset(o.value for o in history
+                            if o.is_ok and o.f == "dequeue")
+        ok = dequeues.intersect(attempts)
+        unexpected = Multiset(x for x in dequeues if x not in attempts)
+        duplicated = dequeues.minus(attempts).minus(unexpected)
+        lost = enqueues.minus(dequeues)
+        recovered = ok.minus(enqueues)
+        return {
+            "valid?": len(lost) == 0 and len(unexpected) == 0,
+            "attempt-count": len(attempts),
+            "acknowledged-count": len(enqueues),
+            "ok-count": len(ok),
+            "unexpected-count": len(unexpected),
+            "duplicated-count": len(duplicated),
+            "lost-count": len(lost),
+            "recovered-count": len(recovered),
+            "lost": lost.to_sorted_list(),
+            "unexpected": unexpected.to_sorted_list(),
+            "duplicated": duplicated.to_sorted_list(),
+            "recovered": recovered.to_sorted_list(),
+        }
+
+
+def total_queue() -> Checker:
+    return TotalQueue()
+
+
+class UniqueIds(Checker):
+    """A unique-id generator must emit unique ids (checker.clj:689-734)."""
+
+    def check(self, test, history, opts=None):
+        attempted = sum(1 for o in history
+                        if o.is_invoke and o.f == "generate")
+        acks = [o.value for o in history if o.is_ok and o.f == "generate"]
+        counts: dict = {}
+        for v in acks:
+            counts[v] = counts.get(v, 0) + 1
+        dups = {k: c for k, c in counts.items() if c > 1}
+        rng = [min(acks), max(acks)] if acks else [None, None]
+        dup_sample = dict(sorted(dups.items(), key=lambda kv: -kv[1])[:48])
+        return {
+            "valid?": not dups,
+            "attempted-count": attempted,
+            "acknowledged-count": len(acks),
+            "duplicated-count": len(dups),
+            "duplicated": dup_sample,
+            "range": rng,
+        }
+
+
+def unique_ids() -> Checker:
+    return UniqueIds()
+
+
+class Counter(Checker):
+    """A monotonically increasing counter: each read must land between the
+    sum of acknowledged adds (lower) and the sum of attempted adds (upper)
+    at that moment (checker.clj:737-795)."""
+
+    def check(self, test, history, opts=None):
+        # Invocations of ops that completed :fail never happened — drop both
+        # halves (the reference runs history/complete, which marks them,
+        # then removes them: checker.clj:747-751).
+        failed = set()
+        for inv, c in history.pairs():
+            if c is not None and c.is_fail:
+                failed.add(id(inv))
+                failed.add(id(c))
+        lower = 0
+        upper = 0
+        pending: dict = {}  # process -> lower bound captured at invoke
+        reads: list = []
+        for op in history:
+            if id(op) in failed or op.process == "nemesis":
+                continue
+            if op.f == "read":
+                if op.is_invoke:
+                    pending[op.process] = lower
+                elif op.is_ok:
+                    lo = pending.pop(op.process, None)
+                    if lo is not None:
+                        reads.append([lo, op.value, upper])
+            elif op.f == "add":
+                if op.is_invoke:
+                    if not isinstance(op.value, (int, float)) or op.value < 0:
+                        raise ValueError(
+                            "counter checker assumes non-negative numeric "
+                            f"adds, got {op.value!r}")
+                    upper += op.value
+                elif op.is_ok:
+                    lower += op.value
+        errors = [r for r in reads
+                  if not (r[0] <= r[1] <= r[2])]
+        return {"valid?": not errors, "reads": reads, "errors": errors}
+
+
+def counter() -> Checker:
+    return Counter()

@@ -1,0 +1,179 @@
+"""Object-form consistency models (the Python correctness oracle).
+
+Capability parity with knossos.model: `Model.step(op) -> Model`, returning
+an `Inconsistent` marker when the op is illegal in the current state. The
+protocol shape is the one the reference documents at
+`doc/tutorial/04-checker.md:38-95` (reproducing knossos's definition) and
+re-defines locally at `jepsen/src/jepsen/tests/causal.clj:12-26`.
+
+Models must be immutable values with structural equality and hashability:
+the WGL search memoizes on (linearized-set, model) pairs.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Optional, Tuple
+
+
+@dataclass(frozen=True)
+class Inconsistent:
+    """Marker returned by step when an operation is illegal."""
+
+    msg: str
+
+    def step(self, op) -> "Inconsistent":
+        return self
+
+
+def inconsistent(msg: str) -> Inconsistent:
+    return Inconsistent(msg)
+
+
+def is_inconsistent(m) -> bool:
+    return isinstance(m, Inconsistent)
+
+
+class Model:
+    """Base class; subclasses are frozen dataclasses implementing step."""
+
+    def step(self, op) -> "Model | Inconsistent":
+        raise NotImplementedError
+
+
+@dataclass(frozen=True)
+class NoOp(Model):
+    """A model that accepts everything (knossos model/noop parity)."""
+
+    def step(self, op):
+        return self
+
+
+@dataclass(frozen=True)
+class Register(Model):
+    """A read/write register. A read with value None matches any state
+    (an unknown read)."""
+
+    value: Any = None
+
+    def step(self, op):
+        f, v = op.f, op.value
+        if f == "write":
+            return Register(v)
+        if f == "read":
+            if v is None or v == self.value:
+                return self
+            return inconsistent(f"can't read {v!r} from register {self.value!r}")
+        return inconsistent(f"unknown op f {f!r} for register")
+
+
+@dataclass(frozen=True)
+class CASRegister(Model):
+    """A compare-and-set register: read / write / cas [old new].
+
+    Semantics match the cas-register the reference's tutorial reproduces
+    from knossos (`doc/tutorial/04-checker.md:60-80`): a cas succeeds only
+    when the current value equals `old`; a read with value None matches
+    anything.
+    """
+
+    value: Any = None
+
+    def step(self, op):
+        f, v = op.f, op.value
+        if f == "write":
+            return CASRegister(v)
+        if f == "cas":
+            cur, new = v
+            if cur == self.value:
+                return CASRegister(new)
+            return inconsistent(f"can't CAS {self.value!r} from {cur!r} to {new!r}")
+        if f == "read":
+            if v is None or v == self.value:
+                return self
+            return inconsistent(f"can't read {v!r} from register {self.value!r}")
+        return inconsistent(f"unknown op f {f!r} for cas-register")
+
+
+@dataclass(frozen=True)
+class Mutex(Model):
+    """A single mutex: acquire / release."""
+
+    locked: bool = False
+
+    def step(self, op):
+        f = op.f
+        if f == "acquire":
+            if self.locked:
+                return inconsistent("cannot acquire a locked mutex")
+            return Mutex(True)
+        if f == "release":
+            if not self.locked:
+                return inconsistent("cannot release a free mutex")
+            return Mutex(False)
+        return inconsistent(f"unknown op f {f!r} for mutex")
+
+
+@dataclass(frozen=True)
+class FIFOQueue(Model):
+    """A FIFO queue: enqueue / dequeue. Dequeue of value v is legal only
+    when v is at the head. A dequeue with value None (unknown) matches any
+    non-empty queue."""
+
+    items: Tuple[Any, ...] = ()
+
+    def step(self, op):
+        f, v = op.f, op.value
+        if f == "enqueue":
+            return FIFOQueue(self.items + (v,))
+        if f == "dequeue":
+            if not self.items:
+                return inconsistent("cannot dequeue from empty queue")
+            head = self.items[0]
+            if v is None or v == head:
+                return FIFOQueue(self.items[1:])
+            return inconsistent(f"queue head is {head!r}, not {v!r}")
+        return inconsistent(f"unknown op f {f!r} for fifo-queue")
+
+
+@dataclass(frozen=True)
+class UnorderedQueue(Model):
+    """A queue without ordering guarantees (knossos unordered-queue parity):
+    dequeue may return any enqueued-but-not-dequeued element."""
+
+    items: frozenset = frozenset()
+
+    def step(self, op):
+        f, v = op.f, op.value
+        if f == "enqueue":
+            return UnorderedQueue(self.items | {v})
+        if f == "dequeue":
+            if v in self.items:
+                return UnorderedQueue(self.items - {v})
+            return inconsistent(f"{v!r} is not in the queue")
+        return inconsistent(f"unknown op f {f!r} for unordered-queue")
+
+
+# -- constructor conveniences (knossos model/register style) --
+def register(value=None) -> Register:
+    return Register(value)
+
+
+def cas_register(value=None) -> CASRegister:
+    return CASRegister(value)
+
+
+def mutex() -> Mutex:
+    return Mutex(False)
+
+
+def fifo_queue() -> FIFOQueue:
+    return FIFOQueue(())
+
+
+def unordered_queue() -> UnorderedQueue:
+    return UnorderedQueue(frozenset())
+
+
+def noop() -> NoOp:
+    return NoOp()

@@ -1,0 +1,7 @@
+"""Checker compute kernels.
+
+`wgl_ref` is the pure-Python Wing–Gong–Lowe search (correctness oracle and
+counterexample extractor); `wgl` is the TPU kernel — the same search as a
+vmapped lockstep frontier exploration under `jax.jit`. `linprep` is the
+shared history → operation-table preprocessing both consume.
+"""

@@ -1,0 +1,261 @@
+"""Kitchen-sink utilities (capability parity with jepsen.util,
+jepsen/src/jepsen/util.clj — real-pmap, relative-time clock, retries,
+majority math, interval-set rendering)."""
+
+from __future__ import annotations
+
+import threading
+import time as _time
+from concurrent.futures import ThreadPoolExecutor
+from contextlib import contextmanager
+from typing import Any, Callable, Iterable, Optional, Sequence
+
+
+def majority(n: int) -> int:
+    """Smallest integer strictly greater than half of n
+    (jepsen.util/majority parity: for 5 -> 3, for 0 -> 1)."""
+    return n // 2 + 1
+
+
+def minority(n: int) -> int:
+    """Largest number of nodes that is still a minority."""
+    return (n - 1) // 2
+
+
+def minority_third(n: int) -> int:
+    """Byzantine-fault threshold: largest f with 3f < n
+    (jepsen.util/minority-third parity)."""
+    return max(0, (n - 1) // 3)
+
+
+def integer_interval_set_str(xs: Iterable) -> str:
+    """Render a set of integers as compact interval notation, e.g.
+    #{1-3 5 7-9} (jepsen.util/integer-interval-set-str parity). Non-integer
+    elements fall back to plain rendering."""
+    xs = sorted(xs, key=lambda x: (not isinstance(x, int), x)
+                if not isinstance(x, bool) else (True, x))
+    parts = []
+    i = 0
+    while i < len(xs):
+        x = xs[i]
+        if isinstance(x, int) and not isinstance(x, bool):
+            j = i
+            while (j + 1 < len(xs) and isinstance(xs[j + 1], int)
+                   and xs[j + 1] == xs[j] + 1):
+                j += 1
+            if j > i:
+                parts.append(f"{x}-{xs[j]}")
+            else:
+                parts.append(str(x))
+            i = j + 1
+        else:
+            parts.append(str(x))
+            i += 1
+    return "#{" + " ".join(parts) + "}"
+
+
+def real_pmap(f: Callable, coll: Sequence) -> list:
+    """Apply f to every element in its own thread; wait for all; raise the
+    most interesting exception if any failed (jepsen.util/real-pmap parity,
+    util.clj:65-77 — 'interesting' = prefer non-interrupt exceptions)."""
+    coll = list(coll)
+    if not coll:
+        return []
+    results: list = [None] * len(coll)
+    errors: list = [None] * len(coll)
+
+    def run(i, x):
+        try:
+            results[i] = f(x)
+        except BaseException as e:  # noqa: BLE001 — rethrown below
+            errors[i] = e
+
+    threads = [threading.Thread(target=run, args=(i, x), daemon=True)
+               for i, x in enumerate(coll)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    errs = [e for e in errors if e is not None]
+    if errs:
+        # Prefer "interesting" exceptions over interrupts/cancellations.
+        boring = (KeyboardInterrupt, SystemExit)
+        interesting = [e for e in errs if not isinstance(e, boring)]
+        raise (interesting[0] if interesting else errs[0])
+    return results
+
+
+def bounded_pmap(f: Callable, coll: Sequence, max_workers: int = 16) -> list:
+    """pmap with a bounded worker pool (jepsen.util/bounded-pmap parity)."""
+    coll = list(coll)
+    if not coll:
+        return []
+    with ThreadPoolExecutor(max_workers=min(max_workers, len(coll))) as ex:
+        return list(ex.map(f, coll))
+
+
+# -- relative-time clock (jepsen.util/with-relative-time, util.clj:326-347).
+# Process-global, like the reference's dynamic var: all worker threads share
+# the test's time origin. --
+_global_origin: Optional[int] = None
+
+
+def linear_time_nanos() -> int:
+    return _time.monotonic_ns()
+
+
+@contextmanager
+def with_relative_time():
+    """Zero the test clock for the duration of the block."""
+    global _global_origin
+    prev = _global_origin
+    _global_origin = linear_time_nanos()
+    try:
+        yield
+    finally:
+        _global_origin = prev
+
+
+def relative_time_nanos() -> int:
+    origin = _global_origin
+    if origin is None:
+        raise RuntimeError("relative_time_nanos outside with_relative_time")
+    return linear_time_nanos() - origin
+
+
+def sleep_nanos(dt: int) -> None:
+    if dt > 0:
+        _time.sleep(dt / 1e9)
+
+
+class TimeoutError_(Exception):
+    pass
+
+
+def timeout(seconds: float, f: Callable, *args, default=TimeoutError_):
+    """Run f in a thread with a timeout (jepsen.util/timeout macro parity).
+    Returns default on timeout (or raises it if it's an exception class).
+    The worker thread is abandoned, not killed — f should be interruptible
+    or side-effect-safe."""
+    result: list = []
+    err: list = []
+
+    def run():
+        try:
+            result.append(f(*args))
+        except BaseException as e:  # noqa: BLE001
+            err.append(e)
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    t.join(seconds)
+    if t.is_alive():
+        if isinstance(default, type) and issubclass(default, BaseException):
+            raise default(f"timed out after {seconds}s")
+        return default
+    if err:
+        raise err[0]
+    return result[0]
+
+
+def await_fn(f: Callable, retry_interval: float = 1.0,
+             timeout_s: float = 60.0, log_message: Optional[str] = None):
+    """Poll f until it returns non-exceptionally (jepsen.util/await-fn
+    parity, util.clj:383)."""
+    deadline = _time.monotonic() + timeout_s
+    last: Optional[BaseException] = None
+    while _time.monotonic() < deadline:
+        try:
+            return f()
+        except Exception as e:  # noqa: BLE001
+            last = e
+            _time.sleep(retry_interval)
+    raise TimeoutError_(log_message or f"await_fn timed out after {timeout_s}s") \
+        from last
+
+
+def with_retry(f: Callable, retries: int = 5, backoff: float = 0.1):
+    """Call f, retrying up to `retries` times with fixed backoff."""
+    for attempt in range(retries + 1):
+        try:
+            return f()
+        except Exception:
+            if attempt == retries:
+                raise
+            _time.sleep(backoff)
+
+
+def nemesis_intervals(history, fs_start=("start",), fs_stop=("stop",)):
+    """Pair up nemesis start/stop ops into [start-op stop-op] intervals
+    (jepsen.util/nemesis-intervals parity, util.clj:736): every start still
+    open when a stop arrives is paired with that stop. Returns a list of
+    (start_op, stop_op_or_None)."""
+    intervals = []
+    open_starts: list = []
+    for op in history:
+        if op.process != "nemesis":
+            continue
+        if op.f in fs_start and not op.is_invoke:
+            open_starts.append(op)
+        elif op.f in fs_stop and not op.is_invoke and open_starts:
+            intervals.extend((s, op) for s in open_starts)
+            open_starts = []
+    intervals.extend((s, None) for s in open_starts)
+    return intervals
+
+
+def rand_exp(rng, mean: float) -> float:
+    """Exponentially distributed random value with the given mean."""
+    return rng.expovariate(1.0 / mean) if mean > 0 else 0.0
+
+
+class Multiset:
+    """A tiny multiset (the reference leans on org.clojure/multiset for
+    total-queue accounting, checker.clj:628-687)."""
+
+    def __init__(self, items: Iterable = ()):
+        self.counts: dict = {}
+        for x in items:
+            self.add(x)
+
+    def add(self, x, n: int = 1):
+        self.counts[x] = self.counts.get(x, 0) + n
+
+    def __len__(self):
+        return sum(self.counts.values())
+
+    def __contains__(self, x):
+        return self.counts.get(x, 0) > 0
+
+    def __iter__(self):
+        for x, c in self.counts.items():
+            for _ in range(c):
+                yield x
+
+    def __eq__(self, other):
+        return isinstance(other, Multiset) and self.counts == other.counts
+
+    def __repr__(self):
+        return f"Multiset({dict(self.counts)})"
+
+    def intersect(self, other: "Multiset") -> "Multiset":
+        m = Multiset()
+        for x, c in self.counts.items():
+            k = min(c, other.counts.get(x, 0))
+            if k > 0:
+                m.add(x, k)
+        return m
+
+    def minus(self, other: "Multiset") -> "Multiset":
+        m = Multiset()
+        for x, c in self.counts.items():
+            k = c - other.counts.get(x, 0)
+            if k > 0:
+                m.add(x, k)
+        return m
+
+    def to_sorted_list(self):
+        try:
+            return sorted(self)
+        except TypeError:
+            return list(self)

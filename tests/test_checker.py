@@ -1,0 +1,171 @@
+from jepsen_tpu import checker as c
+from jepsen_tpu.history import History, Op, invoke, ok, fail, info
+from jepsen_tpu.models import unordered_queue
+
+
+def H(ops):
+    return History(ops).index()
+
+
+def test_merge_valid():
+    assert c.merge_valid([]) is True
+    assert c.merge_valid([True, True]) is True
+    assert c.merge_valid([True, "unknown"]) == "unknown"
+    assert c.merge_valid([True, "unknown", False]) is False
+
+
+def test_unbridled_optimism():
+    assert c.unbridled_optimism().check({}, H([]))["valid?"] is True
+
+
+def test_compose():
+    comp = c.compose({"a": c.unbridled_optimism(),
+                      "b": c.unbridled_optimism()})
+    res = comp.check({}, H([]), {})
+    assert res["valid?"] is True
+    assert res["a"]["valid?"] is True
+
+
+def test_compose_captures_exceptions():
+    class Boom(c.Checker):
+        def check(self, test, history, opts=None):
+            raise RuntimeError("boom")
+
+    res = c.compose({"bad": Boom()}).check({}, H([]), {})
+    assert res["valid?"] == "unknown"
+    assert "boom" in res["bad"]["error"]
+
+
+def test_stats():
+    h = H([invoke(0, "read", None), ok(0, "read", 1),
+           invoke(1, "write", 2), fail(1, "write", 2),
+           invoke(2, "write", 3), ok(2, "write", 3)])
+    res = c.stats().check({}, h, {})
+    assert res["valid?"] is True
+    assert res["ok-count"] == 2
+    assert res["fail-count"] == 1
+    assert res["by-f"]["read"]["ok-count"] == 1
+
+
+def test_stats_invalid_when_f_never_ok():
+    h = H([invoke(0, "read", None), fail(0, "read", None),
+           invoke(1, "write", 2), ok(1, "write", 2)])
+    res = c.stats().check({}, h, {})
+    assert res["valid?"] is False
+
+
+def test_set_checker():
+    h = H([invoke(0, "add", 0), ok(0, "add", 0),
+           invoke(0, "add", 1), ok(0, "add", 1),
+           invoke(0, "add", 2), info(0, "add", 2),
+           invoke(1, "read", None), ok(1, "read", [0, 2])])
+    res = c.set_checker().check({}, h, {})
+    # 1 was acknowledged but not read: lost. 2 was indeterminate but read:
+    # recovered.
+    assert res["valid?"] is False
+    assert res["lost-count"] == 1
+    assert res["recovered-count"] == 1
+    assert res["ok-count"] == 2
+
+
+def test_set_checker_never_read():
+    h = H([invoke(0, "add", 0), ok(0, "add", 0)])
+    assert c.set_checker().check({}, h, {})["valid?"] == "unknown"
+
+
+def test_counter():
+    h = H([invoke(0, "add", 1), ok(0, "add", 1),
+           invoke(1, "read", None), ok(1, "read", 1),
+           invoke(0, "add", 2), info(0, "add", 2),
+           invoke(1, "read", None), ok(1, "read", 3)])
+    res = c.counter().check({}, h, {})
+    assert res["valid?"] is True
+    h2 = H([invoke(0, "add", 1), ok(0, "add", 1),
+            invoke(1, "read", None), ok(1, "read", 9)])
+    res2 = c.counter().check({}, h2, {})
+    assert res2["valid?"] is False
+    assert res2["errors"]
+
+
+def test_counter_failed_add_not_counted():
+    h = H([invoke(0, "add", 5), fail(0, "add", 5),
+           invoke(1, "read", None), ok(1, "read", 5)])
+    assert c.counter().check({}, h, {})["valid?"] is False
+
+
+def test_total_queue():
+    h = H([invoke(0, "enqueue", 1), ok(0, "enqueue", 1),
+           invoke(0, "enqueue", 2), info(0, "enqueue", 2),
+           invoke(1, "dequeue", None), ok(1, "dequeue", 1),
+           invoke(1, "dequeue", None), ok(1, "dequeue", 2)])
+    res = c.total_queue().check({}, h, {})
+    assert res["valid?"] is True
+    assert res["recovered-count"] == 1
+
+
+def test_total_queue_lost_and_unexpected():
+    h = H([invoke(0, "enqueue", 1), ok(0, "enqueue", 1),
+           invoke(1, "dequeue", None), ok(1, "dequeue", 9)])
+    res = c.total_queue().check({}, h, {})
+    assert res["valid?"] is False
+    assert res["lost"] == [1]
+    assert res["unexpected"] == [9]
+
+
+def test_total_queue_drain_expansion():
+    h = H([invoke(0, "enqueue", 1), ok(0, "enqueue", 1),
+           invoke(1, "drain", None), ok(1, "drain", [1])])
+    res = c.total_queue().check({}, h, {})
+    assert res["valid?"] is True
+
+
+def test_queue_checker():
+    h = H([invoke(0, "enqueue", 1), ok(0, "enqueue", 1),
+           invoke(1, "dequeue", None), ok(1, "dequeue", 1)])
+    assert c.queue(unordered_queue()).check({}, h, {})["valid?"] is True
+    h2 = H([invoke(1, "dequeue", None), ok(1, "dequeue", 1)])
+    assert c.queue(unordered_queue()).check({}, h2, {})["valid?"] is False
+
+
+def test_unique_ids():
+    h = H([invoke(0, "generate", None), ok(0, "generate", 1),
+           invoke(0, "generate", None), ok(0, "generate", 2),
+           invoke(1, "generate", None), ok(1, "generate", 2)])
+    res = c.unique_ids().check({}, h, {})
+    assert res["valid?"] is False
+    assert res["duplicated"] == {2: 2}
+    assert res["range"] == [1, 2]
+
+
+def test_unhandled_exceptions():
+    h = H([invoke(0, "read", None),
+           Op("info", f="read", process=0, error="TimeoutError"),
+           invoke(1, "read", None),
+           Op("info", f="read", process=1, error="TimeoutError")])
+    res = c.unhandled_exceptions().check({}, h, {})
+    assert res["valid?"] is True
+    assert res["exceptions"][0]["count"] == 2
+
+
+def test_linearizable_checker_wgl():
+    h = H([invoke(0, "write", 1), ok(0, "write", 1),
+           invoke(1, "read", None), ok(1, "read", 1)])
+    res = c.linearizable(algorithm="wgl").check({}, h, {})
+    assert res["valid?"] is True
+    h2 = H([invoke(0, "write", 1), ok(0, "write", 1),
+            invoke(1, "read", None), ok(1, "read", 2)])
+    assert c.linearizable(algorithm="wgl").check({}, h2, {})["valid?"] is False
+
+
+def test_linearizable_ignores_nemesis():
+    h = H([invoke("nemesis", "start", None), info("nemesis", "start", None),
+           invoke(0, "write", 1), ok(0, "write", 1)])
+    assert c.linearizable(algorithm="wgl").check({}, h, {})["valid?"] is True
+
+
+def test_check_safe():
+    class Boom(c.Checker):
+        def check(self, test, history, opts=None):
+            raise ValueError("nope")
+    res = c.check_safe(Boom(), {}, H([]))
+    assert res["valid?"] == "unknown"

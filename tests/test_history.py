@@ -1,0 +1,74 @@
+import json
+
+from jepsen_tpu.history import History, Op, invoke, ok, fail, info
+
+
+def test_op_predicates():
+    assert invoke(0, "read", None).is_invoke
+    assert ok(0, "read", 1).is_ok
+    assert fail(0, "write", 1).is_fail
+    assert info(0, "write", 1).is_info
+    assert not ok(0, "read", 1).is_invoke
+
+
+def test_index():
+    h = History([invoke(0, "write", 1), ok(0, "write", 1)]).index()
+    assert [op.index for op in h] == [0, 1]
+
+
+def test_pairs():
+    h = History([
+        invoke(0, "write", 1),
+        invoke(1, "read", None),
+        ok(0, "write", 1),
+        ok(1, "read", 1),
+    ])
+    pairs = h.pairs()
+    assert len(pairs) == 2
+    assert pairs[0][0].process == 0 and pairs[0][1].type == "ok"
+    assert pairs[1][0].process == 1 and pairs[1][1].value == 1
+
+
+def test_pairs_incomplete():
+    h = History([invoke(0, "write", 1)])
+    pairs = h.pairs()
+    assert pairs == [(h[0], None)]
+
+
+def test_complete_fills_read_values():
+    h = History([
+        invoke(0, "read", None),
+        ok(0, "read", 42),
+    ]).complete()
+    assert h[0].value == 42
+    assert h[0].index == 0 and h[1].index == 1
+
+
+def test_jsonl_roundtrip(tmp_path):
+    h = History([
+        invoke(0, "write", 1, time=10),
+        ok(0, "write", 1, time=20),
+        info(1, "cas", [1, 2], time=30),
+    ]).index()
+    p = tmp_path / "history.jsonl"
+    h.to_jsonl(str(p))
+    h2 = History.from_jsonl(str(p))
+    assert len(h2) == 3
+    assert h2[2].type == "info"
+    assert h2[2].value == [1, 2]
+    assert h2[0].time == 10
+
+
+def test_columns():
+    h = History([invoke(0, "write", 1, time=5), ok(0, "write", 1, time=9)]).index()
+    types, fs, procs, times, idxs = h.columns()
+    assert list(types) == [0, 1]
+    assert list(fs) == ["write", "write"]
+    assert list(times) == [5, 9]
+
+
+def test_from_dict_extra_fields():
+    op = Op.from_dict({"type": "ok", "f": "read", "process": 3, "value": 7,
+                       "node": "n1"})
+    assert op.extra == {"node": "n1"}
+    assert op.to_dict()["node"] == "n1"

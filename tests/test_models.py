@@ -1,0 +1,63 @@
+from jepsen_tpu.history import ok
+from jepsen_tpu.models import (CASRegister, FIFOQueue, Mutex, Register,
+                               UnorderedQueue, cas_register, fifo_queue,
+                               is_inconsistent, mutex, register,
+                               unordered_queue)
+
+
+def step(m, f, v=None):
+    return m.step(ok(0, f, v))
+
+
+def test_register():
+    m = register()
+    m = step(m, "write", 3)
+    assert m == Register(3)
+    assert step(m, "read", 3) == m
+    assert is_inconsistent(step(m, "read", 4))
+    # unknown read matches anything
+    assert step(m, "read", None) == m
+
+
+def test_cas_register():
+    m = cas_register()
+    m = step(m, "write", 1)
+    m2 = step(m, "cas", [1, 2])
+    assert m2 == CASRegister(2)
+    assert is_inconsistent(step(m, "cas", [3, 4]))
+    assert step(m2, "read", 2) == m2
+    assert is_inconsistent(step(m2, "read", 1))
+
+
+def test_mutex():
+    m = mutex()
+    m2 = step(m, "acquire")
+    assert m2 == Mutex(True)
+    assert is_inconsistent(step(m, "release"))
+    assert is_inconsistent(step(m2, "acquire"))
+    assert step(m2, "release") == Mutex(False)
+
+
+def test_fifo_queue():
+    m = fifo_queue()
+    m = step(m, "enqueue", 1)
+    m = step(m, "enqueue", 2)
+    assert is_inconsistent(step(m, "dequeue", 2))
+    m2 = step(m, "dequeue", 1)
+    assert m2 == FIFOQueue((2,))
+    assert is_inconsistent(step(fifo_queue(), "dequeue", 1))
+    # unknown dequeue matches head
+    assert step(m, "dequeue", None) == FIFOQueue((2,))
+
+
+def test_unordered_queue():
+    m = unordered_queue()
+    m = step(m, "enqueue", 1)
+    m = step(m, "enqueue", 2)
+    assert step(m, "dequeue", 2) == UnorderedQueue(frozenset({1}))
+    assert is_inconsistent(step(m, "dequeue", 3))
+
+
+def test_models_hashable():
+    assert hash(register(1)) == hash(Register(1))
+    assert hash(fifo_queue()) == hash(FIFOQueue(()))

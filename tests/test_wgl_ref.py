@@ -1,0 +1,243 @@
+"""Tests for the pure-Python WGL oracle, including a brute-force
+differential test on small random histories."""
+
+import itertools
+import random
+
+from jepsen_tpu.history import History, Op
+from jepsen_tpu.models import (cas_register, fifo_queue, mutex, register)
+from jepsen_tpu.models.core import is_inconsistent
+from jepsen_tpu.ops import wgl_ref
+from jepsen_tpu.ops.linprep import prepare, precedence_masks
+
+
+def H(*events):
+    """events: (process, type, f, value) tuples in history order."""
+    return History(
+        Op(t, f=f, process=p, value=v, time=i)
+        for i, (p, t, f, v) in enumerate(events)
+    ).index()
+
+
+def test_empty_history_valid():
+    assert wgl_ref.check(register(), History())["valid?"] is True
+
+
+def test_sequential_register_valid():
+    h = H((0, "invoke", "write", 1), (0, "ok", "write", 1),
+          (0, "invoke", "read", None), (0, "ok", "read", 1))
+    assert wgl_ref.check(register(), h)["valid?"] is True
+
+
+def test_sequential_register_invalid():
+    h = H((0, "invoke", "write", 1), (0, "ok", "write", 1),
+          (0, "invoke", "read", None), (0, "ok", "read", 2))
+    res = wgl_ref.check(register(), h)
+    assert res["valid?"] is False
+    assert res["configs"]
+
+
+def test_concurrent_writes_any_order():
+    # Two concurrent writes; a later read may see either.
+    for seen in (1, 2):
+        h = H((0, "invoke", "write", 1), (1, "invoke", "write", 2),
+              (0, "ok", "write", 1), (1, "ok", "write", 2),
+              (2, "invoke", "read", None), (2, "ok", "read", seen))
+        assert wgl_ref.check(register(), h)["valid?"] is True, seen
+
+
+def test_realtime_order_enforced():
+    # w1 completes before w2 invokes; read after w2 completes must not see 1
+    # ... actually it must see 2 since w2 overwrote. Read of 1 is invalid.
+    h = H((0, "invoke", "write", 1), (0, "ok", "write", 1),
+          (0, "invoke", "write", 2), (0, "ok", "write", 2),
+          (1, "invoke", "read", None), (1, "ok", "read", 1))
+    assert wgl_ref.check(register(), h)["valid?"] is False
+
+
+def test_crashed_write_may_take_effect():
+    # Write crashes (:info); later read sees its value: valid.
+    h = H((0, "invoke", "write", 7), (0, "info", "write", 7),
+          (1, "invoke", "read", None), (1, "ok", "read", 7))
+    assert wgl_ref.check(register(), h)["valid?"] is True
+
+
+def test_crashed_write_may_not_take_effect():
+    # Write crashes; later read sees the old value: also valid.
+    h = H((0, "invoke", "write", 1), (0, "ok", "write", 1),
+          (1, "invoke", "write", 7), (1, "info", "write", 7),
+          (2, "invoke", "read", None), (2, "ok", "read", 1))
+    assert wgl_ref.check(register(), h)["valid?"] is True
+
+
+def test_failed_write_never_takes_effect():
+    h = H((0, "invoke", "write", 1), (0, "ok", "write", 1),
+          (1, "invoke", "write", 7), (1, "fail", "write", 7),
+          (2, "invoke", "read", None), (2, "ok", "read", 7))
+    assert wgl_ref.check(register(), h)["valid?"] is False
+
+
+def test_cas_register():
+    h = H((0, "invoke", "write", 0), (0, "ok", "write", 0),
+          (1, "invoke", "cas", [0, 3]), (1, "ok", "cas", [0, 3]),
+          (2, "invoke", "read", None), (2, "ok", "read", 3))
+    assert wgl_ref.check(cas_register(), h)["valid?"] is True
+    h2 = H((0, "invoke", "write", 0), (0, "ok", "write", 0),
+           (1, "invoke", "cas", [1, 3]), (1, "ok", "cas", [1, 3]))
+    assert wgl_ref.check(cas_register(), h2)["valid?"] is False
+
+
+def test_mutex():
+    # Two fully-overlapping successful acquires with no release: invalid.
+    h = H((0, "invoke", "acquire", None), (1, "invoke", "acquire", None),
+          (0, "ok", "acquire", None), (1, "ok", "acquire", None))
+    assert wgl_ref.check(mutex(), h)["valid?"] is False
+    # acquire / release / acquire: valid.
+    h2 = H((0, "invoke", "acquire", None), (0, "ok", "acquire", None),
+           (0, "invoke", "release", None), (0, "ok", "release", None),
+           (1, "invoke", "acquire", None), (1, "ok", "acquire", None))
+    assert wgl_ref.check(mutex(), h2)["valid?"] is True
+
+
+def test_fifo_queue():
+    h = H((0, "invoke", "enqueue", 1), (0, "ok", "enqueue", 1),
+          (0, "invoke", "enqueue", 2), (0, "ok", "enqueue", 2),
+          (1, "invoke", "dequeue", None), (1, "ok", "dequeue", 1))
+    assert wgl_ref.check(fifo_queue(), h)["valid?"] is True
+    h2 = H((0, "invoke", "enqueue", 1), (0, "ok", "enqueue", 1),
+           (0, "invoke", "enqueue", 2), (0, "ok", "enqueue", 2),
+           (1, "invoke", "dequeue", None), (1, "ok", "dequeue", 2))
+    assert wgl_ref.check(fifo_queue(), h2)["valid?"] is False
+
+
+def test_crashed_read_is_dropped():
+    h = H((0, "invoke", "read", None), (0, "info", "read", None),
+          (1, "invoke", "write", 1), (1, "ok", "write", 1))
+    ops = prepare(h)
+    assert len(ops) == 1
+    assert wgl_ref.check(register(), h)["valid?"] is True
+
+
+def test_precedence_masks():
+    h = H((0, "invoke", "write", 1), (0, "ok", "write", 1),
+          (1, "invoke", "write", 2), (2, "invoke", "write", 3),
+          (1, "ok", "write", 2), (2, "ok", "write", 3))
+    ops = prepare(h)
+    pred = precedence_masks(ops)
+    assert pred[0] == 0
+    # ops 1 and 2 both invoked after op 0 returned
+    assert pred[1] == 0b001
+    assert pred[2] == 0b001
+
+
+def test_linearization_witness_is_legal():
+    h = H((0, "invoke", "write", 1), (1, "invoke", "write", 2),
+          (0, "ok", "write", 1), (1, "ok", "write", 2),
+          (2, "invoke", "read", None), (2, "ok", "read", 1))
+    res = wgl_ref.check(register(), h)
+    assert res["valid?"] is True
+    m = register()
+    for opd in res["linearization"]:
+        m = m.step(Op.from_dict(opd))
+        assert not is_inconsistent(m)
+
+
+# ---------- brute-force differential test ----------
+
+def brute_force_check(model, history) -> bool:
+    """Independent oracle: try every permutation of ops and every subset of
+    :info ops, checking real-time order and model legality directly."""
+    ops = prepare(history)
+    n = len(ops)
+    ok_ids = [i for i, o in enumerate(ops) if o.ok]
+    info_ids = [i for i, o in enumerate(ops) if not o.ok]
+    for r in range(len(info_ids) + 1):
+        for info_subset in itertools.combinations(info_ids, r):
+            chosen = sorted(ok_ids + list(info_subset))
+            for perm in itertools.permutations(chosen):
+                # real-time constraint: i before j forbidden when j returned
+                # before i invoked
+                legal = True
+                for a in range(len(perm)):
+                    for b in range(a + 1, len(perm)):
+                        if ops[perm[b]].ret < ops[perm[a]].inv:
+                            legal = False
+                            break
+                    if not legal:
+                        break
+                if not legal:
+                    continue
+                m = model
+                for i in perm:
+                    m = m.step(ops[i].as_op())
+                    if is_inconsistent(m):
+                        break
+                else:
+                    return True
+    return False
+
+
+def random_history(rng, n_procs=3, n_ops=5, fs=("read", "write", "cas"),
+                   vals=3):
+    events = []
+    active = {}
+    t = 0
+    for _ in range(n_ops * 3):
+        p = rng.randrange(n_procs)
+        if p in active:
+            f, v = active.pop(p)
+            typ = rng.choice(["ok", "ok", "fail", "info"])
+            if f == "read":
+                v = rng.randrange(vals) if typ == "ok" else None
+            events.append((p, typ, f, v))
+        else:
+            if sum(1 for e in events if e[1] == "invoke") >= n_ops:
+                continue
+            f = rng.choice(fs)
+            if f == "read":
+                v = None
+            elif f == "cas":
+                v = [rng.randrange(vals), rng.randrange(vals)]
+            else:
+                v = rng.randrange(vals)
+            active[p] = (f, v)
+            events.append((p, "invoke", f, v))
+        t += 1
+    return H(*events)
+
+
+def test_differential_vs_brute_force():
+    rng = random.Random(45100)  # the reference pins rand-seed 45100
+    n_checked = 0
+    for trial in range(150):
+        h = random_history(rng)
+        expected = brute_force_check(cas_register(), h)
+        got = wgl_ref.check(cas_register(), h)["valid?"]
+        assert got is expected, f"trial {trial}: wgl={got} brute={expected}"
+        n_checked += 1
+    assert n_checked == 150
+
+
+def test_differential_fifo_queue():
+    rng = random.Random(12345)
+    for trial in range(80):
+        events = []
+        active = {}
+        n_enq = 0
+        for _ in range(14):
+            p = rng.randrange(3)
+            if p in active:
+                f, v = active.pop(p)
+                typ = rng.choice(["ok", "ok", "info"])
+                if f == "dequeue" and typ == "ok":
+                    v = rng.randrange(4)
+                events.append((p, typ, f, v))
+            else:
+                f = rng.choice(["enqueue", "dequeue"])
+                v = rng.randrange(4) if f == "enqueue" else None
+                active[p] = (f, v)
+                events.append((p, "invoke", f, v))
+        h = H(*events)
+        expected = brute_force_check(fifo_queue(), h)
+        got = wgl_ref.check(fifo_queue(), h)["valid?"]
+        assert got is expected, f"trial {trial}"
