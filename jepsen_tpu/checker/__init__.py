@@ -199,20 +199,22 @@ class Linearizable(Checker):
         self.time_limit = time_limit
 
     def check(self, test, history, opts=None):
+        from ..history import strip_nemesis
         from ..ops import wgl_ref
-        h = history.filter(lambda o: o.process != "nemesis")
+        h = strip_nemesis(history)
         algo = self.algorithm
         res: dict
         if algo == "wgl":
             res = wgl_ref.check(self.model, h, time_limit=self.time_limit)
         elif algo == "tpu-wgl":
             from ..ops import wgl as wgl_tpu
-            res = wgl_tpu.check(self.model, h, time_limit=self.time_limit)
+            res = wgl_tpu.check_with_diagnostics(
+                self.model, h, time_limit=self.time_limit)
         elif algo == "competition":
             try:
                 from ..ops import wgl as wgl_tpu
-                res = wgl_tpu.check(self.model, h,
-                                    time_limit=self.time_limit)
+                res = wgl_tpu.check_with_diagnostics(
+                    self.model, h, time_limit=self.time_limit)
             except ImportError:
                 res = {"valid?": UNKNOWN}
             if res.get("valid?") == UNKNOWN:

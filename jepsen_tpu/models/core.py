@@ -40,6 +40,15 @@ class Model:
     def step(self, op) -> "Model | Inconsistent":
         raise NotImplementedError
 
+    def unreachable(self, op_counts: dict) -> bool:
+        """True when this state cannot arise in a search that applies each
+        history op at most once (`op_counts` maps op f -> multiplicity).
+        Used to bound host-side state-space enumeration for the table-
+        driven TPU kernel; states for which this returns True are pruned
+        as illegal, which is sound because the search never requests
+        them."""
+        return False
+
 
 @dataclass(frozen=True)
 class NoOp(Model):
@@ -135,6 +144,9 @@ class FIFOQueue(Model):
             return inconsistent(f"queue head is {head!r}, not {v!r}")
         return inconsistent(f"unknown op f {f!r} for fifo-queue")
 
+    def unreachable(self, op_counts):
+        return len(self.items) > op_counts.get("enqueue", 0)
+
 
 @dataclass(frozen=True)
 class UnorderedQueue(Model):
@@ -152,6 +164,9 @@ class UnorderedQueue(Model):
                 return UnorderedQueue(self.items - {v})
             return inconsistent(f"{v!r} is not in the queue")
         return inconsistent(f"unknown op f {f!r} for unordered-queue")
+
+    def unreachable(self, op_counts):
+        return len(self.items) > op_counts.get("enqueue", 0)
 
 
 # -- constructor conveniences (knossos model/register style) --

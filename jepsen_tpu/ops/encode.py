@@ -1,0 +1,189 @@
+"""Host-side encoding: history -> tensors for the TPU WGL kernel.
+
+Turns the prepared LinOp list (`linprep.prepare`) into the fixed-shape
+integer arrays the device search consumes:
+
+  * ok ops sorted by invocation: inv[], ret[], opcode[]
+  * info (crashed) ops: inv_info[], opcode_info[]
+  * a model transition table T[S, O] -> next-state index or -1, built by
+    enumerating the model's reachable state space on the host under the
+    history's distinct (f, value) op alphabet
+
+This is the bridge between the object-form models (knossos.model parity,
+`jepsen_tpu.models.core`) and the jitted search. The reference's checker
+selects the search engine by :algorithm (jepsen/src/jepsen/checker.clj:
+199-202); here the table-driven encoding is what makes a single generic
+jitted kernel serve every model.
+
+Window-width theory: with `base` = index of the first unlinearized ok op,
+an ok op j can only be linearized when some unlinearized op i <= j has
+ret(i) > inv(j); hence j < searchsorted(inv, ret(base)). So
+  W_needed = max_i ( #{j >= i : inv(j) < ret(i)} )
+bounds how far beyond `base` any linearizable op can sit, and a W-slot
+window loses nothing.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Optional
+
+import numpy as np
+
+from ..history import History
+from ..models.core import Model, is_inconsistent
+from .linprep import LinOp, prepare
+
+INF = np.int32(2**31 - 1)  # event indices are small; x64 stays off
+
+
+class EncodingUnsupported(Exception):
+    """The history/model cannot be encoded within kernel limits; callers
+    should fall back to the host oracle."""
+
+
+def _hashable(v):
+    if isinstance(v, list):
+        return tuple(_hashable(x) for x in v)
+    if isinstance(v, dict):
+        return tuple(sorted((k, _hashable(x)) for k, x in v.items()))
+    return v
+
+
+def build_table(model: Model, alphabet: list, max_states: int = 1 << 16,
+                op_counts: Optional[dict] = None) -> tuple[np.ndarray, list]:
+    """Enumerate the model's reachable states under `alphabet` (a list of
+    ops as seen by Model.step) and return (T, states) where
+    T[s, o] = next-state index or -1.
+
+    `op_counts` (f -> multiplicity in the history) lets models prune
+    states the at-most-once search can never reach (Model.unreachable),
+    keeping e.g. queue state spaces finite."""
+    op_counts = op_counts or {}
+    states: dict = {model: 0}
+    order: list = [model]
+    rows: list[list[int]] = []
+    i = 0
+    while i < len(order):
+        s = order[i]
+        row = []
+        for op in alphabet:
+            m2 = s.step(op)
+            if is_inconsistent(m2) or m2.unreachable(op_counts):
+                row.append(-1)
+            else:
+                j = states.get(m2)
+                if j is None:
+                    if len(order) >= max_states:
+                        raise EncodingUnsupported(
+                            f"model state space exceeds {max_states}")
+                    j = len(order)
+                    states[m2] = j
+                    order.append(m2)
+                row.append(j)
+        rows.append(row)
+        i += 1
+    return np.asarray(rows, dtype=np.int32), order
+
+
+@dataclass
+class Encoded:
+    """Everything the device search needs, in numpy (host) form."""
+
+    n_ok: int              # number of ok (must-linearize) ops
+    n_info: int            # number of crashed (may-linearize) ops
+    inv: np.ndarray        # (n_pad,) i64, INF beyond n_ok
+    ret: np.ndarray        # (n_pad,) i64, INF beyond n_ok
+    opcode: np.ndarray     # (n_pad,) i32, 0 beyond n_ok
+    sufminret: np.ndarray  # (n_pad+1,) i64; sufminret[i] = min ret[i:]
+    inv_info: np.ndarray   # (ic_pad,) i64, INF beyond n_info
+    opcode_info: np.ndarray  # (ic_pad,) i32
+    table: np.ndarray      # (S, O) i32 transition table
+    states: list           # state index -> model object
+    window: int            # W, multiple of 32
+    lin_ops: list          # LinOp list (ok ops then info ops), for reporting
+
+
+def _pad_to(n: int, multiple: int) -> int:
+    return max(multiple, ((n + multiple - 1) // multiple) * multiple)
+
+
+def encode(model: Model, history: History, max_window: int = 256,
+           max_states: int = 1 << 16, max_info: int = 128) -> Encoded:
+    """History + model -> Encoded tensors, or raise EncodingUnsupported."""
+    ops = prepare(history)
+    ok_ops = [o for o in ops if o.ok]
+    info_ops = [o for o in ops if not o.ok]
+    n, ni = len(ok_ops), len(info_ops)
+    if ni > max_info:
+        raise EncodingUnsupported(f"{ni} crashed ops exceeds cap {max_info}")
+
+    # Distinct op alphabet over every op the search might apply.
+    key_of = {}
+    alphabet = []
+    codes_ok = np.zeros(n, dtype=np.int32)
+    codes_info = np.zeros(ni, dtype=np.int32)
+    for arr, group in ((codes_ok, ok_ops), (codes_info, info_ops)):
+        for i, o in enumerate(group):
+            k = (o.f, _hashable(o.value))
+            c = key_of.get(k)
+            if c is None:
+                c = len(alphabet)
+                key_of[k] = c
+                alphabet.append(o.as_op())
+            arr[i] = c
+
+    op_counts: dict = {}
+    for o in ok_ops + info_ops:
+        op_counts[o.f] = op_counts.get(o.f, 0) + 1
+    table, states = build_table(model, alphabet, max_states=max_states,
+                                op_counts=op_counts)
+
+    inv_ok = np.asarray([o.inv for o in ok_ops], dtype=np.int32)
+    # crashed ops have ret = INF_TIME (2**62); clamp into int32 range
+    ret_ok = np.asarray([min(o.ret, 2**31 - 1) for o in ok_ops],
+                        dtype=np.int32)
+    # ok ops are already inv-sorted (prepare sorts); assert the invariant.
+    if n > 1:
+        assert np.all(np.diff(inv_ok) > 0)
+
+    # Exact window requirement (see module docstring).
+    if n:
+        hi = np.searchsorted(inv_ok, ret_ok)  # first j with inv[j] > ret[i]
+        w_needed = int(np.max(hi - np.arange(n)))
+    else:
+        w_needed = 1
+    W = _pad_to(w_needed, 32)
+    if W > max_window:
+        raise EncodingUnsupported(
+            f"window {w_needed} exceeds max {max_window} "
+            "(extremely skewed op latencies)")
+
+    n_pad = _pad_to(n, 64)
+    ic_pad = _pad_to(ni, 32)
+    inv = np.full(n_pad, INF, dtype=np.int32)
+    ret = np.full(n_pad, INF, dtype=np.int32)
+    opc = np.zeros(n_pad, dtype=np.int32)
+    inv[:n] = inv_ok
+    ret[:n] = ret_ok
+    opc[:n] = codes_ok
+    suf = np.full(n_pad + 1, INF, dtype=np.int32)
+    for i in range(n - 1, -1, -1):
+        suf[i] = min(ret[i], suf[i + 1])
+    suf[n:] = INF  # beyond real ops
+    iinv = np.full(ic_pad, INF, dtype=np.int32)
+    iopc = np.zeros(ic_pad, dtype=np.int32)
+    if ni:
+        iinv[:ni] = np.asarray([o.inv for o in info_ops], dtype=np.int32)
+        iopc[:ni] = codes_info
+
+    # Pad the table to power-of-two-ish shapes so shape buckets recur.
+    S, O = table.shape
+    Sp, Op_ = _pad_to(S, 16), _pad_to(O, 16)
+    tpad = np.full((Sp, Op_), -1, dtype=np.int32)
+    tpad[:S, :O] = table
+
+    return Encoded(n_ok=n, n_info=ni, inv=inv, ret=ret, opcode=opc,
+                   sufminret=suf, inv_info=iinv, opcode_info=iopc,
+                   table=tpad, states=states, window=W,
+                   lin_ops=ok_ops + info_ops)

@@ -1,0 +1,403 @@
+"""TPU Wing–Gong–Lowe linearizability search (the north star).
+
+A JAX reimplementation of the WGL search the reference reaches through
+knossos (`jepsen/src/jepsen/checker.clj:199-202` selects `wgl/analysis` by
+:algorithm). Instead of the JVM's depth-first search with growable bitsets
+and a hash-map memo table, the search here explores **thousands of
+configurations in lockstep**:
+
+  * A configuration is (base, window, info-mask, model-state):
+      - `base`   — index of the first unlinearized :ok op (everything
+                   below is linearized);
+      - `window` — W boolean lanes: linearized flags for ok ops
+                   [base, base+W). W is computed exactly per history
+                   (encode.py) so no reachable config is lost;
+      - `info`   — mask over crashed (:info) ops, which may linearize at
+                   any point after invocation or never;
+      - `state`  — index into the host-enumerated model transition table.
+  * Real-time candidacy uses one reduction instead of precedence bitsets:
+    op j may linearize iff  min{ret(i) : i unlinearized ok op} > inv(j).
+  * Each round expands every frontier config by every legal candidate,
+    packs + hashes the successors, **sort-uniques** them, probes a device
+    open-addressing hash table (the memo cache that makes WGL tractable),
+    and compacts survivors back into the fixed-capacity frontier, spilling
+    overflow to a device backlog.
+  * The whole search runs inside `lax.while_loop` in chunks; the host only
+    checks deadlines between chunks.
+
+Verdict soundness: "valid" requires a config with every ok op linearized;
+"invalid" requires exhausting the reachable config space with no overflow;
+anything cut short (deadline, config budget, backlog overflow) is
+"unknown", and `checker.linearizable(algorithm="competition")` falls back
+to the host oracle — mirroring how the reference races knossos engines
+(`knossos.competition/analysis`). Hash signatures are ~95 bits, so a
+false "seen" (the only unsound event) is astronomically unlikely; it is
+documented here rather than hidden.
+"""
+
+from __future__ import annotations
+
+import functools
+import time as _time
+from typing import Optional
+
+import numpy as np
+
+from ..history import History
+from ..models.core import Model
+from . import wgl_ref
+from .encode import EncodingUnsupported, encode
+
+INF = np.int32(2**31 - 1)
+
+
+# ---------------------------------------------------------------------------
+# Device kernel
+# ---------------------------------------------------------------------------
+
+def _pack_bits(bits):
+    """(..., L) bool -> (..., L//32) uint32."""
+    import jax.numpy as jnp
+    *lead, L = bits.shape
+    lanes = bits.reshape(*lead, L // 32, 32).astype(jnp.uint32)
+    weights = (jnp.uint32(1) << jnp.arange(32, dtype=jnp.uint32))
+    return jnp.sum(lanes * weights, axis=-1, dtype=jnp.uint32)
+
+
+def _fnv(words, seed):
+    """Fold a list of (R,) uint32 arrays into one (R,) uint32 hash."""
+    import jax.numpy as jnp
+    h = jnp.full_like(words[0], jnp.uint32(seed))
+    prime = jnp.uint32(16777619)
+    for w in words:
+        h = (h ^ w) * prime
+        h = h ^ (h >> 15)
+    return h
+
+
+@functools.lru_cache(maxsize=32)
+def _compiled_search(n_pad: int, ic_pad: int, W: int, S: int, O: int,
+                     K: int, H: int, B: int, chunk: int, probes: int):
+    """Build + jit the chunked search for one shape bucket.
+
+    Returns (init_fn, chunk_fn). All capacities are static; the actual op
+    count / info count / table contents are runtime args.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    Wl, Il = W // 32, ic_pad // 32
+
+    def init_fn(mstate0):
+        fr_base = jnp.zeros(K, dtype=jnp.int32)
+        fr_win = jnp.zeros((K, W), dtype=bool)
+        fr_info = jnp.zeros((K, ic_pad), dtype=bool)
+        fr_mst = jnp.zeros(K, dtype=jnp.int32).at[0].set(mstate0)
+        fr_cnt = jnp.int32(1)
+        bk_base = jnp.zeros(B, dtype=jnp.int32)
+        bk_win = jnp.zeros((B, W), dtype=bool)
+        bk_info = jnp.zeros((B, ic_pad), dtype=bool)
+        bk_mst = jnp.zeros(B, dtype=jnp.int32)
+        bk_cnt = jnp.int32(0)
+        table = jnp.zeros((H, 4), dtype=jnp.uint32)
+        flags = jnp.zeros(3, dtype=bool)  # found, overflow, exhausted
+        stats = jnp.zeros(3, dtype=jnp.int32)  # explored, rounds, max_base
+        return (fr_base, fr_win, fr_info, fr_mst, fr_cnt,
+                bk_base, bk_win, bk_info, bk_mst, bk_cnt,
+                table, flags, stats)
+
+    def round_body(consts, carry):
+        (inv, ret, opc, suf, iinv, iopc, T, n_ok, n_info, max_cfg) = consts
+        (fr_base, fr_win, fr_info, fr_mst, fr_cnt,
+         bk_base, bk_win, bk_info, bk_mst, bk_cnt,
+         table, flags, stats) = carry
+
+        alive = jnp.arange(K, dtype=jnp.int32) < fr_cnt
+
+        # --- candidate discovery -------------------------------------
+        pos = fr_base[:, None] + jnp.arange(W, dtype=jnp.int32)   # (K, W)
+        posc = jnp.minimum(pos, n_pad - 1)
+        retw = ret[posc]                                          # (K, W)
+        retw = jnp.where(fr_win | (pos >= n_ok), INF, retw)
+        minret = jnp.min(retw, axis=1)
+        tail = suf[jnp.minimum(fr_base + W, n_pad)]
+        minret = jnp.minimum(minret, tail)                        # (K,)
+
+        invw = inv[posc]
+        cand_ok = (~fr_win) & (pos < n_ok) & (invw < minret[:, None]) \
+            & alive[:, None]
+        opw = opc[posc]
+        nst_ok = T[fr_mst[:, None], opw]                          # (K, W)
+        legal_ok = cand_ok & (nst_ok >= 0)
+
+        iidx = jnp.arange(ic_pad, dtype=jnp.int32)
+        cand_info = (~fr_info) & (iidx[None, :] < n_info) \
+            & (iinv[None, :] < minret[:, None]) & alive[:, None]
+        nst_info = T[fr_mst[:, None], iopc[None, :]]              # (K, Ic)
+        legal_info = cand_info & (nst_info >= 0)
+
+        # --- successor construction ----------------------------------
+        # ok successors: set window bit k, then renormalize (advance base
+        # past the linearized prefix and shift the window down).
+        eye_w = jnp.eye(W, dtype=bool)
+        win2 = fr_win[:, None, :] | eye_w[None]                  # (K, W, W)
+        ext = jnp.concatenate(
+            [win2, jnp.zeros((K, W, 1), dtype=bool)],
+            axis=-1).astype(jnp.int8)
+        t = jnp.argmin(ext, axis=-1).astype(jnp.int32)           # (K, W)
+        gidx = t[:, :, None] + jnp.arange(W, dtype=jnp.int32)    # (K, W, W)
+        shifted = jnp.take_along_axis(
+            jnp.concatenate([win2, jnp.zeros((K, W, W), dtype=bool)],
+                            axis=-1),
+            jnp.minimum(gidx, 2 * W - 1), axis=-1)               # (K, W, W)
+        base_ok = fr_base[:, None] + t                           # (K, W)
+        info_ok = jnp.broadcast_to(fr_info[:, None, :], (K, W, ic_pad))
+
+        # info successors: set info bit m; window/base unchanged.
+        eye_i = jnp.eye(ic_pad, dtype=bool)
+        info2 = fr_info[:, None, :] | eye_i[None]                # (K, Ic, Ic)
+        win_i = jnp.broadcast_to(fr_win[:, None, :], (K, ic_pad, W))
+        base_i = jnp.broadcast_to(fr_base[:, None], (K, ic_pad))
+
+        base_s = jnp.concatenate(
+            [base_ok.reshape(-1), base_i.reshape(-1)])           # (R,)
+        win_s = jnp.concatenate(
+            [shifted.reshape(-1, W), win_i.reshape(-1, W)])      # (R, W)
+        info_s = jnp.concatenate(
+            [info_ok.reshape(-1, ic_pad), info2.reshape(-1, ic_pad)])
+        mst_s = jnp.concatenate(
+            [nst_ok.reshape(-1), nst_info.reshape(-1)])
+        legal = jnp.concatenate(
+            [legal_ok.reshape(-1), legal_info.reshape(-1)])      # (R,)
+        R = legal.shape[0]
+
+        success = legal & (base_s >= n_ok)
+        found = jnp.any(success)
+        explore = legal & ~success
+
+        # --- hash + sort-unique --------------------------------------
+        winp = _pack_bits(win_s)                                 # (R, Wl)
+        infop = _pack_bits(info_s)                               # (R, Il)
+        words = ([base_s.astype(jnp.uint32)]
+                 + [winp[:, i] for i in range(Wl)]
+                 + [infop[:, i] for i in range(Il)]
+                 + [mst_s.astype(jnp.uint32)])
+        s0 = _fnv(words, 0x811C9DC5) | jnp.uint32(1)
+        s1 = _fnv(words, 0x01000193)
+        s2 = _fnv(words, 0xDEADBEEF)
+        big = jnp.uint32(0xFFFFFFFF)
+        s0 = jnp.where(explore, s0, big)
+        s1 = jnp.where(explore, s1, big)
+        s2 = jnp.where(explore, s2, big)
+        rid = jnp.arange(R, dtype=jnp.int32)
+        s0s, s1s, s2s, perm = lax.sort((s0, s1, s2, rid), num_keys=3)
+        ex_s = explore[perm]
+        samep = (s0s == jnp.roll(s0s, 1)) & (s1s == jnp.roll(s1s, 1)) \
+            & (s2s == jnp.roll(s2s, 1))
+        samep = samep.at[0].set(False)
+        uniq = ex_s & ~samep
+
+        # --- memo-table probe (double hashing) -----------------------
+        # NB: racing inserts can interleave words of two signatures into
+        # one slot; the chimera matches nobody w.h.p. and only wastes the
+        # slot (losers keep probing), so soundness is preserved.
+        mysig = jnp.stack([s0s, s1s, s2s], axis=1)               # (R, 3)
+        myrow = jnp.arange(R, dtype=jnp.uint32)
+        step = (s1s | jnp.uint32(1))
+
+        def probe(r, st):
+            table, pending, seen = st
+            ru = lax.convert_element_type(r, jnp.uint32)
+            idx = ((s0s + ru * step) & jnp.uint32(H - 1)).astype(jnp.int32)
+            slot = table[idx]                                    # (R, 4)
+            occupied = slot[:, 0] != 0
+            equal = occupied & jnp.all(slot[:, :3] == mysig, axis=1)
+            seen = seen | (pending & equal)
+            claim = pending & ~occupied
+            widx = jnp.where(claim, idx, H)
+            upd = jnp.concatenate([mysig, myrow[:, None]], axis=1)
+            table = table.at[widx].set(upd, mode="drop")
+            slot2 = table[idx]
+            won = claim & jnp.all(slot2[:, :3] == mysig, axis=1) \
+                & (slot2[:, 3] == myrow)
+            pending = pending & ~equal & ~won
+            return table, pending, seen
+
+        table, pending, seen = lax.fori_loop(
+            0, probes, probe, (table, uniq, jnp.zeros(R, dtype=bool)))
+        # rows still pending after all probes: table too full to insert —
+        # treat as unseen (sound; may re-explore later).
+        new = uniq & ~seen
+
+        # --- compact survivors into frontier + backlog ---------------
+        posn = jnp.cumsum(new.astype(jnp.int32)) - 1             # (R,)
+        total = jnp.sum(new.astype(jnp.int32))
+        base_g = base_s[perm]
+        mst_g = mst_s[perm]
+        win_g = win_s[perm]
+        info_g = info_s[perm]
+
+        to_front = new & (posn < K)
+        fidx = jnp.where(to_front, posn, K)
+        nfr_base = jnp.zeros(K, dtype=jnp.int32).at[fidx].set(
+            base_g, mode="drop")
+        nfr_mst = jnp.zeros(K, dtype=jnp.int32).at[fidx].set(
+            mst_g, mode="drop")
+        nfr_win = jnp.zeros((K, W), dtype=bool).at[fidx].set(
+            win_g, mode="drop")
+        nfr_info = jnp.zeros((K, ic_pad), dtype=bool).at[fidx].set(
+            info_g, mode="drop")
+        nfr_cnt = jnp.minimum(total, K)
+
+        spill = new & (posn >= K)
+        sidx = jnp.where(spill, bk_cnt + posn - K, B)
+        overflow = jnp.any(spill & (sidx >= B))
+        sidx = jnp.minimum(sidx, B)
+        bk_base = bk_base.at[sidx].set(base_g, mode="drop")
+        bk_mst = bk_mst.at[sidx].set(mst_g, mode="drop")
+        bk_win = bk_win.at[sidx].set(win_g, mode="drop")
+        bk_info = bk_info.at[sidx].set(info_g, mode="drop")
+        nbk_cnt = jnp.minimum(bk_cnt + jnp.maximum(total - K, 0), B)
+
+        # refill frontier from backlog top if there is room
+        room = K - nfr_cnt
+        take = jnp.minimum(room, nbk_cnt)
+        kidx = jnp.arange(K, dtype=jnp.int32)
+        taking = kidx < take
+        src = jnp.where(taking, jnp.maximum(nbk_cnt - 1 - kidx, 0), 0)
+        dst = jnp.where(taking, nfr_cnt + kidx, K)
+        nfr_base = nfr_base.at[dst].set(bk_base[src], mode="drop")
+        nfr_mst = nfr_mst.at[dst].set(bk_mst[src], mode="drop")
+        nfr_win = nfr_win.at[dst].set(bk_win[src], mode="drop")
+        nfr_info = nfr_info.at[dst].set(bk_info[src], mode="drop")
+        nfr_cnt = nfr_cnt + take
+        nbk_cnt = nbk_cnt - take
+
+        nflags = jnp.stack([flags[0] | found,
+                            flags[1] | overflow,
+                            nfr_cnt == 0])
+        nstats = jnp.stack([
+            stats[0] + fr_cnt,
+            stats[1] + 1,
+            jnp.maximum(stats[2], jnp.max(jnp.where(legal, base_s, 0)))])
+        return (nfr_base, nfr_win, nfr_info, nfr_mst, nfr_cnt,
+                bk_base, bk_win, bk_info, bk_mst, nbk_cnt,
+                table, nflags, nstats)
+
+    def chunk_fn(consts, carry):
+        max_cfg = consts[-1]
+
+        def cond(c):
+            flags, stats = c[11], c[12]
+            return (~flags[0]) & (c[4] > 0) \
+                & (stats[1] < chunk) & (stats[0] < max_cfg)
+
+        def body(c):
+            return round_body(consts, c)
+
+        # reset the per-chunk round counter
+        stats = carry[12]
+        carry = carry[:12] + (stats.at[1].set(0),)
+        return lax.while_loop(cond, body, carry)
+
+    chunk_jit = jax.jit(chunk_fn, donate_argnums=(1,))
+    return init_fn, chunk_jit
+
+
+# ---------------------------------------------------------------------------
+# Host driver
+# ---------------------------------------------------------------------------
+
+def _pick_capacities(W: int, ic_pad: int, n: int):
+    """Frontier capacity K and memo-table size H scaled to the problem.
+    The (K, W, 2W) successor intermediate is the memory driver."""
+    budget = 32 * 1024 * 1024  # bool elements
+    K = max(256, min(4096, budget // max(1, 2 * W * W)))
+    K = 1 << (K.bit_length() - 1)
+    H = 1 << 21 if n > 2000 else 1 << 18
+    B = 1 << 16
+    return K, H, B
+
+
+def check(model: Model, history: History, time_limit: Optional[float] = None,
+          max_configs: int = 200_000_000, frontier: Optional[int] = None,
+          ) -> dict:
+    """Decide linearizability on the accelerator.
+
+    Returns {"valid?": True/False/"unknown", ...}. "unknown" (deadline,
+    config budget, capacity overflow, or unsupported encoding) signals the
+    caller to fall back to the host oracle.
+    """
+    import jax.numpy as jnp
+
+    try:
+        enc = encode(model, history)
+    except EncodingUnsupported as e:
+        return {"valid?": "unknown", "cause": f"encoding: {e}",
+                "op_count": len(history)}
+    n = enc.n_ok
+    if n == 0:
+        # with no must-linearize ops, skipping every crashed op is a
+        # valid linearization
+        return {"valid?": True, "op_count": enc.n_info}
+
+    W = enc.window
+    ic_pad = len(enc.inv_info)
+    if frontier:
+        K, H, B = frontier, 1 << 18, 1 << 14
+    else:
+        K, H, B = _pick_capacities(W, ic_pad, n)
+    chunk = 2048
+    init_fn, chunk_jit = _compiled_search(
+        n_pad=len(enc.inv), ic_pad=ic_pad, W=W,
+        S=enc.table.shape[0], O=enc.table.shape[1],
+        K=K, H=H, B=B, chunk=chunk, probes=16)
+
+    consts = (jnp.asarray(enc.inv), jnp.asarray(enc.ret),
+              jnp.asarray(enc.opcode), jnp.asarray(enc.sufminret),
+              jnp.asarray(enc.inv_info), jnp.asarray(enc.opcode_info),
+              jnp.asarray(enc.table), jnp.int32(n), jnp.int32(enc.n_info),
+              jnp.int32(min(max_configs, 2**31 - 1)))
+    carry = init_fn(0)
+    deadline = _time.monotonic() + time_limit if time_limit else None
+    t0 = _time.monotonic()
+    while True:
+        carry = chunk_jit(consts, carry)
+        flags = np.asarray(carry[11])
+        stats = np.asarray(carry[12])
+        found, overflow = bool(flags[0]), bool(flags[1])
+        fr_cnt = int(carry[4])
+        total_explored = int(stats[0])
+        detail = {"W": W, "K": K, "configs_explored": total_explored,
+                  "wall_s": round(_time.monotonic() - t0, 4)}
+        if found:
+            return {"valid?": True, "op_count": n + enc.n_info, **detail}
+        if fr_cnt == 0:
+            if overflow:
+                return {"valid?": "unknown", "cause": "backlog-overflow",
+                        "op_count": n + enc.n_info, **detail}
+            return {"valid?": False, "op_count": n + enc.n_info,
+                    "max_linearized": int(stats[2]), **detail}
+        if total_explored >= max_configs:
+            return {"valid?": "unknown", "cause": "config-limit",
+                    "op_count": n + enc.n_info, **detail}
+        if deadline is not None and _time.monotonic() > deadline:
+            return {"valid?": "unknown", "cause": "timeout",
+                    "op_count": n + enc.n_info, **detail}
+
+
+def check_with_diagnostics(model: Model, history: History,
+                           time_limit: Optional[float] = None) -> dict:
+    """TPU verdict; on False, re-run the host oracle briefly to extract
+    counterexample diagnostics (final_paths / configs), matching the
+    reference's expectation that invalid results explain themselves
+    (checker.clj:205-212 renders linear.svg from them)."""
+    res = check(model, history, time_limit=time_limit)
+    if res.get("valid?") is False:
+        ref = wgl_ref.check(model, history, time_limit=30.0)
+        if ref.get("valid?") is False:
+            for k in ("final_paths", "configs", "max_linearized"):
+                if k in ref:
+                    res[k] = ref[k]
+    return res

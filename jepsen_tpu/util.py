@@ -32,8 +32,12 @@ def integer_interval_set_str(xs: Iterable) -> str:
     """Render a set of integers as compact interval notation, e.g.
     #{1-3 5 7-9} (jepsen.util/integer-interval-set-str parity). Non-integer
     elements fall back to plain rendering."""
-    xs = sorted(xs, key=lambda x: (not isinstance(x, int), x)
-                if not isinstance(x, bool) else (True, x))
+    def key(x):
+        if isinstance(x, int) and not isinstance(x, bool):
+            return (0, x, "")
+        return (1, 0, str(x))
+
+    xs = sorted(xs, key=key)
     parts = []
     i = 0
     while i < len(xs):
@@ -186,21 +190,31 @@ def with_retry(f: Callable, retries: int = 5, backoff: float = 0.1):
 
 
 def nemesis_intervals(history, fs_start=("start",), fs_stop=("stop",)):
-    """Pair up nemesis start/stop ops into [start-op stop-op] intervals
-    (jepsen.util/nemesis-intervals parity, util.clj:736): every start still
-    open when a stop arrives is paired with that stop. Returns a list of
-    (start_op, stop_op_or_None)."""
+    """Pair up nemesis start/stop events into [start-op stop-op] intervals
+    (jepsen.util/nemesis-intervals parity, util.clj:736). The reference
+    works over invoke/complete PAIRS: a start's invocation and completion
+    are zipped against the closing stop's invocation and completion, so
+    both [start-invoke stop-invoke] and [start-complete stop-complete]
+    windows are produced — the fault may land anywhere between the start's
+    invocation and completion, so the invocation-side window matters.
+    Every start still open when a stop arrives is closed by that stop.
+    Returns a list of (start_op, stop_op_or_None) over both event kinds."""
     intervals = []
-    open_starts: list = []
+    open_invokes: list = []
+    open_completes: list = []
     for op in history:
         if op.process != "nemesis":
             continue
-        if op.f in fs_start and not op.is_invoke:
-            open_starts.append(op)
-        elif op.f in fs_stop and not op.is_invoke and open_starts:
-            intervals.extend((s, op) for s in open_starts)
-            open_starts = []
-    intervals.extend((s, None) for s in open_starts)
+        if op.f in fs_start:
+            (open_invokes if op.is_invoke else open_completes).append(op)
+        elif op.f in fs_stop:
+            if op.is_invoke:
+                intervals.extend((s, op) for s in open_invokes)
+                open_invokes = []
+            else:
+                intervals.extend((s, op) for s in open_completes)
+                open_completes = []
+    intervals.extend((s, None) for s in open_invokes + open_completes)
     return intervals
 
 

@@ -1,0 +1,216 @@
+"""Differential tests: the JAX WGL kernel must agree with the Python
+oracle on every history (the TPU-vs-CPU differential strategy called for
+by SURVEY.md §4's implication note)."""
+
+import random
+
+import pytest
+
+from jepsen_tpu import history as h
+from jepsen_tpu.models import core as models
+from jepsen_tpu.ops import wgl as wgl_tpu
+from jepsen_tpu.ops import wgl_ref
+
+FRONTIER = 256  # keep device buffers small for CPU-backed CI
+
+
+def run_both(model, hist):
+    ref = wgl_ref.check(model, hist)
+    tpu = wgl_tpu.check(model, hist, frontier=FRONTIER)
+    assert tpu["valid?"] == ref["valid?"], (
+        f"kernel={tpu!r}\noracle={ref!r}\n"
+        f"history={[o.to_dict() for o in hist]}")
+    return tpu
+
+
+# --- deterministic cases -------------------------------------------------
+
+def test_trivial_valid():
+    hist = h.History([
+        h.invoke(0, "write", 1), h.ok(0, "write", 1),
+        h.invoke(0, "read", None), h.ok(0, "read", 1),
+    ])
+    r = run_both(models.register(), hist)
+    assert r["valid?"] is True
+
+
+def test_trivial_invalid():
+    hist = h.History([
+        h.invoke(0, "write", 1), h.ok(0, "write", 1),
+        h.invoke(0, "read", None), h.ok(0, "read", 2),
+    ])
+    r = run_both(models.register(), hist)
+    assert r["valid?"] is False
+
+
+def test_concurrent_reorder_valid():
+    # w1 and w2 overlap; read 1 after both requires w2 before w1
+    hist = h.History([
+        h.invoke(0, "write", 1),
+        h.invoke(1, "write", 2),
+        h.ok(1, "write", 2),
+        h.ok(0, "write", 1),
+        h.invoke(0, "read", None), h.ok(0, "read", 1),
+    ])
+    assert run_both(models.register(), hist)["valid?"] is True
+
+
+def test_realtime_order_enforced():
+    # w1 completes before w2 starts; read 1 at the end is invalid
+    hist = h.History([
+        h.invoke(0, "write", 1), h.ok(0, "write", 1),
+        h.invoke(0, "write", 2), h.ok(0, "write", 2),
+        h.invoke(0, "read", None), h.ok(0, "read", 1),
+    ])
+    assert run_both(models.register(), hist)["valid?"] is False
+
+
+def test_crashed_write_may_take_effect():
+    hist = h.History([
+        h.invoke(0, "write", 1), h.info(0, "write", 1),
+        h.invoke(1, "read", None), h.ok(1, "read", 1),
+    ])
+    assert run_both(models.register(), hist)["valid?"] is True
+
+
+def test_crashed_write_may_not_take_effect():
+    hist = h.History([
+        h.invoke(0, "write", 9), h.info(0, "write", 9),
+        h.invoke(1, "write", 1), h.ok(1, "write", 1),
+        h.invoke(1, "read", None), h.ok(1, "read", 1),
+    ])
+    assert run_both(models.register(), hist)["valid?"] is True
+
+
+def test_cas_basic():
+    hist = h.History([
+        h.invoke(0, "write", 0), h.ok(0, "write", 0),
+        h.invoke(1, "cas", [0, 3]), h.ok(1, "cas", [0, 3]),
+        h.invoke(0, "read", None), h.ok(0, "read", 3),
+    ])
+    assert run_both(models.cas_register(), hist)["valid?"] is True
+
+
+def test_cas_invalid():
+    hist = h.History([
+        h.invoke(0, "write", 0), h.ok(0, "write", 0),
+        h.invoke(1, "cas", [1, 3]), h.ok(1, "cas", [1, 3]),
+    ])
+    assert run_both(models.cas_register(), hist)["valid?"] is False
+
+
+def test_mutex():
+    hist = h.History([
+        h.invoke(0, "acquire", None), h.ok(0, "acquire", None),
+        h.invoke(1, "acquire", None),
+        h.invoke(0, "release", None), h.ok(0, "release", None),
+        h.ok(1, "acquire", None),
+        h.invoke(1, "release", None), h.ok(1, "release", None),
+    ])
+    assert run_both(models.mutex(), hist)["valid?"] is True
+
+
+def test_mutex_double_acquire_invalid():
+    hist = h.History([
+        h.invoke(0, "acquire", None), h.ok(0, "acquire", None),
+        h.invoke(1, "acquire", None), h.ok(1, "acquire", None),
+    ])
+    assert run_both(models.mutex(), hist)["valid?"] is False
+
+
+def test_fifo_queue():
+    hist = h.History([
+        h.invoke(0, "enqueue", 1), h.ok(0, "enqueue", 1),
+        h.invoke(0, "enqueue", 2), h.ok(0, "enqueue", 2),
+        h.invoke(1, "dequeue", None), h.ok(1, "dequeue", 1),
+        h.invoke(1, "dequeue", None), h.ok(1, "dequeue", 2),
+    ])
+    assert run_both(models.fifo_queue(), hist)["valid?"] is True
+
+
+def test_fifo_queue_out_of_order_invalid():
+    hist = h.History([
+        h.invoke(0, "enqueue", 1), h.ok(0, "enqueue", 1),
+        h.invoke(0, "enqueue", 2), h.ok(0, "enqueue", 2),
+        h.invoke(1, "dequeue", None), h.ok(1, "dequeue", 2),
+    ])
+    assert run_both(models.fifo_queue(), hist)["valid?"] is False
+
+
+def test_empty_history():
+    assert wgl_tpu.check(models.register(), h.History())["valid?"] is True
+
+
+# --- randomized differential sweep ---------------------------------------
+
+def gen_register_history(rng, n_procs, n_ops, values=3, crash_p=0.05):
+    """Simulated concurrent run against a *real* register, with occasional
+    lies (to produce invalid histories) and crashes."""
+    hist = h.History()
+    reg = rng.randrange(values)
+    hist.append(h.invoke(99, "write", reg))
+    hist.append(h.ok(99, "write", reg))
+    pending = {}
+    free = list(range(n_procs))
+    issued = 0
+    while issued < n_ops or pending:
+        can_invoke = free and issued < n_ops
+        if not can_invoke and not pending:
+            break  # every process crashed
+        if can_invoke and (not pending or rng.random() < 0.6):
+            p = free.pop(rng.randrange(len(free)))
+            f = rng.choice(["read", "write", "cas"])
+            if f == "read":
+                v = None
+            elif f == "write":
+                v = rng.randrange(values)
+            else:
+                v = [rng.randrange(values), rng.randrange(values)]
+            hist.append(h.invoke(p, f, v))
+            pending[p] = (f, v)
+            issued += 1
+        else:
+            p = rng.choice(list(pending))
+            f, v = pending.pop(p)
+            r = rng.random()
+            if r < crash_p:
+                hist.append(h.info(p, f, v))
+                # crashed op may or may not apply
+                if rng.random() < 0.5 and f != "read":
+                    reg = v if f == "write" else (
+                        v[1] if v[0] == reg else reg)
+            elif r < crash_p + 0.08 and f == "cas":
+                hist.append(h.fail(p, f, v))
+                free.append(p)
+            else:
+                if f == "read":
+                    # small chance of lying -> invalid history
+                    val = reg if rng.random() > 0.06 else (reg + 1) % values
+                    hist.append(h.ok(p, f, val))
+                elif f == "write":
+                    reg = v
+                    hist.append(h.ok(p, f, v))
+                else:
+                    if v[0] == reg:
+                        reg = v[1]
+                        hist.append(h.ok(p, f, v))
+                    else:
+                        hist.append(h.fail(p, f, v))
+                free.append(p)
+            if r < crash_p:
+                pass  # crashed process never returns
+    return hist
+
+
+@pytest.mark.parametrize("seed", range(12))
+def test_random_cas_register_differential(seed):
+    rng = random.Random(1000 + seed)
+    hist = gen_register_history(rng, n_procs=4, n_ops=30)
+    run_both(models.cas_register(), hist)
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_random_larger_differential(seed):
+    rng = random.Random(7000 + seed)
+    hist = gen_register_history(rng, n_procs=5, n_ops=60, crash_p=0.03)
+    run_both(models.cas_register(), hist)
