@@ -1,0 +1,163 @@
+"""Lift single-key tests to maps of keys (jepsen.independent parity).
+
+Expensive checks (linearizability above all) only tolerate short
+histories, so the reference splits a test into independent keys: values
+become `[k v]` tuples, generators are lifted to emit them, and the checker
+partitions the history into per-key subhistories
+(`jepsen/src/jepsen/independent.clj:2-7,21-24,240-317`).
+
+Two checker paths here:
+
+  * `checker(c)` — capability parity: bounded-pmap the wrapped checker
+    over per-key subhistories on host threads (independent.clj:266-317).
+  * `tpu_checker(model)` — the TPU-native path (SURVEY.md P2): all per-key
+    subhistories are batch-encoded and searched in one mesh-sharded WGL
+    call (`jepsen_tpu.parallel.batched`), each device checking its own
+    keys in lockstep.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, Iterable, Optional, Sequence
+
+from .checker import Checker, check_safe, merge_valid
+from .history import History, Op, strip_nemesis
+from .models.core import Model
+from .util import bounded_pmap
+
+DIR = "independent"
+
+
+@dataclass(frozen=True)
+class KV:
+    """A [k v] tuple value (independent.clj:21-29 uses MapEntry)."""
+
+    k: Any
+    v: Any
+
+    def __iter__(self):
+        return iter((self.k, self.v))
+
+    def __repr__(self):
+        return f"[{self.k!r} {self.v!r}]"
+
+
+def tuple_(k, v) -> KV:
+    return KV(k, v)
+
+
+def is_tuple(value) -> bool:
+    return isinstance(value, KV)
+
+
+def history_keys(history: History) -> list:
+    """The set of keys present in a history's tuple values
+    (independent.clj:240-250). Returned as a list in first-seen order so
+    results are deterministic."""
+    seen: dict = {}
+    for op in history:
+        v = op.value
+        if is_tuple(v) and v.k not in seen:
+            seen[v.k] = True
+    return list(seen)
+
+
+def subhistory(k, history: History) -> History:
+    """All ops that do not carry a *different* key, with tuple values
+    unwrapped (independent.clj:252-264) — nemesis/info ops without tuple
+    values are retained in every subhistory."""
+    out = History()
+    for op in history:
+        v = op.value
+        if not is_tuple(v):
+            out.append(op)
+        elif v.k == k:
+            out.append(op.with_(value=v.v))
+    return out
+
+
+class IndependentChecker(Checker):
+    """Host-parallel per-key checking (independent.clj:266-317)."""
+
+    def __init__(self, checker: Checker):
+        self.checker = checker
+
+    def check(self, test, history, opts=None):
+        opts = opts or {}
+        ks = history_keys(history)
+
+        def check_key(k):
+            h = subhistory(k, history)
+            subdir = list(opts.get("subdirectory", [])) + [DIR, str(k)]
+            res = check_safe(self.checker, test, h,
+                             {**opts, "subdirectory": subdir,
+                              "history_key": k})
+            _write_key_artifacts(test, subdir, h, res)
+            return k, res
+
+        results = dict(bounded_pmap(check_key, ks))
+        failures = [k for k in ks if not results[k].get("valid?")]
+        return {"valid?": merge_valid(r.get("valid?")
+                                      for r in results.values()),
+                "results": results,
+                "failures": failures}
+
+
+def checker(c: Checker) -> Checker:
+    return IndependentChecker(c)
+
+
+def _write_key_artifacts(test, subdir, h, res):
+    """Persist per-key results/history under the test's store dir, when
+    the test has one (independent.clj:295-303)."""
+    d = (test or {}).get("store_dir")
+    if not d:
+        return
+    import json
+    import os
+    path = os.path.join(d, *subdir)
+    os.makedirs(path, exist_ok=True)
+    with open(os.path.join(path, "results.json"), "w") as fh:
+        json.dump(res, fh, indent=2, default=str)
+    h.to_jsonl(os.path.join(path, "history.jsonl"))
+
+
+class TPULinearizableIndependent(Checker):
+    """Per-key linearizability in one mesh-sharded device search.
+
+    The history is split into per-key subhistories exactly as
+    `IndependentChecker` does, but instead of a host thread per key, the
+    whole key set is checked by `parallel.check_batched` — the batch axis
+    is laid out over the device mesh, so a v5e-8 checks 8 keys' frontiers
+    at every step.
+    """
+
+    def __init__(self, model: Model, time_limit: Optional[float] = None,
+                 mesh=None):
+        self.model = model
+        self.time_limit = time_limit
+        self.mesh = mesh
+
+    def check(self, test, history, opts=None):
+        from .parallel import check_batched
+        opts = opts or {}
+        ks = history_keys(history)
+        subs = [subhistory(k, history) for k in ks]
+        res_list = check_batched(self.model,
+                                 [strip_nemesis(s) for s in subs],
+                                 time_limit=self.time_limit, mesh=self.mesh)
+        results = dict(zip(ks, res_list))
+        for k, h, res in zip(ks, subs, res_list):
+            subdir = list(opts.get("subdirectory", [])) + [DIR, str(k)]
+            _write_key_artifacts(test, subdir, h, res)
+        failures = [k for k in ks if not results[k].get("valid?")]
+        return {"valid?": merge_valid(r.get("valid?")
+                                      for r in results.values()),
+                "results": results,
+                "failures": failures}
+
+
+def tpu_checker(model: Model, time_limit: Optional[float] = None,
+                mesh=None) -> Checker:
+    return TPULinearizableIndependent(model, time_limit, mesh)

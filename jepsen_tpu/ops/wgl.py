@@ -75,13 +75,15 @@ def _fnv(words, seed):
     return h
 
 
-@functools.lru_cache(maxsize=32)
-def _compiled_search(n_pad: int, ic_pad: int, W: int, S: int, O: int,
-                     K: int, H: int, B: int, chunk: int, probes: int):
-    """Build + jit the chunked search for one shape bucket.
+def _build_search(n_pad: int, ic_pad: int, W: int, S: int, O: int,
+                  K: int, H: int, B: int, chunk: int, probes: int):
+    """Build the chunked search for one shape bucket.
 
-    Returns (init_fn, chunk_fn). All capacities are static; the actual op
-    count / info count / table contents are runtime args.
+    Returns (init_fn, chunk_fn), both unjitted — `_compiled_search` jits
+    chunk_fn for the single-history path, and `jepsen_tpu.parallel.batched`
+    vmaps it over a leading key axis for the per-key sharded path. All
+    capacities are static; the actual op count / info count / table
+    contents are runtime args.
     """
     import jax
     import jax.numpy as jnp
@@ -301,6 +303,17 @@ def _compiled_search(n_pad: int, ic_pad: int, W: int, S: int, O: int,
         carry = carry[:12] + (stats.at[1].set(0),)
         return lax.while_loop(cond, body, carry)
 
+    return init_fn, chunk_fn
+
+
+@functools.lru_cache(maxsize=32)
+def _compiled_search(n_pad: int, ic_pad: int, W: int, S: int, O: int,
+                     K: int, H: int, B: int, chunk: int, probes: int):
+    """Jitted single-history search for one shape bucket."""
+    import jax
+
+    init_fn, chunk_fn = _build_search(n_pad, ic_pad, W, S, O,
+                                      K, H, B, chunk, probes)
     chunk_jit = jax.jit(chunk_fn, donate_argnums=(1,))
     return init_fn, chunk_jit
 
@@ -331,6 +344,9 @@ def check(model: Model, history: History, time_limit: Optional[float] = None,
     """
     import jax.numpy as jnp
 
+    # Device stats are int32; cap the budget so the explored counter can
+    # reach it without wrapping (it grows by at most K per round).
+    max_configs = min(max_configs, 2**30)
     try:
         enc = encode(model, history)
     except EncodingUnsupported as e:

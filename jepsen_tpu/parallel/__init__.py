@@ -1,0 +1,14 @@
+"""TPU data parallelism: per-key sub-histories sharded across devices.
+
+The reference copes with expensive checks by splitting a test into
+independent keys and checking each key's subhistory on a CPU thread pool
+(`jepsen/src/jepsen/independent.clj:266-317`, bounded-pmap). Here the same
+split becomes accelerator data parallelism: per-key histories are encoded
+into a shared shape bucket, the WGL search kernel is vmapped over the key
+axis, and the batch is laid out over a `jax.sharding.Mesh` so each device
+searches its own keys with zero cross-device communication.
+"""
+
+from .batched import BatchEncoded, check_batched, default_mesh, encode_batch
+
+__all__ = ["BatchEncoded", "check_batched", "default_mesh", "encode_batch"]

@@ -1,0 +1,239 @@
+"""Batched, mesh-sharded WGL search over many independent histories.
+
+This is the TPU-native replacement for the reference's per-key CPU fan-out
+(`jepsen.independent/checker` bounded-pmaps subhistory checks,
+`jepsen/src/jepsen/independent.clj:266-317`): every key's history is
+encoded into one shared shape bucket, the lockstep-frontier kernel from
+`jepsen_tpu.ops.wgl` is vmapped over the leading key axis, and all arrays
+are placed with a `NamedSharding` over a 1-D device mesh ("keys"), so XLA
+partitions the search across devices with no collectives — per-key checks
+are embarrassingly parallel, and ICI stays idle by design.
+
+Keys whose history can't be encoded (or that resolve trivially) are
+handled on the host; keys the device search leaves "unknown" fall back to
+the Python oracle, mirroring `knossos.competition/analysis` racing engines.
+"""
+
+from __future__ import annotations
+
+import functools
+import time as _time
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ..history import History
+from ..models.core import Model
+from ..ops import wgl_ref
+from ..ops.encode import INF, Encoded, EncodingUnsupported, _pad_to, encode
+from ..ops.wgl import _build_search
+
+
+def default_mesh(axis: str = "keys"):
+    """A 1-D mesh over every visible device."""
+    import jax
+    from jax.sharding import Mesh
+
+    devs = np.asarray(jax.devices())
+    return Mesh(devs, (axis,))
+
+
+@dataclass
+class BatchEncoded:
+    """A batch of per-key encodings padded into one shape bucket."""
+
+    n_keys: int            # real keys (batch may be padded beyond this)
+    n_pad: int
+    ic_pad: int
+    window: int
+    table_s: int
+    table_o: int
+    inv: np.ndarray        # (Bk, n_pad) i32
+    ret: np.ndarray        # (Bk, n_pad) i32
+    opcode: np.ndarray     # (Bk, n_pad) i32
+    sufminret: np.ndarray  # (Bk, n_pad+1) i32
+    inv_info: np.ndarray   # (Bk, ic_pad) i32
+    opcode_info: np.ndarray  # (Bk, ic_pad) i32
+    table: np.ndarray      # (Bk, S, O) i32
+    n_ok: np.ndarray       # (Bk,) i32
+    n_info: np.ndarray     # (Bk,) i32
+
+
+def encode_batch(encs: Sequence[Encoded], batch_pad: int = 1) -> BatchEncoded:
+    """Pad per-key encodings into a common bucket and stack them.
+
+    `batch_pad`: round the key axis up to a multiple (usually the mesh
+    size) with dummy keys; dummy lanes have n_ok = 0 and an empty frontier
+    after round one, so they cost nothing and their verdicts are ignored.
+    """
+    nk = len(encs)
+    bk = _pad_to(nk, batch_pad)
+    n_pad = max(len(e.inv) for e in encs)
+    ic_pad = max(len(e.inv_info) for e in encs)
+    W = max(e.window for e in encs)
+    S = max(e.table.shape[0] for e in encs)
+    O = max(e.table.shape[1] for e in encs)
+
+    inv = np.full((bk, n_pad), INF, dtype=np.int32)
+    ret = np.full((bk, n_pad), INF, dtype=np.int32)
+    opc = np.zeros((bk, n_pad), dtype=np.int32)
+    suf = np.full((bk, n_pad + 1), INF, dtype=np.int32)
+    iinv = np.full((bk, ic_pad), INF, dtype=np.int32)
+    iopc = np.zeros((bk, ic_pad), dtype=np.int32)
+    table = np.full((bk, S, O), -1, dtype=np.int32)
+    n_ok = np.zeros(bk, dtype=np.int32)
+    n_info = np.zeros(bk, dtype=np.int32)
+    for i, e in enumerate(encs):
+        inv[i, :len(e.inv)] = e.inv
+        ret[i, :len(e.ret)] = e.ret
+        opc[i, :len(e.opcode)] = e.opcode
+        suf[i, :len(e.sufminret)] = e.sufminret
+        iinv[i, :len(e.inv_info)] = e.inv_info
+        iopc[i, :len(e.opcode_info)] = e.opcode_info
+        s, o = e.table.shape
+        table[i, :s, :o] = e.table
+        n_ok[i] = e.n_ok
+        n_info[i] = e.n_info
+    return BatchEncoded(n_keys=nk, n_pad=n_pad, ic_pad=ic_pad, window=W,
+                        table_s=S, table_o=O, inv=inv, ret=ret, opcode=opc,
+                        sufminret=suf, inv_info=iinv, opcode_info=iopc,
+                        table=table, n_ok=n_ok, n_info=n_info)
+
+
+def _batch_capacities(bk: int, W: int, n_pad: int):
+    """Frontier K / memo H / backlog B per key, sized so the whole batch's
+    (Bk, K, W, 2W) successor intermediate stays within budget."""
+    budget = 128 * 1024 * 1024  # bool elements across the batch
+    K = max(128, min(2048, budget // max(1, bk * 2 * W * W)))
+    K = 1 << (K.bit_length() - 1)
+    H = 1 << 18 if n_pad > 2048 else 1 << 16
+    B = 1 << 13
+    return K, H, B
+
+
+@functools.lru_cache(maxsize=16)
+def _compiled_batched(n_pad: int, ic_pad: int, W: int, S: int, O: int,
+                      K: int, H: int, B: int, chunk: int, probes: int):
+    """vmap the shape-bucket kernel over the key axis and jit it."""
+    import jax
+
+    init_fn, chunk_fn = _build_search(n_pad, ic_pad, W, S, O,
+                                      K, H, B, chunk, probes)
+    vinit = jax.vmap(init_fn)
+    vchunk = jax.jit(jax.vmap(chunk_fn), donate_argnums=(1,))
+    return vinit, vchunk
+
+
+def check_batched(model: Model, histories: Sequence[History],
+                  time_limit: Optional[float] = None,
+                  max_configs: int = 50_000_000,
+                  mesh=None, oracle_fallback: bool = True,
+                  chunk: int = 1024) -> list[dict]:
+    """Check many independent histories against `model` in one sharded
+    device search. Returns one result dict per history, in order.
+
+    `max_configs` is a per-key exploration budget. With `oracle_fallback`,
+    keys the device leaves "unknown" are re-checked by the host oracle
+    (competition semantics); pass False to see raw device verdicts.
+    """
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    # Device stats are int32; cap the budget so the explored counter can
+    # reach it without wrapping (it grows by at most K per round).
+    max_configs = min(max_configs, 2**30)
+    results: list[Optional[dict]] = [None] * len(histories)
+    encs: list[Encoded] = []
+    lanes: list[int] = []  # lane -> history index
+    for i, h in enumerate(histories):
+        try:
+            e = encode(model, h)
+        except EncodingUnsupported as exc:
+            if oracle_fallback:
+                results[i] = wgl_ref.check(model, h, time_limit=time_limit)
+            else:
+                results[i] = {"valid?": "unknown", "cause": f"encoding: {exc}",
+                              "op_count": len(h)}
+            continue
+        if e.n_ok == 0:
+            results[i] = {"valid?": True, "op_count": e.n_info}
+            continue
+        encs.append(e)
+        lanes.append(i)
+
+    if not encs:
+        return results  # type: ignore[return-value]
+
+    if mesh is None:
+        mesh = default_mesh()
+    axis = mesh.axis_names[0]
+    nd = mesh.devices.size
+
+    batch = encode_batch(encs, batch_pad=nd)
+    bk = batch.inv.shape[0]
+    K, H, B = _batch_capacities(bk, batch.window, batch.n_pad)
+    vinit, vchunk = _compiled_batched(
+        n_pad=batch.n_pad, ic_pad=batch.ic_pad, W=batch.window,
+        S=batch.table_s, O=batch.table_o, K=K, H=H, B=B,
+        chunk=chunk, probes=16)
+
+    def shard(x):
+        spec = PartitionSpec(axis) if x.ndim else PartitionSpec()
+        return jax.device_put(x, NamedSharding(mesh, spec))
+
+    import jax.numpy as jnp
+    consts = tuple(shard(jnp.asarray(a)) for a in (
+        batch.inv, batch.ret, batch.opcode, batch.sufminret,
+        batch.inv_info, batch.opcode_info, batch.table,
+        batch.n_ok, batch.n_info,
+        np.full(bk, max_configs, dtype=np.int32)))
+    carry = jax.tree.map(shard, vinit(jnp.zeros(bk, dtype=jnp.int32)))
+
+    deadline = _time.monotonic() + time_limit if time_limit else None
+    t0 = _time.monotonic()
+    timed_out = False
+    while True:
+        carry = vchunk(consts, carry)
+        flags = np.asarray(carry[11])       # (Bk, 3)
+        stats = np.asarray(carry[12])       # (Bk, 3)
+        fr_cnt = np.asarray(carry[4])       # (Bk,)
+        found = flags[:, 0]
+        empty = fr_cnt == 0
+        budget = stats[:, 0] >= max_configs
+        live = ~(found | empty | budget)
+        live[batch.n_keys:] = False
+        if not live.any():
+            break
+        if deadline is not None and _time.monotonic() > deadline:
+            timed_out = True
+            break
+    wall = _time.monotonic() - t0
+
+    overflow = flags[:, 1]
+    for lane, hist_i in enumerate(lanes):
+        e = encs[lane]
+        n_total = int(e.n_ok + e.n_info)
+        detail = {"W": batch.window, "K": K,
+                  "configs_explored": int(stats[lane, 0]),
+                  "batch_keys": batch.n_keys, "batch_wall_s": round(wall, 4)}
+        if found[lane]:
+            res = {"valid?": True, "op_count": n_total, **detail}
+        elif empty[lane] and not overflow[lane]:
+            res = {"valid?": False, "op_count": n_total,
+                   "max_linearized": int(stats[lane, 2]), **detail}
+        else:
+            cause = ("backlog-overflow" if overflow[lane]
+                     else "config-limit" if budget[lane] else "timeout")
+            res = {"valid?": "unknown", "cause": cause,
+                   "op_count": n_total, **detail}
+            remaining = (deadline - _time.monotonic()
+                         if deadline is not None else None)
+            if oracle_fallback and not timed_out and (
+                    remaining is None or remaining > 0):
+                ref = wgl_ref.check(model, histories[hist_i],
+                                    time_limit=remaining)
+                ref.setdefault("device_cause", cause)
+                res = ref
+        results[hist_i] = res
+    return results  # type: ignore[return-value]

@@ -1,6 +1,11 @@
 """Test configuration: run JAX on a virtual 8-device CPU mesh so the
 multi-chip sharding paths are exercised without TPU hardware (the driver
-dry-runs the real multi-chip path separately via __graft_entry__)."""
+dry-runs the real multi-chip path separately via __graft_entry__).
+
+Note: the environment may import jax at interpreter startup (site
+customization), which locks config defaults from the env before this file
+runs — so we set the platform through jax.config, not just os.environ.
+"""
 
 import os
 
@@ -9,3 +14,8 @@ flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+assert jax.device_count() == 8, jax.devices()

@@ -1,0 +1,124 @@
+"""Batched mesh-sharded WGL + independent per-key checker tests.
+
+Runs on the 8-device virtual CPU mesh from conftest.py, exercising the
+same sharded path the driver dry-runs via __graft_entry__.
+"""
+
+import random
+
+import pytest
+
+from jepsen_tpu import checker, history as h, independent, synth
+from jepsen_tpu.models import core as models
+from jepsen_tpu.ops import wgl_ref
+from jepsen_tpu.parallel import check_batched, default_mesh, encode_batch
+
+
+def test_batched_matches_oracle():
+    hists = []
+    for seed in range(12):
+        lie = 0.08 if seed % 3 == 0 else 0.0
+        hists.append(synth.cas_register_history(
+            30, n_procs=3, seed=seed, lie_p=lie, crash_p=0.05))
+    res = check_batched(models.cas_register(), hists, oracle_fallback=False)
+    for i, (hist, r) in enumerate(zip(hists, res)):
+        ref = wgl_ref.check(models.cas_register(), hist)
+        assert r["valid?"] == ref["valid?"], (
+            f"seed {i}: batched={r!r} oracle={ref!r}")
+
+
+def test_batched_explicit_mesh():
+    mesh = default_mesh()
+    assert mesh.devices.size == 8  # conftest forces 8 CPU devices
+    hists = [synth.cas_register_history(40, n_procs=4, seed=s)
+             for s in range(5)]  # 5 keys over 8 devices: padded lanes
+    res = check_batched(models.cas_register(), hists, mesh=mesh)
+    assert all(r["valid?"] is True for r in res)
+
+
+def test_batched_empty_and_trivial_keys():
+    hists = [
+        h.History(),  # n_ok == 0 -> host short-circuit
+        synth.cas_register_history(20, seed=1),
+        h.History([h.invoke(0, "read", None), h.ok(0, "read", 7)]),  # invalid
+    ]
+    res = check_batched(models.cas_register(), hists)
+    assert res[0]["valid?"] is True
+    assert res[1]["valid?"] is True
+    assert res[2]["valid?"] is False
+
+
+def test_batched_mixed_models():
+    hists = [synth.mutex_history(30, seed=s) for s in range(4)]
+    res = check_batched(models.mutex(), hists)
+    assert all(r["valid?"] is True for r in res)
+    hists = [synth.fifo_queue_history(30, seed=s) for s in range(4)]
+    res = check_batched(models.fifo_queue(), hists)
+    assert all(r["valid?"] is True for r in res)
+
+
+def test_encode_batch_shapes():
+    from jepsen_tpu.ops.encode import encode
+    encs = [encode(models.cas_register(),
+                   synth.cas_register_history(20 + 10 * i, seed=i))
+            for i in range(3)]
+    b = encode_batch(encs, batch_pad=8)
+    assert b.n_keys == 3
+    assert b.inv.shape[0] == 8
+    assert b.inv.shape[1] == b.n_pad
+    assert b.table.shape == (8, b.table_s, b.table_o)
+
+
+# --- independent (per-key) lifting ---------------------------------------
+
+def build_multikey_history(n_keys=4, ops_per_key=24, bad_keys=()):
+    """Interleave per-key cas-register histories into one tuple-valued
+    history, plus a nemesis marker op that every subhistory must retain."""
+    rng = random.Random(7)
+    hist = h.History()
+    hist.append(h.info("nemesis", "start-partition", None))
+    streams = []
+    for k in range(n_keys):
+        sub = synth.cas_register_history(
+            ops_per_key, n_procs=3, seed=100 + k,
+            lie_p=0.2 if k in bad_keys else 0.0)
+        streams.append((k, list(sub)))
+    while any(ops for _, ops in streams):
+        k, ops = rng.choice([s for s in streams if s[1]])
+        op = ops.pop(0)
+        hist.append(op.with_(process=(op.process, k),
+                             value=independent.tuple_(k, op.value)))
+    hist.append(h.info("nemesis", "stop-partition", None))
+    return hist.index()
+
+
+def test_history_keys_and_subhistory():
+    hist = build_multikey_history(n_keys=3)
+    ks = independent.history_keys(hist)
+    assert sorted(ks) == [0, 1, 2]
+    sub = independent.subhistory(0, hist)
+    # nemesis ops (non-tuple values) are retained in every subhistory
+    assert sub[0].f == "start-partition"
+    assert all(not independent.is_tuple(o.value) for o in sub)
+
+
+def test_independent_host_checker():
+    hist = build_multikey_history(n_keys=4, bad_keys=(2,))
+    c = independent.checker(
+        checker.linearizable(models.cas_register(), algorithm="wgl"))
+    res = c.check({}, hist, {})
+    assert res["valid?"] is False
+    assert res["failures"] == [2]
+    assert res["results"][0]["valid?"] is True
+
+
+def test_independent_tpu_checker_matches_host():
+    hist = build_multikey_history(n_keys=5, bad_keys=(1, 3))
+    tpu = independent.tpu_checker(models.cas_register()).check({}, hist, {})
+    host = independent.checker(
+        checker.linearizable(models.cas_register(), algorithm="wgl")
+    ).check({}, hist, {})
+    assert tpu["valid?"] is False
+    assert sorted(tpu["failures"]) == sorted(host["failures"])
+    for k in independent.history_keys(hist):
+        assert tpu["results"][k]["valid?"] == host["results"][k]["valid?"]
