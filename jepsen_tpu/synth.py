@@ -32,6 +32,7 @@ def cas_register_history(n_ops: int, n_procs: int = 5, values: int = 5,
     reg: Optional[int] = None
     pending: dict = {}
     free = list(range(n_procs))
+    next_pid = n_procs
     issued = 0
     t = 0
     while issued < n_ops or pending:
@@ -61,7 +62,10 @@ def cas_register_history(n_ops: int, n_procs: int = 5, values: int = 5,
                         reg = v
                     elif v[0] == reg:
                         reg = v[1]
-                # crashed processes never come back
+                # a crashed process is retired; the interpreter assigns a
+                # fresh process id to its worker (interpreter.clj:233-236)
+                free.append(next_pid)
+                next_pid += 1
             else:
                 if f == "read":
                     val = reg

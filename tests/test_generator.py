@@ -1,0 +1,311 @@
+"""Generator DSL semantics under the virtual-time simulator — the
+exact-timing contracts the reference asserts in
+jepsen/test/jepsen/generator_test.clj (e.g. delay-test's invocations at
+t=0,3,6,10,13 with 10 ns perfect latency)."""
+
+import pytest
+
+from jepsen_tpu import generator as gen
+from jepsen_tpu.generator import testlib as gt
+
+
+def times(ops):
+    return [o["time"] for o in ops]
+
+
+def values(ops):
+    return [o.get("value") for o in ops]
+
+
+def test_nil():
+    assert gt.perfect(None) == []
+
+
+def test_map_once():
+    out = gt.perfect({"f": "write"})
+    assert len(out) == 1
+    assert out[0]["type"] == "invoke"
+    assert out[0]["time"] == 0
+    assert out[0]["f"] == "write"
+
+
+def test_map_concurrent():
+    # 3 threads (2 workers + nemesis): batches at t=0 and t=10
+    out = gt.perfect(gen.repeat(6, {"f": "write"}))
+    assert times(out) == [0, 0, 0, 10, 10, 10]
+    assert {o["process"] for o in out[:3]} == {0, 1, "nemesis"}
+
+
+def test_map_pending_when_all_busy():
+    from dataclasses import replace
+    ctx = replace(gt.default_context(), free_threads=frozenset())
+    assert gen.op({"f": "write"}, {}, ctx) == (gen.PENDING, {"f": "write"})
+
+
+def test_limit():
+    out = gt.quick(gen.limit(2, gen.repeat({"f": "write", "value": 1})))
+    assert len(out) == 2
+    assert all(o["value"] == 1 for o in out)
+
+
+def test_repeat_does_not_advance():
+    out = gt.perfect(gen.repeat(3, [{"value": v} for v in range(10)]))
+    assert values(out) == [0, 0, 0]
+
+
+def test_delay():
+    # delay 3ns: would emit at 0,3,6,9,12 but all 3 threads are busy for
+    # 10ns, so the 4th/5th start as soon as workers free up (10, 13)
+    out = gt.perfect(gen.limit(5, gen.delay(3e-9, gen.repeat({"f": "w"}))))
+    assert times(out) == [0, 3, 6, 10, 13]
+
+
+def test_seq():
+    out = gt.quick([{"value": 1}, {"value": 2}, {"value": 3}])
+    assert values(out) == [1, 2, 3]
+
+
+def test_on_update_sees_completions():
+    seen = []
+
+    def handler(this, test, ctx, event):
+        seen.append(event.get("type"))
+        return this
+
+    # 6 ops over 3 threads: after the first 3 invokes every thread is
+    # busy, so completions must be delivered before the rest can start
+    g = gen.on_update(handler, gen.limit(6, gen.repeat({"value": 1})))
+    gt.perfect(g)
+    assert "invoke" in seen and "ok" in seen
+
+
+def test_fn_generator():
+    calls = []
+
+    def f():
+        calls.append(1)
+        return {"value": len(calls)} if len(calls) <= 3 else None
+
+    out = gt.quick(f)
+    assert values(out) == [1, 2, 3]
+
+
+def test_fn_generator_with_args():
+    def f(test, ctx):
+        return {"value": ctx.time} if ctx.time < 1 else None
+
+    out = gt.perfect(f)
+    assert all(v == 0 for v in values(out))
+
+
+def test_map_transform():
+    out = gt.quick(gen.map_(lambda o: {**o, "value": o["value"] * 2},
+                            [{"value": 1}, {"value": 2}]))
+    assert values(out) == [2, 4]
+
+
+def test_f_map():
+    out = gt.quick(gen.f_map({"start": "kill"}, [{"f": "start"},
+                                                 {"f": "other"}]))
+    assert [o["f"] for o in out] == ["kill", "other"]
+
+
+def test_filter():
+    out = gt.quick(gen.limit(3, gen.filter_(
+        lambda o: o["value"] % 2 == 0,
+        [{"value": v} for v in range(10)])))
+    assert values(out) == [0, 2, 4]
+
+
+def test_any_takes_soonest():
+    # explicit future times on a; b is ready immediately
+    a = [{"f": "slow", "time": 20}, {"f": "slow", "time": 40}]
+    b = gen.limit(2, gen.repeat({"f": "fast"}))
+    out = gt.quick(gen.any_(a, b))
+    assert [o["f"] for o in out] == ["fast", "fast", "slow", "slow"]
+
+
+def test_mix_distribution():
+    gens = [gen.repeat({"value": v}) for v in range(3)]
+    out = gt.quick(gen.limit(300, gen.mix(gens)))
+    from collections import Counter
+    counts = Counter(values(out))
+    assert set(counts) == {0, 1, 2}
+    assert all(c > 50 for c in counts.values())
+
+
+def test_once():
+    assert len(gt.quick(gen.once(gen.repeat({"f": "w"})))) == 1
+
+
+def test_cycle():
+    out = gt.quick(gen.cycle(2, [{"value": 1}, {"value": 2}]))
+    assert values(out) == [1, 2, 1, 2]
+
+
+def test_time_limit():
+    out = gt.perfect(gen.time_limit(
+        25e-9, gen.delay(10e-9, gen.repeat({"f": "w"}))))
+    # ops at 0, 10, 20; cutoff at 0+25; op at 30 excluded
+    assert times(out) == [0, 10, 20]
+
+
+def test_stagger_spreads_ops():
+    out = gt.perfect(gen.limit(20, gen.stagger(
+        5e-9, gen.repeat({"f": "w"}))))
+    ts = times(out)
+    assert ts == sorted(ts)
+    assert ts[-1] > 0  # actually staggered
+    # mean interval should be within a factor of ~3 of 5ns
+    mean = ts[-1] / (len(ts) - 1)
+    assert 1 <= mean <= 15
+
+
+def test_synchronize_and_phases():
+    out = gt.perfect_star(gen.phases(
+        gen.limit(4, gen.repeat({"f": "a"})),
+        gen.limit(1, gen.repeat({"f": "b"}))))
+    invs = gt.invocations(out)
+    # phase b starts only after every a completes
+    b_start = [o for o in invs if o["f"] == "b"][0]["time"]
+    a_completions = [o["time"] for o in out
+                     if o["f"] == "a" and o["type"] == "ok"]
+    assert b_start >= max(a_completions)
+
+
+def test_then():
+    out = gt.quick(gen.then(gen.once({"f": "read"}),
+                            gen.limit(3, gen.repeat({"f": "write"}))))
+    assert [o["f"] for o in out] == ["write"] * 3 + ["read"]
+
+
+def test_until_ok():
+    # imperfect cycles fail -> info -> ok per thread
+    out = gt.imperfect(gen.until_ok(gen.repeat({"f": "w"})))
+    oks = [o for o in out if o["type"] == "ok"]
+    assert len(oks) >= 1
+    # generator stops after first ok: no invocation starts after the
+    # first ok completes
+    first_ok = min(o["time"] for o in oks)
+    assert all(o["time"] <= first_ok for o in out if o["type"] == "invoke")
+
+
+def test_flip_flop():
+    a = gen.repeat([{"f": "a"}])
+    b = gen.limit(2, gen.repeat({"f": "b"}))
+    out = gt.quick(gen.flip_flop(a, b))
+    assert [o["f"] for o in out] == ["a", "b", "a", "b", "a"]
+
+
+def test_process_limit():
+    # with perfect_info every op crashes, retiring its process; after n
+    # distinct processes the generator stops (generator_test.clj parity:
+    # process ids grow by the count of numeric processes)
+    out = gt.perfect_info(gen.process_limit(
+        5, gen.clients(gen.repeat({"f": "w"}))), gt.n_nemesis_context(2))
+    procs = {o["process"] for o in out}
+    assert len(procs) <= 5
+
+
+def test_clients_excludes_nemesis():
+    out = gt.quick(gen.limit(10, gen.clients(gen.repeat({"f": "w"}))))
+    assert all(o["process"] != "nemesis" for o in out)
+
+
+def test_nemesis_only():
+    out = gt.quick(gen.limit(3, gen.nemesis(gen.repeat({"f": "split"}))))
+    assert all(o["process"] == "nemesis" for o in out)
+
+
+def test_clients_and_nemesis_routing():
+    out = gt.quick(gen.limit(30, gen.clients(
+        gen.repeat({"f": "w"}), gen.repeat({"f": "split"}))))
+    by_f = {o["f"] for o in out if o["process"] == "nemesis"}
+    assert by_f == {"split"}
+    by_f = {o["f"] for o in out if o["process"] != "nemesis"}
+    assert by_f == {"w"}
+
+
+def test_each_thread():
+    out = gt.quick(gen.each_thread([{"value": 1}, {"value": 2}]))
+    # every thread (2 workers + nemesis) runs the full sequence
+    from collections import Counter
+    counts = Counter(o["process"] for o in out)
+    assert counts == {0: 2, 1: 2, "nemesis": 2}
+
+
+def test_reserve():
+    ctx = gt.n_nemesis_context(4)
+    g = gen.reserve(2, gen.repeat({"f": "read"}),
+                    gen.repeat({"f": "write"}))
+    out = gt.quick(gen.limit(40, gen.clients(g)), ctx)
+    readers = {o["process"] for o in out if o["f"] == "read"}
+    writers = {o["process"] for o in out if o["f"] == "write"}
+    assert readers == {0, 1}
+    assert writers == {2, 3}
+
+
+def test_cycle_times():
+    g = gen.cycle_times(10e-9, gen.repeat({"f": "a"}),
+                        10e-9, gen.repeat({"f": "b"}))
+    out = gt.perfect(gen.time_limit(40e-9, g))
+    for o in out:
+        phase = (o["time"] // 10) % 2
+        assert o["f"] == ("a" if phase == 0 else "b"), o
+
+
+def test_validate_rejects_busy_process():
+    class Bad(gen.Generator):
+        def op(self, test, ctx):
+            return ({"type": "invoke", "f": "w", "process": 99, "time": 0},
+                    self)
+
+    with pytest.raises(gen.InvalidOp):
+        gt.quick(Bad())
+
+
+def test_validate_rejects_bad_type():
+    class Bad(gen.Generator):
+        def op(self, test, ctx):
+            p = ctx.some_free_process()
+            return ({"type": "wat", "f": "w", "process": p, "time": 0}, self)
+
+    with pytest.raises(gen.InvalidOp):
+        gt.quick(Bad())
+
+
+def test_friendly_exceptions():
+    class Boom(gen.Generator):
+        def op(self, test, ctx):
+            raise ValueError("boom")
+
+    with pytest.raises(RuntimeError, match="asked for an operation"):
+        gt.quick(gen.friendly_exceptions(Boom()))
+
+
+def test_log_and_sleep_ops():
+    # log/sleep are special op types, not invocations
+    out = gt.quick_ops([gen.log("hello"), gen.sleep(1)])
+    logs = [o for o in out if o["type"] == "log"]
+    sleeps = [o for o in out if o["type"] == "sleep"]
+    assert logs and logs[0]["value"] == "hello"
+    assert sleeps and sleeps[0]["value"] == 1
+
+
+def test_determinism():
+    g = lambda: gen.limit(50, gen.stagger(  # noqa: E731
+        3e-9, gen.mix([gen.repeat({"value": v}) for v in range(3)])))
+    assert gt.perfect(g()) == gt.perfect(g())
+
+
+def test_next_process():
+    ctx = gt.n_nemesis_context(2)
+    # thread 0 crashed: next process = 0 + 2 numeric processes
+    assert ctx.next_process(0) == 2
+    assert ctx.next_process("nemesis") == "nemesis"
+
+
+def test_perfect_info_rotates_processes():
+    out = gt.perfect_info(gen.limit(6, gen.clients(gen.repeat({"f": "w"}))))
+    # crashed processes are retired; later invocations use fresh ids
+    assert max(o["process"] for o in out) >= 2
