@@ -1,0 +1,106 @@
+"""In-process fakes for testing the framework without a cluster.
+
+Capability parity with jepsen.tests (`jepsen/src/jepsen/tests.clj`):
+`noop_test` is a complete test-map stub; `AtomDB`/`AtomClient` implement
+a linearizable CAS register over shared in-process state with a 1 ms
+sleep for real concurrency (tests.clj:27-67) — enough to run the entire
+run() pipeline in CI with no SSH and no database.
+"""
+
+from __future__ import annotations
+
+import threading
+import time as _time
+from typing import Any, Optional
+
+from . import client as jclient
+from . import checker as jchecker
+
+
+class SharedRegister:
+    """The in-process 'database': a lock-guarded register."""
+
+    def __init__(self, value=None):
+        self.lock = threading.Lock()
+        self.value = value
+
+    def read(self):
+        with self.lock:
+            return self.value
+
+    def write(self, v):
+        with self.lock:
+            self.value = v
+
+    def cas(self, cur, new) -> bool:
+        with self.lock:
+            if self.value == cur:
+                self.value = new
+                return True
+            return False
+
+
+class AtomClient(jclient.Client):
+    """CAS-register client over a SharedRegister (tests.clj:34-67).
+    Sleeps 1 ms per op so tests see real concurrency."""
+
+    def __init__(self, state: SharedRegister, meta_log: Optional[list] = None):
+        self.state = state
+        self.meta_log = meta_log if meta_log is not None else []
+
+    def open(self, test, node):
+        self.meta_log.append("open")
+        return AtomClient(self.state, self.meta_log)
+
+    def setup(self, test):
+        self.meta_log.append("setup")
+
+    def invoke(self, test, op):
+        _time.sleep(0.001)
+        f = op.get("f")
+        if f == "write":
+            self.state.write(op.get("value"))
+            return {**op, "type": "ok"}
+        if f == "cas":
+            cur, new = op["value"]
+            ok = self.state.cas(cur, new)
+            return {**op, "type": "ok" if ok else "fail"}
+        if f == "read":
+            return {**op, "type": "ok", "value": self.state.read()}
+        raise ValueError(f"unknown op {f!r}")
+
+    def teardown(self, test):
+        self.meta_log.append("teardown")
+
+    def close(self, test):
+        self.meta_log.append("close")
+
+
+class NoopNemesis:
+    """Accepts every op unchanged."""
+
+    def setup(self, test):
+        return self
+
+    def invoke(self, test, op):
+        return op
+
+    def teardown(self, test):
+        return None
+
+    def fs(self):
+        return set()
+
+
+def noop_test() -> dict:
+    """A boring test stub (tests.clj:12-25); extend with real
+    generator/client/checker as needed."""
+    return {
+        "name": "noop",
+        "nodes": ["n1", "n2", "n3", "n4", "n5"],
+        "concurrency": 5,
+        "client": jclient.noop(),
+        "nemesis": NoopNemesis(),
+        "generator": None,
+        "checker": jchecker.unbridled_optimism(),
+    }

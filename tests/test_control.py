@@ -1,0 +1,175 @@
+"""Control-plane tests: shell algebra, dummy remote, DSL scopes, node
+fan-out — the style of jepsen/test/jepsen/control_test.clj but runnable
+with no reachable node (dummy remote)."""
+
+import threading
+
+import pytest
+
+from jepsen_tpu import control as c
+from jepsen_tpu import reconnect
+from jepsen_tpu.control import dummy, nodeutil
+from jepsen_tpu.control.core import NonzeroExit, env, escape, lit, wrap_sudo
+
+
+# --- shell algebra (control/core.clj:62-153) ------------------------------
+
+def test_escape_plain():
+    assert escape("foo") == "foo"
+    assert escape(123) == "123"
+    assert escape(None) == ""
+    assert escape("") == '""'
+
+
+def test_escape_quoting():
+    assert escape("hello world") == '"hello world"'
+    assert escape("a$b") == '"a\\$b"'
+    assert escape('say "hi"') == '"say \\"hi\\""'
+    assert escape("semi;colon") == '"semi;colon"'
+    assert escape("back\\slash") == '"back\\\\slash"'
+
+
+def test_escape_literal():
+    assert escape(lit("a | b")) == "a | b"
+
+
+def test_escape_collections():
+    assert escape(["a", "b c"]) == 'a "b c"'
+
+
+def test_env():
+    assert env(None) is None
+    assert env({"HOME": "/root", "X": "a b"}).string == 'HOME=/root X="a b"'
+    assert env("FOO=1").string == "FOO=1"
+    assert env(lit("BAR=2")).string == "BAR=2"
+
+
+def test_wrap_sudo():
+    a = {"cmd": "whoami"}
+    assert wrap_sudo({}, a) == a
+    wrapped = wrap_sudo({"sudo": "root"}, a)
+    assert wrapped["cmd"] == "sudo -k -S -u root bash -c whoami"
+    with_pw = wrap_sudo({"sudo": "root", "sudo_password": "hunter2"}, a)
+    assert with_pw["in"].startswith("hunter2\n")
+
+
+# --- dummy remote + DSL ----------------------------------------------------
+
+def test_on_executes_with_dummy():
+    log = []
+    with c.with_remote(dummy.remote(log)):
+        with c.on("n1"):
+            out = c.exec_("echo", "hi there")
+    assert out == ""
+    assert log == [("n1", 'cd /; echo "hi there"')]
+
+
+def test_cd_su_scopes():
+    log = []
+    with c.with_remote(dummy.remote(log)):
+        with c.on("n1"):
+            with c.cd("/tmp"):
+                with c.cd("sub"):
+                    c.exec_("ls")
+    assert log[-1] == ("n1", "cd /tmp/sub; ls")
+
+
+def test_no_session_raises():
+    with pytest.raises(c.NoSessionError):
+        c.exec_("ls")
+
+
+def test_on_many_parallel_bindings():
+    log = []
+    hosts = ["n1", "n2", "n3"]
+    with c.with_remote(dummy.remote(log)):
+        res = c.on_many(hosts, lambda: c.exec_("hostname") or c.state.host)
+    assert res == {"n1": "n1", "n2": "n2", "n3": "n3"}
+    assert {h for h, _ in log} == set(hosts)
+
+
+def test_on_nodes_uses_test_sessions():
+    log = []
+    r = dummy.remote(log)
+    nodes = ["a", "b"]
+    test = {"nodes": nodes,
+            "sessions": {n: r.connect({"host": n}) for n in nodes}}
+    res = c.on_nodes(test, lambda t, n: n.upper())
+    assert res == {"a": "A", "b": "B"}
+
+
+def test_with_ssh_dummy_flag():
+    with c.with_ssh({"dummy?": True}):
+        with c.on("nowhere"):
+            assert c.exec_("anything") == ""
+
+
+# --- nodeutil against dummy remote ----------------------------------------
+
+def test_start_daemon_command_shape():
+    log = []
+    with c.with_remote(dummy.remote(log)):
+        with c.on("n1"):
+            res = nodeutil.start_daemon(
+                {"logfile": "/var/log/db.log", "pidfile": "/run/db.pid",
+                 "chdir": "/opt/db", "env": {"PORT": "99"}},
+                "/opt/db/bin/db", "--serve")
+    assert res == "started"
+    cmd = log[-1][1]
+    assert "start-stop-daemon --start" in cmd
+    assert "--background --no-close" in cmd
+    assert "--make-pidfile" in cmd
+    assert "--pidfile /run/db.pid" in cmd
+    assert "--chdir /opt/db" in cmd
+    assert "--startas /opt/db/bin/db -- --serve" in cmd
+    assert "PORT=99" in cmd
+
+
+def test_grepkill_and_signal_are_meh():
+    # against a dummy remote everything exits 0; just exercise the paths
+    with c.with_remote(dummy.remote()):
+        with c.on("n1"):
+            nodeutil.grepkill("some-proc")
+            assert nodeutil.signal("db", "STOP") == "signaled"
+
+
+# --- reconnect wrapper -----------------------------------------------------
+
+def test_reconnect_reopens_on_failure():
+    opens = []
+
+    class Conn:
+        def __init__(self, i):
+            self.i = i
+            self.dead = i == 0  # first connection is bad
+
+    def open_fn():
+        conn = Conn(len(opens))
+        opens.append(conn)
+        return conn
+
+    w = reconnect.wrapper(open_fn)
+
+    def use(conn):
+        if conn.dead:
+            raise IOError("wedged")
+        return conn.i
+
+    assert w.with_retry(use, retries=2) == 1
+    assert len(opens) == 2
+
+
+def test_reconnect_locks_out_concurrent_reopen():
+    w = reconnect.wrapper(lambda: object())
+    w.open()
+    results = []
+
+    def worker():
+        results.append(w.with_conn(lambda conn: conn is not None))
+
+    ts = [threading.Thread(target=worker) for _ in range(8)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert results == [True] * 8

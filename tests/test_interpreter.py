@@ -1,0 +1,178 @@
+"""Interpreter integration tests: the full scheduler against in-process
+clients, asserting structural invariants of the history — the style of
+jepsen/test/jepsen/generator/interpreter_test.clj:14-80."""
+
+import random
+import threading
+import time
+
+import pytest
+
+from jepsen_tpu import client as jclient, fakes, util
+from jepsen_tpu import generator as gen
+from jepsen_tpu.generator import interpreter
+from jepsen_tpu.history import History
+from jepsen_tpu import checker, models
+
+
+def run_test(test):
+    with util.with_relative_time():
+        return interpreter.run(test)
+
+
+def check_structure(history, concurrency):
+    """Per-process invoke/complete alternation, integer times, known
+    types (interpreter_test.clj asserts these invariants)."""
+    outstanding = {}
+    for op in history:
+        assert op["type"] in ("invoke", "ok", "fail", "info")
+        assert isinstance(op["time"], int) and op["time"] >= 0
+        p = op["process"]
+        if op["type"] == "invoke":
+            assert p not in outstanding, f"double invoke for {p}"
+            outstanding[p] = op
+        else:
+            assert p in outstanding, f"completion without invoke for {p}"
+            assert outstanding.pop(p)["f"] == op["f"]
+
+
+def test_empty_generator():
+    t = fakes.noop_test()
+    assert run_test(t) == []
+
+
+def test_ok_client_history():
+    reg = fakes.SharedRegister()
+    t = {**fakes.noop_test(),
+         "concurrency": 4,
+         "client": fakes.AtomClient(reg),
+         "generator": gen.limit(
+             40, gen.clients(gen.mix(
+                 [gen.repeat({"f": "read"}),
+                  gen.repeat({"f": "write", "value": 1}),
+                  gen.repeat({"f": "cas", "value": [1, 2]})])))}
+    h = run_test(t)
+    invokes = [o for o in h if o["type"] == "invoke"]
+    assert len(invokes) == 40
+    assert len(h) == 80  # every op completes
+    check_structure(h, 4)
+    # times are monotone nondecreasing
+    times = [o["time"] for o in h]
+    assert times == sorted(times)
+
+
+def test_crashing_client_rotates_processes():
+    class Crashy(jclient.Client):
+        def open(self, test, node):
+            return self
+
+        def invoke(self, test, op):
+            raise RuntimeError("boom")
+
+    t = {**fakes.noop_test(),
+         "concurrency": 2,
+         "client": Crashy(),
+         "generator": gen.limit(6, gen.clients(gen.repeat({"f": "read"})))}
+    h = run_test(t)
+    infos = [o for o in h if o["type"] == "info"]
+    assert len(infos) == 6
+    assert all("indeterminate" in o["error"] for o in infos)
+    # crashed processes get fresh ids
+    procs = {o["process"] for o in h}
+    assert len(procs) == 6
+    check_structure(h, 2)
+
+
+def test_mixed_ok_fail_info():
+    rng = random.Random(0)
+
+    class Rand(jclient.Client):
+        def open(self, test, node):
+            return self
+
+        def invoke(self, test, op):
+            r = rng.random()
+            if r < 0.2:
+                raise RuntimeError("crash")
+            if r < 0.4:
+                return {**op, "type": "fail"}
+            return {**op, "type": "ok"}
+
+    t = {**fakes.noop_test(),
+         "concurrency": 3,
+         "client": Rand(),
+         "generator": gen.limit(30, gen.clients(gen.repeat({"f": "w"})))}
+    h = run_test(t)
+    types = {o["type"] for o in h}
+    assert types == {"invoke", "ok", "fail", "info"}
+    check_structure(h, 3)
+
+
+def test_nemesis_ops_routed():
+    seen = []
+
+    class Nem(fakes.NoopNemesis):
+        def invoke(self, test, op):
+            seen.append(op["f"])
+            return {**op, "type": "info"}
+
+    t = {**fakes.noop_test(),
+         "concurrency": 2,
+         "nemesis": Nem(),
+         "generator": gen.phases(
+             gen.limit(4, gen.clients(gen.repeat({"f": "read"}),
+                                      gen.repeat({"f": "split",
+                                                  "type": "info"}))),
+         )}
+    h = run_test(t)
+    assert "split" in seen
+    nem_ops = [o for o in h if o["process"] == "nemesis"]
+    assert all(o["f"] == "split" for o in nem_ops)
+
+
+def test_full_cas_register_pipeline_is_linearizable():
+    """The whole stack: generator -> interpreter -> history -> TPU-gated
+    checker. An in-process register really is linearizable, so the
+    checker must agree (core_test.clj's basic-cas-test analog)."""
+    reg = fakes.SharedRegister()
+    t = {**fakes.noop_test(),
+         "concurrency": 5,
+         "client": fakes.AtomClient(reg),
+         "generator": gen.limit(
+             60, gen.clients(gen.mix(
+                 [gen.repeat(lambda: {"f": "read"}),
+                  gen.repeat(lambda: {"f": "write",
+                                      "value": gen.RNG.randrange(5)}),
+                  gen.repeat(lambda: {"f": "cas",
+                                      "value": [gen.RNG.randrange(5),
+                                                gen.RNG.randrange(5)]})])))}
+    h = run_test(t)
+    hist = History(h).index()
+    res = checker.linearizable(
+        models.cas_register(), algorithm="wgl").check(t, hist, {})
+    assert res["valid?"] is True, res
+
+
+def test_sleep_and_log_not_in_history():
+    t = {**fakes.noop_test(),
+         "concurrency": 1,
+         "generator": [gen.clients(gen.sleep(0.01)),
+                       gen.clients(gen.log("hi")),
+                       gen.clients({"f": "read"})]}
+    h = run_test(t)
+    assert {o["type"] for o in h} == {"invoke", "ok"}
+    assert all(o["f"] == "read" for o in h)
+
+
+def test_stagger_rate_roughly_matches():
+    t = {**fakes.noop_test(),
+         "concurrency": 5,
+         "generator": gen.time_limit(0.4, gen.stagger(
+             0.01, gen.clients(gen.repeat({"f": "read"}))))}
+    start = time.monotonic()
+    h = run_test(t)
+    wall = time.monotonic() - start
+    invokes = [o for o in h if o["type"] == "invoke"]
+    # ~40 ops expected at 100 ops/s over 0.4 s; allow broad slack
+    assert 10 <= len(invokes) <= 120
+    assert wall < 5
