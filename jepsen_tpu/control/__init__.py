@@ -99,6 +99,19 @@ def _snapshot() -> dict:
     return {k: getattr(state, k) for k in _STATE_FIELDS}
 
 
+def bound_fn(f: Callable) -> Callable:
+    """Wrap f so it runs under the calling thread's control bindings —
+    the reference's bound-fn* (used e.g. to open sessions from worker
+    threads, core.clj:285-287)."""
+    snap = _snapshot()
+
+    def wrapped(*args, **kw):
+        with _bind(**snap):
+            return f(*args, **kw)
+
+    return wrapped
+
+
 def expand_path(path: str) -> str:
     if path.startswith("/"):
         return path

@@ -18,7 +18,8 @@ class K8sRemote(Remote):
         self.namespace = namespace
 
     def connect(self, conn_spec):
-        return K8sRemote(conn_spec["host"], conn_spec.get("namespace"))
+        return K8sRemote(conn_spec["host"],
+                         conn_spec.get("namespace") or self.namespace)
 
     def _ns(self) -> list:
         return ["-n", self.namespace] if self.namespace else []

@@ -138,9 +138,14 @@ def install_archive(url: str, dest: str, force: bool = False,
             exec_("tar", "--no-same-owner", "--no-same-permissions",
                   "--extract", "--file", local, "--directory", tmp)
         entries = ls(tmp)
-        src = f"{tmp}/{entries[0]}" if len(entries) == 1 else tmp
-        # Move contents (including dotfiles) into dest
-        exec_star(f"mv {escape(src)}/* {escape(dest)}/ 2>/dev/null || true")
+        if len(entries) == 1 and not is_file(f"{tmp}/{entries[0]}"):
+            # single top-level directory: move its contents
+            src = f"{tmp}/{entries[0]}"
+        else:
+            # flat archive (possibly a single file): move everything
+            src = tmp
+        # Move contents into dest; dotfiles may legitimately be absent.
+        exec_star(f"mv {escape(src)}/* {escape(dest)}/")
         exec_star(f"mv {escape(src)}/.[!.]* {escape(dest)}/ "
                   "2>/dev/null || true")
         if user:
@@ -151,12 +156,20 @@ def install_archive(url: str, dest: str, force: bool = False,
 
 
 def grepkill(pattern: str, signal: str = "9") -> None:
-    """Kill all processes matching a pattern (control/util.clj:286-308)."""
-    meh(exec_, "pkill", "--signal", signal, "-f", pattern)
+    """Kill all processes matching a pattern (control/util.clj:286-308).
+    Deliberately NOT pkill -f: the remote bash/sudo wrapper's own command
+    line contains the pattern and would signal itself (the reference uses
+    ps | grep -v grep for exactly this reason)."""
+    meh(exec_star,
+        f"ps -ef | grep {escape(pattern)} | grep -v grep "
+        f"| awk '{{print $2}}' | xargs --no-run-if-empty "
+        f"kill -s {escape(str(signal))}")
 
 
 def signal(process_name: str, sig: str) -> str:
-    """Send a signal to a named process (control/util.clj:399-403)."""
+    """Send a signal to a named process by COMM field
+    (control/util.clj:399-403). pkill without -f matches only the
+    process name, so the shell wrapper is safe."""
     meh(exec_, "pkill", "--signal", str(sig), process_name)
     return "signaled"
 

@@ -115,7 +115,8 @@ class SSHRemote(Remote):
             res = self._run(self._scp_args() + [str(p) for p in local_paths]
                             + [f"{self._dest()}:{remote_path}"])
         if res.returncode != 0:
-            raise IOError(f"scp upload failed: {res.stderr.decode()}")
+            raise IOError("scp upload failed: "
+                          f"{res.stderr.decode(errors='replace')}")
 
     def download(self, context, remote_paths, local_path, opts=None):
         if isinstance(remote_paths, (str, os.PathLike)):
@@ -125,7 +126,8 @@ class SSHRemote(Remote):
                             + [f"{self._dest()}:{p}" for p in remote_paths]
                             + [str(local_path)])
         if res.returncode != 0:
-            raise IOError(f"scp download failed: {res.stderr.decode()}")
+            raise IOError("scp download failed: "
+                          f"{res.stderr.decode(errors='replace')}")
 
 
 def remote() -> SSHRemote:

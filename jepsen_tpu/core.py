@@ -1,0 +1,218 @@
+"""Test orchestration: the master run() lifecycle.
+
+Capability parity with jepsen.core (`jepsen/src/jepsen/core.clj`):
+`run(test)` takes a test map (documented at core.clj:328-353 — nodes,
+ssh, os, db, client, nemesis, generator, checker, net, remote, …),
+prepares it (core.clj:311-325), opens sessions to every node in
+parallel (with-sessions, core.clj:275-295), sets up the OS
+(core.clj:93-100) and DB (db.cycle with retries + log snarfing,
+core.clj:172-181), runs the case — nemesis setup in parallel with
+client open/setup per node, then the interpreter hot loop
+(core.clj:183-219) — under the relative-time clock, indexes the
+history, checks it (core.clj:221-237), persists everything through the
+store (3-phase save), and logs a human verdict (core.clj:239-252).
+"""
+
+from __future__ import annotations
+
+import logging
+import time as _time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Optional
+
+from . import checker as jchecker
+from . import client as jclient
+from . import control
+from . import db as jdb
+from . import nemesis as jnemesis
+from . import util
+from .generator import interpreter
+from .history import History
+
+log = logging.getLogger("jepsen_tpu.core")
+
+
+def prepare_test(test: dict) -> dict:
+    """Ensure start_time and concurrency (core.clj:311-325)."""
+    test = dict(test)
+    if not test.get("start_time"):
+        test["start_time"] = _time.strftime("%Y%m%dT%H%M%S")
+    if not test.get("concurrency"):
+        test["concurrency"] = len(test.get("nodes") or [])
+    return test
+
+
+class _Sessions:
+    """Open sessions to all nodes in parallel; close them afterwards
+    (with-sessions + with-resources, core.clj:70-91, 275-295)."""
+
+    def __init__(self, test: dict):
+        self.test = test
+        self.sessions: dict = {}
+
+    def __enter__(self) -> dict:
+        nodes = self.test.get("nodes") or []
+        try:
+            opened = util.real_pmap(control.bound_fn(control.session),
+                                    nodes)
+        except Exception:
+            self.close()
+            raise
+        self.sessions = dict(zip(nodes, opened))
+        return {**self.test, "sessions": self.sessions}
+
+    def close(self):
+        for s in self.sessions.values():
+            try:
+                control.disconnect(s)
+            except Exception:  # noqa: BLE001
+                pass
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def snarf_logs(test: dict) -> None:
+    """Download DB log files into the store directory (core.clj:102-136)."""
+    db = test.get("db")
+    store_dir = test.get("store_dir")
+    if not isinstance(db, jdb.LogFiles) or not store_dir:
+        return
+    import os
+
+    def snarf(t, node):
+        from .control import nodeutil as cu
+        for remote in db.log_files(t, node):
+            if cu.file_exists(remote):
+                local = os.path.join(store_dir, str(node),
+                                     remote.lstrip("/"))
+                os.makedirs(os.path.dirname(local), exist_ok=True)
+                try:
+                    control.download(remote, local)
+                except Exception as e:  # noqa: BLE001
+                    log.info("couldn't download %s: %s", remote, e)
+
+    log.info("Snarfing log files")
+    control.on_nodes(test, snarf)
+
+
+def run_case(test: dict) -> list:
+    """Set up nemesis (concurrently) + clients, run the interpreter,
+    tear everything down (core.clj:183-219)."""
+    client = test["client"]
+    nemesis = jnemesis.validate(test.get("nemesis") or jnemesis.noop())
+    with ThreadPoolExecutor(max_workers=1) as pool:
+        nemesis_fut = pool.submit(nemesis.setup, test)
+
+        def open_and_setup(node):
+            c = client.open(test, node)
+            c.setup(test)
+            return c
+
+        clients = util.real_pmap(open_and_setup, test.get("nodes") or [])
+        nemesis = nemesis_fut.result()
+    test = {**test, "nemesis": nemesis}
+    try:
+        return interpreter.run(test)
+    finally:
+        with ThreadPoolExecutor(max_workers=1) as pool:
+            td = pool.submit(nemesis.teardown, test)
+
+            def teardown_client(c):
+                try:
+                    c.teardown(test)
+                finally:
+                    c.close(test)
+
+            util.real_pmap(teardown_client, clients)
+            td.result()
+
+
+def analyze(test: dict) -> dict:
+    """Index the history, run the checker (core.clj:221-237)."""
+    log.info("Analyzing...")
+    history = test["history"]
+    if not isinstance(history, History):
+        history = History(history)
+    history = history.index()
+    test = {**test, "history": history}
+    test["results"] = jchecker.check_safe(
+        test.get("checker") or jchecker.unbridled_optimism(),
+        test, history, {})
+    log.info("Analysis complete")
+    return test
+
+
+def log_results(test: dict) -> dict:
+    """core.clj:239-252."""
+    valid = test.get("results", {}).get("valid?")
+    if valid is False:
+        verdict = "Analysis invalid! (ノಥ益ಥ）ノ ┻━┻"
+    elif valid == "unknown":
+        verdict = ("Errors occurred during analysis, "
+                   "but no anomalies found. ಠ~ಠ")
+    else:
+        verdict = "Everything looks good! ヽ('ー`)ノ"
+    log.info("%r\n\n%s", test.get("results"), verdict)
+    return test
+
+
+def run(test: dict) -> dict:
+    """Run a complete test; returns the test map with "history" and
+    "results" (core.clj:327-406). See module docstring for phases."""
+    test = prepare_test(test)
+
+    from . import store
+    writer = store.Writer(test) if test.get("name") else None
+    if writer:
+        test["store_dir"] = writer.dir
+        store.start_logging(test)
+    try:
+        if writer:
+            writer.save_0(test)
+        remote_ctx = control.with_remote(test["remote"]) \
+            if test.get("remote") is not None else None
+        with (remote_ctx or _nullcontext()):
+            with control.with_ssh(test.get("ssh")):
+                with _Sessions(test) as test:
+                    os_obj = test.get("os")
+                    try:
+                        if os_obj:
+                            control.on_nodes(
+                                test, lambda t, n: os_obj.setup(t, n))
+                        try:
+                            if test.get("db"):
+                                jdb.cycle(test)
+                            with util.with_relative_time():
+                                test = {**test,
+                                        "history": run_case(test)}
+                            log.info("Run complete, writing")
+                            if writer:
+                                writer.save_1(test)
+                            snarf_logs(test)
+                        finally:
+                            if test.get("db") and not test.get(
+                                    "leave_db_running?"):
+                                db = test["db"]
+                                control.on_nodes(
+                                    test, lambda t, n: db.teardown(t, n))
+                    finally:
+                        if os_obj:
+                            control.on_nodes(
+                                test, lambda t, n: os_obj.teardown(t, n))
+                    test = analyze(test)
+                    if writer:
+                        writer.save_2(test)
+        return log_results(test)
+    finally:
+        if writer:
+            store.stop_logging()
+            writer.close()
+
+
+class _nullcontext:
+    def __enter__(self):
+        return None
+
+    def __exit__(self, *exc):
+        return False

@@ -15,6 +15,7 @@ from typing import Any, Optional
 
 from . import client as jclient
 from . import checker as jchecker
+from . import nemesis as jnemesis
 
 
 class SharedRegister:
@@ -76,20 +77,8 @@ class AtomClient(jclient.Client):
         self.meta_log.append("close")
 
 
-class NoopNemesis:
+class NoopNemesis(jnemesis.Noop):
     """Accepts every op unchanged."""
-
-    def setup(self, test):
-        return self
-
-    def invoke(self, test, op):
-        return op
-
-    def teardown(self, test):
-        return None
-
-    def fs(self):
-        return set()
 
 
 def noop_test() -> dict:

@@ -1,0 +1,120 @@
+"""OS preparation (parity with jepsen.os + os/{debian,ubuntu,centos},
+`jepsen/src/jepsen/os.clj:4-8` and `os/debian.clj` etc.): hostfile setup,
+package installation, and time sync, run once per node before DB setup."""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional, Sequence
+
+from . import control as c
+from .control import nodeutil as cu
+from .control.core import lit
+
+log = logging.getLogger("jepsen_tpu.os")
+
+
+class OS:
+    """os.clj:4-8."""
+
+    def setup(self, test: dict, node: str) -> None:
+        return None
+
+    def teardown(self, test: dict, node: str) -> None:
+        return None
+
+
+class Noop(OS):
+    """os.clj:10-14."""
+
+
+noop = Noop
+
+
+def setup_hostfile(test: dict, node: str) -> None:
+    """Write /etc/hosts mapping every test node (os/debian.clj:13-31):
+    nodes resolve each other by name even without cluster DNS."""
+    from .control import netinfo
+    lines = ["127.0.0.1 localhost"]
+    for n in test.get("nodes", []):
+        try:
+            lines.append(f"{netinfo.ip(n)} {n}")
+        except Exception:  # noqa: BLE001 - unresolvable in dummy tests
+            continue
+    content = "\n".join(lines) + "\n"
+    with c.su():
+        cu.write_file(content, "/etc/hosts")
+
+
+class Debian(OS):
+    """Debian preparation (os/debian.clj:80-205): hostfile, apt packages,
+    ntp sync."""
+
+    def __init__(self, packages: Sequence[str] = ()):
+        self.packages = list(packages)
+
+    def install(self, pkgs: Sequence[str]) -> None:
+        """Install packages unless already present (os/debian.clj:60-80)."""
+        if not pkgs:
+            return
+        with c.su():
+            c.exec_(c.env({"DEBIAN_FRONTEND": "noninteractive"}),
+                    "apt-get", "install", "-y", "--force-yes", *pkgs)
+
+    def installed(self, pkg: str) -> bool:
+        try:
+            c.exec_("dpkg", "-s", pkg)
+            return True
+        except Exception:  # noqa: BLE001
+            return False
+
+    def install_jdk(self) -> None:
+        """os/debian.clj:153-170."""
+        self.install(["openjdk-17-jdk-headless"])
+
+    def setup(self, test, node):
+        log.info("Setting up debian on %s", node)
+        setup_hostfile(test, node)
+        with c.su():
+            cu.meh(c.exec_, "apt-get", "update")
+        self.install(["curl", "wget", "unzip", "iptables", "psmisc",
+                      "tar", "bzip2", "ntpdate", "faketime", "rsyslog",
+                      "logrotate"] + self.packages)
+        with c.su():
+            cu.meh(c.exec_, "service", "ntp", "stop")
+            cu.meh(c.exec_, "ntpdate", "-p", "1", "-b",
+                   "pool.ntp.org")
+
+
+debian = Debian
+
+
+class Ubuntu(Debian):
+    """os/ubuntu.clj — identical shape to debian."""
+
+
+ubuntu = Ubuntu
+
+
+class CentOS(OS):
+    """CentOS preparation (os/centos.clj)."""
+
+    def __init__(self, packages: Sequence[str] = ()):
+        self.packages = list(packages)
+
+    def install(self, pkgs: Sequence[str]) -> None:
+        if not pkgs:
+            return
+        with c.su():
+            c.exec_("yum", "install", "-y", *pkgs)
+
+    def setup(self, test, node):
+        log.info("Setting up centos on %s", node)
+        setup_hostfile(test, node)
+        self.install(["curl", "wget", "unzip", "iptables", "psmisc",
+                      "tar", "bzip2", "ntpdate"] + self.packages)
+        with c.su():
+            cu.meh(c.exec_, "ntpdate", "-p", "1", "-b", "pool.ntp.org")
+
+
+centos = CentOS

@@ -1,0 +1,51 @@
+// Shift the system wall clock by a delta, in milliseconds.
+//
+// TPU-framework C++ port of the reference's clock-bump tool
+// (jepsen/resources/bump-time.c, driven from jepsen/src/jepsen/nemesis/
+// time.clj:86-90): used by the clock nemesis to introduce clock skew on
+// DB nodes. Prints the new wall-clock time in fractional POSIX seconds.
+//
+// usage: bump-time <delta-ms>   (requires CAP_SYS_TIME / root)
+
+#include <cinttypes>
+#include <cstdio>
+#include <cstdlib>
+#include <ctime>
+
+int main(int argc, char **argv) {
+  if (argc < 2) {
+    std::fprintf(stderr, "usage: %s <delta>, where delta is in ms\n", argv[0]);
+    return 1;
+  }
+
+  const double delta_ms = std::atof(argv[1]);
+  const int64_t delta_ns = static_cast<int64_t>(delta_ms * 1e6);
+
+  timespec ts;
+  if (clock_gettime(CLOCK_REALTIME, &ts) != 0) {
+    std::perror("clock_gettime");
+    return 1;
+  }
+
+  int64_t ns = ts.tv_nsec + delta_ns % 1000000000;
+  int64_t s = ts.tv_sec + delta_ns / 1000000000;
+  // Renormalize so tv_nsec lands in [0, 1e9).
+  if (ns >= 1000000000) {
+    ns -= 1000000000;
+    s += 1;
+  } else if (ns < 0) {
+    ns += 1000000000;
+    s -= 1;
+  }
+  ts.tv_sec = static_cast<time_t>(s);
+  ts.tv_nsec = static_cast<long>(ns);
+
+  if (clock_settime(CLOCK_REALTIME, &ts) != 0) {
+    std::perror("clock_settime");
+    return 1;
+  }
+
+  std::printf("%" PRId64 ".%09ld\n", static_cast<int64_t>(ts.tv_sec),
+              ts.tv_nsec);
+  return 0;
+}

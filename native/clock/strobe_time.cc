@@ -1,0 +1,81 @@
+// Strobe the system wall clock back and forth.
+//
+// TPU-framework C++ port of the reference's clock-strobe tool
+// (jepsen/resources/strobe-time.c, driven from jepsen/src/jepsen/nemesis/
+// time.clj:92-96): flips the clock by +/- delta every period, for
+// duration seconds — a brutal fault for leases and timeouts.
+//
+// usage: strobe-time <delta-ms> <period-ms> <duration-s>
+
+#include <cstdio>
+#include <cstdlib>
+#include <ctime>
+
+namespace {
+
+// Add `delta_ns` to the realtime clock.
+int shift_clock(int64_t delta_ns) {
+  timespec ts;
+  if (clock_gettime(CLOCK_REALTIME, &ts) != 0) {
+    std::perror("clock_gettime");
+    return 1;
+  }
+  int64_t ns = ts.tv_nsec + delta_ns % 1000000000;
+  int64_t s = ts.tv_sec + delta_ns / 1000000000;
+  if (ns >= 1000000000) {
+    ns -= 1000000000;
+    s += 1;
+  } else if (ns < 0) {
+    ns += 1000000000;
+    s -= 1;
+  }
+  ts.tv_sec = static_cast<time_t>(s);
+  ts.tv_nsec = static_cast<long>(ns);
+  if (clock_settime(CLOCK_REALTIME, &ts) != 0) {
+    std::perror("clock_settime");
+    return 1;
+  }
+  return 0;
+}
+
+}  // namespace
+
+int main(int argc, char **argv) {
+  if (argc < 4) {
+    std::fprintf(stderr,
+                 "usage: %s <delta-ms> <period-ms> <duration-s>\n"
+                 "Strobes the clock +/- delta every period, for duration.\n",
+                 argv[0]);
+    return 1;
+  }
+
+  const int64_t delta_ns =
+      static_cast<int64_t>(std::atof(argv[1]) * 1e6);
+  const int64_t period_ns =
+      static_cast<int64_t>(std::atof(argv[2]) * 1e6);
+  const double duration_s = std::atof(argv[3]);
+
+  // Track elapsed time with the monotonic clock: the realtime clock is
+  // the thing we're mangling.
+  timespec start;
+  clock_gettime(CLOCK_MONOTONIC, &start);
+
+  const timespec nap = {static_cast<time_t>(period_ns / 1000000000),
+                        static_cast<long>(period_ns % 1000000000)};
+  bool up = true;
+  while (true) {
+    timespec now;
+    clock_gettime(CLOCK_MONOTONIC, &now);
+    const double elapsed = (now.tv_sec - start.tv_sec) +
+                           (now.tv_nsec - start.tv_nsec) / 1e9;
+    if (elapsed >= duration_s) break;
+    if (shift_clock(up ? delta_ns : -delta_ns) != 0) return 1;
+    up = !up;
+    nanosleep(&nap, nullptr);
+  }
+
+  // Leave the clock where it started: an even number of flips cancels;
+  // if we ended mid-flip, undo the last shift.
+  if (!up) shift_clock(-delta_ns);
+  return 0;
+}

@@ -1,0 +1,162 @@
+"""Full run() lifecycle tests with the dummy remote and in-process
+fakes — the style of jepsen/test/jepsen/core_test.clj: the entire
+pipeline (sessions -> OS -> DB -> generator/interpreter -> checker ->
+store) runs in-process with no cluster."""
+
+import os
+
+import pytest
+
+from jepsen_tpu import checker, client as jclient, core, db as jdb, fakes
+from jepsen_tpu import generator as gen
+from jepsen_tpu import models, net as jnet
+from jepsen_tpu import os_setup
+from jepsen_tpu.control import dummy
+
+
+class RecordingDB(jdb.DB, jdb.Primary, jdb.LogFiles):
+    def __init__(self):
+        self.events = []
+
+    def setup(self, test, node):
+        self.events.append(("setup", node))
+
+    def teardown(self, test, node):
+        self.events.append(("teardown", node))
+
+    def setup_primary(self, test, node):
+        self.events.append(("setup-primary", node))
+
+    def primaries(self, test):
+        return [test["nodes"][0]]
+
+    def log_files(self, test, node):
+        return []
+
+
+class RecordingOS(os_setup.OS):
+    def __init__(self):
+        self.events = []
+
+    def setup(self, test, node):
+        self.events.append(("os-setup", node))
+
+    def teardown(self, test, node):
+        self.events.append(("os-teardown", node))
+
+
+def base_test(tmp_path, **kw):
+    reg = fakes.SharedRegister()
+    return {
+        "name": "cas-demo",
+        "store_root": str(tmp_path / "store"),
+        "nodes": ["n1", "n2", "n3"],
+        "concurrency": 3,
+        "ssh": {"dummy?": True},
+        "os": RecordingOS(),
+        "db": RecordingDB(),
+        "net": jnet.noop(),
+        "client": fakes.AtomClient(reg),
+        "nemesis": fakes.NoopNemesis(),
+        "checker": checker.linearizable(models.cas_register(),
+                                        algorithm="wgl"),
+        "generator": gen.limit(30, gen.clients(gen.mix(
+            [gen.repeat(lambda: {"f": "read"}),
+             gen.repeat(lambda: {"f": "write",
+                                 "value": gen.RNG.randrange(5)}),
+             gen.repeat(lambda: {"f": "cas",
+                                 "value": [gen.RNG.randrange(5),
+                                           gen.RNG.randrange(5)]})]))),
+        **kw,
+    }
+
+
+def test_full_run_valid(tmp_path):
+    t = base_test(tmp_path)
+    res = core.run(t)
+    assert res["results"]["valid?"] is True
+    assert len(res["history"]) == 60
+    # os + db lifecycle hit every node, teardown-then-setup ordering
+    db_events = t["db"].events
+    assert ("setup", "n1") in db_events
+    assert ("setup-primary", "n1") in db_events
+    assert db_events.index(("teardown", "n1")) < db_events.index(
+        ("setup", "n1"))
+    assert ("os-setup", "n2") in t["os"].events
+    # store artifacts written
+    d = core.prepare_test(t)
+    from jepsen_tpu import store
+    run_dir = os.path.join(t["store_root"], "cas-demo")
+    runs = os.listdir(run_dir)
+    assert any(r != "latest" for r in runs)
+    latest = store.latest(t["store_root"])
+    assert os.path.exists(os.path.join(latest, "test.jepsen"))
+    assert os.path.exists(os.path.join(latest, "results.json"))
+    assert os.path.exists(os.path.join(latest, "jepsen.log"))
+
+
+def test_run_detects_lying_client(tmp_path):
+    class LyingClient(jclient.Client):
+        def open(self, test, node):
+            return self
+
+        def invoke(self, test, op):
+            if op["f"] == "read":
+                return {**op, "type": "ok", "value": 99}
+            return {**op, "type": "ok"}
+
+    t = base_test(tmp_path, client=LyingClient(), name="liar")
+    res = core.run(t)
+    assert res["results"]["valid?"] is False
+
+
+def test_setup_failed_retries(tmp_path):
+    class FlakyDB(RecordingDB):
+        def __init__(self):
+            super().__init__()
+            self.failures = 2
+
+        def setup(self, test, node):
+            super().setup(test, node)
+            if node == "n1" and self.failures > 0:
+                self.failures -= 1
+                raise jdb.SetupFailed("not yet")
+
+    t = base_test(tmp_path, db=FlakyDB(), name="flaky")
+    res = core.run(t)
+    assert res["results"]["valid?"] is True
+    setups = [e for e in t["db"].events if e == ("setup", "n1")]
+    assert len(setups) == 3  # two failures + one success
+
+
+def test_setup_failed_exhausts(tmp_path):
+    class DoomedDB(RecordingDB):
+        def setup(self, test, node):
+            raise jdb.SetupFailed("never")
+
+    t = base_test(tmp_path, db=DoomedDB(), name="doomed")
+    with pytest.raises(jdb.SetupFailed):
+        core.run(t)
+
+
+def test_client_lifecycle_called(tmp_path):
+    meta = []
+    reg = fakes.SharedRegister()
+    t = base_test(tmp_path, client=fakes.AtomClient(reg, meta),
+                  name="lifecycle")
+    core.run(t)
+    assert "open" in meta and "setup" in meta
+    assert "teardown" in meta and "close" in meta
+
+
+def test_interesting_exception_propagates(tmp_path):
+    """Exceptions from DB setup beat broken-barrier noise
+    (core_test.clj:43-60 analog)."""
+    class ExplodingDB(RecordingDB):
+        def setup(self, test, node):
+            if node == "n2":
+                raise RuntimeError("disk on fire")
+
+    t = base_test(tmp_path, db=ExplodingDB(), name="explode")
+    with pytest.raises(RuntimeError, match="disk on fire"):
+        core.run(t)

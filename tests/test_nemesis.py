@@ -1,0 +1,232 @@
+"""Nemesis tests: grudge algebra (pure), partitioners/compose/f_map
+against the dummy remote, node-spec targeting."""
+
+import pytest
+
+from jepsen_tpu import control as c
+from jepsen_tpu import db as jdb
+from jepsen_tpu import nemesis as n
+from jepsen_tpu import net as jnet
+from jepsen_tpu.control import dummy
+from jepsen_tpu.nemesis import combined
+from jepsen_tpu.util import majority
+
+
+NODES = ["n1", "n2", "n3", "n4", "n5"]
+
+
+# --- grudge algebra (pure) -------------------------------------------------
+
+def test_bisect():
+    assert n.bisect(NODES) == [["n1", "n2"], ["n3", "n4", "n5"]]
+
+
+def test_split_one():
+    loner, rest = n.split_one(NODES, loner="n3")
+    assert loner == ["n3"]
+    assert rest == ["n1", "n2", "n4", "n5"]
+
+
+def test_complete_grudge():
+    g = n.complete_grudge(n.bisect(NODES))
+    assert g["n1"] == {"n3", "n4", "n5"}
+    assert g["n4"] == {"n1", "n2"}
+
+
+def test_bridge():
+    g = n.bridge(NODES)
+    # n3 is the bridge: snubs nobody, nobody snubs it
+    assert "n3" not in g
+    assert g["n1"] == {"n4", "n5"}
+    assert g["n4"] == {"n1", "n2"}
+
+
+def test_majorities_ring_five():
+    g = n.majorities_ring(NODES)
+    m = majority(len(NODES))
+    # every node is cut off from at most n - majority nodes
+    for node, cut in g.items():
+        assert len(cut) <= len(NODES) - m
+        assert node not in cut
+
+
+def test_majorities_ring_large():
+    nodes = [f"n{i}" for i in range(9)]
+    g = n.majorities_ring(nodes)
+    m = majority(len(nodes))
+    for node, cut in g.items():
+        visible = len(nodes) - len(cut)
+        assert visible >= m, f"{node} sees only {visible}"
+
+
+def test_invert_grudge():
+    conns = {"a": {"a", "b"}, "b": {"a", "b"}, "c": {"c"}}
+    g = n.invert_grudge(["a", "b", "c"], conns)
+    assert g["a"] == {"c"}
+    assert g["c"] == {"a", "b"}
+
+
+# --- partitioner against dummy net ----------------------------------------
+
+class RecordingNet(jnet.Net, jnet.PartitionAll):
+    def __init__(self):
+        self.events = []
+
+    def heal(self, test):
+        self.events.append("heal")
+
+    def drop_all(self, test, grudge):
+        self.events.append(("drop_all", grudge))
+
+
+def make_test(**kw):
+    return {"nodes": list(NODES), "net": RecordingNet(),
+            "sessions": {}, **kw}
+
+
+def test_partitioner_start_stop():
+    t = make_test()
+    p = n.partition_random_halves().setup(t)
+    res = p.invoke(t, {"f": "start", "process": "nemesis"})
+    assert res["type"] == "info"
+    assert res["value"][0] == "isolated"
+    assert any(isinstance(e, tuple) and e[0] == "drop_all"
+               for e in t["net"].events)
+    res = p.invoke(t, {"f": "stop", "process": "nemesis"})
+    assert res["value"] == "network-healed"
+
+
+def test_partitioner_explicit_grudge():
+    t = make_test()
+    g = {"n1": {"n2"}}
+    p = n.partitioner().setup(t)
+    res = p.invoke(t, {"f": "start", "process": "nemesis", "value": g})
+    assert ("drop_all", g) in t["net"].events
+
+
+# --- composition -----------------------------------------------------------
+
+class FakeNemesis(n.Nemesis):
+    def __init__(self, fs, log=None):
+        self._fs = set(fs)
+        self.log = log if log is not None else []
+
+    def invoke(self, test, op):
+        self.log.append(op["f"])
+        return {**op, "type": "info"}
+
+    def fs(self):
+        return set(self._fs)
+
+
+def test_compose_reflection():
+    log1, log2 = [], []
+    comp = n.compose([FakeNemesis({"a", "b"}, log1),
+                      FakeNemesis({"c"}, log2)])
+    comp.invoke({}, {"f": "a", "process": "nemesis"})
+    comp.invoke({}, {"f": "c", "process": "nemesis"})
+    assert log1 == ["a"] and log2 == ["c"]
+    assert comp.fs() == {"a", "b", "c"}
+    with pytest.raises(ValueError):
+        comp.invoke({}, {"f": "zzz"})
+
+
+def test_compose_conflicting_fs():
+    with pytest.raises(AssertionError):
+        n.compose([FakeNemesis({"a"}), FakeNemesis({"a"})])
+
+
+def test_compose_map_routing():
+    log = []
+    comp = n.compose({frozenset({"x", "y"}): FakeNemesis({"x", "y"}, log)})
+    res = comp.invoke({}, {"f": "x", "process": "nemesis"})
+    assert res["f"] == "x" and log == ["x"]
+
+
+def test_f_map():
+    log = []
+    fm = n.f_map(lambda f: ("lifted", f), FakeNemesis({"start", "stop"}, log))
+    assert fm.fs() == {("lifted", "start"), ("lifted", "stop")}
+    res = fm.invoke({}, {"f": ("lifted", "start"), "process": "nemesis"})
+    assert log == ["start"]
+    assert res["f"] == ("lifted", "start")
+
+
+# --- combined packages -----------------------------------------------------
+
+class KillableDB(jdb.DB, jdb.Process, jdb.Pause):
+    def __init__(self):
+        self.events = []
+
+    def start(self, test, node):
+        self.events.append(("start", node))
+        return "started"
+
+    def kill(self, test, node):
+        self.events.append(("kill", node))
+        return "killed"
+
+    def pause(self, test, node):
+        self.events.append(("pause", node))
+        return "paused"
+
+    def resume(self, test, node):
+        self.events.append(("resume", node))
+        return "resumed"
+
+
+def dummy_sessions(nodes):
+    r = dummy.remote()
+    return {node: r.connect({"host": node}) for node in nodes}
+
+
+def test_db_nodes_specs():
+    t = {"nodes": NODES}
+    db = KillableDB()
+    assert combined.db_nodes(t, db, "all") == NODES
+    assert len(combined.db_nodes(t, db, "one")) == 1
+    assert len(combined.db_nodes(t, db, "majority")) == 3
+    assert len(combined.db_nodes(t, db, "minority")) == 2
+    assert len(combined.db_nodes(t, db, "minority-third")) == 1
+    assert combined.db_nodes(t, db, ["n2"]) == ["n2"]
+    assert 1 <= len(combined.db_nodes(t, db, None)) <= 5
+
+
+def test_db_nemesis_kill():
+    db = KillableDB()
+    t = {"nodes": NODES, "sessions": dummy_sessions(NODES)}
+    nem = combined.DBNemesis(db)
+    res = nem.invoke(t, {"f": "kill", "process": "nemesis", "value": "all"})
+    assert res["type"] == "info"
+    assert {e[0] for e in db.events} == {"kill"}
+    assert len(db.events) == 5
+
+
+def test_nemesis_package_composition():
+    db = KillableDB()
+    pkg = combined.nemesis_package({
+        "db": db, "faults": ["partition", "kill", "pause"], "interval": 1})
+    assert pkg["generator"] is not None
+    assert pkg["nemesis"].fs() >= {"start", "kill", "pause", "resume",
+                                  "start-partition", "stop-partition"}
+    # final generators heal everything
+    finals = pkg["final_generator"]
+    fs = set()
+    for g in finals:
+        if isinstance(g, list):
+            fs |= {x["f"] for x in g}
+        elif isinstance(g, dict):
+            fs.add(g["f"])
+    assert "start" in fs and "resume" in fs
+
+
+def test_package_generator_emits_lifted_ops():
+    from jepsen_tpu import generator as gen
+    from jepsen_tpu.generator import testlib as gt
+    db = KillableDB()
+    pkg = combined.partition_package(
+        {"db": db, "faults": {"partition"}, "interval": 1e-9})
+    out = gt.quick_ops(gen.limit(6, gen.nemesis(pkg["generator"])))
+    fs = [o["f"] for o in out if o["type"] == "info"]
+    assert set(fs) <= {"start-partition", "stop-partition"}
+    assert fs[0] == "start-partition"

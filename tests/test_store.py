@@ -1,0 +1,143 @@
+"""Store tests: block-format round trips, lazy reads, crash recovery,
+GC — the style of jepsen/test/jepsen/store{,/format}_test.clj."""
+
+import json
+import os
+import struct
+
+import pytest
+
+from jepsen_tpu.store import Writer, latest, load, path, serializable_test
+from jepsen_tpu.store import tests as stored_tests
+from jepsen_tpu.store.format import (CorruptFile, JepsenFile, LazyTest,
+                                     MAGIC)
+
+
+def make_test(tmp_path, **kw):
+    return {"name": "demo", "start_time": "20260729T120000",
+            "store_root": str(tmp_path / "store"), "nodes": ["n1"],
+            "concurrency": 2, **kw}
+
+
+HISTORY = [
+    {"type": "invoke", "f": "write", "process": 0, "value": 1, "time": 0,
+     "index": 0},
+    {"type": "ok", "f": "write", "process": 0, "value": 1, "time": 5,
+     "index": 1},
+]
+
+
+def test_block_file_roundtrip(tmp_path):
+    p = str(tmp_path / "t.jepsen")
+    jf = JepsenFile(p, "w")
+    jf.write_initial_test({"name": "x", "concurrency": 4})
+    jf.write_history({"name": "x"}, ops=HISTORY)
+    jf.write_results({"name": "x"}, {"valid?": True, "count": 2})
+    jf.close()
+
+    jf = JepsenFile(p)
+    t = jf.read_test(lazy=False)
+    assert t["name"] == "x"
+    assert t["history"] == HISTORY
+    assert t["results"]["valid?"] is True
+    assert t["results"]["count"] == 2
+
+
+def test_lazy_read_skips_history(tmp_path):
+    p = str(tmp_path / "t.jepsen")
+    jf = JepsenFile(p, "w")
+    jf.write_history({"name": "x"}, ops=HISTORY)
+    jf.write_results({"name": "x"}, {"valid?": False, "huge": list(range(
+        1000))})
+    jf.close()
+
+    jf = JepsenFile(p)
+    # valid? loads without touching history or the full results
+    assert jf.read_valid() is False
+    t = jf.read_test()
+    assert isinstance(t, LazyTest)
+    assert t["history"][0]["f"] == "write"
+
+
+def test_incremental_history_chunks(tmp_path):
+    p = str(tmp_path / "t.jepsen")
+    jf = JepsenFile(p, "w")
+    c1 = jf.append_history_chunk(HISTORY[:1])
+    c2 = jf.append_history_chunk(HISTORY[1:])
+    jf.write_history({"name": "x"}, chunk_ids=[c1, c2])
+    jf.close()
+    assert JepsenFile(p).read_test(lazy=False)["history"] == HISTORY
+
+
+def test_crash_recovery_truncated_tail(tmp_path):
+    """A torn trailing write must not lose the last save point
+    (format.clj:140-150: history commits before analysis)."""
+    p = str(tmp_path / "t.jepsen")
+    jf = JepsenFile(p, "w")
+    jf.write_history({"name": "x"}, ops=HISTORY)
+    jf.close()
+    good_size = os.path.getsize(p)
+    # simulate a crash mid-append: garbage after the last save point
+    with open(p, "ab") as fh:
+        fh.write(b"\x00" * 17)
+    jf = JepsenFile(p)
+    assert jf.read_test(lazy=False)["history"] == HISTORY
+
+
+def test_checksum_detects_corruption(tmp_path):
+    p = str(tmp_path / "t.jepsen")
+    jf = JepsenFile(p, "w")
+    jf.write_history({"name": "x"}, ops=HISTORY)
+    jf.close()
+    # flip a byte inside a block payload
+    with open(p, "r+b") as fh:
+        fh.seek(len(MAGIC) + 8 + 20)
+        b = fh.read(1)
+        fh.seek(-1, os.SEEK_CUR)
+        fh.write(bytes([b[0] ^ 0xFF]))
+    with pytest.raises(CorruptFile):
+        JepsenFile(p).read_test(lazy=False)
+
+
+def test_gc_drops_stale_blocks(tmp_path):
+    p = str(tmp_path / "t.jepsen")
+    jf = JepsenFile(p, "a")
+    for i in range(20):
+        jf.write_results({"name": "x", "i": i}, {"valid?": True, "i": i})
+    size_before = os.path.getsize(p)
+    jf.gc()
+    size_after = os.path.getsize(p)
+    assert size_after < size_before
+    t = jf.read_test(lazy=False)
+    assert t["results"]["i"] == 19
+    jf.close()
+
+
+def test_writer_three_phase(tmp_path):
+    t = make_test(tmp_path)
+    w = Writer(t)
+    w.save_0(t)
+    t2 = {**t, "history": HISTORY}
+    w.save_1(t2)
+    t3 = {**t2, "results": {"valid?": True}}
+    w.save_2(t3)
+    w.close()
+    d = path(t)
+    assert sorted(os.listdir(d)) == ["history.jsonl", "history.txt",
+                                     "results.json", "test.jepsen"]
+    loaded = load("demo", "20260729T120000", store_root=t["store_root"])
+    assert loaded["results"]["valid?"] is True
+    assert loaded["history"] == HISTORY
+    # symlinks maintained
+    assert os.path.islink(os.path.join(t["store_root"], "latest"))
+    assert latest(t["store_root"]).endswith("20260729T120000")
+    assert "demo" in stored_tests(t["store_root"])
+
+
+def test_serializable_test_drops_live_objects(tmp_path):
+    t = make_test(tmp_path, client=object(), db=object(),
+                  nonserializable_keys=["secret"])
+    t["secret"] = object()
+    s = serializable_test(t)
+    assert "client" not in s and "db" not in s and "secret" not in s
+    assert s["name"] == "demo"
