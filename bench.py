@@ -1,0 +1,87 @@
+#!/usr/bin/env python
+"""Headline benchmark: decide a 10k-op cas-register history on the TPU.
+
+The north star (BASELINE.md): JVM Knossos-WGL *times out* at the 60 s
+budget on a 10k-op single-key cas-register history; this framework must
+decide it in under 60 s. The history is an etcd-style concurrent run (5
+worker processes, r/w/cas over 5 values, sparse crashes) produced by the
+deterministic synthesizer, checked by the lockstep-frontier WGL kernel
+(`jepsen_tpu.ops.wgl`, bitmask fast path).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": seconds, "unit": "s", "vs_baseline": x}
+
+value      = wall seconds to a definitive verdict, compile-warm (the
+             steady-state cost of checking a fresh history of this
+             shape; cold/compile time is reported alongside).
+vs_baseline = 60 / value — how many times faster than the reference's
+             60 s budget, at which it DNFs.
+
+Env knobs: JEPSEN_TPU_BENCH_OPS (default 10000),
+JEPSEN_TPU_BENCH_BUDGET_S (default 120 per attempt).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+
+def main() -> int:
+    n_ops = int(os.environ.get("JEPSEN_TPU_BENCH_OPS", "10000"))
+    budget = float(os.environ.get("JEPSEN_TPU_BENCH_BUDGET_S", "120"))
+
+    import jax
+
+    # For CI hosts without a working accelerator: JEPSEN_TPU_BENCH_PLATFORM
+    # =cpu pins the backend via jax.config (the env var alone can be
+    # overridden by site customization that pre-imports jax).
+    plat = os.environ.get("JEPSEN_TPU_BENCH_PLATFORM")
+    if plat:
+        jax.config.update("jax_platforms", plat)
+
+    from jepsen_tpu.models import cas_register
+    from jepsen_tpu.ops import wgl
+    from jepsen_tpu.synth import cas_register_history
+
+    print(f"platform: {jax.devices()}", file=sys.stderr)
+    hist = cas_register_history(n_ops, n_procs=5, seed=42, crash_p=0.002)
+    print(f"history: {len(hist)} events ({n_ops} invocations)",
+          file=sys.stderr)
+
+    model = cas_register()
+    t0 = time.monotonic()
+    res_cold = wgl.check(model, hist, time_limit=budget)
+    cold_s = time.monotonic() - t0
+    print(f"cold (incl compile): {cold_s:.2f}s -> {res_cold}",
+          file=sys.stderr)
+
+    if res_cold.get("valid?") == "unknown":
+        # Did not finish within budget: report the cold attempt as the
+        # value so the regression is visible.
+        out = {"metric": f"cas_register_{n_ops//1000}k_wgl_wall_s",
+               "value": round(cold_s, 3), "unit": "s",
+               "vs_baseline": round(60.0 / cold_s, 3),
+               "verdict": "unknown", "cause": res_cold.get("cause")}
+        print(json.dumps(out))
+        return 1
+
+    t0 = time.monotonic()
+    res = wgl.check(model, hist, time_limit=budget)
+    warm_s = time.monotonic() - t0
+    print(f"warm: {warm_s:.2f}s -> {res}", file=sys.stderr)
+
+    out = {"metric": f"cas_register_{n_ops//1000}k_wgl_wall_s",
+           "value": round(warm_s, 3), "unit": "s",
+           "vs_baseline": round(60.0 / warm_s, 3),
+           "verdict": res.get("valid?"),
+           "cold_s": round(cold_s, 3),
+           "configs_explored": res.get("configs_explored")}
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

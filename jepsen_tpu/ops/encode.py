@@ -101,6 +101,7 @@ class Encoded:
     table: np.ndarray      # (S, O) i32 transition table
     states: list           # state index -> model object
     window: int            # W, multiple of 32
+    window_raw: int        # exact W requirement before padding
     lin_ops: list          # LinOp list (ok ops then info ops), for reporting
 
 
@@ -186,4 +187,4 @@ def encode(model: Model, history: History, max_window: int = 256,
     return Encoded(n_ok=n, n_info=ni, inv=inv, ret=ret, opcode=opc,
                    sufminret=suf, inv_info=iinv, opcode_info=iopc,
                    table=tpad, states=states, window=W,
-                   lin_ops=ok_ops + info_ops)
+                   window_raw=w_needed, lin_ops=ok_ops + info_ops)

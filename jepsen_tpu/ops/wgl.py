@@ -322,13 +322,25 @@ def _compiled_search(n_pad: int, ic_pad: int, W: int, S: int, O: int,
 # Host driver
 # ---------------------------------------------------------------------------
 
+def _pad_to_mult(n: int, m: int) -> int:
+    return max(m, ((n + m - 1) // m) * m)
+
+
 def _pick_capacities(W: int, ic_pad: int, n: int):
     """Frontier capacity K and memo-table size H scaled to the problem.
-    The (K, W, 2W) successor intermediate is the memory driver."""
+    The (K, W, 2W) successor intermediate is the memory driver for the
+    general kernel; the memo table must stay well under ~60% load or
+    probe-based dedup degrades into re-exploration (each slot is 16
+    bytes, so even 2^23 slots is only 128 MB)."""
     budget = 32 * 1024 * 1024  # bool elements
     K = max(256, min(4096, budget // max(1, 2 * W * W)))
     K = 1 << (K.bit_length() - 1)
-    H = 1 << 21 if n > 2000 else 1 << 18
+    if n > 5000:
+        H = 1 << 23
+    elif n > 2000:
+        H = 1 << 22
+    else:
+        H = 1 << 19
     B = 1 << 16
     return K, H, B
 
@@ -360,19 +372,41 @@ def check(model: Model, history: History, time_limit: Optional[float] = None,
 
     W = enc.window
     ic_pad = len(enc.inv_info)
+    K, H, B = _pick_capacities(W, ic_pad, n)
+    if enc.window_raw <= 32:
+        # Fast-path sweet spot (measured on 10k-op cas-register
+        # histories): narrow frontiers explore far fewer redundant
+        # configs and per-round cost scales with K, so depth-first-ish
+        # beats breadth. Valid histories exit early; exhaustion cost is
+        # roughly K-independent.
+        K = 256
     if frontier:
-        K, H, B = frontier, 1 << 18, 1 << 14
-    else:
-        K, H, B = _pick_capacities(W, ic_pad, n)
+        K = frontier  # override breadth only; the memo table must still
+        #               fit the config space (see _pick_capacities)
     chunk = 2048
-    init_fn, chunk_jit = _compiled_search(
-        n_pad=len(enc.inv), ic_pad=ic_pad, W=W,
-        S=enc.table.shape[0], O=enc.table.shape[1],
-        K=K, H=H, B=B, chunk=chunk, probes=16)
+    iinv, iopc = enc.inv_info, enc.opcode_info
+    if enc.window_raw <= 32:
+        # Bitmask fast path: window in one uint32 lane, sort-free dedup.
+        # Successor-row count R = K*(W_eff + ic_eff) drives probe traffic
+        # (the dominant cost), so materialize only what the history needs.
+        from .wgl32 import compiled_search32
+        W_eff = max(8, _pad_to_mult(enc.window_raw, 8))
+        ic_eff = max(8, _pad_to_mult(enc.n_info, 8))
+        ic_eff = min(ic_eff, ic_pad)
+        iinv, iopc = iinv[:ic_eff], iopc[:ic_eff]
+        init_fn, chunk_jit = compiled_search32(
+            n_pad=len(enc.inv), ic_pad=ic_eff,
+            S=enc.table.shape[0], O=enc.table.shape[1],
+            K=K, H=H, B=B, chunk=chunk, probes=4, W=W_eff)
+    else:
+        init_fn, chunk_jit = _compiled_search(
+            n_pad=len(enc.inv), ic_pad=ic_pad, W=W,
+            S=enc.table.shape[0], O=enc.table.shape[1],
+            K=K, H=H, B=B, chunk=chunk, probes=16)
 
     consts = (jnp.asarray(enc.inv), jnp.asarray(enc.ret),
               jnp.asarray(enc.opcode), jnp.asarray(enc.sufminret),
-              jnp.asarray(enc.inv_info), jnp.asarray(enc.opcode_info),
+              jnp.asarray(iinv), jnp.asarray(iopc),
               jnp.asarray(enc.table), jnp.int32(n), jnp.int32(enc.n_info),
               jnp.int32(min(max_configs, 2**31 - 1)))
     carry = init_fn(0)

@@ -1,0 +1,290 @@
+"""Bitmask fast-path WGL kernel (windows ≤ 32 ok-ops wide).
+
+The general kernel (`wgl.py`) keeps the linearized-window as a (K, W)
+bool tensor and renormalizes configs with (K, W, 2W) gather machinery;
+profiling showed those gathers plus the 3-key successor sort dominate
+per-round time. Real Jepsen histories have small concurrency, so the
+exact window bound W (encode.py) is almost always ≤ 32 — and a window
+that fits one uint32 lane turns the whole successor construction into
+elementwise bit arithmetic:
+
+  * set bit j:        win' = win | (1 << j)
+  * renormalize:      t = count-trailing-ones(win'), base += t,
+                      win' >>= t        (ctz via popcount((x & -x) - 1))
+  * crashed-op masks: one uint32 word per 32 info ops
+
+Dedup drops the sort entirely: every successor probes the memo hash
+table directly, and racing twins (two parents producing the same config
+in one round) are detected at insert time — the loser re-reads the slot
+it just contended for and sees its own signature with a different row
+id, i.e. "seen". Per-round work is a few (K, 32) gathers, elementwise
+u32 math, and `probes` gather/scatter rounds on the table.
+
+Same consts/carry contract as `wgl._build_search`, so the host driver
+and the batched mesh path dispatch between kernels by window width.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+INF = np.int32(2**31 - 1)
+
+
+def _popcount32(x):
+    """Bit population count for uint32 lanes."""
+    import jax.numpy as jnp
+    x = x - ((x >> 1) & jnp.uint32(0x55555555))
+    x = (x & jnp.uint32(0x33333333)) + ((x >> 2) & jnp.uint32(0x33333333))
+    x = (x + (x >> 4)) & jnp.uint32(0x0F0F0F0F)
+    return (x * jnp.uint32(0x01010101)) >> 24
+
+
+def _ctz32(x):
+    """Count trailing zeros; 32 for x == 0."""
+    import jax.numpy as jnp
+    low = x & (~x + jnp.uint32(1))  # lowest set bit (two's complement)
+    return jnp.where(x == 0, jnp.uint32(32), _popcount32(low - jnp.uint32(1)))
+
+
+def _fnv_words(words, seed):
+    import jax.numpy as jnp
+    h = jnp.full_like(words[0], jnp.uint32(seed))
+    prime = jnp.uint32(16777619)
+    for w in words:
+        h = (h ^ w) * prime
+        h = h ^ (h >> 15)
+    return h
+
+
+def _build_search32(n_pad: int, ic_pad: int, S: int, O: int,
+                    K: int, H: int, B: int, chunk: int, probes: int,
+                    W: int = 32):
+    """Build (init_fn, chunk_fn) for the W<=32 bitmask kernel. `W` is the
+    window width actually materialized (pad the exact requirement to a
+    small multiple — successor row count R = K*(W + ic_pad) drives probe
+    traffic, the kernel's dominant cost). Crashed-op masks use
+    ceil(ic_pad/32) uint32 words."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    assert 1 <= W <= 32
+    Il = max(1, (ic_pad + 31) // 32)
+
+    # Host-precomputed per-info-op word/bit masks: setting info op m.
+    info_word = np.arange(ic_pad) // 32                     # (ic,)
+    info_bit = (np.uint32(1) << (np.arange(ic_pad) % 32))   # (ic,)
+    info_set_mask = np.zeros((ic_pad, Il), dtype=np.uint32)
+    info_set_mask[np.arange(ic_pad), info_word] = info_bit
+
+    def init_fn(mstate0):
+        fr_base = jnp.zeros(K, dtype=jnp.int32)
+        fr_win = jnp.zeros(K, dtype=jnp.uint32)
+        fr_info = jnp.zeros((K, Il), dtype=jnp.uint32)
+        fr_mst = jnp.zeros(K, dtype=jnp.int32).at[0].set(mstate0)
+        fr_cnt = jnp.int32(1)
+        bk_base = jnp.zeros(B, dtype=jnp.int32)
+        bk_win = jnp.zeros(B, dtype=jnp.uint32)
+        bk_info = jnp.zeros((B, Il), dtype=jnp.uint32)
+        bk_mst = jnp.zeros(B, dtype=jnp.int32)
+        bk_cnt = jnp.int32(0)
+        table = jnp.zeros((H, 4), dtype=jnp.uint32)
+        flags = jnp.zeros(3, dtype=bool)   # found, overflow, exhausted
+        stats = jnp.zeros(3, dtype=jnp.int32)  # explored, rounds, max_base
+        return (fr_base, fr_win, fr_info, fr_mst, fr_cnt,
+                bk_base, bk_win, bk_info, bk_mst, bk_cnt,
+                table, flags, stats)
+
+    jinfo_word = jnp.asarray(info_word.astype(np.int32))
+    jinfo_bit = jnp.asarray(info_bit)
+    jinfo_set = jnp.asarray(info_set_mask)
+
+    def round_body(consts, carry):
+        (inv, ret, opc, suf, iinv, iopc, T, n_ok, n_info, max_cfg) = consts
+        (fr_base, fr_win, fr_info, fr_mst, fr_cnt,
+         bk_base, bk_win, bk_info, bk_mst, bk_cnt,
+         table, flags, stats) = carry
+
+        alive = jnp.arange(K, dtype=jnp.int32) < fr_cnt
+        j = jnp.arange(W, dtype=jnp.int32)
+        winbit = (fr_win[:, None] >> j[None, :].astype(jnp.uint32)) \
+            & jnp.uint32(1)                                   # (K, 32)
+        linearized = winbit == 1
+
+        # --- candidate discovery -------------------------------------
+        pos = fr_base[:, None] + j                            # (K, 32)
+        posc = jnp.minimum(pos, n_pad - 1)
+        retw = jnp.where(linearized | (pos >= n_ok), INF, ret[posc])
+        minret = jnp.min(retw, axis=1)
+        tail = suf[jnp.minimum(fr_base + W, n_pad)]
+        minret = jnp.minimum(minret, tail)                    # (K,)
+
+        invw = inv[posc]
+        cand_ok = (~linearized) & (pos < n_ok) \
+            & (invw < minret[:, None]) & alive[:, None]
+        opw = opc[posc]
+        nst_ok = T[fr_mst[:, None], opw]                      # (K, 32)
+        legal_ok = cand_ok & (nst_ok >= 0)
+
+        m = jnp.arange(ic_pad, dtype=jnp.int32)
+        # info bit m of lane k: (fr_info[k, word(m)] & bit(m)) != 0
+        info_words = fr_info[:, jinfo_word]                   # (K, ic)
+        info_set = (info_words & jinfo_bit[None, :]) != 0
+        cand_info = (~info_set) & (m[None, :] < n_info) \
+            & (iinv[None, :] < minret[:, None]) & alive[:, None]
+        nst_info = T[fr_mst[:, None], iopc[None, :]]          # (K, ic)
+        legal_info = cand_info & (nst_info >= 0)
+
+        # --- successor construction (pure bit math) ------------------
+        bit = (jnp.uint32(1) << j.astype(jnp.uint32))         # (32,)
+        win_ok = fr_win[:, None] | bit[None, :]               # (K, 32)
+        t = _ctz32(~win_ok)                                   # trailing ones
+        ti = t.astype(jnp.int32)
+        shifted = jnp.where(t >= 32, jnp.uint32(0),
+                            win_ok >> jnp.minimum(t, jnp.uint32(31)))
+        # t in [1, 32]; t == 32 -> window fully drained
+        base_ok = fr_base[:, None] + ti                       # (K, 32)
+
+        base_s = jnp.concatenate(
+            [base_ok.reshape(-1),
+             jnp.broadcast_to(fr_base[:, None], (K, ic_pad)).reshape(-1)])
+        win_s = jnp.concatenate(
+            [shifted.reshape(-1),
+             jnp.broadcast_to(fr_win[:, None], (K, ic_pad)).reshape(-1)])
+        info_ok = jnp.broadcast_to(fr_info[:, None, :], (K, W, Il))
+        info_new = fr_info[:, None, :] | jinfo_set[None, :, :]  # (K, ic, Il)
+        info_s = jnp.concatenate(
+            [info_ok.reshape(-1, Il), info_new.reshape(-1, Il)])
+        mst_s = jnp.concatenate(
+            [nst_ok.reshape(-1), nst_info.reshape(-1)])
+        legal = jnp.concatenate(
+            [legal_ok.reshape(-1), legal_info.reshape(-1)])   # (R,)
+        R = legal.shape[0]
+
+        success = legal & (base_s >= n_ok) & (win_s == 0)
+        found = jnp.any(success)
+        explore = legal & ~success
+
+        # --- hash signatures -----------------------------------------
+        words = ([base_s.astype(jnp.uint32), win_s, mst_s.astype(jnp.uint32)]
+                 + [info_s[:, i] for i in range(Il)])
+        s0 = _fnv_words(words, 0x811C9DC5) | jnp.uint32(1)  # never 0
+        s1 = _fnv_words(words, 0x01000193)
+        s2 = _fnv_words(words, 0xDEADBEEF)
+        myrow = jnp.arange(R, dtype=jnp.uint32)
+        step = s1 | jnp.uint32(1)
+        mysig = jnp.stack([s0, s1, s2], axis=1)               # (R, 3)
+
+        # --- probe-based dedup (no sort) -----------------------------
+        # Twins (same signature, same round) collide on the same probe
+        # sequence: the claim loser re-reads the slot, sees its own
+        # signature under a different row id, and counts as seen.
+        def probe(_, st):
+            table, pending, seen, pr = st
+            idx = ((s0 + pr * step) & jnp.uint32(H - 1)).astype(jnp.int32)
+            slot = table[idx]                                 # (R, 4)
+            occupied = slot[:, 0] != 0
+            sig_eq = jnp.all(slot[:, :3] == mysig, axis=1)
+            equal = occupied & sig_eq
+            seen = seen | (pending & equal)
+            claim = pending & ~occupied
+            widx = jnp.where(claim, idx, H)
+            entry = jnp.concatenate([mysig, myrow[:, None]], axis=1)
+            table = table.at[widx].set(entry, mode="drop")
+            slot2 = table[idx]
+            sig_eq2 = jnp.all(slot2[:, :3] == mysig, axis=1)
+            won = claim & sig_eq2 & (slot2[:, 3] == myrow)
+            twin = claim & sig_eq2 & ~won
+            seen = seen | twin
+            pending = pending & ~(equal | won | twin)
+            pr = pr + pending.astype(jnp.uint32)
+            return table, pending, seen, pr
+
+        table, pending, seen, _ = lax.fori_loop(
+            0, probes, probe,
+            (table, explore, jnp.zeros(R, dtype=bool),
+             jnp.zeros(R, dtype=jnp.uint32)))
+        # leftover pending (table too contended): treat as unseen — may
+        # re-explore later; sound.
+        new = explore & ~seen
+
+        # --- compact survivors into frontier + backlog ---------------
+        posn = jnp.cumsum(new.astype(jnp.int32)) - 1          # (R,)
+        total = jnp.sum(new.astype(jnp.int32))
+
+        to_front = new & (posn < K)
+        fidx = jnp.where(to_front, posn, K)
+        nfr_base = jnp.zeros(K, dtype=jnp.int32).at[fidx].set(
+            base_s, mode="drop")
+        nfr_win = jnp.zeros(K, dtype=jnp.uint32).at[fidx].set(
+            win_s, mode="drop")
+        nfr_info = jnp.zeros((K, Il), dtype=jnp.uint32).at[fidx].set(
+            info_s, mode="drop")
+        nfr_mst = jnp.zeros(K, dtype=jnp.int32).at[fidx].set(
+            mst_s, mode="drop")
+        nfr_cnt = jnp.minimum(total, K)
+
+        spill = new & (posn >= K)
+        sidx = jnp.where(spill, bk_cnt + posn - K, B)
+        overflow = jnp.any(spill & (sidx >= B))
+        sidx = jnp.minimum(sidx, B)
+        bk_base = bk_base.at[sidx].set(base_s, mode="drop")
+        bk_win = bk_win.at[sidx].set(win_s, mode="drop")
+        bk_info = bk_info.at[sidx].set(info_s, mode="drop")
+        bk_mst = bk_mst.at[sidx].set(mst_s, mode="drop")
+        nbk_cnt = jnp.minimum(bk_cnt + jnp.maximum(total - K, 0), B)
+
+        # refill frontier from the backlog top
+        room = K - nfr_cnt
+        take = jnp.minimum(room, nbk_cnt)
+        kidx = jnp.arange(K, dtype=jnp.int32)
+        taking = kidx < take
+        src = jnp.where(taking, jnp.maximum(nbk_cnt - 1 - kidx, 0), 0)
+        dst = jnp.where(taking, nfr_cnt + kidx, K)
+        nfr_base = nfr_base.at[dst].set(bk_base[src], mode="drop")
+        nfr_win = nfr_win.at[dst].set(bk_win[src], mode="drop")
+        nfr_info = nfr_info.at[dst].set(bk_info[src], mode="drop")
+        nfr_mst = nfr_mst.at[dst].set(bk_mst[src], mode="drop")
+        nfr_cnt = nfr_cnt + take
+        nbk_cnt = nbk_cnt - take
+
+        nflags = jnp.stack([flags[0] | found,
+                            flags[1] | overflow,
+                            nfr_cnt == 0])
+        nstats = jnp.stack([
+            stats[0] + fr_cnt,
+            stats[1] + 1,
+            jnp.maximum(stats[2], jnp.max(jnp.where(legal, base_s, 0)))])
+        return (nfr_base, nfr_win, nfr_info, nfr_mst, nfr_cnt,
+                bk_base, bk_win, bk_info, bk_mst, nbk_cnt,
+                table, nflags, nstats)
+
+    def chunk_fn(consts, carry):
+        max_cfg = consts[-1]
+
+        def cond(c):
+            flags, stats = c[11], c[12]
+            return (~flags[0]) & (c[4] > 0) \
+                & (stats[1] < chunk) & (stats[0] < max_cfg)
+
+        def body(c):
+            return round_body(consts, c)
+
+        stats = carry[12]
+        carry = carry[:12] + (stats.at[1].set(0),)
+        return lax.while_loop(cond, body, carry)
+
+    return init_fn, chunk_fn
+
+
+@functools.lru_cache(maxsize=32)
+def compiled_search32(n_pad: int, ic_pad: int, S: int, O: int,
+                      K: int, H: int, B: int, chunk: int, probes: int,
+                      W: int = 32):
+    import jax
+
+    init_fn, chunk_fn = _build_search32(n_pad, ic_pad, S, O,
+                                        K, H, B, chunk, probes, W=W)
+    return init_fn, jax.jit(chunk_fn, donate_argnums=(1,))

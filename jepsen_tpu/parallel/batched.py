@@ -115,11 +115,17 @@ def _batch_capacities(bk: int, W: int, n_pad: int):
 @functools.lru_cache(maxsize=16)
 def _compiled_batched(n_pad: int, ic_pad: int, W: int, S: int, O: int,
                       K: int, H: int, B: int, chunk: int, probes: int):
-    """vmap the shape-bucket kernel over the key axis and jit it."""
+    """vmap the shape-bucket kernel over the key axis and jit it.
+    Windows that fit a uint32 lane use the bitmask fast path."""
     import jax
 
-    init_fn, chunk_fn = _build_search(n_pad, ic_pad, W, S, O,
-                                      K, H, B, chunk, probes)
+    if W <= 32:
+        from ..ops.wgl32 import _build_search32
+        init_fn, chunk_fn = _build_search32(n_pad, ic_pad, S, O,
+                                            K, H, B, chunk, probes)
+    else:
+        init_fn, chunk_fn = _build_search(n_pad, ic_pad, W, S, O,
+                                          K, H, B, chunk, probes)
     vinit = jax.vmap(init_fn)
     vchunk = jax.jit(jax.vmap(chunk_fn), donate_argnums=(1,))
     return vinit, vchunk
