@@ -17,36 +17,78 @@ value      = wall seconds to a definitive verdict, compile-warm (the
 vs_baseline = 60 / value — how many times faster than the reference's
              60 s budget, at which it DNFs.
 
+Robustness contract (VERDICT r1): this script must ALWAYS print its JSON
+line, even when the accelerator backend fails or hangs at init. Backend
+init is probed in a subprocess with a hard timeout; on failure the bench
+pins the CPU platform via jax.config (env vars alone are overridden by
+site customization that pre-imports jax) and records the platform used.
+
 Env knobs: JEPSEN_TPU_BENCH_OPS (default 10000),
-JEPSEN_TPU_BENCH_BUDGET_S (default 120 per attempt).
+JEPSEN_TPU_BENCH_BUDGET_S (default 120 per attempt),
+JEPSEN_TPU_BENCH_PLATFORM (skip probing, pin this platform),
+JEPSEN_TPU_BENCH_PROBE_S (default 90, backend-probe timeout).
 """
 
 from __future__ import annotations
 
 import json
 import os
+import subprocess
 import sys
 import time
+import traceback
 
 
-def main() -> int:
+def _probe_default_backend(timeout_s: float) -> str | None:
+    """Return the default backend's platform name, or None if init
+    fails or hangs. Runs in a subprocess so a hung init can't take this
+    process down with it."""
+    code = "import jax; print('PROBE_OK', jax.default_backend())"
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, text=True,
+            timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        print("backend probe: timed out (init hang)", file=sys.stderr)
+        return None
+    for line in out.stdout.splitlines():
+        if line.startswith("PROBE_OK"):
+            return line.split()[1]
+    tail = (out.stderr or "").strip().splitlines()[-3:]
+    print("backend probe: failed:", *tail, sep="\n  ", file=sys.stderr)
+    return None
+
+
+def _pick_platform() -> str:
+    plat = os.environ.get("JEPSEN_TPU_BENCH_PLATFORM")
+    if plat:
+        return plat
+    probe_s = float(os.environ.get("JEPSEN_TPU_BENCH_PROBE_S", "90"))
+    found = _probe_default_backend(probe_s)
+    if found is None:
+        print("backend probe: falling back to cpu", file=sys.stderr)
+        return "cpu"
+    return found
+
+
+def run_bench() -> tuple[dict, int]:
     n_ops = int(os.environ.get("JEPSEN_TPU_BENCH_OPS", "10000"))
     budget = float(os.environ.get("JEPSEN_TPU_BENCH_BUDGET_S", "120"))
 
+    plat = _pick_platform()
+
     import jax
 
-    # For CI hosts without a working accelerator: JEPSEN_TPU_BENCH_PLATFORM
-    # =cpu pins the backend via jax.config (the env var alone can be
-    # overridden by site customization that pre-imports jax).
-    plat = os.environ.get("JEPSEN_TPU_BENCH_PLATFORM")
-    if plat:
-        jax.config.update("jax_platforms", plat)
+    # Pin through jax.config: the env-var route is ignored because site
+    # customization pre-imports jax before this script runs.
+    jax.config.update("jax_platforms", plat)
 
     from jepsen_tpu.models import cas_register
     from jepsen_tpu.ops import wgl
     from jepsen_tpu.synth import cas_register_history
 
-    print(f"platform: {jax.devices()}", file=sys.stderr)
+    metric = f"cas_register_{n_ops//1000}k_wgl_wall_s"
+    print(f"platform: {plat} -> {jax.devices()}", file=sys.stderr)
     hist = cas_register_history(n_ops, n_procs=5, seed=42, crash_p=0.002)
     print(f"history: {len(hist)} events ({n_ops} invocations)",
           file=sys.stderr)
@@ -61,26 +103,42 @@ def main() -> int:
     if res_cold.get("valid?") == "unknown":
         # Did not finish within budget: report the cold attempt as the
         # value so the regression is visible.
-        out = {"metric": f"cas_register_{n_ops//1000}k_wgl_wall_s",
-               "value": round(cold_s, 3), "unit": "s",
-               "vs_baseline": round(60.0 / cold_s, 3),
-               "verdict": "unknown", "cause": res_cold.get("cause")}
-        print(json.dumps(out))
-        return 1
+        return ({"metric": metric, "value": round(cold_s, 3), "unit": "s",
+                 "vs_baseline": round(60.0 / cold_s, 3),
+                 "verdict": "unknown", "platform": plat,
+                 "cause": res_cold.get("cause")}, 1)
 
     t0 = time.monotonic()
     res = wgl.check(model, hist, time_limit=budget)
     warm_s = time.monotonic() - t0
     print(f"warm: {warm_s:.2f}s -> {res}", file=sys.stderr)
 
-    out = {"metric": f"cas_register_{n_ops//1000}k_wgl_wall_s",
-           "value": round(warm_s, 3), "unit": "s",
-           "vs_baseline": round(60.0 / warm_s, 3),
-           "verdict": res.get("valid?"),
-           "cold_s": round(cold_s, 3),
-           "configs_explored": res.get("configs_explored")}
+    return ({"metric": metric, "value": round(warm_s, 3), "unit": "s",
+             "vs_baseline": round(60.0 / warm_s, 3),
+             "verdict": res.get("valid?"), "platform": plat,
+             "cold_s": round(cold_s, 3),
+             "configs_explored": res.get("configs_explored")}, 0)
+
+
+def main() -> int:
+    try:
+        out, rc = run_bench()
+    except BaseException as e:  # always emit the JSON line
+        traceback.print_exc(file=sys.stderr)
+        try:
+            n_ops = int(os.environ.get("JEPSEN_TPU_BENCH_OPS", "10000"))
+        except ValueError:
+            n_ops = 10000
+        out = {"metric": f"cas_register_{n_ops//1000}k_wgl_wall_s",
+               "value": None, "unit": "s", "vs_baseline": None,
+               "verdict": "error",
+               "error": f"{type(e).__name__}: {e}"[:500]}
+        print(json.dumps(out))
+        if isinstance(e, KeyboardInterrupt):
+            raise
+        return 1
     print(json.dumps(out))
-    return 0
+    return rc
 
 
 if __name__ == "__main__":

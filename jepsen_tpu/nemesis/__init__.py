@@ -411,7 +411,7 @@ class ClockScrambler(Nemesis):
 
     def invoke(self, test, op):
         def f(t, node):
-            set_time(_time.time() + RNG.randint(-self.dt, self.dt))
+            set_time(_time.time() + RNG.uniform(-self.dt, self.dt))
         value = c.on_nodes(test, f)
         return {**op, "type": "info", "value": value}
 

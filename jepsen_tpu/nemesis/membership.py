@@ -13,6 +13,7 @@ import time as _time
 from typing import Any, Optional
 
 from .. import control as c
+from .. import generator as gen
 from . import Nemesis
 
 log = logging.getLogger("jepsen_tpu.nemesis.membership")
@@ -137,14 +138,26 @@ class MembershipNemesis(Nemesis):
 
     def generator(self):
         """A generator asking the state for legal ops
-        (membership.clj's op flow)."""
-        def gen_fn(test, ctx):
-            with self.lock:
-                op = self.state.op(test)
-            if op == "pending":
-                return None
-            return op
-        return gen_fn
+        (membership.clj:231-237). When the state has no op available it
+        reports "pending"; we emit PENDING (keeping the generator alive
+        so it is asked again) rather than None, which the DSL would
+        treat as permanent exhaustion."""
+        return _MembershipGen(self)
+
+
+class _MembershipGen(gen.Generator):
+    def __init__(self, nem: "MembershipNemesis"):
+        self.nem = nem
+
+    def op(self, test, ctx):
+        with self.nem.lock:
+            o = self.nem.state.op(test)
+        if o == "pending":
+            return (gen.PENDING, self)
+        if o is None:
+            return None
+        filled = gen.fill_in_op(dict(o), ctx)
+        return (filled, self)
 
 
 def _freeze(op: dict):

@@ -98,25 +98,87 @@ class JepsenFile:
         else:
             self.fh = open(path, "r+b" if mode == "a" else "rb")
             self._load()
+            if mode == "a":
+                # Never append past a torn/uncommitted tail: blocks
+                # written there would be unreachable to the
+                # scan-forward recovery path. Everything up to and
+                # including the committed index block is known valid
+                # (blocks are fsynced before the pointer moves), so
+                # trim right after it — O(1), no full-file scan.
+                end = self._committed_end if self._committed_end \
+                    else HEADER_LEN
+                self.fh.seek(0, os.SEEK_END)
+                if self.fh.tell() > end:
+                    self.fh.truncate(end)
 
     # -- low level -------------------------------------------------------
     def _load(self):
         self.fh.seek(0)
         if self.fh.read(len(MAGIC)) != MAGIC:
             raise CorruptFile(f"{self.path}: bad magic")
-        (index_off,) = struct.unpack("<Q", self.fh.read(8))
-        if index_off == 0:
+        ptr = self.fh.read(8)
+        if len(ptr) < 8:
+            raise CorruptFile(f"{self.path}: truncated file header")
+        (index_off,) = struct.unpack("<Q", ptr)
+        payload = None
+        self._committed_end = 0  # offset just past the committed index
+        if index_off:
+            try:
+                btype, payload = self._read_block_at(index_off)
+                if btype != INDEX_BLOCK:
+                    payload = None
+            except CorruptFile:
+                payload = None
+            if payload is not None:
+                self._committed_end = (index_off + _BLOCK_HEADER.size
+                                       + len(payload))
+        if payload is None:
+            # Pointer missing, torn, or stale: recover by scanning
+            # forward over the append-only block stream for the last
+            # valid index block (the documented crash-recovery path).
+            found = self._scan_last_index()
+            if found is not None:
+                off, payload = found
+                self._committed_end = (off + _BLOCK_HEADER.size
+                                       + len(payload))
+                if self.writable:
+                    # repair the header pointer for future readers
+                    self.fh.seek(len(MAGIC))
+                    self.fh.write(struct.pack("<Q", off))
+                    self.fh.flush()
+                    os.fsync(self.fh.fileno())
+        if payload is None:
             self.index = {"root": 0, "blocks": {}}
         else:
-            btype, payload = self._read_block_at(index_off)
-            if btype != INDEX_BLOCK:
-                raise CorruptFile(f"{self.path}: index pointer does not "
-                                  f"reference an index block")
             self.index = json.loads(payload)
             self.index["blocks"] = {int(k): v for k, v
                                     in self.index["blocks"].items()}
         ids = self.index["blocks"].keys()
         self.next_id = max(ids, default=0) + 1
+
+    def _iter_valid_blocks(self):
+        """Yield (offset, btype, payload) for the contiguous run of
+        valid blocks from the start of the file, stopping at the first
+        torn/corrupt one."""
+        offset = HEADER_LEN
+        self.fh.seek(0, os.SEEK_END)
+        end = self.fh.tell()
+        while offset < end:
+            try:
+                btype, payload = self._read_block_at(offset)
+            except CorruptFile:
+                return
+            yield offset, btype, payload
+            offset += _BLOCK_HEADER.size + len(payload)
+
+    def _scan_last_index(self) -> Optional[tuple]:
+        """(offset, payload) of the last checksummed index block,
+        ignoring any torn tail (the crash-recovery path)."""
+        last = None
+        for off, btype, payload in self._iter_valid_blocks():
+            if btype == INDEX_BLOCK:
+                last = (off, payload)
+        return last
 
     def _read_block_at(self, offset: int) -> tuple:
         self.fh.seek(offset)
@@ -124,6 +186,9 @@ class JepsenFile:
         if len(header) < _BLOCK_HEADER.size:
             raise CorruptFile(f"{self.path}@{offset}: truncated header")
         length, crc, btype = _BLOCK_HEADER.unpack(header)
+        if length < _BLOCK_HEADER.size:
+            raise CorruptFile(f"{self.path}@{offset}: bad block length "
+                              f"{length}")
         payload = self.fh.read(length - _BLOCK_HEADER.size)
         if len(payload) != length - _BLOCK_HEADER.size:
             raise CorruptFile(f"{self.path}@{offset}: truncated block")
@@ -148,7 +213,11 @@ class JepsenFile:
         payload = json.dumps({"root": self.index["root"],
                               "blocks": self.index["blocks"]}).encode()
         offset = self._append_block(INDEX_BLOCK, payload)
+        # Make the appended blocks durable BEFORE the header points at
+        # them, so a crash between the two writes leaves a pointer that
+        # references only fully-written bytes.
         self.fh.flush()
+        os.fsync(self.fh.fileno())
         self.fh.seek(len(MAGIC))
         self.fh.write(struct.pack("<Q", offset))
         self.fh.flush()

@@ -1,0 +1,141 @@
+"""Membership nemesis tests: a fake clustered State driven through
+grow/shrink, view refresh/merge, pending-op resolution, and the
+generator's keep-alive PENDING behavior (parity targets:
+jepsen/src/jepsen/nemesis/membership.clj:80-270 and
+membership/state.clj:21-59)."""
+
+import pytest
+
+from jepsen_tpu import generator as gen
+from jepsen_tpu.control import dummy
+from jepsen_tpu.nemesis import membership
+
+NODES = ["n1", "n2", "n3"]
+
+
+class FakeClusterState(membership.State):
+    """An in-memory 'cluster': every node sees the shared membership
+    set; ops grow/shrink it; an op resolves once the merged view
+    reflects it."""
+
+    def __init__(self, actual, plan):
+        self.actual = actual          # the cluster's real member set
+        self.plan = list(plan)        # ops still to issue
+        self.node_views = {}
+        self.view = None
+        self.pending = frozenset()
+
+    def node_view(self, test, node):
+        return sorted(self.actual)
+
+    def merge_views(self, test):
+        merged = set()
+        for v in self.node_views.values():
+            merged.update(v)
+        return sorted(merged) if merged else None
+
+    def fs(self):
+        return {"grow", "shrink"}
+
+    def op(self, test):
+        if self.pending:
+            return "pending"          # wait for resolution first
+        if not self.plan:
+            return None
+        return dict(self.plan[0])
+
+    def invoke(self, test, op):
+        self.plan = self.plan[1:]
+        if op["f"] == "grow":
+            self.actual.add(op["value"])
+        else:
+            self.actual.discard(op["value"])
+        return {k: v for k, v in op.items() if k != "process"}
+
+    def resolve_op(self, test, pair):
+        # resolved once the merged view has caught up with reality
+        if self.view == sorted(self.actual):
+            return self
+        return None
+
+
+def make_test(nodes):
+    r = dummy.remote()
+    return {"nodes": list(nodes), "concurrency": 2,
+            "sessions": {n: r.connect({"host": n}) for n in nodes}}
+
+
+def drive(nem, test, ctx):
+    """One generator poll through the DSL dispatch."""
+    return gen.op(nem.generator(), test, ctx)
+
+
+def test_grow_shrink_scenario():
+    test = make_test(NODES)
+    actual = set(NODES)
+    state = FakeClusterState(actual, [{"f": "grow", "value": "n4"},
+                                      {"f": "shrink", "value": "n1"}])
+    nem = membership.nemesis(state)
+    nem.setup(test)
+    try:
+        ctx = gen.context(test)
+        # 1: the generator proposes the first planned op, filled in
+        o, g2 = drive(nem, test, ctx)
+        assert o["f"] == "grow" and o["value"] == "n4"
+        assert o["type"] == "invoke" and "process" in o
+
+        res = nem.invoke(test, o)
+        assert res["type"] == "info"
+        assert "n4" in actual
+        assert nem.state.pending  # awaiting view resolution
+
+        # 2: while pending, the generator stays alive and PENDING
+        o2, g3 = gen.op(g2, test, ctx)
+        assert o2 is gen.PENDING
+        assert g3 is not None
+
+        # 3: a view refresh resolves the pending op; next op flows
+        nem._refresh(test)
+        assert not nem.state.pending
+        assert nem.state.view == sorted(actual)
+        o4, _ = gen.op(g3, test, ctx)
+        assert o4["f"] == "shrink" and o4["value"] == "n1"
+        nem.invoke(test, o4)
+        assert "n1" not in actual
+        nem._refresh(test)
+
+        # 4: plan exhausted -> generator finally ends
+        assert gen.op(nem.generator(), test, ctx) is None
+    finally:
+        nem.teardown(test)
+
+
+def test_generator_pending_not_exhausted():
+    """Regression (ADVICE r1): a pending state must NOT exhaust the
+    generator — it must emit PENDING and keep itself alive."""
+    test = make_test(NODES)
+    state = FakeClusterState(set(NODES), [{"f": "grow", "value": "n4"}])
+    state.pending = frozenset({(("f", "x"),)})  # force pending
+    nem = membership.nemesis(state)
+    ctx = gen.context(test)
+    res = drive(nem, test, ctx)
+    assert res is not None
+    o, g2 = res
+    assert o is gen.PENDING
+    # still alive: once unblocked the op appears
+    state.pending = frozenset()
+    o2, _ = gen.op(g2, test, ctx)
+    assert o2["f"] == "grow"
+
+
+def test_fs_and_view_merge():
+    test = make_test(NODES)
+    state = FakeClusterState(set(NODES), [])
+    nem = membership.nemesis(state)
+    nem.setup(test)
+    try:
+        assert nem.fs() == {"grow", "shrink"}
+        assert nem.state.view == sorted(NODES)
+        assert set(nem.state.node_views) == set(NODES)
+    finally:
+        nem.teardown(test)

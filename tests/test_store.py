@@ -84,6 +84,81 @@ def test_crash_recovery_truncated_tail(tmp_path):
     assert jf.read_test(lazy=False)["history"] == HISTORY
 
 
+def test_crash_recovery_torn_index_pointer(tmp_path):
+    """If a crash leaves the header pointer referencing unwritten bytes,
+    _load must scan back to the last valid index block instead of
+    refusing the file (ADVICE r1 / format.clj:140-150)."""
+    p = str(tmp_path / "t.jepsen")
+    jf = JepsenFile(p, "w")
+    jf.write_history({"name": "x"}, ops=HISTORY)
+    jf.close()
+    size = os.path.getsize(p)
+    with open(p, "r+b") as fh:
+        fh.seek(len(MAGIC))
+        fh.write(struct.pack("<Q", size + 64))  # points past EOF
+    jf = JepsenFile(p)
+    assert jf.read_test(lazy=False)["history"] == HISTORY
+
+
+def test_crash_recovery_pointer_into_torn_block(tmp_path):
+    """Pointer patched but the new index block itself is torn: recover
+    the previous save point."""
+    p = str(tmp_path / "t.jepsen")
+    jf = JepsenFile(p, "w")
+    jf.write_history({"name": "x"}, ops=HISTORY)
+    jf.close()
+    size = os.path.getsize(p)
+    with open(p, "r+b") as fh:
+        fh.seek(0, os.SEEK_END)
+        fh.write(b"\x40\x00\x00\x00\x00\x00\x00\x00")  # torn half-header
+        fh.seek(len(MAGIC))
+        fh.write(struct.pack("<Q", size))  # pointer at the torn block
+    jf = JepsenFile(p)
+    assert jf.read_test(lazy=False)["history"] == HISTORY
+
+
+def test_append_mode_truncates_torn_tail(tmp_path):
+    """Reopening for append after a torn write must truncate the tail,
+    so new save points stay reachable to the scan-forward recovery."""
+    p = str(tmp_path / "t.jepsen")
+    jf = JepsenFile(p, "w")
+    jf.write_history({"name": "x"}, ops=HISTORY)
+    jf.close()
+    with open(p, "ab") as fh:
+        fh.write(b"\x00" * 17)  # torn tail
+    jf = JepsenFile(p, "a")
+    jf.write_results({"name": "x"}, {"valid?": True})
+    jf.close()
+    # even with the header pointer lost, recovery finds the NEW results
+    with open(p, "r+b") as fh:
+        fh.seek(len(MAGIC))
+        fh.write(struct.pack("<Q", 0))
+    t = JepsenFile(p).read_test(lazy=False)
+    assert t["results"]["valid?"] is True
+    assert t["history"] == HISTORY
+
+
+def test_append_open_preserves_tail_despite_early_corruption(tmp_path):
+    """A bit-rotted EARLY block must not cause append-mode open to
+    truncate the valid committed tail (index + results live at the
+    end of the file)."""
+    p = str(tmp_path / "t.jepsen")
+    jf = JepsenFile(p, "w")
+    jf.write_history({"name": "x"}, ops=HISTORY)
+    jf.write_results({"name": "x"}, {"valid?": False})
+    jf.close()
+    size = os.path.getsize(p)
+    with open(p, "r+b") as fh:  # corrupt a byte in the first data block
+        fh.seek(len(MAGIC) + 8 + 20)
+        b = fh.read(1)
+        fh.seek(-1, os.SEEK_CUR)
+        fh.write(bytes([b[0] ^ 0xFF]))
+    jf = JepsenFile(p, "a")
+    assert os.path.getsize(p) == size  # nothing truncated
+    assert jf.read_valid() is False    # committed results intact
+    jf.close()
+
+
 def test_checksum_detects_corruption(tmp_path):
     p = str(tmp_path / "t.jepsen")
     jf = JepsenFile(p, "w")
