@@ -1,0 +1,39 @@
+"""Transactional anomaly detection by dependency-graph cycle search.
+
+This package is the framework's second compute plane: the capability of
+the Elle checker (an external dependency of the reference, wrapped at
+`jepsen/src/jepsen/tests/cycle.clj:9-16`,
+`tests/cycle/append.clj:11-22`, and `tests/cycle/wr.clj:14-53`),
+re-implemented from its published semantics rather than ported:
+
+  * `jepsen_tpu.elle.graph`   — dependency graphs held as index arrays
+                                (src/dst/type int32 columns — the layout
+                                a TPU SCC pass consumes directly), with
+                                host Tarjan SCC + shortest-cycle search;
+  * `jepsen_tpu.elle.append`  — list-append histories: infer the version
+                                order of each key's list from observed
+                                read prefixes, derive ww/wr/rw edges,
+                                and classify G0/G1a/G1b/G1c/G-single/G2
+                                plus internal/dirty-update/duplicate/
+                                incompatible-order anomalies;
+  * `jepsen_tpu.elle.wr`      — write/read registers with unique writes:
+                                version orders inferred under the
+                                sequential/linearizable/wfr assumptions.
+
+Anomaly taxonomy (naming follows Adya, as the reference documents in
+tests/cycle/wr.clj:30-46):
+
+  G0        write cycle (ww edges only)
+  G1a       aborted read
+  G1b       intermediate read
+  G1c       circular information flow (ww + wr edges)
+  G-single  cycle with exactly one anti-dependency (rw) edge
+  G2        cycle with at least one rw edge
+  internal  txn inconsistent with its own prior reads/writes
+"""
+
+from .graph import (EDGE_NAMES, PROCESS, REALTIME, RW, WR, WW, DepGraph,
+                    process_graph, realtime_graph)
+
+__all__ = ["DepGraph", "WW", "WR", "RW", "REALTIME", "PROCESS",
+           "EDGE_NAMES", "realtime_graph", "process_graph"]

@@ -137,6 +137,15 @@ class JepsenFile:
             # forward over the append-only block stream for the last
             # valid index block (the documented crash-recovery path).
             found = self._scan_last_index()
+            if found is None and index_off:
+                # The pointer claims a committed save point but neither
+                # it nor the scan can reach one (e.g. early bit-rot
+                # blocking the scan): refuse rather than proceed with —
+                # or worse, truncate to — an empty index.
+                raise CorruptFile(
+                    f"{self.path}: committed index unreachable "
+                    f"(pointer @{index_off} invalid, scan found no "
+                    f"index block)")
             if found is not None:
                 off, payload = found
                 self._committed_end = (off + _BLOCK_HEADER.size

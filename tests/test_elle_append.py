@@ -1,0 +1,215 @@
+"""List-append anomaly detection: golden histories with known
+anomalies, in the style the reference uses for checker tests
+(jepsen/test/jepsen/checker_test.clj — exact expected verdicts).
+
+Anomaly semantics follow the Elle taxonomy the reference documents at
+jepsen/src/jepsen/tests/cycle/wr.clj:30-46."""
+
+import pytest
+
+from jepsen_tpu.elle import append as ea
+from jepsen_tpu.history import History, Op
+
+
+def txn(typ, mops, process=0, time=0):
+    return Op(type=typ, f="txn", process=process, value=mops, time=time)
+
+
+def hist(*ops):
+    h = History()
+    for i, op in enumerate(ops):
+        h.append(op.with_(index=i, time=op.time or i))
+    return h
+
+
+def check(*ops, **kw):
+    return ea.check(hist(*ops), **kw)
+
+
+# --- clean histories -------------------------------------------------------
+
+def test_valid_serial_history():
+    res = check(
+        txn("ok", [["append", "x", 1]]),
+        txn("ok", [["r", "x", [1]], ["append", "x", 2]]),
+        txn("ok", [["r", "x", [1, 2]]]),
+    )
+    assert res["valid?"] is True
+    assert res["anomaly-types"] == []
+
+
+def test_empty_history():
+    res = ea.check(History())
+    assert res["valid?"] is True
+
+
+# --- direct anomalies ------------------------------------------------------
+
+def test_g1a_aborted_read():
+    res = check(
+        txn("fail", [["append", "x", 1]]),
+        txn("ok", [["r", "x", [1]]]),
+    )
+    assert res["valid?"] is False
+    assert "G1a" in res["anomaly-types"]
+    case = res["anomalies"]["G1a"][0]
+    assert case["key"] == "x" and case["value"] == 1
+    assert "read-committed" in res["not"]
+
+
+def test_g1b_intermediate_read():
+    # T0 appends 1 then 2 (1 is intermediate); T1 reads up to 1 only
+    res = check(
+        txn("ok", [["append", "x", 1], ["append", "x", 2]]),
+        txn("ok", [["r", "x", [1]]]),
+    )
+    assert res["valid?"] is False
+    assert "G1b" in res["anomaly-types"]
+
+
+def test_internal_inconsistency():
+    # txn reads [1], appends 2, then reads [1] again — missing its own
+    # append
+    res = check(
+        txn("ok", [["append", "x", 1]]),
+        txn("ok", [["r", "x", [1]], ["append", "x", 2], ["r", "x", [1]]]),
+    )
+    assert res["valid?"] is False
+    assert "internal" in res["anomaly-types"]
+
+
+def test_duplicate_elements():
+    res = check(
+        txn("ok", [["append", "x", 1]]),
+        txn("ok", [["append", "x", 1]]),
+    )
+    assert res["valid?"] is False
+    assert "duplicate-elements" in res["anomaly-types"]
+
+
+def test_incompatible_order():
+    res = check(
+        txn("ok", [["append", "x", 1], ["append", "x", 2],
+                   ["append", "x", 3]]),
+        txn("ok", [["r", "x", [1, 2]]]),
+        txn("ok", [["r", "x", [2, 1]]]),
+    )
+    assert res["valid?"] is False
+    assert "incompatible-order" in res["anomaly-types"]
+
+
+# --- cycle anomalies -------------------------------------------------------
+
+def test_g0_write_cycle():
+    # x's order: T0's 1 then T1's 2; y's order: T1's 1 then T0's 2
+    # => ww cycle T0 <-> T1
+    res = check(
+        txn("ok", [["append", "x", 1], ["append", "y", 2]]),
+        txn("ok", [["append", "y", 1], ["append", "x", 2]]),
+        txn("ok", [["r", "x", [1, 2]], ["r", "y", [1, 2]]]),
+    )
+    assert res["valid?"] is False
+    assert "G0" in res["anomaly-types"]
+    cyc = res["anomalies"]["G0"][0]
+    assert cyc["cycle"][0] == cyc["cycle"][-1]
+    assert len(cyc["steps"]) >= 2
+
+
+def test_g1c_circular_information_flow():
+    # T0 appends x=1 and reads y=[1] (written by T1);
+    # T1 appends y=1 and reads x=[1] (written by T0): wr cycle
+    res = check(
+        txn("ok", [["append", "x", 1], ["r", "y", [1]]]),
+        txn("ok", [["append", "y", 1], ["r", "x", [1]]]),
+    )
+    assert res["valid?"] is False
+    assert "G1c" in res["anomaly-types"]
+
+
+def test_g_single_read_skew():
+    # T1 reads x before T0's append lands (rw), but reads y after T0
+    # wrote it (wr): classic read skew, exactly one anti-dependency.
+    res = check(
+        txn("ok", [["append", "x", 2], ["append", "y", 1]]),  # T0
+        txn("ok", [["r", "x", []], ["r", "y", [1]]]),          # T1
+        txn("ok", [["r", "x", [2]]]),
+    )
+    assert res["valid?"] is False
+    assert "G-single" in res["anomaly-types"]
+    assert "consistent-view" in res["not"]
+
+
+def test_g2_write_skew():
+    # Two txns each read the other's key before the other's append:
+    # two rw edges, no ww/wr cycle — pure G2 (write skew).
+    res = check(
+        txn("ok", [["r", "x", []], ["append", "y", 1]]),  # T0
+        txn("ok", [["r", "y", []], ["append", "x", 1]]),  # T1
+        txn("ok", [["r", "x", [1]], ["r", "y", [1]]]),
+    )
+    assert res["valid?"] is False
+    assert "G2" in res["anomaly-types"]
+    assert "serializable" in res["not"]
+    # exactly-one-rw search must NOT fire: both edges are rw
+    assert "G-single" not in res["anomaly-types"]
+
+
+def test_anomaly_filter_reports_unknown():
+    # G2 present but only G0 requested: valid? is unknown, not true
+    res = check(
+        txn("ok", [["r", "x", []], ["append", "y", 1]]),
+        txn("ok", [["r", "y", []], ["append", "x", 1]]),
+        txn("ok", [["r", "x", [1]], ["r", "y", [1]]]),
+        anomalies=("G0",),
+    )
+    assert res["valid?"] == "unknown"
+    assert "G2" in res["unchecked-anomaly-types"]
+
+
+# --- realtime strengthening ------------------------------------------------
+
+def test_realtime_cycle_strict_serializability():
+    # Serializable but not strictly so: T1 (later in real time) reads
+    # state from BEFORE T0's append, after T0 completed.
+    h = History()
+    ops = [
+        Op(type="invoke", f="txn", process=0,
+           value=[["append", "x", 1]], time=0),
+        Op(type="ok", f="txn", process=0,
+           value=[["append", "x", 1]], time=1),
+        Op(type="invoke", f="txn", process=1,
+           value=[["r", "x", None]], time=2),
+        Op(type="ok", f="txn", process=1,
+           value=[["r", "x", []]], time=3),
+        # establishes x's version order [1]
+        Op(type="invoke", f="txn", process=2,
+           value=[["r", "x", None]], time=4),
+        Op(type="ok", f="txn", process=2,
+           value=[["r", "x", [1]]], time=5),
+    ]
+    for i, op in enumerate(ops):
+        h.append(op.with_(index=i))
+    plain = ea.check(h)
+    assert plain["valid?"] is True  # serializable: T1 before T0
+    rt = ea.check(h, additional_graphs=("realtime",))
+    assert rt["valid?"] is False   # but T0 completed before T1 began
+
+
+# --- generator -------------------------------------------------------------
+
+def test_append_gen_unique_monotone_values():
+    g = ea.AppendGen(key_count=2, max_writes_per_key=5, seed=7)
+    seen = set()
+    for _ in range(200):
+        for f, k, v in g.txn():
+            if f == "append":
+                assert (k, v) not in seen
+                seen.add((k, v))
+    assert seen  # generated at least one append
+
+
+def test_append_gen_as_dsl_generator():
+    g = ea.AppendGen(seed=1)
+    op = g(None, None)
+    assert op["f"] == "txn"
+    assert all(m[0] in ("r", "append") for m in op["value"])

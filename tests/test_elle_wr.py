@@ -1,0 +1,167 @@
+"""Write/read register anomaly detection: golden histories with known
+anomalies (taxonomy per jepsen/src/jepsen/tests/cycle/wr.clj:30-46)."""
+
+from jepsen_tpu.elle import wr as ew
+from jepsen_tpu.history import History, Op
+
+
+def txn(typ, mops, process=0, time=0):
+    return Op(type=typ, f="txn", process=process, value=mops, time=time)
+
+
+def hist(*ops):
+    h = History()
+    for i, op in enumerate(ops):
+        h.append(op.with_(index=i, time=op.time or i))
+    return h
+
+
+def check(*ops, **kw):
+    return ew.check(hist(*ops), **kw)
+
+
+def test_valid_history():
+    res = check(
+        txn("ok", [["w", "x", 1]]),
+        txn("ok", [["r", "x", 1], ["w", "x", 2]]),
+        txn("ok", [["r", "x", 2]]),
+    )
+    assert res["valid?"] is True
+
+
+def test_g1a_aborted_read():
+    res = check(
+        txn("fail", [["w", "x", 1]]),
+        txn("ok", [["r", "x", 1]]),
+    )
+    assert res["valid?"] is False
+    assert "G1a" in res["anomaly-types"]
+
+
+def test_g1b_intermediate_read():
+    res = check(
+        txn("ok", [["w", "x", 1], ["w", "x", 2]]),
+        txn("ok", [["r", "x", 1]]),
+    )
+    assert res["valid?"] is False
+    assert "G1b" in res["anomaly-types"]
+
+
+def test_internal():
+    res = check(
+        txn("ok", [["w", "x", 1], ["r", "x", 2]]),
+    )
+    assert res["valid?"] is False
+    assert "internal" in res["anomaly-types"]
+
+
+def test_g1c_wr_cycle():
+    # T0 writes x=1, reads y=1 (T1's); T1 writes y=1, reads x=1 (T0's)
+    res = check(
+        txn("ok", [["w", "x", 1], ["r", "y", 1]]),
+        txn("ok", [["w", "y", 1], ["r", "x", 1]]),
+    )
+    assert res["valid?"] is False
+    assert "G1c" in res["anomaly-types"]
+
+
+def test_g0_write_cycle():
+    # T0 and T1 each write both keys; observed version orders disagree:
+    # x goes 1 then 2 (T0 before T1), y goes 2 then 1 (T1 before T0) —
+    # a pure ww cycle. Per-process read sequences pin the orders under
+    # the sequential-keys assumption.
+    res = check(
+        txn("ok", [["w", "x", 1], ["w", "y", 1]], process=0, time=0),
+        txn("ok", [["w", "x", 2], ["w", "y", 2]], process=1, time=1),
+        txn("ok", [["r", "x", 1]], process=2, time=2),
+        txn("ok", [["r", "x", 2]], process=2, time=3),
+        txn("ok", [["r", "y", 2]], process=3, time=4),
+        txn("ok", [["r", "y", 1]], process=3, time=5),
+        sequential_keys=True,
+    )
+    assert res["valid?"] is False
+    assert "G0" in res["anomaly-types"]
+
+
+def test_cyclic_versions():
+    # process 0 observes x: 1 then 2; process 1 observes x: 2 then 1
+    res = check(
+        txn("ok", [["r", "x", 1], ["w", "x", 2]], process=0, time=0),
+        txn("ok", [["r", "x", 2], ["w", "x", 1]], process=1, time=1),
+        sequential_keys=True,
+    )
+    assert res["valid?"] is False
+    assert "cyclic-versions" in res["anomaly-types"]
+
+
+def test_g_single():
+    # T0 writes x=1,y=1. T1 reads x=nil (missed T0: rw) and y=1 (wr).
+    res = check(
+        txn("ok", [["w", "x", 1], ["w", "y", 1]]),
+        txn("ok", [["r", "x", None], ["r", "y", 1]]),
+    )
+    assert res["valid?"] is False
+    assert "G-single" in res["anomaly-types"]
+
+
+def test_g2_write_skew():
+    res = check(
+        txn("ok", [["r", "x", None], ["w", "y", 1]]),
+        txn("ok", [["r", "y", None], ["w", "x", 1]]),
+        txn("ok", [["r", "x", 1], ["r", "y", 1]]),
+    )
+    assert res["valid?"] is False
+    assert "G2" in res["anomaly-types"]
+    assert "G-single" not in res["anomaly-types"]
+
+
+def test_linearizable_keys_concurrent_ops_no_false_anomaly():
+    """Two overlapping reads observing different versions must NOT
+    yield version-order evidence (completion order alone is not
+    realtime order): this linearizable history stays valid."""
+    h = History()
+    ops = [
+        Op(type="invoke", f="txn", process=0,
+           value=[["r", "x", None], ["w", "x", 2]], time=0),
+        Op(type="invoke", f="txn", process=1,
+           value=[["r", "x", None]], time=1),   # overlaps with p0's txn
+        Op(type="invoke", f="txn", process=2,
+           value=[["r", "x", None]], time=2),   # also overlaps
+        # p2 completes FIRST observing 2; p1 later observing 1: legal —
+        # p1 linearized before p0's write, p2 after.
+        Op(type="ok", f="txn", process=2, value=[["r", "x", 2]], time=3),
+        Op(type="ok", f="txn", process=1, value=[["r", "x", 1]], time=4),
+        Op(type="ok", f="txn", process=0,
+           value=[["r", "x", 1], ["w", "x", 2]], time=5),
+    ]
+    for i, op in enumerate(ops):
+        h.append(op.with_(index=i))
+    res = ew.check(h, linearizable_keys=True, wfr_keys=True)
+    assert res["valid?"] is True, res
+
+
+def test_wfr_keys_opt_in():
+    """Read-then-write precedence applies only when wfr_keys is set:
+    a same-key cycle through read/write pairs is invisible without it."""
+    ops = [
+        txn("ok", [["r", "x", 1], ["w", "x", 2]], process=0, time=0),
+        txn("ok", [["r", "x", 2], ["w", "x", 1]], process=1, time=1),
+    ]
+    off = check(*ops)
+    # the wr cycle (each reads the other's write) is real either way,
+    # but version-order evidence — hence cyclic-versions — needs wfr
+    assert "cyclic-versions" not in off["anomaly-types"]
+    on = check(*ops, wfr_keys=True)
+    assert on["valid?"] is False
+    assert "cyclic-versions" in on["anomaly-types"]
+
+
+def test_wr_gen_unique_writes():
+    g = ew.WrGen(key_count=2, max_writes_per_key=4, seed=3)
+    seen = set()
+    for _ in range(100):
+        for f, k, v in g.txn():
+            if f == "w":
+                assert (k, v) not in seen
+                seen.add((k, v))
+    assert seen

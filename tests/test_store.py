@@ -159,6 +159,27 @@ def test_append_open_preserves_tail_despite_early_corruption(tmp_path):
     jf.close()
 
 
+def test_unreachable_committed_index_refuses_not_truncates(tmp_path):
+    """Pointer claims a commit but early bit-rot blocks both the
+    pointer and the scan: open must raise, never truncate the file
+    down to a bare header."""
+    p = str(tmp_path / "t.jepsen")
+    jf = JepsenFile(p, "w")
+    jf.write_history({"name": "x"}, ops=HISTORY)
+    jf.close()
+    size = os.path.getsize(p)
+    with open(p, "r+b") as fh:
+        fh.seek(len(MAGIC) + 8 + 20)   # rot the first block
+        b = fh.read(1)
+        fh.seek(-1, os.SEEK_CUR)
+        fh.write(bytes([b[0] ^ 0xFF]))
+        fh.seek(len(MAGIC))
+        fh.write(struct.pack("<Q", size + 64))  # pointer torn too
+    with pytest.raises(CorruptFile):
+        JepsenFile(p, "a")
+    assert os.path.getsize(p) == size  # bytes preserved for forensics
+
+
 def test_checksum_detects_corruption(tmp_path):
     p = str(tmp_path / "t.jepsen")
     jf = JepsenFile(p, "w")

@@ -1,0 +1,49 @@
+"""Txn micro-op algebra tests (parity targets:
+txn/src/jepsen/txn.clj, txn/src/jepsen/txn/micro_op.clj)."""
+
+from jepsen_tpu import txn
+from jepsen_tpu.history import Op
+
+
+def test_accessors_and_predicates():
+    m = ["append", 3, 2]
+    assert txn.mop_f(m) == "append"
+    assert txn.mop_key(m) == 3
+    assert txn.mop_value(m) == 2
+    assert txn.is_write(m) and not txn.is_read(m)
+    assert txn.is_read(["r", 1, None])
+    assert txn.is_mop(["w", "x", 1])
+    assert not txn.is_mop(["cas", "x", 1])
+    assert not txn.is_mop(["w", "x"])
+
+
+def test_ext_reads():
+    # a read after our own write of the key is internal, not external
+    assert txn.ext_reads([["r", "x", 1], ["w", "x", 2],
+                          ["r", "x", 2], ["r", "y", 3]]) == \
+        {"x": 1, "y": 3}
+    # read after read of same key: only the first is external
+    assert txn.ext_reads([["r", "x", 1], ["r", "x", 2]]) == {"x": 1}
+    # write shadows subsequent reads entirely
+    assert txn.ext_reads([["w", "x", 5], ["r", "x", 5]]) == {}
+
+
+def test_ext_writes():
+    assert txn.ext_writes([["w", "x", 1], ["w", "x", 2],
+                           ["w", "y", 3], ["r", "z", 4]]) == \
+        {"x": 2, "y": 3}
+
+
+def test_int_write_mops():
+    assert txn.int_write_mops([["w", "x", 1], ["w", "x", 2],
+                               ["w", "y", 3]]) == \
+        {"x": [["w", "x", 1]]}
+    assert txn.int_write_mops([["w", "x", 1]]) == {}
+
+
+def test_reduce_mops_and_op_mops():
+    h = [Op(type="ok", f="txn", value=[["w", "x", 1], ["r", "y", 2]]),
+         Op(type="ok", f="txn", value=[["w", "z", 3]])]
+    assert txn.reduce_mops(lambda acc, op, mop: acc + [mop[2]], [], h) \
+        == [1, 2, 3]
+    assert len(list(txn.op_mops(h))) == 3
