@@ -296,16 +296,13 @@ def _g1a_cases(oks, failed):
 def _g1b_cases(oks):
     """Reads whose final element is an *intermediate* append: the
     writer went on to append more to that key in the same txn."""
-    # (k, v) -> True when v is a non-final append of its txn
+    from ..txn import int_write_mops
+    # (k, v) -> writer index when v is a non-final append of its txn
     intermediate = {}
     for op in oks:
-        per_key: dict = {}
-        for f, k, v in op.value:
-            if f == APPEND:
-                per_key.setdefault(k, []).append(v)
-        for k, vs in per_key.items():
-            for v in vs[:-1]:
-                intermediate[(k, v)] = op.index
+        for k, mops in int_write_mops(op.value).items():
+            for m in mops:
+                intermediate[(k, m[2])] = op.index
     cases = []
     for op in oks:
         own = {(k, v) for f, k, v in op.value if f == APPEND}
@@ -374,8 +371,12 @@ def _cycle_case(g: DepGraph, cycle: list, history: History) -> dict:
 class AppendGen:
     """Generates list-append transactions (elle.list-append/gen
     semantics, exposed at tests/cycle/append.clj:28-31): a rotating pool
-    of active keys, unique monotonically increasing append values per
-    key, keys retired after max_writes_per_key appends."""
+    of active keys, unique monotonically increasing write values per
+    key, keys retired after max_writes_per_key writes. The write mop
+    tag is parameterizable so the rw-register generator (unique plain
+    writes) shares the exact same key-pool behavior."""
+
+    write_f = APPEND
 
     def __init__(self, key_count: int = 3, min_txn_length: int = 1,
                  max_txn_length: int = 4, max_writes_per_key: int = 32,
@@ -398,7 +399,7 @@ class AppendGen:
                 out.append([R, k, None])
             else:
                 self.writes[k] += 1
-                out.append([APPEND, k, self.writes[k]])
+                out.append([self.write_f, k, self.writes[k]])
                 if self.writes[k] >= self.max_writes:
                     self.active.remove(k)
                     self.active.append(self.next_key)

@@ -231,35 +231,40 @@ def _bfs_path(adj: dict, start: int, goal: int,
 # -- additional graphs (Elle's :additional-graphs) -------------------------
 
 def realtime_graph(history) -> DepGraph:
-    """A completes strictly before B begins => A -> B (transitively
-    reduced: each op links only from the frontier of ops nothing else
-    has succeeded yet)."""
+    """A completes strictly before B begins => A -> B, transitively
+    reduced.
+
+    Sweep events in time order keeping a frontier of completed ops not
+    yet *superseded*. B invoking links B from every frontier op; those
+    predecessors leave the frontier only when B COMPLETES — any op D
+    invoking after B's completion reaches them through B (A -> B -> D),
+    but an op C invoking before B completes still needs its own A -> C
+    edge (removing predecessors at B's invocation would drop it)."""
     g = DepGraph()
-    # completed ops with their invocation, in history order
     pairs = [(inv, comp) for inv, comp in history.pairs()
              if comp is not None and comp.is_ok]
-    # events: (time, kind, op-index); completions before invocations at
-    # equal times (an op invoked at t sees completions at t)
+    # events: (time, kind, ...); completions before invocations at equal
+    # times (an op invoked at t sees completions at t)
     events = []
     for inv, comp in pairs:
         events.append((inv.time, 1, comp.index, inv, comp))
         events.append((comp.time, 0, comp.index, inv, comp))
     events.sort(key=lambda e: (e[0], e[1]))
-    frontier: set = set()       # completed, not yet succeeded
-    done: dict = {}             # index -> completion op
+    frontier: set = set()   # completed, not superseded
+    done: dict = {}         # index -> completion op
+    preds_of: dict = {}     # index -> frontier snapshot at invocation
     for _t, kind, idx, inv, comp in events:
-        if kind == 0:
+        if kind == 1:
+            preds = frontier - {idx}
+            preds_of[idx] = preds
+            for p in preds:
+                g.add_edge(p, idx, REALTIME,
+                           {"pred_completed": done[p].time,
+                            "succ_began": inv.time})
+        else:
+            frontier -= preds_of.get(idx, set())
             frontier.add(idx)
             done[idx] = comp
-        else:
-            preds = list(frontier)
-            for p in preds:
-                if p != idx:
-                    g.add_edge(p, idx, REALTIME,
-                               {"pred_completed": done[p].time,
-                                "succ_began": inv.time})
-            # anything with a successor leaves the frontier
-            frontier -= {p for p in preds if p != idx}
     return g
 
 

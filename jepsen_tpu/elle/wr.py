@@ -33,7 +33,7 @@ from ..history import History
 from ..txn import R, W
 from .graph import (PROCESS, REALTIME, RW, WR, WW, DepGraph,
                     process_graph, realtime_graph)
-from .append import MODEL_VIOLATIONS
+from .append import MODEL_VIOLATIONS, AppendGen
 
 DEFAULT_ANOMALIES = ("G0", "G1a", "G1b", "G1c", "G-single", "G2",
                      "internal", "cyclic-versions")
@@ -167,15 +167,12 @@ def _g1a_cases(oks, failed):
 
 
 def _g1b_cases(oks):
+    from ..txn import int_write_mops
     intermediate = {}
     for op in oks:
-        per_key: dict = {}
-        for f, k, v in op.value:
-            if f == W:
-                per_key.setdefault(k, []).append(v)
-        for k, vs in per_key.items():
-            for v in vs[:-1]:
-                intermediate[(k, v)] = op.index
+        for k, mops in int_write_mops(op.value).items():
+            for m in mops:
+                intermediate[(k, m[2])] = op.index
     cases = []
     for op in oks:
         for f, k, v in op.value:
@@ -389,39 +386,9 @@ def _cycle_case(g: DepGraph, cycle: list) -> dict:
 
 # -- generator ---------------------------------------------------------------
 
-class WrGen:
-    """Write/read register txn generator with globally unique write
-    values per key (rw-register's core assumption)."""
+class WrGen(AppendGen):
+    """Register txn generator: identical key-pool behavior to
+    AppendGen, but emits plain unique writes (rw-register's core
+    assumption) instead of appends."""
 
-    def __init__(self, key_count: int = 3, min_txn_length: int = 1,
-                 max_txn_length: int = 4, max_writes_per_key: int = 32,
-                 seed: Optional[int] = None):
-        import random
-        self.key_count = key_count
-        self.min_len = min_txn_length
-        self.max_len = max_txn_length
-        self.max_writes = max_writes_per_key
-        self.rng = random.Random(seed)
-        self.next_key = key_count
-        self.active = list(range(key_count))
-        self.writes: dict = {k: 0 for k in self.active}
-
-    def txn(self) -> list:
-        n = self.rng.randint(self.min_len, self.max_len)
-        out = []
-        for _ in range(n):
-            k = self.rng.choice(self.active)
-            if self.rng.random() < 0.5:
-                out.append([R, k, None])
-            else:
-                self.writes[k] += 1
-                out.append([W, k, self.writes[k]])
-                if self.writes[k] >= self.max_writes:
-                    self.active.remove(k)
-                    self.active.append(self.next_key)
-                    self.writes[self.next_key] = 0
-                    self.next_key += 1
-        return out
-
-    def __call__(self, test, ctx):
-        return {"f": "txn", "value": self.txn()}
+    write_f = W

@@ -77,6 +77,64 @@ class AtomClient(jclient.Client):
         self.meta_log.append("close")
 
 
+class IndependentAtomClient(jclient.Client):
+    """Multi-key CAS-register client for independent workloads: op
+    values are [k v] tuples; each key addresses its own SharedRegister
+    in a shared dict (the in-process analog of the reference's
+    register-map tests)."""
+
+    def __init__(self, states: Optional[dict] = None, lie_keys=(),
+                 lock: Optional[threading.Lock] = None):
+        self.states = states if states is not None else {}
+        # the registry lock must be SHARED across open() clones, or two
+        # clones could both install a fresh register for the same key
+        # and one of them silently lose writes
+        self.lock = lock or threading.Lock()
+        self.lie_keys = set(lie_keys)  # keys whose reads lie (for tests)
+
+    def open(self, test, node):
+        return IndependentAtomClient(self.states, self.lie_keys,
+                                     self.lock)
+
+    def setup(self, test):
+        pass
+
+    def _reg(self, k) -> SharedRegister:
+        with self.lock:
+            if k not in self.states:
+                self.states[k] = SharedRegister()
+            return self.states[k]
+
+    def invoke(self, test, op):
+        from .independent import KV, tuple_
+        _time.sleep(0.0002)
+        kv = op.get("value")
+        if not isinstance(kv, KV):
+            raise ValueError(f"expected [k v] tuple value, got {kv!r}")
+        k, v = kv
+        reg = self._reg(k)
+        f = op.get("f")
+        if f == "write":
+            reg.write(v)
+            return {**op, "type": "ok"}
+        if f == "cas":
+            cur, new = v
+            okd = reg.cas(cur, new)
+            return {**op, "type": "ok" if okd else "fail"}
+        if f == "read":
+            out = reg.read()
+            if k in self.lie_keys:
+                out = (out or 0) + 100  # deliberately wrong
+            return {**op, "type": "ok", "value": tuple_(k, out)}
+        raise ValueError(f"unknown op {f!r}")
+
+    def teardown(self, test):
+        pass
+
+    def close(self, test):
+        pass
+
+
 class NoopNemesis(jnemesis.Noop):
     """Accepts every op unchanged."""
 

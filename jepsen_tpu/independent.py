@@ -21,6 +21,7 @@ from __future__ import annotations
 from dataclasses import dataclass
 from typing import Any, Callable, Iterable, Optional, Sequence
 
+from . import generator as gen
 from .checker import Checker, check_safe, merge_valid
 from .history import History, Op, strip_nemesis
 from .models.core import Model
@@ -49,6 +50,135 @@ def tuple_(k, v) -> KV:
 
 def is_tuple(value) -> bool:
     return isinstance(value, KV)
+
+
+# ---------------------------------------------------------------------------
+# Generator lifting (independent.clj:31-238)
+# ---------------------------------------------------------------------------
+
+def tuple_gen(k, g):
+    """Wrap a generator so its invocations carry [k v] tuple values
+    (independent.clj:96-103)."""
+    def wrap(op):
+        if op.get("type", "invoke") == "invoke":
+            return {**op, "value": tuple_(k, op.get("value"))}
+        return op
+    return gen.map_(wrap, g)
+
+
+def sequential_generator(keys: Iterable, fgen: Callable):
+    """One key at a time: exhaust fgen(k1), move to k2, ... — each op's
+    value wrapped as a [k v] tuple (independent.clj:31-47). fgen must be
+    pure."""
+    return [tuple_gen(k, fgen(k)) for k in keys]
+
+
+def group_threads(n: int, ctx) -> list:
+    """Partition the context's threads (sorted) into groups of n
+    (independent.clj:49-76); asserts divisibility the same way."""
+    threads = sorted(ctx.all_threads(), key=str)
+    count = len(threads)
+    assert n <= count, (
+        f"with {count} worker threads, a concurrent generator cannot run "
+        f"a key with {n} threads; raise concurrency to at least {n}")
+    assert count % n == 0, (
+        f"{count} worker threads cannot be evenly split into groups of "
+        f"{n}; set concurrency to a multiple of {n}")
+    return [frozenset(threads[i:i + n]) for i in range(0, count, n)]
+
+
+class ConcurrentGenerator(gen.Generator):
+    """Splits worker threads into groups of n per key; each group runs
+    fgen(k) until exhaustion, then takes the next key. Ops are chosen by
+    soonest-op selection across free groups; updates route to the
+    owning group's generator (independent.clj:103-211).
+
+    Use via `concurrent_generator(...)`, which excludes the nemesis."""
+
+    def __init__(self, n: int, keys: Sequence, fgen: Callable,
+                 groups: Optional[list] = None,
+                 thread_group: Optional[dict] = None,
+                 gens: Optional[list] = None):
+        assert n > 0 and isinstance(n, int)
+        self.n = n
+        self.keys = list(keys)
+        self.fgen = fgen
+        self.groups = groups            # list of frozensets of threads
+        self.thread_group = thread_group  # thread -> group index
+        self.gens = gens                # per-group generator (or None)
+
+    def _grouped(self, ctx):
+        groups = self.groups or group_threads(self.n, ctx)
+        tg = self.thread_group or {t: i for i, g in enumerate(groups)
+                                   for t in g}
+        if self.gens is None:
+            head = self.keys[:len(groups)]
+            gens = [tuple_gen(k, self.fgen(k)) for k in head]
+            gens += [None] * (len(groups) - len(gens))
+            keys = self.keys[len(groups):]
+        else:
+            gens, keys = list(self.gens), list(self.keys)
+        return groups, tg, gens, keys
+
+    def op(self, test, ctx):
+        groups, tg, gens, keys = self._grouped(ctx)
+        free_groups = sorted({tg[t] for t in ctx.free_threads if t in tg})
+        soonest = None
+        for grp in free_groups:
+            while True:
+                g = gens[grp]
+                if g is None:
+                    break
+                members = groups[grp]
+                gctx = ctx.restrict(lambda t, s=members: t in s)
+                res = gen.op(g, test, gctx)
+                if res is None:
+                    # exhausted: take the next key, or retire the group
+                    if keys:
+                        k, keys = keys[0], keys[1:]
+                        gens[grp] = tuple_gen(k, self.fgen(k))
+                        continue
+                    gens[grp] = None
+                    break
+                o, g2 = res
+                soonest = gen.soonest_op_map(
+                    soonest, {"op": o, "group": grp, "gen": g2,
+                              "weight": len(members)})
+                if o is gen.PENDING:
+                    gens[grp] = g2
+                break
+        if soonest is not None and soonest["op"] is not gen.PENDING:
+            gens2 = list(gens)
+            gens2[soonest["group"]] = soonest["gen"]
+            return (soonest["op"],
+                    ConcurrentGenerator(self.n, keys, self.fgen, groups,
+                                        tg, gens2))
+        if any(g is not None for g in gens):
+            # busy groups may still produce ops
+            return (gen.PENDING,
+                    ConcurrentGenerator(self.n, keys, self.fgen, groups,
+                                        tg, gens))
+        return None
+
+    def update(self, test, ctx, event):
+        if self.thread_group is None or self.gens is None:
+            return self
+        t = ctx.process_to_thread(event.get("process"))
+        grp = self.thread_group.get(t)
+        if grp is None or self.gens[grp] is None:
+            return self
+        members = self.groups[grp]
+        gctx = ctx.restrict(lambda th, s=members: th in s)
+        gens = list(self.gens)
+        gens[grp] = gen.update(gens[grp], test, gctx, event)
+        return ConcurrentGenerator(self.n, self.keys, self.fgen,
+                                   self.groups, self.thread_group, gens)
+
+
+def concurrent_generator(n: int, keys: Iterable, fgen: Callable):
+    """Thread groups of n per key, soonest-op scheduling, nemesis
+    excluded (independent.clj:213-238)."""
+    return gen.clients(ConcurrentGenerator(n, list(keys), fgen))
 
 
 def history_keys(history: History) -> list:

@@ -195,6 +195,36 @@ def test_realtime_cycle_strict_serializability():
     assert rt["valid?"] is False   # but T0 completed before T1 began
 
 
+def test_realtime_reduction_keeps_overlapped_edges():
+    """Regression: op A completes, then B invokes (still running), then
+    C invokes and completes while B runs. A->C must still be emitted —
+    A->B->C does NOT cover it because B completes after C invokes."""
+    h = History()
+    ops = [
+        Op(type="invoke", f="txn", process=0,
+           value=[["append", "x", 1]], time=0),
+        Op(type="ok", f="txn", process=0,
+           value=[["append", "x", 1]], time=1),          # A done t1
+        Op(type="invoke", f="txn", process=1,
+           value=[["r", "y", None]], time=2),            # B begins t2
+        Op(type="invoke", f="txn", process=2,
+           value=[["r", "x", None]], time=3),            # C begins t3
+        Op(type="ok", f="txn", process=2,
+           value=[["r", "x", []]], time=4),              # C: stale read!
+        Op(type="ok", f="txn", process=1,
+           value=[["r", "y", []]], time=10),             # B done late
+        # establish x's version order
+        Op(type="invoke", f="txn", process=3,
+           value=[["r", "x", None]], time=11),
+        Op(type="ok", f="txn", process=3,
+           value=[["r", "x", [1]]], time=12),
+    ]
+    for i, op in enumerate(ops):
+        h.append(op.with_(index=i))
+    res = ea.check(h, additional_graphs=("realtime",))
+    assert res["valid?"] is False, res
+
+
 # --- generator -------------------------------------------------------------
 
 def test_append_gen_unique_monotone_values():
