@@ -1,0 +1,83 @@
+"""`python -m jepsen_tpu` — the built-in demo test runner.
+
+A complete CLI suite wired around the in-process CAS-register fakes,
+mirroring how per-DB suites wire `cli/single-test-cmd` in the reference
+(e.g. `zookeeper/src/jepsen/zookeeper.clj:131-145`): `test` runs one
+demo test end to end (dummy remote, in-process register, WGL checker)
+and exits by validity; `test-all` sweeps seeds; `analyze` re-checks the
+latest stored run; `serve` browses the store.
+
+Usage:
+  python -m jepsen_tpu test --time-limit 5 --concurrency 2n
+  python -m jepsen_tpu test-all --test-count 3
+  python -m jepsen_tpu serve -p 8080
+"""
+
+from __future__ import annotations
+
+from . import checker, cli, fakes, models
+from . import generator as gen
+from .cli import Opt
+
+
+def demo_workload():
+    """r/w/cas op mix over a small value alphabet
+    (tests/linearizable_register.clj:18-29)."""
+    return gen.mix([
+        gen.repeat(lambda: {"f": "read"}),
+        gen.repeat(lambda: {"f": "write", "value": gen.RNG.randrange(5)}),
+        gen.repeat(lambda: {"f": "cas",
+                            "value": [gen.RNG.randrange(5),
+                                      gen.RNG.randrange(5)]}),
+    ])
+
+
+def demo_test(options: dict) -> dict:
+    """Build the demo test map from parsed CLI options."""
+    reg = fakes.SharedRegister()
+    rate = options.get("rate") or 10.0
+    return {
+        "name": options.get("name") or "demo",
+        "store_root": options.get("store_root") or "store",
+        "nodes": options["nodes"],
+        "concurrency": options["concurrency"],
+        # the demo's "cluster" is in-process; always use the dummy remote
+        "ssh": {"dummy?": True},
+        "client": fakes.AtomClient(reg),
+        "nemesis": fakes.NoopNemesis(),
+        "leave_db_running?": options.get("leave_db_running?", False),
+        # the reference register workload composes linearizable (+
+        # timeline) only — stats would fail any short run where some op
+        # type happens to record zero oks (checker.clj:166-183)
+        "checker": checker.linearizable(models.cas_register(),
+                                        algorithm="wgl"),
+        "generator": gen.time_limit(
+            options.get("time_limit") or 60,
+            gen.clients(gen.stagger(1.0 / rate, demo_workload()))),
+    }
+
+
+def demo_tests(options: dict):
+    """test-all: the demo test repeated across seeds."""
+    for i in range(options.get("test_count") or 1):
+        t = demo_test(options)
+        yield {**t, "name": f"{t['name']}-{i}"}
+
+
+DEMO_OPTS = [
+    Opt("name", metavar="NAME", default="demo",
+        help="Name for this test run"),
+    Opt("store_root", metavar="DIR", default="store",
+        help="Where to write results"),
+    Opt("rate", metavar="HZ", default=10.0, parse=float,
+        help="Approximate ops/sec per worker"),
+]
+
+COMMANDS = {
+    **cli.single_test_cmd({"test_fn": demo_test, "opt_spec": DEMO_OPTS}),
+    **cli.test_all_cmd({"tests_fn": demo_tests, "opt_spec": DEMO_OPTS}),
+    **cli.serve_cmd(),
+}
+
+if __name__ == "__main__":
+    cli.main(COMMANDS)

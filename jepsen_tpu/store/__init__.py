@@ -121,6 +121,19 @@ def load(name: str, start_time: str, store_root: str = BASE_DIR) -> dict:
     return jf.read_test(lazy=True)
 
 
+def load_latest(store_root: str = BASE_DIR) -> Optional[dict]:
+    """Fully load the most recent run's test map — history and results
+    included (store.clj:282 + load). Used by `analyze` CLI commands."""
+    d = latest(store_root)
+    if d is None:
+        return None
+    jf = JepsenFile(os.path.join(d, "test.jepsen"), "r")
+    try:
+        return jf.read_test(lazy=False)
+    finally:
+        jf.close()
+
+
 def tests(store_root: str = BASE_DIR) -> dict:
     """{name: {start-time: path}} for every stored run (store.clj:226)."""
     out: dict = {}

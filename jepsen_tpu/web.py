@@ -1,0 +1,308 @@
+"""Web frontend for browsing test results.
+
+Capability parity with jepsen.web (`jepsen/src/jepsen/web.clj`): a
+small HTTP server over the store directory — a home page listing every
+run with validity coloring (web.clj:146-159), a file browser with
+breadcrumbs, colored run cells, inline image/text previews
+(web.clj:235-284), raw file serving with content types
+(web.clj:340-377), and zip download of whole run directories
+(web.clj:305-327). Requests outside the store root are rejected
+(web.clj:329-334).
+
+Redesign notes: the reference rides http-kit + hiccup; here it is the
+standard library's ThreadingHTTPServer and direct HTML strings — no
+external dependencies, which matters for control-node installs. The
+fast path for validity is `JepsenFile.read_valid()`, which reads just
+the results block, never the history; results are memoized except for
+the most recent few runs, which may still be mid-write
+(web.clj:48-75).
+"""
+
+from __future__ import annotations
+
+import html
+import io
+import logging
+import os
+import re
+import threading
+import urllib.parse
+import zipfile
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from . import store
+
+log = logging.getLogger("jepsen_tpu.web")
+
+VALID_COLORS = {
+    True: "#79c77a",       # ok: green
+    "unknown": "#f2b75c",  # indeterminate: amber
+    False: "#ee7785",      # invalid: red
+    None: "#e3e3e3",       # no results yet
+}
+
+CONTENT_TYPES = {
+    ".txt": "text/plain; charset=utf-8",
+    ".log": "text/plain; charset=utf-8",
+    ".json": "text/plain; charset=utf-8",
+    ".jsonl": "text/plain; charset=utf-8",
+    ".edn": "text/plain; charset=utf-8",
+    ".html": "text/html; charset=utf-8",
+    ".svg": "image/svg+xml",
+    ".png": "image/png",
+    ".jpg": "image/jpeg",
+    ".jpeg": "image/jpeg",
+    ".gif": "image/gif",
+    ".zip": "application/zip",
+}
+
+_IMG_RE = re.compile(r"\.(png|jpe?g|gif|svg)$", re.I)
+_TEXT_RE = re.compile(r"\.(txt|edn|json|jsonl|ya?ml|log|stdout|stderr)$",
+                      re.I)
+
+# How many of the most recent runs to re-read on every page load — they
+# may still be running (web.clj:57-61).
+MUTABLE_WINDOW = 2
+
+
+class _ValidityCache:
+    """Memoized {(name, time): valid?} over the store (web.clj:48-92)."""
+
+    def __init__(self, store_root: str):
+        self.store_root = store_root
+        self.cache: dict = {}
+        self.lock = threading.Lock()
+
+    def read_valid(self, run_dir: str):
+        jf_path = os.path.join(run_dir, "test.jepsen")
+        try:
+            jf = store.JepsenFile(jf_path, "r")
+            try:
+                return jf.read_valid()
+            finally:
+                jf.close()
+        except FileNotFoundError:
+            return None
+        except Exception:  # torn mid-write file etc.
+            log.warning("Unable to parse %s", jf_path, exc_info=True)
+            return "incomplete"
+
+    def runs(self) -> list:
+        """[(name, time, path, valid?)] sorted newest-first."""
+        entries = []
+        for name, by_time in store.tests(self.store_root).items():
+            for t, path in by_time.items():
+                entries.append((t, name, path))
+        entries.sort(reverse=True)
+        out = []
+        with self.lock:
+            for i, (t, name, path) in enumerate(entries):
+                key = (name, t)
+                if i >= MUTABLE_WINDOW and key in self.cache:
+                    v = self.cache[key]
+                else:
+                    v = self.read_valid(path)
+                    self.cache[key] = v
+                out.append((name, t, path, v))
+        return out
+
+
+def _esc(s) -> str:
+    return html.escape(str(s), quote=True)
+
+
+def _file_href(store_root: str, path: str) -> str:
+    rel = os.path.relpath(path, store_root)
+    return "/files/" + "/".join(
+        urllib.parse.quote(c) for c in rel.split(os.sep))
+
+
+def _page(title: str, body: str) -> bytes:
+    return (f"<!doctype html><html><head><meta charset='utf-8'>"
+            f"<title>{_esc(title)}</title>"
+            f"<style>body{{font-family:sans-serif;margin:1.5em}}"
+            f"table{{border-collapse:collapse}}"
+            f"td,th{{padding:4px 10px;text-align:left}}"
+            f"a{{color:#205080}}</style></head>"
+            f"<body>{body}</body></html>").encode()
+
+
+def render_home(cache: _ValidityCache) -> bytes:
+    """The test table (web.clj:146-159)."""
+    rows = []
+    for name, t, path, valid in cache.runs():
+        href = _file_href(cache.store_root, path)
+        color = VALID_COLORS.get(valid, VALID_COLORS[None])
+        rows.append(
+            f"<tr><td><a href='{href}'>{_esc(name)}</a></td>"
+            f"<td><a href='{href}'>{_esc(t)}</a></td>"
+            f"<td style='background:{color}'>{_esc(valid)}</td>"
+            f"<td><a href='{href}/results.json'>results.json</a></td>"
+            f"<td><a href='{href}/history.txt'>history.txt</a></td>"
+            f"<td><a href='{href}/jepsen.log'>jepsen.log</a></td>"
+            f"<td><a href='{href}.zip'>zip</a></td></tr>")
+    body = ("<h1>jepsen_tpu</h1><table><thead><tr><th>Name</th>"
+            "<th>Time</th><th>Valid?</th><th>Results</th><th>History</th>"
+            "<th>Log</th><th>Zip</th></tr></thead><tbody>"
+            + "".join(rows) + "</tbody></table>")
+    return _page("jepsen_tpu", body)
+
+
+def _dir_sort(names: list) -> list:
+    """Numeric sort when every name is an integer (web.clj:223-229)."""
+    if names and all(re.fullmatch(r"\d+", n) for n in names):
+        return sorted(names, key=int)
+    return sorted(names)
+
+
+def render_dir(cache: _ValidityCache, path: str) -> bytes:
+    """Directory browse page: breadcrumbs, colored subdir cells, file
+    previews (web.clj:235-284)."""
+    root = cache.store_root
+    crumbs = ["<a href='/'>jepsen_tpu</a>"]
+    rel = os.path.relpath(path, root)
+    acc = root
+    if rel != ".":
+        for comp in rel.split(os.sep):
+            acc = os.path.join(acc, comp)
+            crumbs.append(
+                f"<a href='{_file_href(root, acc)}'>{_esc(comp)}</a>")
+    parts = [" / ".join(crumbs),
+             f"<h1>{_esc(os.path.basename(path))} "
+             f"<a style='font-size:60%' "
+             f"href='{_file_href(root, path)}.zip'>.zip</a></h1>"]
+
+    entries = sorted(os.listdir(path))
+    dirs = [e for e in entries
+            if os.path.isdir(os.path.join(path, e))]
+    files = [e for e in entries
+             if not os.path.isdir(os.path.join(path, e))]
+
+    cells = []
+    for d in _dir_sort(dirs):
+        sub = os.path.join(path, d)
+        valid = None
+        if os.path.exists(os.path.join(sub, "test.jepsen")):
+            valid = cache.read_valid(sub)
+        color = VALID_COLORS.get(valid, VALID_COLORS[None])
+        cells.append(
+            f"<a href='{_file_href(root, sub)}' "
+            f"style='text-decoration:none;color:#000'>"
+            f"<div style='background:{color};display:inline-block;"
+            f"margin:8px;padding:10px;width:280px;overflow:hidden'>"
+            f"{_esc(d)}</div></a>")
+    parts.append("<div>" + "".join(cells) + "</div>")
+
+    # results first, then history, then the rest (web.clj:279-283)
+    files.sort(key=lambda f: (f != "results.json", f != "history.txt", f))
+    fcells = []
+    for f in files:
+        fp = os.path.join(path, f)
+        href = _file_href(root, fp)
+        if _IMG_RE.search(f):
+            preview = (f"<img src='{href}' title='{_esc(f)}' "
+                       f"style='width:auto;height:200px'>")
+        elif _TEXT_RE.search(f):
+            try:
+                with open(fp, errors="replace") as fh:
+                    head = fh.read(4096)
+            except OSError:
+                head = ""
+            preview = f"<pre style='font-size:11px'>{_esc(head)}</pre>"
+        else:
+            preview = ("<div style='background:#f4f4f4;width:100%;"
+                       "height:100%'></div>")
+        fcells.append(
+            f"<div style='display:inline-block;margin:8px;vertical-align:"
+            f"top'><div style='height:200px;width:300px;overflow:hidden'>"
+            f"<a href='{href}' style='text-decoration:none;color:#555'>"
+            f"{preview}</a></div><a href='{href}'>{_esc(f)}</a></div>")
+    parts.append("<div>" + "".join(fcells) + "</div>")
+    return _page(os.path.basename(path), "".join(parts))
+
+
+def zip_dir_bytes(path: str) -> io.BytesIO:
+    """A whole run directory as an in-memory zip (web.clj:287-327;
+    run dirs are small — logs + results, never model weights)."""
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as z:
+        for dirpath, _dirs, files in os.walk(path):
+            for f in files:
+                fp = os.path.join(dirpath, f)
+                if os.path.isfile(fp):
+                    z.write(fp, os.path.relpath(fp, path))
+    buf.seek(0)
+    return buf
+
+
+def in_scope(store_root: str, path: str) -> bool:
+    """Reject paths outside the store dir (web.clj:329-334)."""
+    real = os.path.realpath(path)
+    rootp = os.path.realpath(store_root)
+    return real == rootp or real.startswith(rootp + os.sep)
+
+
+class Handler(BaseHTTPRequestHandler):
+    cache: _ValidityCache  # injected by serve()
+
+    def log_message(self, fmt, *args):  # route through logging
+        log.debug("%s " + fmt, self.address_string(), *args)
+
+    def _send(self, code: int, ctype: str, body: bytes):
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _404(self):
+        self._send(404, "text/plain", b"404 not found")
+
+    def do_GET(self):  # noqa: N802 (http.server API)
+        try:
+            uri = urllib.parse.unquote(
+                urllib.parse.urlparse(self.path).path)
+            if uri == "/":
+                self._send(200, "text/html; charset=utf-8",
+                           render_home(self.cache))
+                return
+            m = re.match(r"^/files/(.+)$", uri)
+            if not m:
+                self._404()
+                return
+            root = self.cache.store_root
+            path = os.path.join(root, *m.group(1).split("/"))
+            if not in_scope(root, path):
+                self._send(403, "text/plain", b"File out of scope.")
+                return
+            if os.path.isfile(path):
+                ext = os.path.splitext(path)[1].lower()
+                ctype = CONTENT_TYPES.get(ext,
+                                          "application/octet-stream")
+                with open(path, "rb") as fh:
+                    self._send(200, ctype, fh.read())
+            elif path.endswith(".zip") and os.path.isdir(path[:-4]):
+                self._send(200, "application/zip",
+                           zip_dir_bytes(path[:-4]).getvalue())
+            elif os.path.isdir(path):
+                self._send(200, "text/html; charset=utf-8",
+                           render_dir(self.cache, path))
+            else:
+                self._404()
+        except BrokenPipeError:
+            pass
+        except Exception:  # noqa: BLE001
+            log.warning("error serving %s", self.path, exc_info=True)
+            try:
+                self._send(500, "text/plain", b"500 internal error")
+            except OSError:
+                pass
+
+
+def serve(host: str = "0.0.0.0", port: int = 8080,
+          store_root: str = store.BASE_DIR) -> ThreadingHTTPServer:
+    """Build the server (web.clj:385-390). Caller runs serve_forever();
+    port 0 picks a free port (the tests use this)."""
+    cache = _ValidityCache(store_root)
+    handler = type("BoundHandler", (Handler,), {"cache": cache})
+    return ThreadingHTTPServer((host, port), handler)

@@ -1,0 +1,205 @@
+"""CLI framework tests — option parsing, spec merging, node and
+concurrency resolution, exit-code contract (cli.clj:64-168,129-139),
+and the demo suite end to end through run_cli."""
+
+import io
+import sys
+
+import pytest
+
+from jepsen_tpu import cli
+from jepsen_tpu.cli import Opt, Parsed
+
+
+def parse(argv, spec=None):
+    return cli.parse_opts(argv, spec or cli.TEST_OPT_SPEC)
+
+
+class TestParseOpts:
+    def test_defaults(self):
+        p = parse([])
+        assert p.options["node"] is cli.DEFAULT_NODES
+        assert p.options["concurrency"] == "1n"
+        assert p.options["time_limit"] == 60
+        assert not p.errors
+
+    def test_repeated_node_replaces_default(self):
+        p = parse(["-n", "a", "-n", "b"])
+        assert p.options["node"] == ["a", "b"]
+
+    def test_flag_and_value_styles(self):
+        p = parse(["--time-limit=30", "--no-ssh", "--username", "admin"])
+        assert p.options["time_limit"] == 30
+        assert p.options["no_ssh"] is True
+        assert p.options["username"] == "admin"
+
+    def test_unknown_option_collects_error(self):
+        p = parse(["--bogus"])
+        assert any("Unknown option" in e for e in p.errors)
+
+    def test_validation_failure(self):
+        p = parse(["--concurrency", "abc"])
+        assert any("integer" in e for e in p.errors)
+
+    def test_parse_failure(self):
+        p = parse(["--time-limit", "-3"])
+        assert p.errors
+
+    def test_positional_arguments(self):
+        p = parse(["foo", "--time-limit", "9", "bar"])
+        assert p.arguments == ["foo", "bar"]
+        assert p.options["time_limit"] == 9
+
+
+class TestOptFns:
+    def test_parse_concurrency_3n(self):
+        p = Parsed(options={"concurrency": "3n", "nodes": ["a", "b"]})
+        assert cli.parse_concurrency(p).options["concurrency"] == 6
+
+    def test_parse_concurrency_plain(self):
+        p = Parsed(options={"concurrency": "7", "nodes": ["a"]})
+        assert cli.parse_concurrency(p).options["concurrency"] == 7
+
+    def test_parse_concurrency_invalid(self):
+        p = Parsed(options={"concurrency": "x", "nodes": []})
+        with pytest.raises(ValueError):
+            cli.parse_concurrency(p)
+
+    def test_parse_nodes_default(self):
+        p = parse([])
+        out = cli.parse_nodes(p).options
+        assert out["nodes"] == cli.DEFAULT_NODES
+        assert "node" not in out
+
+    def test_parse_nodes_merge(self, tmp_path):
+        f = tmp_path / "nodes.txt"
+        f.write_text("x1\nx2\n")
+        p = parse(["--nodes", "y1,y2", "--nodes-file", str(f)])
+        out = cli.parse_nodes(p).options
+        # file + comma-list; default -n list dropped
+        assert out["nodes"] == ["x1", "x2", "y1", "y2"]
+
+    def test_explicit_node_kept(self):
+        p = parse(["-n", "z1", "--nodes", "y1"])
+        out = cli.parse_nodes(p).options
+        assert out["nodes"] == ["y1", "z1"]
+
+    def test_test_opt_fn_full_chain(self):
+        p = parse(["--no-ssh", "--concurrency", "2n",
+                   "--leave-db-running"])
+        out = cli.test_opt_fn(p).options
+        assert out["ssh"]["dummy?"] is True
+        assert out["ssh"]["username"] == "root"
+        assert out["concurrency"] == 2 * len(cli.DEFAULT_NODES)
+        assert out["leave_db_running?"] is True
+        assert "no_ssh" not in out
+
+
+class TestMergeOptSpecs:
+    def test_latter_wins_and_appends(self):
+        a = [Opt("x", default=1), Opt("y", default=2)]
+        b = [Opt("y", default=99), Opt("z", default=3)]
+        merged = cli.merge_opt_specs(a, b)
+        by = {o.name: o for o in merged}
+        assert by["y"].default == 99
+        assert set(by) == {"x", "y", "z"}
+        # order preserved: x, y, z
+        assert [o.name for o in merged] == ["x", "y", "z"]
+
+
+class TestRunCli:
+    def test_unknown_command(self, capsys):
+        rc = cli.run_cli({"go": {}}, ["nope"])
+        assert rc == cli.EXIT_BAD_ARGS
+        assert "Commands: go" in capsys.readouterr().out
+
+    def test_no_command(self):
+        assert cli.run_cli({"go": {}}, []) == cli.EXIT_BAD_ARGS
+
+    def test_help_exits_zero(self, capsys):
+        rc = cli.run_cli(
+            {"go": {"opt_spec": [Opt("help", short="-h", help="help")]}},
+            ["go", "--help"])
+        assert rc == cli.EXIT_OK
+
+    def test_bad_args_254(self, capsys):
+        spec = [Opt("n", metavar="N", parse=int)]
+        rc = cli.run_cli({"go": {"opt_spec": spec}}, ["go", "--n", "x"])
+        assert rc == cli.EXIT_BAD_ARGS
+
+    def test_run_return_code_passthrough(self):
+        sub = {"go": {"opt_spec": [], "run": lambda p: 2}}
+        assert cli.run_cli(sub, ["go"]) == 2
+
+    def test_run_none_is_zero(self):
+        sub = {"go": {"opt_spec": [], "run": lambda p: None}}
+        assert cli.run_cli(sub, ["go"]) == 0
+
+    def test_crash_is_255(self):
+        def boom(p):
+            raise RuntimeError("boom")
+        sub = {"go": {"opt_spec": [], "run": boom}}
+        assert cli.run_cli(sub, ["go"]) == cli.EXIT_ERROR
+
+    def test_opt_fn_error_is_254(self):
+        def bad(p):
+            raise ValueError("nope")
+        sub = {"go": {"opt_spec": [], "opt_fn": bad}}
+        assert cli.run_cli(sub, ["go"]) == cli.EXIT_BAD_ARGS
+
+
+class TestTestAllHelpers:
+    def test_exit_codes(self):
+        assert cli.test_all_exit_code({True: ["a"]}) == 0
+        assert cli.test_all_exit_code({True: ["a"], False: ["b"]}) == 1
+        assert cli.test_all_exit_code({"unknown": ["a"]}) == 2
+        assert cli.test_all_exit_code(
+            {"crashed": ["a"], False: ["b"]}) == cli.EXIT_ERROR
+
+    def test_run_tests_groups_outcomes(self, tmp_path):
+        from jepsen_tpu import checker, fakes
+
+        def mk(valid):
+            return {
+                "name": f"t-{valid}",
+                "store_root": str(tmp_path),
+                "nodes": ["n1"],
+                "concurrency": 1,
+                "ssh": {"dummy?": True},
+                "client": fakes.AtomClient(fakes.SharedRegister()),
+                "generator": None,
+                "checker": checker.FnChecker(
+                    lambda t, h, o: {"valid?": valid}),
+            }
+
+        def crasher():
+            t = mk(True)
+            t["client"] = None  # run() will blow up opening clients
+            return t
+
+        res = cli.test_all_run_tests([mk(True), mk(False)])
+        assert len(res[True]) == 1 and len(res[False]) == 1
+
+
+class TestDemoSuite:
+    """End to end: the built-in demo through run_cli (the VERDICT item-4
+    done criterion)."""
+
+    def test_demo_runs_and_exits_zero(self, tmp_path):
+        from jepsen_tpu.__main__ import COMMANDS
+        rc = cli.run_cli(COMMANDS, [
+            "test", "--time-limit", "2", "--concurrency", "1n",
+            "--nodes", "n1,n2", "--rate", "20",
+            "--store-root", str(tmp_path / "store")])
+        assert rc == cli.EXIT_OK
+
+    def test_demo_analyze_latest(self, tmp_path):
+        from jepsen_tpu.__main__ import COMMANDS
+        root = str(tmp_path / "store")
+        rc = cli.run_cli(COMMANDS, [
+            "test", "--time-limit", "2", "--nodes", "n1",
+            "--store-root", root])
+        assert rc == cli.EXIT_OK
+        rc = cli.run_cli(COMMANDS, ["analyze", "--nodes", "n1",
+                                    "--store-root", root])
+        assert rc == cli.EXIT_OK
