@@ -21,7 +21,8 @@ from typing import Any, Callable, Iterable, Optional
 
 from ..history import History, Op
 from ..models import core as models
-from ..util import Multiset, bounded_pmap, integer_interval_set_str
+from ..util import (Multiset, bounded_pmap, integer_interval_set_str,
+                    polysort_key)
 
 UNKNOWN = "unknown"
 
@@ -427,3 +428,261 @@ class Counter(Checker):
 
 def counter() -> Checker:
     return Counter()
+
+
+# -- set-full (checker.clj:294-592) -----------------------------------------
+
+class _SetFullElement:
+    """Per-element timeline state (checker.clj:295-338): when the
+    element became known (add completion or first observing read,
+    whichever first), the latest read invocation that observed it, and
+    the latest read invocation that missed it."""
+
+    __slots__ = ("element", "known", "last_present", "last_absent")
+
+    def __init__(self, element):
+        self.element = element
+        self.known = None         # completion op that proved existence
+        self.last_present = None  # latest read INVOCATION observing it
+        self.last_absent = None   # latest read INVOCATION missing it
+
+    def add_ok(self, op):
+        if self.known is None:
+            self.known = op
+
+    def read_present(self, inv, op):
+        if self.known is None:
+            self.known = op
+        if self.last_present is None or \
+                self.last_present.index < inv.index:
+            self.last_present = inv
+
+    def read_absent(self, inv, op):
+        if self.last_absent is None or \
+                self.last_absent.index < inv.index:
+            self.last_absent = inv
+
+    def results(self) -> dict:
+        """Outcome classification (checker.clj:345-404). stable = some
+        read invoked after the last absence observed the element; lost =
+        known, then a read invoked after both the add and the last
+        presence missed it (an absent read *concurrent* with the add is
+        never-read, not lost)."""
+        absent_idx = self.last_absent.index if self.last_absent else -1
+        present_idx = self.last_present.index if self.last_present else -1
+        stable = self.last_present is not None and \
+            absent_idx < present_idx
+        lost = bool(self.known is not None and self.last_absent is not None
+                    and present_idx < absent_idx
+                    and self.known.index < absent_idx)
+        known_time = self.known.time if self.known else None
+        stable_latency = lost_latency = None
+        if stable:
+            t = self.last_absent.time + 1 if self.last_absent else 0
+            stable_latency = max(0, t - known_time) // 1_000_000
+        if lost:
+            t = self.last_present.time + 1 if self.last_present else 0
+            lost_latency = max(0, t - known_time) // 1_000_000
+        return {
+            "element": self.element,
+            "outcome": ("stable" if stable
+                        else "lost" if lost else "never-read"),
+            "stable-latency": stable_latency,
+            "lost-latency": lost_latency,
+            "known": self.known,
+            "last-absent": self.last_absent,
+        }
+
+
+def frequency_distribution(points, values) -> Optional[dict]:
+    """{quantile: value} at the given 0-1 points (checker.clj:406-420)."""
+    s = sorted(values)
+    if not s:
+        return None
+    n = len(s)
+    return {p: s[min(n - 1, int(n * p))] for p in points}
+
+
+class SetFull(Checker):
+    """Per-element stable/lost/never-read analysis with latency
+    quantiles (checker.clj:462-592). With linearizable=True, stale
+    elements (observed only after a delay) are failures too."""
+
+    def __init__(self, linearizable: bool = False):
+        self.linearizable = linearizable
+
+    def check(self, test, history, opts=None):
+        elements: dict = {}
+        reads: dict = {}  # process -> read invocation
+        dups: dict = {}   # element -> max multiplicity > 1 in one read
+        for op in history:
+            # only numeric client processes (checker.clj:545)
+            if not isinstance(op.process, int) or \
+                    isinstance(op.process, bool):
+                continue
+            if op.f == "add":
+                if op.is_invoke:
+                    elements.setdefault(op.value,
+                                        _SetFullElement(op.value))
+                elif op.is_ok and op.value in elements:
+                    elements[op.value].add_ok(op)
+            elif op.f == "read":
+                if op.is_invoke:
+                    reads[op.process] = op
+                elif op.is_fail:
+                    reads.pop(op.process, None)
+                elif op.is_ok:
+                    inv = reads.pop(op.process, op)
+                    seen: dict = {}
+                    for v in (op.value or []):
+                        seen[v] = seen.get(v, 0) + 1
+                    for v, n in seen.items():
+                        if n > 1:
+                            dups[v] = max(dups.get(v, 0), n)
+                    vs = set(seen)
+                    for el, state in elements.items():
+                        if el in vs:
+                            state.read_present(inv, op)
+                        else:
+                            state.read_absent(inv, op)
+        rs = [elements[k].results() for k in sorted(elements,
+                                                    key=polysort_key)]
+        outcomes: dict = {}
+        for r in rs:
+            outcomes.setdefault(r["outcome"], []).append(r)
+        stable = outcomes.get("stable", [])
+        lost = outcomes.get("lost", [])
+        never_read = outcomes.get("never-read", [])
+        stale = [r for r in stable if r["stable-latency"] > 0]
+        worst_stale = sorted(stale, key=lambda r: -r["stable-latency"])[:8]
+        if lost:
+            valid = False
+        elif not stable:
+            valid = UNKNOWN
+        elif self.linearizable and stale:
+            valid = False
+        else:
+            valid = True
+        out = {
+            "valid?": (valid if not dups else False),
+            "attempt-count": len(rs),
+            "stable-count": len(stable),
+            "lost-count": len(lost),
+            "lost": sorted((r["element"] for r in lost), key=polysort_key),
+            "never-read-count": len(never_read),
+            "never-read": sorted((r["element"] for r in never_read),
+                                 key=polysort_key),
+            "stale-count": len(stale),
+            "stale": sorted((r["element"] for r in stale), key=polysort_key),
+            "worst-stale": worst_stale,
+            "duplicated-count": len(dups),
+            "duplicated": dups,
+        }
+        points = (0, 0.5, 0.95, 0.99, 1)
+        sl = frequency_distribution(
+            points, [r["stable-latency"] for r in rs
+                     if r["stable-latency"] is not None])
+        if sl is not None:
+            out["stable-latencies"] = sl
+        ll = frequency_distribution(
+            points, [r["lost-latency"] for r in rs
+                     if r["lost-latency"] is not None])
+        if ll is not None:
+            out["lost-latencies"] = ll
+        return out
+
+
+def set_full(linearizable: bool = False) -> Checker:
+    return SetFull(linearizable)
+
+
+# -- log-file-pattern (checker.clj:839-881) ---------------------------------
+
+class LogFilePattern(Checker):
+    """Greps each node's downloaded log file in the store directory for
+    a pattern; matches mean invalid."""
+
+    def __init__(self, pattern: str, filename: str):
+        import re as _re
+        self.pattern = _re.compile(pattern)
+        self.filename = filename
+
+    def check(self, test, history, opts=None):
+        import os as _os
+        from .. import store as _store
+        matches = []
+        for node in (test.get("nodes") or []):
+            p = _store.path(test, node, self.filename)
+            if not _os.path.exists(p):
+                continue
+            try:
+                with open(p, errors="replace") as fh:
+                    for line in fh:
+                        if self.pattern.search(line):
+                            matches.append({"node": node,
+                                            "line": line.rstrip("\n")})
+            except OSError as e:
+                return {"valid?": UNKNOWN,
+                        "error": f"{type(e).__name__}: {e}"}
+        return {"valid?": not matches,
+                "count": len(matches),
+                "matches": matches}
+
+
+def log_file_pattern(pattern: str, filename: str) -> Checker:
+    return LogFilePattern(pattern, filename)
+
+
+# -- plot checkers (checker.clj:797-837) ------------------------------------
+
+class LatencyGraph(Checker):
+    """latency-raw.png + latency-quantiles.png (checker.clj:797-809)."""
+
+    def __init__(self, opts: Optional[dict] = None):
+        self.opts = opts or {}
+
+    def check(self, test, history, opts=None):
+        from . import plots as perf_mod
+        o = {**self.opts, **(opts or {})}
+        perf_mod.point_graph(test, history, o)
+        perf_mod.quantiles_graph(test, history, o)
+        return {"valid?": True}
+
+
+def latency_graph(opts: Optional[dict] = None) -> Checker:
+    return LatencyGraph(opts)
+
+
+class RateGraph(Checker):
+    """rate.png (checker.clj:811-821)."""
+
+    def __init__(self, opts: Optional[dict] = None):
+        self.opts = opts or {}
+
+    def check(self, test, history, opts=None):
+        from . import plots as perf_mod
+        perf_mod.rate_graph(test, history, {**self.opts, **(opts or {})})
+        return {"valid?": True}
+
+
+def rate_graph(opts: Optional[dict] = None) -> Checker:
+    return RateGraph(opts)
+
+
+def perf(opts: Optional[dict] = None) -> Checker:
+    """Latency + rate graphs composed (checker.clj:823-831)."""
+    return compose({"latency-graph": latency_graph(opts),
+                    "rate-graph": rate_graph(opts)})
+
+
+class ClockPlot(Checker):
+    """clock-skew.png (checker.clj:831-837)."""
+
+    def check(self, test, history, opts=None):
+        from . import clock as clock_mod
+        clock_mod.plot(test, history, opts or {})
+        return {"valid?": True}
+
+
+def clock_plot() -> Checker:
+    return ClockPlot()

