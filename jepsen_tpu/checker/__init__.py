@@ -644,8 +644,9 @@ class LatencyGraph(Checker):
     def check(self, test, history, opts=None):
         from . import plots as perf_mod
         o = {**self.opts, **(opts or {})}
-        perf_mod.point_graph(test, history, o)
-        perf_mod.quantiles_graph(test, history, o)
+        pts = perf_mod.latency_points(history)  # pair history once
+        perf_mod.point_graph(test, history, o, pts=pts)
+        perf_mod.quantiles_graph(test, history, o, pts=pts)
         return {"valid?": True}
 
 

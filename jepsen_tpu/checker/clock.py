@@ -13,9 +13,8 @@ from __future__ import annotations
 import logging
 from typing import Optional
 
-from .. import store
 from ..history import History
-from .plots import NEMESIS_ALPHA, NEMESIS_COLOR, _plt, _save
+from .plots import _plt, _save
 
 log = logging.getLogger("jepsen_tpu.checker.clock")
 

@@ -75,13 +75,13 @@ def quantile_series(points, dt: float, qs=QUANTILES) -> dict:
     buckets = np.floor(t / dt).astype(np.int64)
     out = {q: ([], []) for q in qs}
     for b in np.unique(buckets):
-        sel = lat[buckets == b]
+        sel = np.sort(lat[buckets == b])
         mid = b * dt + dt / 2
         for q in qs:
             out[q][0].append(mid)
             # floor-index quantile, exactly the reference's extract fn
             idx = min(len(sel) - 1, int(np.floor(len(sel) * q)))
-            out[q][1].append(float(np.sort(sel)[idx]))
+            out[q][1].append(float(sel[idx]))
     return out
 
 
@@ -122,11 +122,13 @@ def _fmarker(fs):
     return {f: MARKERS[i % len(MARKERS)] for i, f in enumerate(order)}
 
 
-def point_graph(test, history, opts=None) -> Optional[str]:
+def point_graph(test, history, opts=None, pts=None) -> Optional[str]:
     """Raw latency scatter, log-y, one marker per f, one color per
-    completion type (perf.clj:484-511)."""
+    completion type (perf.clj:484-511). Pass precomputed pts to avoid
+    re-pairing the history."""
     plt = _plt()
-    pts = latency_points(history)
+    if pts is None:
+        pts = latency_points(history)
     if not pts:
         return None
     fig, ax = plt.subplots(figsize=(10, 4.5))
@@ -153,10 +155,11 @@ def point_graph(test, history, opts=None) -> Optional[str]:
     return out
 
 
-def quantiles_graph(test, history, opts=None) -> Optional[str]:
+def quantiles_graph(test, history, opts=None, pts=None) -> Optional[str]:
     """Latency quantiles by f over time (perf.clj:513-556)."""
     plt = _plt()
-    pts = latency_points(history)
+    if pts is None:
+        pts = latency_points(history)
     if not pts:
         return None
     fig, ax = plt.subplots(figsize=(10, 4.5))

@@ -70,9 +70,9 @@ def _title(start, stop) -> str:
 
 def _body(start, stop) -> str:
     op = stop or start
-    s = f"{op.process} {op.f}"
+    s = _esc(f"{op.process} {op.f}")
     if op.process != "nemesis":
-        s += f" {start.value!r}"
+        s += f" {_esc(repr(start.value))}"
     if stop is not None and stop.value != start.value:
         s += f"<br />{_esc(repr(stop.value))}"
     return s

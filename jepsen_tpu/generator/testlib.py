@@ -12,7 +12,7 @@ from __future__ import annotations
 from dataclasses import replace
 from typing import Callable, Optional
 
-from . import (NEMESIS, PENDING, Context, with_seed)
+from . import (NEMESIS, PENDING, Context, secs_to_nanos, with_seed)
 from . import context as make_context
 from . import op as gen_op
 from . import update as gen_update
@@ -51,7 +51,8 @@ def simulate(gen, complete_fn: Callable, ctx: Optional[Context] = None,
         while True:
             res = gen_op(gen, test, ctx)
             if res is None:
-                return ops + in_flight
+                return ops + [o for o in in_flight
+                              if not o.get("_silent")]
             invoke, gen2 = res
             if invoke is not PENDING and (
                     not in_flight
@@ -61,10 +62,21 @@ def simulate(gen, complete_fn: Callable, ctx: Optional[Context] = None,
                 ctx = replace(ctx, time=max(ctx.time, invoke["time"]),
                               free_threads=ctx.free_threads - {thread})
                 gen = gen_update(gen2, test, ctx, invoke)
-                complete = complete_fn(ctx, invoke)
+                if invoke["type"] in ("sleep", "log"):
+                    # the worker naps for `value` seconds / logs; these
+                    # never enter the history but do consume the thread,
+                    # and the worker echoes the op back unchanged
+                    # (interpreter.py:117-124, goes_in_history :162)
+                    dt = secs_to_nanos(invoke.get("value") or 0) \
+                        if invoke["type"] == "sleep" else 0
+                    complete = {**invoke,
+                                "time": invoke["time"] + dt,
+                                "_silent": True}
+                else:
+                    complete = complete_fn(ctx, invoke)
+                    ops.append(invoke)
                 in_flight = sorted(in_flight + [complete],
                                    key=lambda o: o["time"])
-                ops.append(invoke)
             else:
                 # Complete something before the next invocation; the
                 # speculative invoke is discarded and re-asked next loop.
@@ -73,7 +85,14 @@ def simulate(gen, complete_fn: Callable, ctx: Optional[Context] = None,
                 thread = ctx.process_to_thread(done["process"])
                 ctx = replace(ctx, time=max(ctx.time, done["time"]),
                               free_threads=ctx.free_threads | {thread})
+                silent = done.pop("_silent", False)
                 gen = gen_update(gen, test, ctx, done)
+                if silent:
+                    # waking from a sleep/log: updates the generator
+                    # (the interpreter passes the echoed op to update
+                    # too) but never enters the history
+                    in_flight = in_flight[1:]
+                    continue
                 if thread != NEMESIS and done.get("type") == "info":
                     workers = dict(ctx.workers)
                     workers[thread] = ctx.next_process(thread)

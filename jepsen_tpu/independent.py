@@ -19,7 +19,7 @@ Two checker paths here:
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Any, Callable, Iterable, Optional, Sequence
+from typing import Any, Callable, Iterable, Optional
 
 from . import generator as gen
 from .checker import Checker, check_safe, merge_valid
@@ -87,41 +87,77 @@ def group_threads(n: int, ctx) -> list:
     return [frozenset(threads[i:i + n]) for i in range(0, count, n)]
 
 
+class _KeyStream:
+    """Memoized view over a (possibly infinite) key iterable. Cloned
+    ConcurrentGenerator states share one stream and index into it; the
+    buffer only grows, so `get(i)` is deterministic regardless of which
+    clone asks first — pure-value semantics preserved over a lazy
+    source (the reference's `(range)` infinite key seq,
+    independent.clj:228)."""
+
+    _EXHAUSTED = object()
+
+    def __init__(self, keys: Iterable):
+        self._it = iter(keys)
+        self._buf: list = []
+        self._done = False
+
+    def get(self, i: int):
+        """Key #i, or _EXHAUSTED if the source ran out."""
+        while len(self._buf) <= i and not self._done:
+            try:
+                self._buf.append(next(self._it))
+            except StopIteration:
+                self._done = True
+        return self._buf[i] if i < len(self._buf) else self._EXHAUSTED
+
+
 class ConcurrentGenerator(gen.Generator):
     """Splits worker threads into groups of n per key; each group runs
     fgen(k) until exhaustion, then takes the next key. Ops are chosen by
     soonest-op selection across free groups; updates route to the
-    owning group's generator (independent.clj:103-211).
+    owning group's generator (independent.clj:103-211). Keys may be an
+    infinite iterable (wrap the whole thing in gen.time_limit/limit).
 
     Use via `concurrent_generator(...)`, which excludes the nemesis."""
 
-    def __init__(self, n: int, keys: Sequence, fgen: Callable,
+    def __init__(self, n: int, keys, fgen: Callable,
                  groups: Optional[list] = None,
                  thread_group: Optional[dict] = None,
-                 gens: Optional[list] = None):
+                 gens: Optional[list] = None,
+                 pos: int = 0):
         assert n > 0 and isinstance(n, int)
         self.n = n
-        self.keys = list(keys)
+        self.keys = keys if isinstance(keys, _KeyStream) \
+            else _KeyStream(keys)
         self.fgen = fgen
         self.groups = groups            # list of frozensets of threads
         self.thread_group = thread_group  # thread -> group index
         self.gens = gens                # per-group generator (or None)
+        self.pos = pos                  # next key index in the stream
+
+    def _next_key(self, pos: int):
+        k = self.keys.get(pos)
+        return (None, pos) if k is self.keys._EXHAUSTED else (k, pos + 1)
 
     def _grouped(self, ctx):
         groups = self.groups or group_threads(self.n, ctx)
         tg = self.thread_group or {t: i for i, g in enumerate(groups)
                                    for t in g}
+        pos = self.pos
         if self.gens is None:
-            head = self.keys[:len(groups)]
-            gens = [tuple_gen(k, self.fgen(k)) for k in head]
-            gens += [None] * (len(groups) - len(gens))
-            keys = self.keys[len(groups):]
+            gens = []
+            for _ in groups:
+                k, pos2 = self._next_key(pos)
+                gens.append(tuple_gen(k, self.fgen(k))
+                            if pos2 != pos else None)
+                pos = pos2
         else:
-            gens, keys = list(self.gens), list(self.keys)
-        return groups, tg, gens, keys
+            gens = list(self.gens)
+        return groups, tg, gens, pos
 
     def op(self, test, ctx):
-        groups, tg, gens, keys = self._grouped(ctx)
+        groups, tg, gens, pos = self._grouped(ctx)
         free_groups = sorted({tg[t] for t in ctx.free_threads if t in tg})
         soonest = None
         for grp in free_groups:
@@ -134,8 +170,9 @@ class ConcurrentGenerator(gen.Generator):
                 res = gen.op(g, test, gctx)
                 if res is None:
                     # exhausted: take the next key, or retire the group
-                    if keys:
-                        k, keys = keys[0], keys[1:]
+                    k, pos2 = self._next_key(pos)
+                    if pos2 != pos:
+                        pos = pos2
                         gens[grp] = tuple_gen(k, self.fgen(k))
                         continue
                     gens[grp] = None
@@ -151,13 +188,13 @@ class ConcurrentGenerator(gen.Generator):
             gens2 = list(gens)
             gens2[soonest["group"]] = soonest["gen"]
             return (soonest["op"],
-                    ConcurrentGenerator(self.n, keys, self.fgen, groups,
-                                        tg, gens2))
+                    ConcurrentGenerator(self.n, self.keys, self.fgen,
+                                        groups, tg, gens2, pos))
         if any(g is not None for g in gens):
             # busy groups may still produce ops
             return (gen.PENDING,
-                    ConcurrentGenerator(self.n, keys, self.fgen, groups,
-                                        tg, gens))
+                    ConcurrentGenerator(self.n, self.keys, self.fgen,
+                                        groups, tg, gens, pos))
         return None
 
     def update(self, test, ctx, event):
@@ -172,13 +209,15 @@ class ConcurrentGenerator(gen.Generator):
         gens = list(self.gens)
         gens[grp] = gen.update(gens[grp], test, gctx, event)
         return ConcurrentGenerator(self.n, self.keys, self.fgen,
-                                   self.groups, self.thread_group, gens)
+                                   self.groups, self.thread_group, gens,
+                                   self.pos)
 
 
 def concurrent_generator(n: int, keys: Iterable, fgen: Callable):
     """Thread groups of n per key, soonest-op scheduling, nemesis
-    excluded (independent.clj:213-238)."""
-    return gen.clients(ConcurrentGenerator(n, list(keys), fgen))
+    excluded (independent.clj:213-238). keys may be infinite (e.g.
+    itertools.count()); bound the workload with gen.time_limit."""
+    return gen.clients(ConcurrentGenerator(n, keys, fgen))
 
 
 def history_keys(history: History) -> list:

@@ -20,3 +20,10 @@ Each module exposes a `workload(**opts) -> dict` returning at least
 {"generator": ..., "checker": ...}; suites merge that into their test
 map and add a client.
 """
+
+from . import (adya, bank, causal, causal_reverse, cycle, cycle_append,
+               cycle_wr, linearizable_register, long_fork, sets)
+
+__all__ = ["adya", "bank", "causal", "causal_reverse", "cycle",
+           "cycle_append", "cycle_wr", "linearizable_register",
+           "long_fork", "sets"]

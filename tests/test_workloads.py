@@ -1,0 +1,437 @@
+"""Workload package tests: golden checker assertions (the reference's
+exact expected-map style) plus end-to-end runs of each workload's
+generator through the real interpreter with in-process clients."""
+
+import threading
+
+import pytest
+
+from jepsen_tpu import checker as jchecker
+from jepsen_tpu import client as jclient
+from jepsen_tpu import core, fakes
+from jepsen_tpu import generator as gen
+from jepsen_tpu.generator import testlib
+from jepsen_tpu.history import History, Op
+from jepsen_tpu.independent import KV
+from jepsen_tpu.workloads import (adya, bank, causal, causal_reverse,
+                                  linearizable_register, long_fork, sets)
+
+
+def op(typ, process, f, value, time=0, **extra):
+    return Op(typ, f=f, process=process, value=value, time=time,
+              extra=extra)
+
+
+def hist(ops):
+    return History(ops).index()
+
+
+# -- bank -------------------------------------------------------------------
+
+class TestBankChecker:
+    TEST = {"accounts": [0, 1, 2], "total-amount": 30}
+
+    def c(self, ops, negative=False):
+        return bank.checker(negative).check(self.TEST, hist(ops), {})
+
+    def test_valid(self):
+        res = self.c([op("ok", 0, "read", {0: 10, 1: 10, 2: 10})])
+        assert res["valid?"] is True
+        assert res["read-count"] == 1
+        assert res["error-count"] == 0
+
+    def test_wrong_total(self):
+        res = self.c([op("ok", 0, "read", {0: 10, 1: 10, 2: 11}),
+                      op("ok", 0, "read", {0: 10, 1: 10, 2: 5})])
+        assert res["valid?"] is False
+        err = res["errors"]["wrong-total"]
+        assert err["count"] == 2
+        assert err["lowest"]["total"] == 25
+        assert err["highest"]["total"] == 31
+
+    def test_unexpected_key_and_nil(self):
+        res = self.c([op("ok", 0, "read", {0: 10, 9: 20}),
+                      op("ok", 0, "read", {0: None, 1: 20, 2: 10})])
+        assert res["valid?"] is False
+        assert res["errors"]["unexpected-key"]["first"]["unexpected"] == [9]
+        assert res["errors"]["nil-balance"]["first"]["nils"] == {0: None}
+
+    def test_negative_value(self):
+        h = [op("ok", 0, "read", {0: -5, 1: 20, 2: 15})]
+        assert self.c(h)["valid?"] is False
+        assert self.c(h, negative=True)["valid?"] is True
+
+    def test_first_error_is_earliest(self):
+        res = self.c([op("ok", 0, "read", {0: 10, 1: 10, 2: 10}),
+                      op("ok", 0, "read", {0: 1, 1: 1, 2: 1}),
+                      op("ok", 0, "read", {0: 99, 1: 0, 2: 0})])
+        assert res["first-error"]["type"] == "wrong-total"
+        assert res["first-error"]["op"].index == 1
+
+
+class BankClient(jclient.Client):
+    """In-process bank: per-account balances under one lock."""
+
+    def __init__(self, state=None, lock=None):
+        self.state = state
+        self.lock = lock or threading.Lock()
+
+    def open(self, test, node):
+        if self.state is None:
+            n = len(test["accounts"])
+            self.state = {a: test["total-amount"] // n
+                          for a in test["accounts"]}
+            self.state[test["accounts"][0]] += (
+                test["total-amount"] - sum(self.state.values()))
+        return BankClient(self.state, self.lock)
+
+    def invoke(self, test, o):
+        with self.lock:
+            if o["f"] == "read":
+                return {**o, "type": "ok", "value": dict(self.state)}
+            v = o["value"]
+            if self.state[v["from"]] < v["amount"]:
+                return {**o, "type": "fail"}
+            self.state[v["from"]] -= v["amount"]
+            self.state[v["to"]] += v["amount"]
+            return {**o, "type": "ok"}
+
+
+def test_bank_end_to_end(tmp_path):
+    w = bank.workload()
+    t = {
+        "name": "bank-e2e", "store_root": str(tmp_path),
+        "nodes": ["n1", "n2", "n3"], "concurrency": 3,
+        "ssh": {"dummy?": True},
+        "client": BankClient(),
+        **w,
+        "generator": gen.limit(60, gen.clients(w["generator"])),
+    }
+    res = core.run(t)
+    assert res["results"]["valid?"] is True
+    assert res["results"]["SI"]["read-count"] > 0
+
+
+# -- linearizable-register --------------------------------------------------
+
+def test_register_workload_end_to_end(tmp_path):
+    w = linearizable_register.workload(
+        {"nodes": ["n1", "n2"], "per_key_limit": 12, "algorithm": "wgl"})
+    t = {
+        "name": "reg-e2e", "store_root": str(tmp_path),
+        "nodes": ["n1", "n2"], "concurrency": 4,
+        "ssh": {"dummy?": True},
+        "client": fakes.IndependentAtomClient(),
+        "checker": w["checker"],
+        "generator": gen.time_limit(5, w["generator"]),
+    }
+    res = core.run(t)
+    assert res["results"]["valid?"] is True
+    # multiple keys were exercised and each got a linear verdict
+    results = res["results"]["results"]
+    assert len(results) >= 2
+    for k, r in results.items():
+        assert r["linear"]["valid?"] is True
+
+
+def test_register_workload_catches_lying_key(tmp_path):
+    w = linearizable_register.workload(
+        {"nodes": ["n1"], "per_key_limit": 10, "algorithm": "wgl"})
+    t = {
+        "name": "reg-liar", "store_root": str(tmp_path),
+        "nodes": ["n1"], "concurrency": 2,
+        "ssh": {"dummy?": True},
+        "client": fakes.IndependentAtomClient(lie_keys=[0]),
+        "checker": w["checker"],
+        "generator": gen.time_limit(4, w["generator"]),
+    }
+    res = core.run(t)
+    assert res["results"]["valid?"] is False
+
+
+# -- long-fork --------------------------------------------------------------
+
+def rt(k_vs):
+    """read txn [[r k v] ...]"""
+    return [["r", k, v] for k, v in k_vs]
+
+
+class TestLongForkChecker:
+    def c(self, ops):
+        return long_fork.checker(2).check({}, hist(ops), {})
+
+    def test_valid_order(self):
+        res = self.c([
+            op("ok", 0, "read", rt([(0, None), (1, None)])),
+            op("ok", 1, "read", rt([(0, 1), (1, None)])),
+            op("ok", 2, "read", rt([(0, 1), (1, 1)])),
+        ])
+        assert res["valid?"] is True
+        assert res["reads-count"] == 3
+        assert res["early-read-count"] == 1
+        assert res["late-read-count"] == 1
+
+    def test_long_fork_detected(self):
+        # T3 sees x=1,y=nil; T4 sees x=nil,y=1 -> incomparable
+        res = self.c([
+            op("ok", 0, "read", rt([(0, 1), (1, None)])),
+            op("ok", 1, "read", rt([(0, None), (1, 1)])),
+        ])
+        assert res["valid?"] is False
+        assert len(res["forks"]) == 1
+
+    def test_multiple_writes_unknown(self):
+        res = self.c([
+            op("invoke", 0, "write", [["w", 0, 1]]),
+            op("ok", 0, "write", [["w", 0, 1]]),
+            op("invoke", 1, "write", [["w", 0, 1]]),
+            op("ok", 1, "write", [["w", 0, 1]]),
+        ])
+        assert res["valid?"] == "unknown"
+        assert res["error"] == ["multiple-writes", 0]
+
+    def test_group_for(self):
+        assert list(long_fork.group_for(2, 5)) == [4, 5]
+        assert list(long_fork.group_for(3, 7)) == [6, 7, 8]
+
+    def test_read_compare(self):
+        assert long_fork.read_compare({0: 1, 1: None},
+                                      {0: 1, 1: None}) == 0
+        assert long_fork.read_compare({0: 1, 1: None},
+                                      {0: None, 1: None}) == -1
+        assert long_fork.read_compare({0: None}, {0: 1}) == 1
+        assert long_fork.read_compare({0: 1, 1: None},
+                                      {0: None, 1: 1}) is None
+        with pytest.raises(long_fork.IllegalHistory):
+            long_fork.read_compare({0: 1}, {0: 2})
+
+
+class LongForkMemClient(jclient.Client):
+    """Serializable in-memory store for long-fork txns."""
+
+    def __init__(self, state=None, lock=None):
+        self.state = state if state is not None else {}
+        self.lock = lock or threading.Lock()
+
+    def open(self, test, node):
+        return LongForkMemClient(self.state, self.lock)
+
+    def invoke(self, test, o):
+        with self.lock:
+            out = []
+            for f, k, v in o["value"]:
+                if f == "w":
+                    self.state[k] = v
+                    out.append([f, k, v])
+                else:
+                    out.append([f, k, self.state.get(k)])
+            return {**o, "type": "ok", "value": out}
+
+
+def test_long_fork_end_to_end(tmp_path):
+    w = long_fork.workload(2)
+    t = {
+        "name": "lf-e2e", "store_root": str(tmp_path),
+        "nodes": ["n1", "n2"], "concurrency": 4,
+        "ssh": {"dummy?": True},
+        "client": LongForkMemClient(),
+        "checker": w["checker"],
+        "generator": gen.limit(80, w["generator"]),
+    }
+    res = core.run(t)
+    assert res["results"]["valid?"] is True
+    assert res["results"]["reads-count"] > 0
+
+
+# -- causal -----------------------------------------------------------------
+
+class TestCausalChecker:
+    def c(self, ops):
+        return causal.check().check({}, hist(ops), {})
+
+    def test_valid_chain(self):
+        res = self.c([
+            op("ok", 0, "read-init", None, position=1, link="init"),
+            op("ok", 0, "write", 1, position=2, link=1),
+            op("ok", 0, "read", 1, position=3, link=2),
+            op("ok", 0, "write", 2, position=4, link=3),
+            op("ok", 0, "read", 2, position=5, link=4),
+        ])
+        assert res["valid?"] is True
+
+    def test_broken_link(self):
+        res = self.c([
+            op("ok", 0, "read-init", None, position=1, link="init"),
+            op("ok", 0, "write", 1, position=2, link=99),
+        ])
+        assert res["valid?"] is False
+        assert "Cannot link" in res["error"]
+
+    def test_wrong_write_value(self):
+        res = self.c([
+            op("ok", 0, "read-init", None, position=1, link="init"),
+            op("ok", 0, "write", 5, position=2, link=1),
+        ])
+        assert res["valid?"] is False
+        assert "expected value 1" in res["error"]
+
+    def test_bad_init_read(self):
+        res = self.c([
+            op("ok", 0, "read-init", 7, position=1, link="init"),
+        ])
+        assert res["valid?"] is False
+        assert "init value" in res["error"]
+
+    def test_stale_read(self):
+        res = self.c([
+            op("ok", 0, "read-init", None, position=1, link="init"),
+            op("ok", 0, "write", 1, position=2, link=1),
+            op("ok", 0, "read", 0, position=3, link=2),
+        ])
+        assert res["valid?"] is False
+        assert "can't read" in res["error"]
+
+
+# -- causal-reverse ---------------------------------------------------------
+
+class TestCausalReverse:
+    def c(self, ops):
+        return causal_reverse.checker().check({}, hist(ops), {})
+
+    def test_valid(self):
+        res = self.c([
+            op("invoke", 0, "write", 1), op("ok", 0, "write", 1),
+            op("invoke", 1, "write", 2), op("ok", 1, "write", 2),
+            op("invoke", 2, "read", None),
+            op("ok", 2, "read", [1, 2]),
+        ])
+        assert res["valid?"] is True
+
+    def test_t2_without_t1(self):
+        # w1 acked before w2 invoked; a read sees 2 but not 1
+        res = self.c([
+            op("invoke", 0, "write", 1), op("ok", 0, "write", 1),
+            op("invoke", 1, "write", 2), op("ok", 1, "write", 2),
+            op("invoke", 2, "read", None),
+            op("ok", 2, "read", [2]),
+        ])
+        assert res["valid?"] is False
+        assert res["errors"][0]["missing"] == [1]
+        assert res["errors"][0]["expected-count"] == 1
+
+    def test_concurrent_writes_ok_either_way(self):
+        # w2 invoked before w1 acked: no precedence, read may see only 2
+        res = self.c([
+            op("invoke", 0, "write", 1),
+            op("invoke", 1, "write", 2),
+            op("ok", 0, "write", 1), op("ok", 1, "write", 2),
+            op("invoke", 2, "read", None),
+            op("ok", 2, "read", [2]),
+        ])
+        assert res["valid?"] is True
+
+
+# -- adya -------------------------------------------------------------------
+
+class TestAdyaG2:
+    def c(self, ops):
+        return adya.g2_checker().check({}, hist(ops), {})
+
+    def test_single_insert_ok(self):
+        res = self.c([
+            op("invoke", 0, "insert", KV(0, [None, 1])),
+            op("ok", 0, "insert", KV(0, [None, 1])),
+            op("invoke", 1, "insert", KV(0, [2, None])),
+            op("fail", 1, "insert", KV(0, [2, None])),
+        ])
+        assert res["valid?"] is True
+        assert res["key-count"] == 1
+        assert res["legal-count"] == 1
+
+    def test_double_insert_illegal(self):
+        res = self.c([
+            op("ok", 0, "insert", KV(3, [None, 1])),
+            op("ok", 1, "insert", KV(3, [2, None])),
+        ])
+        assert res["valid?"] is False
+        assert res["illegal"] == {3: 2}
+
+    def test_generator_emits_unique_id_pairs(self):
+        # virtual-time quick() has zero latency, so a time_limit would
+        # never expire over the infinite key stream; cap by op count
+        g = gen.limit(12, adya.g2_gen())
+        ctx = testlib.n_nemesis_context(4)
+        ops = [o for o in testlib.quick(g, ctx=ctx)
+               if o.get("f") == "insert"]
+        assert len(ops) >= 4
+        ids = [x for o in ops for x in o["value"].v if x is not None]
+        assert len(ids) == len(set(ids))
+        # each key gets exactly two inserts: one a-id, one b-id
+        by_key: dict = {}
+        for o in ops:
+            by_key.setdefault(o["value"].k, []).append(o["value"].v)
+        for k, vs in by_key.items():
+            assert len(vs) <= 2
+
+
+# -- sets -------------------------------------------------------------------
+
+class SetMemClient(jclient.Client):
+    def __init__(self, state=None, lock=None, lose_every=None):
+        self.state = state if state is not None else set()
+        self.lock = lock or threading.Lock()
+        self.lose_every = lose_every
+
+    def open(self, test, node):
+        return SetMemClient(self.state, self.lock, self.lose_every)
+
+    def invoke(self, test, o):
+        with self.lock:
+            if o["f"] == "add":
+                if self.lose_every and o["value"] % self.lose_every == 0:
+                    return {**o, "type": "ok"}  # ack but drop
+                self.state.add(o["value"])
+                return {**o, "type": "ok"}
+            return {**o, "type": "ok", "value": sorted(self.state)}
+
+
+def test_set_workload_end_to_end(tmp_path):
+    w = sets.workload({"time_limit": 2})
+    t = {
+        "name": "set-e2e", "store_root": str(tmp_path),
+        "nodes": ["n1", "n2"], "concurrency": 2,
+        "ssh": {"dummy?": True},
+        "client": SetMemClient(),
+        **w,
+    }
+    res = core.run(t)
+    assert res["results"]["valid?"] is True
+    assert res["results"]["set"]["ok-count"] > 0
+
+
+def test_set_workload_detects_lost(tmp_path):
+    w = sets.workload({"time_limit": 2})
+    t = {
+        "name": "set-lost", "store_root": str(tmp_path),
+        "nodes": ["n1"], "concurrency": 1,
+        "ssh": {"dummy?": True},
+        "client": SetMemClient(lose_every=3),
+        **w,
+    }
+    res = core.run(t)
+    assert res["results"]["valid?"] is False
+    assert res["results"]["set"]["lost-count"] > 0
+
+
+def test_causal_workload_emits_canonical_order():
+    """Regression: bare fns repeat forever; each step must be one-shot
+    so the 5-op causal order (ri w1 r w2 r) advances. Also exercises
+    virtual-time sleep handling in the simulator (nemesis cycle)."""
+    from jepsen_tpu.generator import testlib
+    w = causal.workload({"time_limit": 30})
+    ops = testlib.quick(w["generator"], ctx=testlib.n_nemesis_context(1))
+    fs = [o["f"] for o in ops if o.get("process") != "nemesis"]
+    assert fs[:5] == ["read-init", "write", "read", "write", "read"]
+    vals = [getattr(o.get("value"), "v", None) for o in ops
+            if o.get("process") != "nemesis"][:5]
+    assert vals == [None, 1, None, 2, None]
