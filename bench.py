@@ -1,5 +1,6 @@
 #!/usr/bin/env python
-"""Headline benchmark: decide a 10k-op cas-register history on the TPU.
+"""Headline benchmark: decide a 10k-op cas-register history on the TPU,
+plus the full BASELINE config matrix.
 
 The north star (BASELINE.md): JVM Knossos-WGL *times out* at the 60 s
 budget on a 10k-op single-key cas-register history; this framework must
@@ -8,12 +9,20 @@ worker processes, r/w/cas over 5 values, sparse crashes) produced by the
 deterministic synthesizer, checked by the lockstep-frontier WGL kernel
 (`jepsen_tpu.ops.wgl`, bitmask fast path).
 
-Prints ONE JSON line:
-  {"metric": ..., "value": seconds, "unit": "s", "vs_baseline": x}
+After the headline, the remaining BASELINE configs run with per-config
+budgets: register (500-op), mutex, fifo-queue, the Porcupine-style
+adversarial long tail (wide window, general kernel), and the
+100-key x 2k-op independent workload batch-checked over the device
+mesh. Their results land in the same single JSON line under "configs".
 
-value      = wall seconds to a definitive verdict, compile-warm (the
-             steady-state cost of checking a fresh history of this
-             shape; cold/compile time is reported alongside).
+Prints ONE JSON line:
+  {"metric": ..., "value": seconds, "unit": "s", "vs_baseline": x,
+   "configs": {...}}
+
+value      = wall seconds to a definitive verdict on the headline
+             config, compile-warm (the steady-state cost of checking a
+             fresh history of this shape; cold/compile time is
+             reported alongside).
 vs_baseline = 60 / value — how many times faster than the reference's
              60 s budget, at which it DNFs.
 
@@ -22,11 +31,14 @@ line, even when the accelerator backend fails or hangs at init. Backend
 init is probed in a subprocess with a hard timeout; on failure the bench
 pins the CPU platform via jax.config (env vars alone are overridden by
 site customization that pre-imports jax) and records the platform used.
+Per-config failures are captured into that config's entry, never raised.
 
 Env knobs: JEPSEN_TPU_BENCH_OPS (default 10000),
 JEPSEN_TPU_BENCH_BUDGET_S (default 120 per attempt),
 JEPSEN_TPU_BENCH_PLATFORM (skip probing, pin this platform),
-JEPSEN_TPU_BENCH_PROBE_S (default 90, backend-probe timeout).
+JEPSEN_TPU_BENCH_PROBE_S (default 90, backend-probe timeout),
+JEPSEN_TPU_BENCH_EXTRAS (default 1; 0 = headline only),
+JEPSEN_TPU_BENCH_KEYS / _PER_KEY (independent config, default 100x2000).
 """
 
 from __future__ import annotations
@@ -71,9 +83,91 @@ def _pick_platform() -> str:
     return found
 
 
+def _timed(fn, *args, **kw):
+    t0 = time.monotonic()
+    res = fn(*args, **kw)
+    return res, time.monotonic() - t0
+
+
+def _config_entry(res: dict, wall: float) -> dict:
+    out = {"verdict": res.get("valid?"), "wall_s": round(wall, 3),
+           "op_count": res.get("op_count")}
+    for k in ("W", "K", "configs_explored", "cause", "engine"):
+        if res.get(k) is not None:
+            out[k] = res[k]
+    return out
+
+
+def run_extras(budget: float) -> dict:
+    """The non-headline BASELINE configs; each failure is contained."""
+    from jepsen_tpu.models import (cas_register, fifo_queue, mutex,
+                                   register)
+    from jepsen_tpu.ops import wgl
+    from jepsen_tpu import synth
+
+    configs = {}
+
+    def run(name, model, hist, checker=None):
+        try:
+            t0 = time.monotonic()
+            if checker is None:
+                res = wgl.check(model, hist, time_limit=budget)
+            else:
+                res = checker()
+            configs[name] = _config_entry(res, time.monotonic() - t0)
+        except Exception as e:  # noqa: BLE001
+            traceback.print_exc(file=sys.stderr)
+            configs[name] = {"verdict": "error",
+                            "error": f"{type(e).__name__}: {e}"[:300]}
+        print(f"config {name}: {configs.get(name)}", file=sys.stderr)
+
+    run("register_500", register(),
+        synth.cas_register_history(500, n_procs=5, seed=7,
+                                   fs=("read", "write")))
+    run("mutex_1k", mutex(), synth.mutex_history(1000, n_procs=4, seed=7))
+
+    # FIFO queue: state-space search (ours AND JVM knossos) explodes on
+    # queue histories, so this config rides the polynomial queue checker
+    # behind the competition algorithm — 100k ops where the JVM DNFs at
+    # a few hundred.
+    def fifo():
+        from jepsen_tpu import checker as jchecker
+        hq = synth.fifo_queue_history(100_000, n_procs=4, seed=7)
+        # time_limit bounds the WGL fallback if the fast path declines
+        return jchecker.linearizable(
+            fifo_queue(), algorithm="competition",
+            time_limit=budget).check({}, hq, {})
+
+    run("fifo_queue_100k", None, None, checker=fifo)
+    run("long_tail_900", cas_register(),
+        synth.long_tail_history(900, seed=7))
+
+    # independent 100 keys x 2k ops, batch-checked over the device mesh
+    n_keys = int(os.environ.get("JEPSEN_TPU_BENCH_KEYS", "100"))
+    per_key = int(os.environ.get("JEPSEN_TPU_BENCH_PER_KEY", "2000"))
+
+    def indep():
+        from jepsen_tpu.parallel import check_batched
+        hists = [synth.cas_register_history(per_key, n_procs=5, seed=s)
+                 for s in range(n_keys)]
+        res = check_batched(cas_register(), hists, oracle_fallback=True)
+        bad = [i for i, r in enumerate(res) if r["valid?"] is not True]
+        return {"valid?": (True if not bad else False),
+                "op_count": sum(len(h) for h in hists),
+                "K": len(hists), "cause": f"bad keys: {bad[:5]}" if bad
+                else None}
+
+    per_key_label = f"{per_key // 1000}k" if per_key >= 1000 \
+        else str(per_key)
+    run(f"independent_{n_keys}x{per_key_label}", None, None,
+        checker=indep)
+    return configs
+
+
 def run_bench() -> tuple[dict, int]:
     n_ops = int(os.environ.get("JEPSEN_TPU_BENCH_OPS", "10000"))
     budget = float(os.environ.get("JEPSEN_TPU_BENCH_BUDGET_S", "120"))
+    extras = os.environ.get("JEPSEN_TPU_BENCH_EXTRAS", "1") != "0"
 
     plat = _pick_platform()
 
@@ -94,9 +188,7 @@ def run_bench() -> tuple[dict, int]:
           file=sys.stderr)
 
     model = cas_register()
-    t0 = time.monotonic()
-    res_cold = wgl.check(model, hist, time_limit=budget)
-    cold_s = time.monotonic() - t0
+    res_cold, cold_s = _timed(wgl.check, model, hist, time_limit=budget)
     print(f"cold (incl compile): {cold_s:.2f}s -> {res_cold}",
           file=sys.stderr)
 
@@ -108,16 +200,17 @@ def run_bench() -> tuple[dict, int]:
                  "verdict": "unknown", "platform": plat,
                  "cause": res_cold.get("cause")}, 1)
 
-    t0 = time.monotonic()
-    res = wgl.check(model, hist, time_limit=budget)
-    warm_s = time.monotonic() - t0
+    res, warm_s = _timed(wgl.check, model, hist, time_limit=budget)
     print(f"warm: {warm_s:.2f}s -> {res}", file=sys.stderr)
 
-    return ({"metric": metric, "value": round(warm_s, 3), "unit": "s",
-             "vs_baseline": round(60.0 / warm_s, 3),
-             "verdict": res.get("valid?"), "platform": plat,
-             "cold_s": round(cold_s, 3),
-             "configs_explored": res.get("configs_explored")}, 0)
+    out = {"metric": metric, "value": round(warm_s, 3), "unit": "s",
+           "vs_baseline": round(60.0 / warm_s, 3),
+           "verdict": res.get("valid?"), "platform": plat,
+           "cold_s": round(cold_s, 3),
+           "configs_explored": res.get("configs_explored")}
+    if extras:
+        out["configs"] = run_extras(budget)
+    return out, 0
 
 
 def main() -> int:

@@ -205,6 +205,26 @@ class Linearizable(Checker):
         h = strip_nemesis(history)
         algo = self.algorithm
         res: dict
+        if algo in ("competition", "queue-poly") and isinstance(
+                self.model, models.FIFOQueue):
+            # FIFO queues defeat state-space search (ours and JVM
+            # knossos alike); the polynomial checker decides 100k-op
+            # histories in milliseconds when the history qualifies
+            # (distinct values, known dequeue returns)
+            from ..ops import queuecheck
+            try:
+                res = queuecheck.check(h)
+                res["algorithm"] = algo
+                return res
+            except queuecheck.QueueUnsupported as e:
+                if algo == "queue-poly":
+                    res = {"valid?": UNKNOWN, "algorithm": algo,
+                           "cause": f"queue-poly: {e}"}
+                    return res
+        elif algo == "queue-poly":
+            return {"valid?": UNKNOWN, "algorithm": algo,
+                    "cause": "queue-poly requires a FIFOQueue model, "
+                             f"got {type(self.model).__name__}"}
         if algo == "wgl":
             res = wgl_ref.check(self.model, h, time_limit=self.time_limit)
         elif algo == "tpu-wgl":

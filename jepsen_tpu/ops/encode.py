@@ -109,8 +109,8 @@ def _pad_to(n: int, multiple: int) -> int:
     return max(multiple, ((n + multiple - 1) // multiple) * multiple)
 
 
-def encode(model: Model, history: History, max_window: int = 256,
-           max_states: int = 1 << 16, max_info: int = 128) -> Encoded:
+def encode(model: Model, history: History, max_window: int = 1024,
+           max_states: int = 1 << 16, max_info: int = 256) -> Encoded:
     """History + model -> Encoded tensors, or raise EncodingUnsupported."""
     ops = prepare(history)
     ok_ops = [o for o in ops if o.ok]
@@ -154,7 +154,10 @@ def encode(model: Model, history: History, max_window: int = 256,
         w_needed = int(np.max(hi - np.arange(n)))
     else:
         w_needed = 1
-    W = _pad_to(w_needed, 32)
+    # Narrow windows bucket at 32 (few shapes, cheap); wide ones at 128
+    # so adversarial long-tail runs don't compile a fresh kernel per
+    # history length.
+    W = _pad_to(w_needed, 32 if w_needed <= 256 else 128)
     if W > max_window:
         raise EncodingUnsupported(
             f"window {w_needed} exceeds max {max_window} "

@@ -333,7 +333,10 @@ def _pick_capacities(W: int, ic_pad: int, n: int):
     probe-based dedup degrades into re-exploration (each slot is 16
     bytes, so even 2^23 slots is only 128 MB)."""
     budget = 32 * 1024 * 1024  # bool elements
-    K = max(256, min(4096, budget // max(1, 2 * W * W)))
+    # Wide windows (Porcupine-style long tails, W up to 1024) shrink the
+    # frontier instead of overflowing memory: the backlog absorbs the
+    # lost breadth, so only throughput degrades, never soundness.
+    K = max(16, min(4096, budget // max(1, 2 * W * W)))
     K = 1 << (K.bit_length() - 1)
     if n > 5000:
         H = 1 << 23

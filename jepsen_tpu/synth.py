@@ -143,7 +143,11 @@ def fifo_queue_history(n_ops: int, n_procs: int = 4, seed: int = 0
             break
         if can_invoke and (not pending or rng.random() < 0.6):
             p = free.pop(rng.randrange(len(free)))
-            if rng.random() < 0.55:
+            # a dequeue is only issued when something can satisfy it,
+            # or every process could end up blocked on an empty queue
+            can_deq = q or any(f == "enqueue"
+                               for f, _ in pending.values())
+            if rng.random() < 0.55 or not can_deq:
                 f, v = "enqueue", nxt
                 nxt += 1
             else:
@@ -166,5 +170,53 @@ def fifo_queue_history(n_ops: int, n_procs: int = 4, seed: int = 0
             else:
                 hist.append(h.ok(p, f, q.pop(0), time=t))
             free.append(p)
+        t += 1
+    return hist.index()
+
+
+def long_tail_history(n_quick: int, n_slow: int = 1, values: int = 5,
+                      lie_p: float = 0.0, seed: int = 0) -> h.History:
+    """Porcupine-style adversarial long tail: `n_slow` reads stay open
+    across the whole run while other processes complete `n_quick` fast
+    ops — every fast op overlaps the slow ones, so the WGL window
+    requirement is ~n_quick (BASELINE.md "adversarial long-tail
+    histories"; the JVM checker degrades in exactly this regime)."""
+    rng = random.Random(seed)
+    hist = h.History()
+    reg: Optional[int] = None
+    t = 0
+    for p in range(n_slow):
+        hist.append(h.invoke(p, "read", None, time=t))
+        t += 1
+    fast = n_slow
+    for _ in range(n_quick):
+        f = rng.choice(["write", "read", "cas"])
+        if f == "write":
+            v = rng.randrange(values)
+        elif f == "cas":
+            v = [rng.randrange(values), rng.randrange(values)]
+        else:
+            v = None
+        hist.append(h.invoke(fast, f, v, time=t))
+        t += 1
+        if f == "write":
+            reg = v
+            hist.append(h.ok(fast, f, v, time=t))
+        elif f == "cas":
+            if v[0] == reg:
+                reg = v[1]
+                hist.append(h.ok(fast, f, v, time=t))
+            else:
+                hist.append(h.fail(fast, f, v, time=t))
+        else:
+            out = reg
+            if lie_p and rng.random() < lie_p:
+                out = (reg or 0) + 1
+            hist.append(h.ok(fast, f, out, time=t))
+        t += 1
+    # the slow reads finally return: any value the register ever held is
+    # linearizable somewhere in their span; report the final value
+    for p in range(n_slow):
+        hist.append(h.ok(p, "read", reg, time=t))
         t += 1
     return hist.index()

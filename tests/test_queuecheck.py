@@ -1,0 +1,167 @@
+"""Differential validation of the polynomial FIFO-queue checker against
+the WGL oracle: thousands of random small interval structures — valid
+runs, corrupted runs, adversarial overlaps — must produce identical
+verdicts. This is the proof the Gibbons–Korach-style constraint graph
+characterization in ops/queuecheck.py is implemented correctly."""
+
+import random
+
+import pytest
+
+from jepsen_tpu import history as h
+from jepsen_tpu.models import fifo_queue
+from jepsen_tpu.ops import queuecheck, wgl_ref
+from jepsen_tpu.synth import fifo_queue_history
+
+
+def hist_from_intervals(ops):
+    """[(f, v, inv_t, ret_t)] -> History; each op on its own process so
+    any overlap structure is expressible."""
+    events = []
+    for p, (f, v, t0, t1) in enumerate(ops):
+        events.append((t0, 0, h.invoke(p, f, v, time=t0)))
+        events.append((t1, 1, h.ok(p, f, v, time=t1)))
+    events.sort(key=lambda e: (e[0], e[1]))
+    return h.History([e[2] for e in events]).index()
+
+
+def random_history(rng: random.Random):
+    """A random interval structure over a few values. Roughly half are
+    real queue runs (valid), half are random timings (often invalid)."""
+    n_vals = rng.randint(1, 5)
+    t_max = rng.randint(4, 20)
+    ops = []
+    if rng.random() < 0.5:
+        # simulate a real queue, then optionally corrupt one value
+        q, log = [], []
+        t = 0
+        vals = list(range(n_vals))
+        pend = []
+        while vals or q or pend:
+            r = rng.random()
+            if vals and r < 0.45:
+                v = vals.pop(0)
+                pend.append(("enqueue", v, t))
+            elif pend and r < 0.75:
+                f, v, t0 = pend.pop(rng.randrange(len(pend)))
+                if f == "enqueue":
+                    q.append(v)
+                else:
+                    if not q:
+                        continue
+                    v = q.pop(0)
+                log.append((f, v, t0, t))
+            elif q and rng.random() < 0.8:
+                pend.append(("dequeue", None, t))
+            t += 1
+        ops = [(f, v, t0, t1) for f, v, t0, t1 in log]
+        if ops and rng.random() < 0.4:
+            # corrupt: swap two dequeue values
+            dqs = [i for i, o in enumerate(ops) if o[0] == "dequeue"]
+            if len(dqs) >= 2:
+                i, j = rng.sample(dqs, 2)
+                oi, oj = ops[i], ops[j]
+                ops[i] = (oi[0], oj[1], oi[2], oi[3])
+                ops[j] = (oj[0], oi[1], oj[2], oj[3])
+    else:
+        # fully random intervals
+        deq_of = []
+        for v in range(n_vals):
+            a = rng.randint(0, t_max)
+            ops.append(("enqueue", v, a, a + rng.randint(1, 6)))
+            if rng.random() < 0.8:
+                b = rng.randint(0, t_max)
+                deq_of.append(("dequeue", v, b, b + rng.randint(1, 6)))
+        order = list(range(len(deq_of)))
+        rng.shuffle(order)
+        ops += [deq_of[i] for i in order]
+    # dedup: queuecheck needs each dequeue value unique; random swaps
+    # can't produce dupes here by construction
+    return hist_from_intervals(ops)
+
+
+@pytest.mark.parametrize("seed_base", [0, 5000])
+def test_differential_vs_oracle(seed_base):
+    n_checked = 0
+    for seed in range(seed_base, seed_base + 2500):
+        rng = random.Random(seed)
+        hist = random_history(rng)
+        try:
+            fast = queuecheck.check(hist)
+        except queuecheck.QueueUnsupported:
+            continue
+        ref = wgl_ref.check(fifo_queue(), hist, time_limit=20)
+        assert ref["valid?"] != "unknown", f"oracle DNF at seed {seed}"
+        assert fast["valid?"] == ref["valid?"], (
+            f"seed {seed}: poly={fast} oracle={ref['valid?']}\n"
+            f"history={[o.to_dict() for o in hist]}")
+        n_checked += 1
+    # the fuzzer must actually exercise the checker
+    assert n_checked > 1500
+
+
+def test_synthesized_valid_runs():
+    for n, seed in [(500, 1), (2000, 2), (5000, 3)]:
+        hist = fifo_queue_history(n, n_procs=4, seed=seed)
+        assert queuecheck.check(hist)["valid?"] is True
+
+
+def test_corrupted_big_run_invalid():
+    hist = fifo_queue_history(2000, n_procs=4, seed=9)
+    ops = list(hist)
+    # swap the values of two ok dequeues far apart
+    dq = [i for i, o in enumerate(ops)
+          if o.is_ok and o.f == "dequeue"]
+    i, j = dq[50], dq[-50]
+    ops[i], ops[j] = (ops[i].with_(value=ops[j].value),
+                      ops[j].with_(value=ops[i].value))
+    bad = h.History(ops).index()
+    assert queuecheck.check(bad)["valid?"] is False
+
+
+def test_open_ops():
+    # a crashed enqueue never dequeued may simply not have happened:
+    # excluding it is exact, verdict True
+    hist = h.History([h.invoke(0, "enqueue", 1), h.info(0, "enqueue", 1)
+                      ]).index()
+    assert queuecheck.check(hist)["valid?"] is True
+    # a crashed enqueue whose value IS dequeued definitely happened
+    hist = h.History([h.invoke(0, "enqueue", 1), h.info(0, "enqueue", 1),
+                      h.invoke(1, "dequeue", None),
+                      h.ok(1, "dequeue", 1)]).index()
+    assert queuecheck.check(hist)["valid?"] is True
+    # invalid-looking history with an open dequeue excluded must fall
+    # back to the search (the open op might have rescued it)
+    hist = h.History([
+        h.invoke(0, "enqueue", 1), h.ok(0, "enqueue", 1),
+        h.invoke(1, "dequeue", None),             # open dequeue
+        h.invoke(2, "enqueue", 2), h.ok(2, "enqueue", 2),
+        h.invoke(3, "dequeue", None), h.ok(3, "dequeue", 2),
+    ]).index()
+    with pytest.raises(queuecheck.QueueUnsupported):
+        queuecheck.check(hist)
+
+
+def test_unsupported_shapes():
+    # unknown dequeue value
+    hist = h.History([h.invoke(0, "enqueue", 1), h.ok(0, "enqueue", 1),
+                      h.invoke(1, "dequeue", None),
+                      h.ok(1, "dequeue", None)]).index()
+    with pytest.raises(queuecheck.QueueUnsupported):
+        queuecheck.check(hist)
+    # duplicate enqueue values
+    hist = h.History([h.invoke(0, "enqueue", 1), h.ok(0, "enqueue", 1),
+                      h.invoke(1, "enqueue", 1),
+                      h.ok(1, "enqueue", 1)]).index()
+    with pytest.raises(queuecheck.QueueUnsupported):
+        queuecheck.check(hist)
+
+
+def test_dequeue_never_enqueued_invalid():
+    hist = h.History([h.invoke(0, "dequeue", None),
+                      h.ok(0, "dequeue", 77)]).index()
+    assert queuecheck.check(hist)["valid?"] is False
+
+
+def test_empty_history():
+    assert queuecheck.check(h.History().index())["valid?"] is True

