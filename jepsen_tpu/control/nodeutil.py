@@ -160,10 +160,13 @@ def grepkill(pattern: str, signal: str = "9") -> None:
     Deliberately NOT pkill -f: the remote bash/sudo wrapper's own command
     line contains the pattern and would signal itself (the reference uses
     ps | grep -v grep for exactly this reason)."""
+    # $$ exclusion: the wrapping shell's own command line contains the
+    # pattern (fatal under the localexec remote, where bash -c IS the
+    # node process; merely cosmetic over SSH)
     meh(exec_star,
         f"ps -ef | grep {escape(pattern)} | grep -v grep "
-        f"| awk '{{print $2}}' | xargs --no-run-if-empty "
-        f"kill -s {escape(str(signal))}")
+        f"| awk -v self=$$ '$2 != self {{print $2}}' "
+        f"| xargs --no-run-if-empty kill -s {escape(str(signal))}")
 
 
 def signal(process_name: str, sig: str) -> str:
@@ -214,9 +217,20 @@ def stop_daemon(cmd_or_pidfile: str, pidfile: Optional[str] = None) -> None:
         pf = cmd_or_pidfile
         if file_exists(pf):
             log.info("Stopping %s", pf)
-            pid = exec_("cat", pf).strip()
-            meh(exec_, "kill", "-9", pid)
-            meh(exec_, "rm", "-rf", pf)
+            # the pidfile may vanish between the check and the read (a
+            # concurrent nemesis kill + teardown, both stopping)
+            pid = (meh(exec_, "cat", pf) or "").strip()
+            if pid:
+                meh(exec_, "kill", "-9", pid)
+                meh(exec_, "rm", "-rf", pf)
+            elif not file_exists(pf):
+                pass  # vanished mid-race: the other stopper owns it
+            else:
+                # cat failed while the file still exists (transient
+                # remote error?) — leave the pidfile so a later stop
+                # can still find the daemon
+                log.warning("could not read %s; daemon may still be "
+                            "running", pf)
     else:
         log.info("Stopping %s", cmd_or_pidfile)
         meh(exec_, "killall", "-9", "-w", cmd_or_pidfile)

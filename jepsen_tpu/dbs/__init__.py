@@ -1,0 +1,11 @@
+"""Database test suites — the L7 layer.
+
+The reference ships 25 standalone per-database suites (tidb, yugabyte,
+zookeeper, ...: SURVEY.md §2.4), each wiring a DB lifecycle
+implementation, per-workload clients, a nemesis, and a CLI main into
+the shared framework. This package holds this framework's suites; the
+exemplar is `toykv` — a real networked key-value store driven end to
+end over the localexec remote, proving the whole L0-L6 stack against
+live processes (the role zookeeper plays as the reference's minimal
+single-file suite, `zookeeper/src/jepsen/zookeeper.clj:1-145`).
+"""

@@ -138,9 +138,14 @@ class _WorkerHandle:
         self.id = wid
         self.inbox: _queue.Queue = _queue.Queue(maxsize=1)
         worker = worker_factory.open(test, wid)
+        # convey the spawning thread's control bindings (remote, ssh
+        # config) into the worker, as Clojure's binding conveyance does
+        # for the reference's worker futures — the nemesis runs control
+        # actions from its worker thread (interpreter.clj:99-116)
+        from .. import control
         self.thread = threading.Thread(
-            target=_worker_loop, args=(test, worker, wid, self.inbox,
-                                       completions),
+            target=control.bound_fn(_worker_loop),
+            args=(test, worker, wid, self.inbox, completions),
             name=f"jepsen-worker-{wid}", daemon=True)
         self.thread.start()
 
