@@ -227,6 +227,10 @@ class Linearizable(Checker):
                              f"got {type(self.model).__name__}"}
         if algo == "wgl":
             res = wgl_ref.check(self.model, h, time_limit=self.time_limit)
+        elif algo == "linear":
+            from ..ops import jitlin
+            res = jitlin.check(self.model, h,
+                               time_limit=self.time_limit)
         elif algo == "tpu-wgl":
             from ..ops import wgl as wgl_tpu
             res = wgl_tpu.check_with_diagnostics(
@@ -257,6 +261,12 @@ class Linearizable(Checker):
             if k in res and isinstance(res[k], list):
                 res[k] = res[k][:10]
         res["algorithm"] = algo
+        if res.get("valid?") is False:
+            # render the counterexample (checker.clj:205-212)
+            from . import linear_report
+            p = linear_report.render_analysis(test, h, res, opts)
+            if p:
+                res["counterexample-svg"] = p
         return res
 
 

@@ -35,14 +35,17 @@ class LinOp:
     f: Any  # op function (read/write/cas/acquire/...)
     value: Any  # completed value (see module docstring)
     ok: bool  # True: must linearize; False (:info): may linearize
-    inv: int  # index of invocation event in the history
+    inv: int  # index of invocation event in the (stripped) history
     ret: int  # index of completion event, or INF_TIME
     process: Any = None
+    orig_index: int = -1  # the invocation Op's own .index — the
+    #   coordinate users see; inv/ret renumber after nemesis stripping
 
     def as_op(self) -> Op:
-        """The op as seen by Model.step."""
+        """The op as seen by Model.step / reported in diagnostics."""
+        idx = self.orig_index if self.orig_index >= 0 else self.inv
         return Op("ok" if self.ok else "info", f=self.f, process=self.process,
-                  value=self.value, index=self.inv)
+                  value=self.value, index=idx)
 
 
 def prepare(history: History, crashed_read_fs=("read",)) -> list[LinOp]:
@@ -76,14 +79,16 @@ def prepare(history: History, crashed_read_fs=("read",)) -> list[LinOp]:
                 if inv.f in crashed_read_fs:
                     continue  # crashed read: no effect, no constraint
                 ops.append(LinOp(inv.f, inv.value, False, inv_i, INF_TIME,
-                                 inv.process))
+                                 inv.process, orig_index=inv.index))
             else:
-                ops.append(LinOp(inv.f, value, True, inv_i, i, inv.process))
+                ops.append(LinOp(inv.f, value, True, inv_i, i, inv.process,
+                                 orig_index=inv.index))
     # ops whose processes never completed: crashed
     for inv_i, inv in pending.values():
         if inv.f in crashed_read_fs:
             continue
-        ops.append(LinOp(inv.f, inv.value, False, inv_i, INF_TIME, inv.process))
+        ops.append(LinOp(inv.f, inv.value, False, inv_i, INF_TIME,
+                         inv.process, orig_index=inv.index))
     ops.sort(key=lambda o: o.inv)
     return ops
 

@@ -1,0 +1,136 @@
+"""JIT-linearization engine tests: differential against the WGL oracle
+over random histories (the knossos linear/wgl agreement property), plus
+failure-diagnostic shape and the linear.svg counterexample render."""
+
+import os
+import random
+
+import pytest
+
+from jepsen_tpu import checker as jchecker
+from jepsen_tpu import history as h
+from jepsen_tpu import models, synth
+from jepsen_tpu.checker import linear_report
+from jepsen_tpu.history import History
+from jepsen_tpu.ops import jitlin, wgl_ref
+
+
+@pytest.mark.parametrize("seed", range(30))
+def test_differential_cas_register(seed):
+    hist = synth.cas_register_history(
+        40, n_procs=4, seed=seed,
+        crash_p=0.05, lie_p=(0.08 if seed % 2 else 0.0))
+    lin = jitlin.check(models.cas_register(), hist)
+    ref = wgl_ref.check(models.cas_register(), hist)
+    assert lin["valid?"] == ref["valid?"], (seed, lin, ref)
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_differential_mutex(seed):
+    hist = synth.mutex_history(60, n_procs=3, seed=seed)
+    lin = jitlin.check(models.mutex(), hist)
+    ref = wgl_ref.check(models.mutex(), hist)
+    assert lin["valid?"] == ref["valid?"], (seed, lin, ref)
+
+
+def test_large_valid_history():
+    # complete history (no crashes): crashed ops stay pending forever
+    # and blow up the closure — the regime where wgl's bounded
+    # info-mask wins and knossos linear equally DNFs
+    hist = synth.cas_register_history(3000, n_procs=5, seed=3,
+                                      crash_p=0.0)
+    res = jitlin.check(models.cas_register(), hist, time_limit=120)
+    assert res["valid?"] is True
+
+
+def test_invalid_names_the_blocking_op():
+    hist = History([
+        h.invoke(0, "write", 1), h.ok(0, "write", 1),
+        h.invoke(0, "read", None), h.ok(0, "read", 2),
+    ]).index()
+    res = jitlin.check(models.cas_register(), hist)
+    assert res["valid?"] is False
+    assert res["op"]["f"] == "read"
+    assert res["op"]["value"] == 2
+    assert res["final_paths"]  # witnessed prefix present
+
+
+def test_empty_history():
+    assert jitlin.check(models.cas_register(),
+                        History().index())["valid?"] is True
+
+
+def test_linear_algorithm_via_checker(tmp_path):
+    hist = History([
+        h.invoke(0, "write", 1), h.ok(0, "write", 1),
+        h.invoke(1, "read", None), h.ok(1, "read", 3),
+    ]).index()
+    test = {"name": "lin-svg", "start_time": "t0",
+            "store_root": str(tmp_path)}
+    res = jchecker.linearizable(
+        models.cas_register(), algorithm="linear").check(test, hist, {})
+    assert res["valid?"] is False
+    assert res["algorithm"] == "linear"
+    svg = os.path.join(str(tmp_path), "lin-svg", "t0", "linear.svg")
+    assert os.path.exists(svg)
+    doc = open(svg).read()
+    assert "not linearizable" in doc
+    assert "read" in doc
+
+
+def test_svg_render_handles_big_histories():
+    hist = synth.cas_register_history(2000, n_procs=5, seed=1,
+                                      lie_p=0.02)
+    res = jitlin.check(models.cas_register(), hist)
+    assert res["valid?"] is False
+    doc = linear_report.render(hist, res)
+    assert doc is not None
+    assert doc.count("<rect") <= linear_report.MAX_OPS + 10
+
+
+def test_svg_escapes_hostile_values(tmp_path):
+    hist = History([
+        h.invoke(0, "write", "<img src=x>"),
+        h.ok(0, "write", "<img src=x>"),
+        h.invoke(1, "read", None), h.ok(1, "read", "nope"),
+    ]).index()
+    res = jitlin.check(models.register(), hist)
+    assert res["valid?"] is False
+    doc = linear_report.render(hist, res)
+    assert "<img" not in doc
+
+
+def test_diagnostics_in_full_history_coordinates():
+    """Regression: op indexes in diagnostics must be full-history
+    coordinates even though the checker strips nemesis ops before
+    analysis — the SVG previously highlighted the wrong op."""
+    hist = History([
+        h.info("nemesis", "start", None),
+        h.info("nemesis", "start", None),
+        h.invoke(0, "write", 1), h.ok(0, "write", 1),
+        h.invoke(0, "read", None), h.ok(0, "read", 2),
+    ]).index()
+    res = jchecker.linearizable(
+        models.cas_register(), algorithm="linear").check({}, hist, {})
+    assert res["valid?"] is False
+    assert res["op"]["index"] == 4  # the read's real index
+    doc = linear_report.render(
+        hist.filter(lambda o: o.process != "nemesis"), res)
+    # the red highlight sits on the failing read's bar
+    assert "stroke='#d03030'" in doc
+
+
+def test_svg_window_keeps_slow_failing_op():
+    """Regression: the failing op must survive windowing even when its
+    return trails its invocation by many events."""
+    ops = [h.invoke(9, "read", None)]  # slow read spanning everything
+    for i in range(200):
+        ops.append(h.invoke(0, "write", i % 5))
+        ops.append(h.ok(0, "write", i % 5))
+    ops.append(h.ok(9, "read", 99))  # impossible value
+    hist = History(ops).index()
+    res = jitlin.check(models.cas_register(), hist)
+    assert res["valid?"] is False
+    doc = linear_report.render(hist, res)
+    assert doc is not None
+    assert "stroke='#d03030'" in doc
