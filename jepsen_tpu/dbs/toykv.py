@@ -133,8 +133,10 @@ def node_for_key(test: dict, k) -> str:
 class ToyKVDB(jdb.DB, jdb.Process, jdb.LogFiles):
     """Install + daemon lifecycle (zookeeper.clj db; db.clj:11-41)."""
 
-    def __init__(self, volatile: bool = False):
+    def __init__(self, volatile: bool = False,
+                 env: Optional[dict] = None):
         self.volatile = volatile
+        self.env = env  # extra daemon env, e.g. a faultlib preload
 
     def _start(self, test, node):
         args = ["toykv_server.py", "--port", str(node_port(test, node))]
@@ -146,6 +148,7 @@ class ToyKVDB(jdb.DB, jdb.Process, jdb.LogFiles):
         nodeutil.start_daemon(
             {"logfile": LOGFILE, "pidfile": PIDFILE,
              "exec": "/usr/bin/python3",
+             "env": self.env,
              "chdir": control.lit("$PWD")},
             "/usr/bin/python3", *args)
         nodeutil.await_tcp_port(node_port(test, node), timeout_s=30)

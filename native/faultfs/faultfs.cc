@@ -1,0 +1,306 @@
+// faultfs — a FUSE passthrough filesystem with programmable fault
+// injection. The TPU-native build's CharybdeFS equivalent: the
+// reference clones and compiles scylladb/charybdefs (C++/Thrift) on
+// each node (charybdefs/src/jepsen/charybdefs.clj:40-66) and drives it
+// through a Thrift RPC "cookbook" (:68-86). This is a from-scratch
+// redesign: same capability (passthrough FS where any operation can be
+// made to fail with EIO or stall, globally, probabilistically, or by
+// path substring) with a much smaller control surface — a magic
+// control file inside the mount (".faultfs_ctl") accepts one-line
+// commands, so the nemesis drives it with plain `echo >` over the
+// control layer instead of a Thrift stack.
+//
+//   mount:    faultfs <backing-dir> <mountpoint> [fuse options]
+//   control:  echo "eio all"            > /faulty/.faultfs_ctl
+//             echo "eio p 0.01"         > /faulty/.faultfs_ctl
+//             echo "eio path state.log" > /faulty/.faultfs_ctl
+//             echo "delay ms 100 p 0.5" > /faulty/.faultfs_ctl
+//             echo "clear"              > /faulty/.faultfs_ctl
+//   inspect:  cat /faulty/.faultfs_ctl
+//
+// Build (on the db node, like the clock programs and the reference's
+// on-node charybdefs build): g++ -O2 -o faultfs faultfs.cc \
+//     $(pkg-config fuse3 --cflags --libs)
+// Needs libfuse3-dev; the nemesis wrapper installs it.
+
+#define FUSE_USE_VERSION 31
+
+#include <fuse3/fuse.h>
+
+#include <cerrno>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <ctime>
+#include <mutex>
+#include <random>
+#include <string>
+
+#include <dirent.h>
+#include <fcntl.h>
+#include <sys/stat.h>
+#include <sys/statvfs.h>
+#include <sys/time.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr const char *kCtlName = "/.faultfs_ctl";
+
+struct FaultState {
+  bool eio_all = false;
+  double eio_p = 0.0;          // probabilistic EIO
+  std::string eio_path;        // substring match -> EIO
+  double delay_p = 0.0;        // probabilistic delay
+  long delay_ms = 0;
+  std::mutex mu;
+  std::mt19937_64 rng{0xFA17FA17};
+
+  std::string describe() {
+    std::lock_guard<std::mutex> lk(mu);  // races apply_command
+    char buf[256];
+    std::snprintf(buf, sizeof buf,
+                  "eio_all=%d eio_p=%.4f eio_path=%s delay_ms=%ld "
+                  "delay_p=%.4f\n",
+                  eio_all ? 1 : 0, eio_p,
+                  eio_path.empty() ? "-" : eio_path.c_str(), delay_ms,
+                  delay_p);
+    return buf;
+  }
+};
+
+FaultState g_state;
+std::string g_backing;
+
+// -1 = inject EIO; otherwise apply any configured delay and continue.
+int check_fault(const char *path) {
+  std::lock_guard<std::mutex> lk(g_state.mu);
+  if (g_state.delay_ms > 0) {
+    double roll =
+        std::uniform_real_distribution<>(0, 1)(g_state.rng);
+    if (g_state.delay_p >= 1.0 || roll < g_state.delay_p) {
+      struct timespec ts = {g_state.delay_ms / 1000,
+                            (g_state.delay_ms % 1000) * 1000000L};
+      nanosleep(&ts, nullptr);
+    }
+  }
+  if (g_state.eio_all) return -1;
+  if (!g_state.eio_path.empty() && path != nullptr &&
+      std::strstr(path, g_state.eio_path.c_str()) != nullptr)
+    return -1;
+  if (g_state.eio_p > 0.0) {
+    double roll =
+        std::uniform_real_distribution<>(0, 1)(g_state.rng);
+    if (roll < g_state.eio_p) return -1;
+  }
+  return 0;
+}
+
+void apply_command(const std::string &cmd) {
+  std::lock_guard<std::mutex> lk(g_state.mu);
+  char a[64] = {0}, b[64] = {0};
+  double x = 0;
+  if (cmd.rfind("clear", 0) == 0) {
+    g_state.eio_all = false;
+    g_state.eio_p = 0;
+    g_state.eio_path.clear();
+    g_state.delay_p = 0;
+    g_state.delay_ms = 0;
+  } else if (cmd == "eio all") {
+    g_state.eio_all = true;
+  } else if (std::sscanf(cmd.c_str(), "eio p %lf", &x) == 1) {
+    g_state.eio_p = x;
+  } else if (std::sscanf(cmd.c_str(), "eio path %63s", a) == 1) {
+    g_state.eio_path = a;
+  } else if (std::sscanf(cmd.c_str(), "delay ms %63s p %lf", a, &x) ==
+             2) {
+    g_state.delay_ms = std::strtol(a, nullptr, 10);
+    g_state.delay_p = x;
+  } else if (std::sscanf(cmd.c_str(), "delay ms %63s", a) == 1) {
+    g_state.delay_ms = std::strtol(a, nullptr, 10);
+    g_state.delay_p = 1.0;
+  } else {
+    std::fprintf(stderr, "faultfs: unknown command: %s (b=%s)\n",
+                 cmd.c_str(), b);
+  }
+}
+
+std::string real_path(const char *path) { return g_backing + path; }
+
+bool is_ctl(const char *path) {
+  return std::strcmp(path, kCtlName) == 0;
+}
+
+#define FAULT_GUARD(path)            \
+  do {                               \
+    if (check_fault(path) != 0) return -EIO; \
+  } while (0)
+
+int ff_getattr(const char *path, struct stat *st,
+               struct fuse_file_info *) {
+  if (is_ctl(path)) {
+    std::memset(st, 0, sizeof *st);
+    st->st_mode = S_IFREG | 0666;
+    st->st_nlink = 1;
+    st->st_size = 4096;
+    return 0;
+  }
+  FAULT_GUARD(path);
+  return lstat(real_path(path).c_str(), st) == -1 ? -errno : 0;
+}
+
+int ff_readdir(const char *path, void *buf, fuse_fill_dir_t fill,
+               off_t, struct fuse_file_info *,
+               enum fuse_readdir_flags) {
+  FAULT_GUARD(path);
+  DIR *dp = opendir(real_path(path).c_str());
+  if (dp == nullptr) return -errno;
+  struct dirent *de;
+  while ((de = readdir(dp)) != nullptr)
+    fill(buf, de->d_name, nullptr, 0, (fuse_fill_dir_flags)0);
+  closedir(dp);
+  return 0;
+}
+
+int ff_open(const char *path, struct fuse_file_info *fi) {
+  if (is_ctl(path)) return 0;
+  FAULT_GUARD(path);
+  int fd = open(real_path(path).c_str(), fi->flags);
+  if (fd == -1) return -errno;
+  fi->fh = fd;
+  return 0;
+}
+
+int ff_create(const char *path, mode_t mode,
+              struct fuse_file_info *fi) {
+  if (is_ctl(path)) return 0;
+  FAULT_GUARD(path);
+  int fd = open(real_path(path).c_str(), fi->flags, mode);
+  if (fd == -1) return -errno;
+  fi->fh = fd;
+  return 0;
+}
+
+int ff_read(const char *path, char *buf, size_t size, off_t off,
+            struct fuse_file_info *fi) {
+  if (is_ctl(path)) {
+    std::string s = g_state.describe();
+    if ((size_t)off >= s.size()) return 0;
+    size_t n = std::min(size, s.size() - off);
+    std::memcpy(buf, s.data() + off, n);
+    return (int)n;
+  }
+  FAULT_GUARD(path);
+  ssize_t n = pread((int)fi->fh, buf, size, off);
+  return n == -1 ? -errno : (int)n;
+}
+
+int ff_write(const char *path, const char *buf, size_t size, off_t off,
+             struct fuse_file_info *fi) {
+  if (is_ctl(path)) {
+    std::string cmd(buf, size);
+    while (!cmd.empty() &&
+           (cmd.back() == '\n' || cmd.back() == ' '))
+      cmd.pop_back();
+    apply_command(cmd);
+    return (int)size;
+  }
+  FAULT_GUARD(path);
+  ssize_t n = pwrite((int)fi->fh, buf, size, off);
+  return n == -1 ? -errno : (int)n;
+}
+
+int ff_release(const char *path, struct fuse_file_info *fi) {
+  if (!is_ctl(path)) close((int)fi->fh);
+  return 0;
+}
+
+int ff_fsync(const char *path, int datasync,
+             struct fuse_file_info *fi) {
+  if (is_ctl(path)) return 0;
+  FAULT_GUARD(path);
+  int r = datasync ? fdatasync((int)fi->fh) : fsync((int)fi->fh);
+  return r == -1 ? -errno : 0;
+}
+
+int ff_truncate(const char *path, off_t size,
+                struct fuse_file_info *) {
+  if (is_ctl(path)) return 0;
+  FAULT_GUARD(path);
+  return truncate(real_path(path).c_str(), size) == -1 ? -errno : 0;
+}
+
+int ff_unlink(const char *path) {
+  FAULT_GUARD(path);
+  return unlink(real_path(path).c_str()) == -1 ? -errno : 0;
+}
+
+int ff_mkdir(const char *path, mode_t mode) {
+  FAULT_GUARD(path);
+  return mkdir(real_path(path).c_str(), mode) == -1 ? -errno : 0;
+}
+
+int ff_rmdir(const char *path) {
+  FAULT_GUARD(path);
+  return rmdir(real_path(path).c_str()) == -1 ? -errno : 0;
+}
+
+int ff_rename(const char *from, const char *to, unsigned int) {
+  FAULT_GUARD(from);
+  return rename(real_path(from).c_str(), real_path(to).c_str()) == -1
+             ? -errno
+             : 0;
+}
+
+int ff_statfs(const char *path, struct statvfs *st) {
+  return statvfs(real_path(path).c_str(), st) == -1 ? -errno : 0;
+}
+
+int ff_utimens(const char *path, const struct timespec tv[2],
+               struct fuse_file_info *) {
+  if (is_ctl(path)) return 0;
+  FAULT_GUARD(path);
+  return utimensat(AT_FDCWD, real_path(path).c_str(), tv,
+                   AT_SYMLINK_NOFOLLOW) == -1
+             ? -errno
+             : 0;
+}
+
+int ff_chmod(const char *path, mode_t mode, struct fuse_file_info *) {
+  FAULT_GUARD(path);
+  return chmod(real_path(path).c_str(), mode) == -1 ? -errno : 0;
+}
+
+}  // namespace
+
+int main(int argc, char *argv[]) {
+  if (argc < 3) {
+    std::fprintf(stderr,
+                 "usage: faultfs <backing-dir> <mountpoint> "
+                 "[fuse options]\n");
+    return 2;
+  }
+  g_backing = argv[1];
+  // strip the backing dir from the argv FUSE parses
+  static struct fuse_operations ops = {};
+  ops.getattr = ff_getattr;
+  ops.readdir = ff_readdir;
+  ops.open = ff_open;
+  ops.create = ff_create;
+  ops.read = ff_read;
+  ops.write = ff_write;
+  ops.release = ff_release;
+  ops.fsync = ff_fsync;
+  ops.truncate = ff_truncate;
+  ops.unlink = ff_unlink;
+  ops.mkdir = ff_mkdir;
+  ops.rmdir = ff_rmdir;
+  ops.rename = ff_rename;
+  ops.statfs = ff_statfs;
+  ops.utimens = ff_utimens;
+  ops.chmod = ff_chmod;
+  int fargc = argc - 1;
+  char **fargv = argv + 1;
+  fargv[0] = argv[0];
+  return fuse_main(fargc, fargv, &ops, nullptr);
+}

@@ -1,0 +1,251 @@
+// faultlib — LD_PRELOAD I/O fault injector.
+//
+// The process-scoped sibling of faultfs: where faultfs interposes at
+// the filesystem boundary (FUSE, needs root + /dev/fuse), faultlib
+// interposes at the libc boundary, the same mechanism the reference
+// uses for clock virtualization (libfaketime, faketime.clj:8-22).
+// Wrap a DB process with LD_PRELOAD=faultlib.so and acknowledged
+// writes/fsyncs start failing with EIO — no kernel support, no
+// privileges, works in any container. This is the path the CI
+// integration tests exercise against a live toykv cluster.
+//
+// Config via environment:
+//   FAULTLIB_PATH      substring of paths to target (default: all)
+//   FAULTLIB_EIO_P     probability [0,1] a matching write/fsync
+//                      returns EIO (default 0)
+//   FAULTLIB_EIO_AFTER fail every matching call after this many
+//                      successes (default -1 = never)
+//   FAULTLIB_DELAY_MS  sleep this long before each matching call
+//   FAULTLIB_CONF      path to a file re-read before each decision:
+//                      lines "eio_p=0.5", "eio_after=100", "path=x",
+//                      "delay_ms=10", empty/missing file = clear —
+//                      lets a nemesis retarget a live process
+//
+// Build: g++ -O2 -shared -fPIC -o faultlib.so faultlib.cc -ldl
+//
+// Intercepts: write, pwrite, fsync, fdatasync (the acknowledged-
+// durability surface; reads stay untouched so the victim can limp on).
+
+#define _GNU_SOURCE 1
+
+#include <atomic>
+#include <cstdarg>
+#include <cerrno>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <ctime>
+#include <mutex>
+#include <random>
+#include <string>
+#include <unordered_map>
+
+#include <dlfcn.h>
+#include <fcntl.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+using write_fn = ssize_t (*)(int, const void *, size_t);
+using pwrite_fn = ssize_t (*)(int, const void *, size_t, off_t);
+using fsync_fn = int (*)(int);
+using open_fn = int (*)(const char *, int, ...);
+using close_fn = int (*)(int);
+
+write_fn real_write;
+pwrite_fn real_pwrite;
+fsync_fn real_fsync;
+fsync_fn real_fdatasync;
+open_fn real_open;
+close_fn real_close;
+
+struct Config {
+  std::string path;
+  double eio_p = 0.0;
+  long eio_after = -1;
+  long delay_ms = 0;
+};
+
+std::mutex g_mu;
+Config g_cfg;
+std::string g_conf_file;
+time_t g_conf_mtime = 0;
+std::atomic<long> g_matched{0};
+std::unordered_map<int, std::string> g_fd_paths;
+
+void load_env() {
+  const char *p = getenv("FAULTLIB_PATH");
+  if (p) g_cfg.path = p;
+  const char *e = getenv("FAULTLIB_EIO_P");
+  if (e) g_cfg.eio_p = atof(e);
+  const char *a = getenv("FAULTLIB_EIO_AFTER");
+  if (a) g_cfg.eio_after = atol(a);
+  const char *d = getenv("FAULTLIB_DELAY_MS");
+  if (d) g_cfg.delay_ms = atol(d);
+  const char *c = getenv("FAULTLIB_CONF");
+  if (c) g_conf_file = c;
+}
+
+void reload_conf_locked() {
+  if (g_conf_file.empty()) return;
+  struct stat st;
+  if (stat(g_conf_file.c_str(), &st) != 0) {
+    // missing file = cleared faults; reset the mtime cache so a conf
+    // recreated within the same second still loads
+    g_cfg.eio_p = 0;
+    g_cfg.eio_after = -1;
+    g_cfg.delay_ms = 0;
+    g_conf_mtime = 0;
+    return;
+  }
+  if (st.st_mtime == g_conf_mtime) return;
+  g_conf_mtime = st.st_mtime;
+  FILE *fh = fopen(g_conf_file.c_str(), "r");
+  if (!fh) return;
+  Config fresh;
+  fresh.path = g_cfg.path;
+  char line[256];
+  while (fgets(line, sizeof line, fh)) {
+    double x;
+    char s[200];
+    if (sscanf(line, "eio_p=%lf", &x) == 1) fresh.eio_p = x;
+    else if (sscanf(line, "eio_after=%lf", &x) == 1)
+      fresh.eio_after = (long)x;
+    else if (sscanf(line, "delay_ms=%lf", &x) == 1)
+      fresh.delay_ms = (long)x;
+    else if (sscanf(line, "path=%199s", s) == 1) fresh.path = s;
+  }
+  fclose(fh);
+  g_cfg = fresh;
+  g_matched = 0;  // eio_after counts from each retarget
+}
+
+// Lazy init from the first interposed call: an
+// __attribute__((constructor)) would run before this TU's C++ global
+// initializers, which then default-construct g_cfg over the loaded
+// values. A function-local static initializes exactly once, after
+// globals, thread-safely.
+void ensure_init() {
+  static bool once = [] {
+    real_write = (write_fn)dlsym(RTLD_NEXT, "write");
+    real_pwrite = (pwrite_fn)dlsym(RTLD_NEXT, "pwrite");
+    real_fsync = (fsync_fn)dlsym(RTLD_NEXT, "fsync");
+    real_fdatasync = (fsync_fn)dlsym(RTLD_NEXT, "fdatasync");
+    real_open = (open_fn)dlsym(RTLD_NEXT, "open");
+    real_close = (close_fn)dlsym(RTLD_NEXT, "close");
+    load_env();
+    return true;
+  }();
+  (void)once;
+}
+
+bool fd_matches(int fd) {
+  if (g_cfg.path.empty()) return true;
+  auto it = g_fd_paths.find(fd);
+  if (it != g_fd_paths.end())
+    return it->second.find(g_cfg.path) != std::string::npos;
+  // fall back to /proc resolution (fd opened before interposition)
+  char link[64], target[512];
+  snprintf(link, sizeof link, "/proc/self/fd/%d", fd);
+  ssize_t n = readlink(link, target, sizeof target - 1);
+  if (n <= 0) return false;
+  target[n] = 0;
+  return strstr(target, g_cfg.path.c_str()) != nullptr;
+}
+
+// true -> caller should fail with EIO. The sleep and the probability
+// roll happen on a copy OUTSIDE the lock, so a latency fault on one
+// fd never stalls the whole process's interposed I/O.
+bool inject(int fd) {
+  ensure_init();
+  Config cfg;
+  {
+    std::lock_guard<std::mutex> lk(g_mu);
+    reload_conf_locked();
+    if (g_cfg.eio_p <= 0 && g_cfg.eio_after < 0 &&
+        g_cfg.delay_ms <= 0)
+      return false;
+    if (!fd_matches(fd)) return false;
+    cfg = g_cfg;
+  }
+  if (cfg.delay_ms > 0) {
+    struct timespec ts = {cfg.delay_ms / 1000,
+                          (cfg.delay_ms % 1000) * 1000000L};
+    nanosleep(&ts, nullptr);
+  }
+  long seen = g_matched.fetch_add(1);
+  if (cfg.eio_after >= 0 && seen >= cfg.eio_after) return true;
+  if (cfg.eio_p > 0) {
+    static thread_local std::mt19937_64 rng{
+        0xFA17F11Eull ^ (unsigned long)gettid()};
+    double roll = std::uniform_real_distribution<>(0, 1)(rng);
+    if (roll < cfg.eio_p) return true;
+  }
+  return false;
+}
+
+}  // namespace
+
+extern "C" {
+
+int open(const char *path, int flags, ...) {
+  ensure_init();
+  mode_t mode = 0;
+  if (flags & O_CREAT) {
+    va_list ap;
+    va_start(ap, flags);
+    mode = va_arg(ap, mode_t);
+    va_end(ap);
+  }
+  int fd = real_open(path, flags, mode);
+  if (fd >= 0) {
+    std::lock_guard<std::mutex> lk(g_mu);
+    g_fd_paths[fd] = path;
+  }
+  return fd;
+}
+
+int close(int fd) {
+  ensure_init();
+  {
+    // recycled fd numbers must not inherit a stale path match
+    std::lock_guard<std::mutex> lk(g_mu);
+    g_fd_paths.erase(fd);
+  }
+  return real_close(fd);
+}
+
+ssize_t write(int fd, const void *buf, size_t count) {
+  if (inject(fd)) {
+    errno = EIO;
+    return -1;
+  }
+  return real_write(fd, buf, count);
+}
+
+ssize_t pwrite(int fd, const void *buf, size_t count, off_t off) {
+  if (inject(fd)) {
+    errno = EIO;
+    return -1;
+  }
+  return real_pwrite(fd, buf, count, off);
+}
+
+int fsync(int fd) {
+  if (inject(fd)) {
+    errno = EIO;
+    return -1;
+  }
+  return real_fsync(fd);
+}
+
+int fdatasync(int fd) {
+  if (inject(fd)) {
+    errno = EIO;
+    return -1;
+  }
+  return real_fdatasync(fd);
+}
+
+}  // extern "C"
