@@ -35,7 +35,8 @@ Per-config failures are captured into that config's entry, never raised.
 
 Env knobs: JEPSEN_TPU_BENCH_OPS (default 10000),
 JEPSEN_TPU_BENCH_BUDGET_S (default 120 per attempt),
-JEPSEN_TPU_BENCH_PLATFORM (skip probing, pin this platform),
+JEPSEN_TPU_BENCH_PLATFORM (skip probing, pin this platform strictly —
+init failure is then an error, never a silent cpu fallback),
 JEPSEN_TPU_BENCH_PROBE_S (default 90, backend-probe timeout),
 JEPSEN_TPU_BENCH_EXTRAS (default 1; 0 = headline only),
 JEPSEN_TPU_BENCH_KEYS / _PER_KEY (independent config, default 100x2000).
@@ -55,7 +56,10 @@ def _probe_default_backend(timeout_s: float) -> str | None:
     """Return the default backend's platform name, or None if init
     fails or hangs. Runs in a subprocess so a hung init can't take this
     process down with it."""
-    code = "import jax; print('PROBE_OK', jax.default_backend())"
+    # jax.devices() forces real backend init — default_backend() alone
+    # can report 'tpu' while the actual device init would still fail.
+    code = ("import jax; jax.devices(); "
+            "print('PROBE_OK', jax.default_backend())")
     try:
         out = subprocess.run(
             [sys.executable, "-c", code], capture_output=True, text=True,
@@ -71,16 +75,19 @@ def _probe_default_backend(timeout_s: float) -> str | None:
     return None
 
 
-def _pick_platform() -> str:
+def _pick_platform() -> tuple[str, bool]:
+    """Returns (platform, pinned?). A pinned platform must be honored
+    exactly (no silent fallback — cpu numbers under a tpu pin would be
+    a lie); an auto-probed one may drop to cpu if init fails later."""
     plat = os.environ.get("JEPSEN_TPU_BENCH_PLATFORM")
     if plat:
-        return plat
+        return plat, True
     probe_s = float(os.environ.get("JEPSEN_TPU_BENCH_PROBE_S", "90"))
     found = _probe_default_backend(probe_s)
     if found is None:
         print("backend probe: falling back to cpu", file=sys.stderr)
-        return "cpu"
-    return found
+        return "cpu", False
+    return found, False
 
 
 def _timed(fn, *args, **kw):
@@ -169,7 +176,7 @@ def run_bench() -> tuple[dict, int]:
     budget = float(os.environ.get("JEPSEN_TPU_BENCH_BUDGET_S", "120"))
     extras = os.environ.get("JEPSEN_TPU_BENCH_EXTRAS", "1") != "0"
 
-    plat = _pick_platform()
+    plat, pinned = _pick_platform()
 
     import jax
 
@@ -182,7 +189,17 @@ def run_bench() -> tuple[dict, int]:
     from jepsen_tpu.synth import cas_register_history
 
     metric = f"cas_register_{n_ops//1000}k_wgl_wall_s"
-    print(f"platform: {plat} -> {jax.devices()}", file=sys.stderr)
+    try:
+        devices = jax.devices()
+    except Exception as e:  # noqa: BLE001 — probe lied; drop to cpu
+        if pinned:
+            raise  # explicit pin: fail loudly (main() emits error JSON)
+        print(f"platform {plat} failed at device init ({e}); "
+              "falling back to cpu", file=sys.stderr)
+        plat = "cpu"
+        jax.config.update("jax_platforms", plat)
+        devices = jax.devices()
+    print(f"platform: {plat} -> {devices}", file=sys.stderr)
     hist = cas_register_history(n_ops, n_procs=5, seed=42, crash_p=0.002)
     print(f"history: {len(hist)} events ({n_ops} invocations)",
           file=sys.stderr)

@@ -356,9 +356,26 @@ TOYKV_OPTS = [
                  "catch it)"),
 ]
 
+def toykv_tests(options: dict):
+    """tests_fn for `test-all`: the sweep of durability x fault cadence
+    (the tidb all-combos pattern, tidb/src/tidb/core.clj:46-120 —
+    scaled to this suite's two axes)."""
+    base = options.get("nemesis_interval") or 10.0
+    for volatile in (False, True):
+        for interval in (base, base / 2):
+            opts = dict(options, volatile=volatile,
+                        nemesis_interval=interval)
+            opts["name"] = (f"{options.get('name') or 'toykv'}"
+                            f"{'-volatile' if volatile else ''}"
+                            f"-nem{interval:g}")
+            yield toykv_test(opts)
+
+
 COMMANDS = {
     **cli.single_test_cmd({"test_fn": toykv_test,
                            "opt_spec": TOYKV_OPTS}),
+    **cli.test_all_cmd({"tests_fn": toykv_tests,
+                        "opt_spec": TOYKV_OPTS}),
     **cli.serve_cmd(),
 }
 

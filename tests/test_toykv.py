@@ -131,3 +131,15 @@ def test_localexec_real_processes(tmp_path):
                 pid = control.exec_("cat", "proc.pid").strip()
                 assert pid.isdigit()
                 control.exec_("kill", "-9", pid)
+
+
+def test_tests_fn_sweep(tmp_path):
+    """toykv_tests yields the durability x cadence sweep for test-all
+    (the tidb all-combos pattern) without running anything."""
+    tests = list(toykv.toykv_tests(options(tmp_path, name="sweep")))
+    assert len(tests) == 4
+    names = [t["name"] for t in tests]
+    assert names == ["sweep-nem2.5", "sweep-nem1.25",
+                     "sweep-volatile-nem2.5", "sweep-volatile-nem1.25"]
+    assert [t["db"].volatile for t in tests] == [False, False, True,
+                                                True]
