@@ -39,6 +39,9 @@ JEPSEN_TPU_BENCH_PLATFORM (skip probing, pin this platform strictly —
 init failure is then an error, never a silent cpu fallback),
 JEPSEN_TPU_BENCH_PROBE_S (default 90, backend-probe timeout),
 JEPSEN_TPU_BENCH_EXTRAS (default 1; 0 = headline only),
+JEPSEN_TPU_BENCH_TOTAL_S (default 480, global wall budget — extra
+configs that would start too close to it are recorded as skipped;
+SIGTERM mid-run still emits the partial JSON line),
 JEPSEN_TPU_BENCH_KEYS / _PER_KEY (independent config, default 100x2000).
 """
 
@@ -105,16 +108,27 @@ def _config_entry(res: dict, wall: float) -> dict:
     return out
 
 
-def run_extras(budget: float) -> dict:
-    """The non-headline BASELINE configs; each failure is contained."""
+def run_extras(budget: float, deadline: float) -> dict:
+    """The non-headline BASELINE configs; each failure is contained.
+    Configs that would start with < 10 s left before `deadline`
+    (monotonic) are skipped-and-recorded rather than risking the whole
+    JSON line on a driver timeout."""
     from jepsen_tpu.models import (cas_register, fifo_queue, mutex,
                                    register)
     from jepsen_tpu.ops import wgl
     from jepsen_tpu import synth
 
     configs = {}
+    _PARTIAL["configs"] = configs  # fills in live for the SIGTERM path
 
-    def run(name, model, hist, checker=None):
+    def run(name, model, hist, checker=None, need=10):
+        left = deadline - time.monotonic()
+        if left < need:
+            configs[name] = {"verdict": "skipped",
+                             "cause": f"time budget ({left:.0f}s left)"}
+            print(f"config {name}: skipped, {left:.0f}s left",
+                  file=sys.stderr)
+            return
         try:
             t0 = time.monotonic()
             if checker is None:
@@ -149,6 +163,21 @@ def run_extras(budget: float) -> dict:
     run("long_tail_900", cas_register(),
         synth.long_tail_history(900, seed=7))
 
+    # Elle plane: list-append txn anomaly search, graph cycle queries
+    # as batched closure matmuls on device (elle/tpu.py)
+    def elle_append():
+        from jepsen_tpu.elle import append as elle_append_mod
+        hist_a = synth.list_append_history(3000, n_procs=5, seed=7)
+        res = elle_append_mod.check(hist_a,
+                                    additional_graphs=("realtime",),
+                                    cycle_backend="auto")
+        return {"valid?": res["valid?"],
+                "op_count": len(hist_a) // 2,
+                "engine": res.get("cycle-engine"),
+                "cause": ",".join(res["anomaly-types"]) or None}
+
+    run("elle_append_3k", None, None, checker=elle_append, need=45)
+
     # independent 100 keys x 2k ops, batch-checked over the device mesh
     n_keys = int(os.environ.get("JEPSEN_TPU_BENCH_KEYS", "100"))
     per_key = int(os.environ.get("JEPSEN_TPU_BENCH_PER_KEY", "2000"))
@@ -166,8 +195,9 @@ def run_extras(budget: float) -> dict:
 
     per_key_label = f"{per_key // 1000}k" if per_key >= 1000 \
         else str(per_key)
+    # the heavyweight config: don't start it on a nearly-spent budget
     run(f"independent_{n_keys}x{per_key_label}", None, None,
-        checker=indep)
+        checker=indep, need=150)
     return configs
 
 
@@ -175,6 +205,8 @@ def run_bench() -> tuple[dict, int]:
     n_ops = int(os.environ.get("JEPSEN_TPU_BENCH_OPS", "10000"))
     budget = float(os.environ.get("JEPSEN_TPU_BENCH_BUDGET_S", "120"))
     extras = os.environ.get("JEPSEN_TPU_BENCH_EXTRAS", "1") != "0"
+    total_s = float(os.environ.get("JEPSEN_TPU_BENCH_TOTAL_S", "480"))
+    deadline = time.monotonic() + total_s
 
     plat, pinned = _pick_platform()
 
@@ -226,11 +258,33 @@ def run_bench() -> tuple[dict, int]:
            "cold_s": round(cold_s, 3),
            "configs_explored": res.get("configs_explored")}
     if extras:
-        out["configs"] = run_extras(budget)
+        _PARTIAL.update(out)  # SIGTERM during extras still emits this
+        out["configs"] = run_extras(budget, deadline)
     return out, 0
 
 
+# Partial result emitted if the driver SIGTERMs us mid-run; run_bench
+# fills it in as milestones land.
+_PARTIAL: dict = {}
+
+
+def _sigterm(_signo, _frame):
+    try:
+        n_ops = int(os.environ.get("JEPSEN_TPU_BENCH_OPS", "10000"))
+    except ValueError:
+        n_ops = 10000
+    out = dict(_PARTIAL) or {
+        "metric": f"cas_register_{n_ops//1000}k_wgl_wall_s",
+        "value": None, "unit": "s", "vs_baseline": None}
+    out.setdefault("verdict", "terminated")
+    out["terminated"] = True
+    print(json.dumps(out), flush=True)
+    os._exit(1)
+
+
 def main() -> int:
+    import signal
+    signal.signal(signal.SIGTERM, _sigterm)
     try:
         out, rc = run_bench()
     except BaseException as e:  # always emit the JSON line

@@ -58,10 +58,14 @@ DEFAULT_ANOMALIES = ("G0", "G1a", "G1b", "G1c", "G-single", "G2",
 
 
 def check(history: History, anomalies: Iterable[str] = DEFAULT_ANOMALIES,
-          additional_graphs: Iterable[str] = ()) -> dict:
+          additional_graphs: Iterable[str] = (),
+          cycle_backend: str = "auto") -> dict:
     """Analyze a list-append history. Returns
     {"valid?": bool, "anomaly-types": [...], "anomalies": {...},
-    "not": [violated models]}."""
+    "not": [violated models]}.
+
+    cycle_backend: "host" (Tarjan oracle), "tpu" (batched
+    closure-matmul kernel, elle/tpu.py), or "auto"."""
     anomalies = set(anomalies)
     found: dict[str, list] = {}
 
@@ -105,20 +109,16 @@ def check(history: History, anomalies: Iterable[str] = DEFAULT_ANOMALIES,
             raise ValueError(f"unknown additional graph {name!r}")
 
     # -- 4. cycles --------------------------------------------------------
-    cyc = g.find_cycle(types={WW, REALTIME, PROCESS})
-    if cyc:
-        found["G0"] = [_cycle_case(g, cyc, history)]
-    cyc = g.find_cycle(types={WW, WR, REALTIME, PROCESS})
-    if cyc and "G0" not in found:
-        found["G1c"] = [_cycle_case(g, cyc, history)]
-    cyc = g.find_cycle_with(RW, {WW, WR, REALTIME, PROCESS},
-                            exactly_one=True)
-    if cyc:
-        found["G-single"] = [_cycle_case(g, cyc, history)]
-    cyc = g.find_cycle_with(RW, {WW, WR, REALTIME, PROCESS},
-                            exactly_one=False)
-    if cyc and "G-single" not in found:
-        found["G2"] = [_cycle_case(g, cyc, history)]
+    from .tpu import standard_cycle_search
+    cycles = standard_cycle_search(g, backend=cycle_backend)
+    if cycles["G0"]:
+        found["G0"] = [_cycle_case(g, cycles["G0"], history)]
+    if cycles["G1c"] and "G0" not in found:
+        found["G1c"] = [_cycle_case(g, cycles["G1c"], history)]
+    if cycles["G-single"]:
+        found["G-single"] = [_cycle_case(g, cycles["G-single"], history)]
+    if cycles["G2"] and "G-single" not in found:
+        found["G2"] = [_cycle_case(g, cycles["G2"], history)]
 
     reported = {k: v for k, v in found.items() if k in anomalies}
     # anomalies outside the requested set still make the result unknown
@@ -129,6 +129,7 @@ def check(history: History, anomalies: Iterable[str] = DEFAULT_ANOMALIES,
     out = {"valid?": valid,
            "anomaly-types": sorted(reported),
            "anomalies": reported,
+           "cycle-engine": cycles.get("engine"),
            "not": sorted({MODEL_VIOLATIONS[a] for a in reported
                           if a in MODEL_VIOLATIONS})}
     if silent:

@@ -1,0 +1,243 @@
+"""The TPU Elle plane: cycle detection as dense boolean linear algebra.
+
+The reference's Elle (dependency-graph cycle search over txn histories,
+wrapped at jepsen/src/jepsen/tests/cycle/append.clj:11-22 and wr.clj:
+14-53) walks graphs with DFS on the JVM. SURVEY.md flags it as the
+phase-2 TPU target: "SCC/cycle detection as sparse matrix ops". This
+module is that pass, designed MXU-first rather than as a graph-walk
+translation:
+
+  adjacency  A[s]        one (N, N) 0/1 matrix per edge-type subset s
+                         (G0 wants ww-only, G1c ww+wr, G2 adds rw),
+                         scattered from the DepGraph's (E, 3) edge
+                         columns in one indexed update — the subsets
+                         ride a leading batch axis, so all closures
+                         compute in lockstep.
+  closure    R = (A|I)^(2^k)   repeated squaring under lax.fori_loop:
+                         ceil(log2(N)) batched matmuls, each a bf16
+                         (N, N) @ (N, N) on the MXU with f32
+                         accumulation, re-binarized after every step.
+                         Static iteration count — no data-dependent
+                         control flow, one compile per shape bucket.
+  SCCs       mutual = R & R^T; label[i] = min{j : mutual[i, j]}
+                         a nontrivial SCC exists iff label != arange.
+  rw queries G-single / G2 ask "is some rw edge (s, d) closed by a
+                         path d -> s?" — per-edge BFS on the host
+                         (O(rw_edges * E), the host path's hot spot),
+                         but a single gather R[:, dst, src] here.
+
+Verdicts come off the device; *explanations* stay on the host: when a
+query fires, the caller re-derives the concrete cycle by BFS restricted
+to the flagged component / edge, which is tiny. This mirrors the WGL
+split (device decides, host explains counterexamples).
+
+bf16 safety: matmul entries count paths (up to N); bf16 rounds integers
+above 256, but every addend is >= 0 and rounding is to-nearest, so a
+positive sum can never round to zero — and only (sum > 0) is consumed.
+
+Capacity: dense (S, N, N) closure is the right trade below ~8k txns
+(64 MB per subset matrix at 8192^2 bf16; one squaring is ~2 * 8192^3
+flops =~ 1.1 TFLOP, sub-10 ms on a v5e MXU). Histories past the cap —
+BASELINE's independent configs shard per key long before that — fall
+back to the host oracle, recorded in the result.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import lru_cache
+from typing import Optional, Sequence
+
+import numpy as np
+
+from .graph import PROCESS, REALTIME, RW, WR, WW, DepGraph
+
+# The standard Elle query battery (append.clj / wr.clj semantics).
+# Subsets are cumulative: S0 (G0) < S1 (G1c, and the G-single closure)
+# < S2 (the G2 closure).
+SUBSETS = (
+    frozenset({WW, REALTIME, PROCESS}),
+    frozenset({WW, WR, REALTIME, PROCESS}),
+    frozenset({WW, WR, RW, REALTIME, PROCESS}),
+)
+
+DEFAULT_MAX_N = 8192
+
+
+def _round_up(n: int, mult: int) -> int:
+    return ((n + mult - 1) // mult) * mult
+
+
+def _bucket(n: int) -> int:
+    """Next power of two, so jit recompiles stay logarithmic in size."""
+    return max(1, 1 << (int(n) - 1).bit_length())
+
+
+@lru_cache(maxsize=32)
+def _compiled(n_pad: int, e_pad: int, q_pad: int, n_sub: int,
+              iters: int):
+    """The jitted closure kernel for one shape bucket."""
+    import jax
+    import jax.numpy as jnp
+
+    dtype = jnp.bfloat16 if jax.default_backend() == "tpu" \
+        else jnp.float32
+
+    def kernel(src, dst, w, q_src, q_dst):
+        # adjacency per subset: (S, N, N); padded edges carry w == 0
+        adj = jnp.zeros((n_sub, n_pad, n_pad), dtype)
+        adj = adj.at[:, src, dst].max(w.astype(dtype))
+        eye = jnp.eye(n_pad, dtype=dtype)
+        reach = jnp.maximum(adj, eye[None])
+
+        def square(_, r):
+            prod = jnp.einsum("sij,sjk->sik", r, r,
+                              preferred_element_type=jnp.float32)
+            return (prod > 0).astype(dtype)
+
+        reach = jax.lax.fori_loop(0, iters, square, reach)
+        rb = reach > 0
+        mutual = rb & jnp.swapaxes(rb, 1, 2)
+        cols = jnp.arange(n_pad, dtype=jnp.int32)
+        labels = jnp.where(mutual, cols[None, None, :],
+                           n_pad).min(axis=2)
+        # rw-closure queries: path q_dst -> q_src under each subset
+        closed = rb[:, q_dst, q_src]
+        return labels.astype(jnp.int32), closed
+
+    return jax.jit(kernel)
+
+
+def cycle_queries(g: DepGraph,
+                  subsets: Sequence[frozenset] = SUBSETS,
+                  rw_type: int = RW,
+                  max_n: int = DEFAULT_MAX_N) -> Optional[dict]:
+    """Run the batched closure over `subsets` and the rw-closure
+    queries on the device. Returns
+      {"sccs": [per-subset list of >1-node components (history ids)],
+       "rw_edges": [(src, dst) history ids],
+       "rw_closed": (S, n_rw) bool — rw edge closed under subset s}
+    or None when the graph exceeds max_n (caller falls back to host).
+    """
+    nodes = g.nodes
+    n = int(nodes.shape[0])
+    if n > max_n:
+        return None
+    edges = g.edges
+    id_of = {int(v): i for i, v in enumerate(nodes)}
+
+    # padding nodes are isolated; n_pad >= n + 2 guarantees two distinct
+    # isolated nodes for the padded (always-False) rw queries
+    n_pad = _round_up(max(_bucket(n), n + 2), 128)
+    src = np.array([id_of[int(s)] for s in edges[:, 0]], np.int32)
+    dst = np.array([id_of[int(d)] for d in edges[:, 1]], np.int32)
+    typ = edges[:, 2]
+    n_sub = len(subsets)
+    w = np.zeros((n_sub, len(src)), np.float32)
+    for si, sub in enumerate(subsets):
+        w[si] = np.isin(typ, list(sub)).astype(np.float32)
+
+    rw_mask = typ == rw_type
+    q_src, q_dst = src[rw_mask], dst[rw_mask]
+    rw_edges = [(int(edges[i, 0]), int(edges[i, 1]))
+                for i in np.flatnonzero(rw_mask)]
+
+    e_pad = _bucket(max(len(src), 1))
+    q_pad = _bucket(max(len(q_src), 1))
+
+    def pad(a, size, fill):
+        out = np.full(size, fill, a.dtype if len(a) else np.int32)
+        out[:len(a)] = a
+        return out
+
+    src_p = pad(src, e_pad, 0)
+    dst_p = pad(dst, e_pad, 0)
+    w_p = np.zeros((n_sub, e_pad), np.float32)
+    w_p[:, :w.shape[1]] = w
+    # padded queries land on distinct isolated padding nodes -> False
+    q_src_p = pad(q_src, q_pad, n_pad - 1)
+    q_dst_p = pad(q_dst, q_pad, n_pad - 2)
+
+    iters = max(1, math.ceil(math.log2(n_pad)))
+    kernel = _compiled(n_pad, e_pad, q_pad, n_sub, iters)
+    labels, closed = kernel(src_p, dst_p, w_p, q_src_p, q_dst_p)
+    labels = np.asarray(labels)[:, :n]
+    closed = np.asarray(closed)[:, :len(rw_edges)]
+
+    sccs: list = []
+    for si in range(n_sub):
+        comps: dict = {}
+        for i in range(n):
+            lab = int(labels[si, i])
+            if lab != i:
+                comps.setdefault(lab, [int(nodes[lab])]).append(
+                    int(nodes[i]))
+        sccs.append([sorted(c) for c in comps.values()])
+    return {"sccs": sccs, "rw_edges": rw_edges, "rw_closed": closed}
+
+
+def standard_cycle_search(g: DepGraph, backend: str = "host",
+                          max_n: int = DEFAULT_MAX_N) -> dict:
+    """The four-query battery both elle checkers run, on either
+    backend. Returns {"G0": cycle|None, "G1c": ..., "G-single": ...,
+    "G2": ...} where each cycle is a node list [a, ..., a]. Device
+    verdicts are re-derived into concrete cycles host-side, restricted
+    to the flagged component/edge.
+
+    backend: "host" (Tarjan + per-edge BFS oracle), "tpu" (batched
+    closure kernel), or "auto" (tpu when the graph is big enough that
+    the O(rw_edges * E) host queries hurt, else host).
+
+    The "engine" key records which backend actually ran ("tpu",
+    "host", or "host-fallback" when a tpu request exceeded max_n)."""
+    s0, s1, s2 = SUBSETS
+    engine = backend
+    if backend == "auto":
+        backend = "tpu" if (len(g.nodes) >= 512 and len(g) >= 512) \
+            else "host"
+        engine = backend
+    if backend == "tpu":
+        res = cycle_queries(g, max_n=max_n)
+        if res is None:
+            backend = engine = "host-fallback"  # over capacity
+        else:
+            out: dict = {"engine": "tpu"}
+            for name, si, sub in (("G0", 0, s0), ("G1c", 1, s1)):
+                cyc = None
+                for comp in res["sccs"][si]:
+                    cyc = g._cycle_in(set(comp), set(sub))
+                    if cyc:
+                        break
+                out[name] = cyc
+            # G-single: rw edge closed by a NON-rw path (subset 1);
+            # G2: closed by any path (subset 2)
+            out["G-single"] = _first_closed(g, res, 1, set(s1))
+            out["G2"] = _first_closed(g, res, 2, set(s2))
+            return out
+    if backend not in ("host", "host-fallback"):
+        raise ValueError(f"unknown backend {backend!r}")
+    return {
+        "engine": engine,
+        "G0": g.find_cycle(types=set(s0)),
+        "G1c": g.find_cycle(types=set(s1)),
+        "G-single": g.find_cycle_with(RW, set(s1), exactly_one=True),
+        "G2": g.find_cycle_with(RW, set(s1), exactly_one=False),
+    }
+
+
+def _first_closed(g: DepGraph, res: dict, subset_idx: int,
+                  path_types: set) -> Optional[list]:
+    """Host re-derivation: for the first device-flagged rw edge, the
+    concrete closing path (BFS over path_types, one edge's worth of
+    work)."""
+    from .graph import _bfs_path
+    closed = res["rw_closed"][subset_idx]
+    adj = g.adjacency(path_types - {RW}) if subset_idx == 1 \
+        else g.adjacency(path_types)
+    for ei, (s, d) in enumerate(res["rw_edges"]):
+        if not closed[ei]:
+            continue
+        path = _bfs_path(adj, d, s)
+        if path is not None:
+            return [s] + path
+    return None

@@ -45,8 +45,10 @@ def check(history: History, anomalies: Iterable[str] = DEFAULT_ANOMALIES,
           additional_graphs: Iterable[str] = (),
           sequential_keys: bool = False,
           linearizable_keys: bool = False,
-          wfr_keys: bool = False) -> dict:
-    """Analyze a write/read register history."""
+          wfr_keys: bool = False,
+          cycle_backend: str = "auto") -> dict:
+    """Analyze a write/read register history. cycle_backend as in
+    append.check: "host" | "tpu" | "auto"."""
     anomalies = set(anomalies)
     found: dict[str, list] = {}
 
@@ -83,20 +85,16 @@ def check(history: History, anomalies: Iterable[str] = DEFAULT_ANOMALIES,
         else:
             raise ValueError(f"unknown additional graph {name!r}")
 
-    cyc = g.find_cycle(types={WW, REALTIME, PROCESS})
-    if cyc:
-        found["G0"] = [_cycle_case(g, cyc)]
-    cyc = g.find_cycle(types={WW, WR, REALTIME, PROCESS})
-    if cyc and "G0" not in found:
-        found["G1c"] = [_cycle_case(g, cyc)]
-    cyc = g.find_cycle_with(RW, {WW, WR, REALTIME, PROCESS},
-                            exactly_one=True)
-    if cyc:
-        found["G-single"] = [_cycle_case(g, cyc)]
-    cyc = g.find_cycle_with(RW, {WW, WR, REALTIME, PROCESS},
-                            exactly_one=False)
-    if cyc and "G-single" not in found:
-        found["G2"] = [_cycle_case(g, cyc)]
+    from .tpu import standard_cycle_search
+    cycles = standard_cycle_search(g, backend=cycle_backend)
+    if cycles["G0"]:
+        found["G0"] = [_cycle_case(g, cycles["G0"])]
+    if cycles["G1c"] and "G0" not in found:
+        found["G1c"] = [_cycle_case(g, cycles["G1c"])]
+    if cycles["G-single"]:
+        found["G-single"] = [_cycle_case(g, cycles["G-single"])]
+    if cycles["G2"] and "G-single" not in found:
+        found["G2"] = [_cycle_case(g, cycles["G2"])]
 
     reported = {k: v for k, v in found.items() if k in anomalies}
     silent = set(found) - set(reported)
@@ -106,6 +104,7 @@ def check(history: History, anomalies: Iterable[str] = DEFAULT_ANOMALIES,
     out = {"valid?": valid,
            "anomaly-types": sorted(reported),
            "anomalies": reported,
+           "cycle-engine": cycles.get("engine"),
            "not": sorted({MODEL_VIOLATIONS[a] for a in reported
                           if a in MODEL_VIOLATIONS})}
     if silent:

@@ -220,3 +220,67 @@ def long_tail_history(n_quick: int, n_slow: int = 1, values: int = 5,
         hist.append(h.ok(p, "read", reg, time=t))
         t += 1
     return hist.index()
+
+
+def list_append_history(n_txns: int, n_procs: int = 5, key_count: int = 4,
+                        max_txn_length: int = 4, crash_p: float = 0.01,
+                        corrupt_p: float = 0.0,
+                        seed: int = 0) -> h.History:
+    """A concurrent list-append run for the elle checkers: each txn's
+    mops apply atomically at its completion instant against real
+    in-memory lists, so the history is serializable (and realtime-
+    consistent) by construction. `corrupt_p` drops a random element from
+    a random read's result to produce known-invalid histories.
+
+    Shapes follow the reference generator (elle.list-append/gen via
+    tests/cycle/append.clj:28-31): rotating key pool, unique
+    monotonically increasing values per key."""
+    from .elle.append import AppendGen
+
+    rng = random.Random(seed)
+    gen = AppendGen(key_count=key_count, max_txn_length=max_txn_length,
+                    seed=seed)
+    hist = h.History()
+    lists: dict = {}
+    pending: dict = {}
+    free = list(range(n_procs))
+    next_pid = n_procs
+    issued = 0
+    t = 0
+    while issued < n_txns or pending:
+        can_invoke = free and issued < n_txns
+        if not can_invoke and not pending:
+            break
+        if can_invoke and (not pending or rng.random() < 0.6):
+            p = free.pop(rng.randrange(len(free)))
+            txn = gen.txn()
+            hist.append(h.invoke(p, "txn", txn, time=t))
+            pending[p] = txn
+            issued += 1
+        else:
+            p = rng.choice(list(pending))
+            txn = pending.pop(p)
+            if rng.random() < crash_p:
+                hist.append(h.info(p, "txn", txn, time=t))
+                if rng.random() < 0.5:  # may or may not have applied
+                    for f, k, v in txn:
+                        if f == "append":
+                            lists.setdefault(k, []).append(v)
+                free.append(next_pid)
+                next_pid += 1
+            else:
+                done = []
+                for f, k, v in txn:
+                    if f == "append":
+                        lists.setdefault(k, []).append(v)
+                        done.append([f, k, v])
+                    else:
+                        out = list(lists.get(k, []))
+                        if corrupt_p and out and \
+                                rng.random() < corrupt_p:
+                            out.pop(rng.randrange(len(out)))
+                        done.append([f, k, out])
+                hist.append(h.ok(p, "txn", done, time=t))
+                free.append(p)
+        t += 1
+    return hist.index()

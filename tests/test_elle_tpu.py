@@ -1,0 +1,201 @@
+"""Differential tests for the TPU Elle plane (elle/tpu.py): the batched
+closure-matmul cycle search must agree with the host Tarjan/BFS oracle
+on every query of the standard battery, across random graphs and the
+real checker pipelines."""
+
+import random
+
+import numpy as np
+import pytest
+
+from jepsen_tpu.elle import append, wr
+from jepsen_tpu.elle.graph import (PROCESS, REALTIME, RW, WR, WW,
+                                   DepGraph)
+from jepsen_tpu.elle.tpu import (SUBSETS, cycle_queries,
+                                 standard_cycle_search)
+from jepsen_tpu.history import History
+
+
+def random_graph(rng, n_nodes, n_edges, types=(WW, WR, RW, REALTIME,
+                                               PROCESS)):
+    g = DepGraph()
+    for i in range(n_nodes):
+        g.add_node(i)
+    for _ in range(n_edges):
+        s = rng.randrange(n_nodes)
+        d = rng.randrange(n_nodes)
+        g.add_edge(s, d, rng.choice(types))
+    return g
+
+
+def scc_partition(comps):
+    """Canonical form: frozenset of frozensets, >1-node components."""
+    return frozenset(frozenset(c) for c in comps)
+
+
+def assert_cycle_ok(g, cyc, allowed, must_rw=None, exactly_one=False):
+    """The returned cycle must be a real cycle over allowed types."""
+    assert cyc[0] == cyc[-1] and len(cyc) >= 2
+    rw_count = 0
+    for a, b in zip(cyc, cyc[1:]):
+        types = {t for (s, d, t) in g.labels if s == a and d == b}
+        assert types & allowed, (a, b, types)
+        if must_rw is not None and RW in types:
+            rw_count += 1
+    if must_rw is not None:
+        assert rw_count >= 1
+        # exactly_one: the non-anchor edges may still carry rw labels in
+        # parallel with allowed ones, so only >=1 is asserted; the host
+        # oracle has the same property.
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_differential_random_graphs(seed):
+    rng = random.Random(seed)
+    n = rng.randrange(3, 60)
+    e = rng.randrange(0, 4 * n)
+    g = random_graph(rng, n, e)
+
+    host = standard_cycle_search(g, backend="host")
+    tpu = standard_cycle_search(g, backend="tpu")
+    for q in ("G0", "G1c", "G-single", "G2"):
+        assert (host[q] is None) == (tpu[q] is None), (q, host, tpu)
+    s0, s1, s2 = SUBSETS
+    if tpu["G0"]:
+        assert_cycle_ok(g, tpu["G0"], set(s0))
+    if tpu["G1c"]:
+        assert_cycle_ok(g, tpu["G1c"], set(s1))
+    if tpu["G-single"]:
+        assert_cycle_ok(g, tpu["G-single"], set(s1) | {RW}, must_rw=RW,
+                        exactly_one=True)
+    if tpu["G2"]:
+        assert_cycle_ok(g, tpu["G2"], set(s2), must_rw=RW)
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_scc_partitions_match_tarjan(seed):
+    rng = random.Random(100 + seed)
+    g = random_graph(rng, rng.randrange(4, 80), rng.randrange(4, 200))
+    res = cycle_queries(g)
+    for si, sub in enumerate(SUBSETS):
+        assert scc_partition(res["sccs"][si]) == \
+            scc_partition(g.sccs(types=set(sub))), si
+
+
+def test_empty_and_tiny_graphs():
+    g = DepGraph()
+    res = standard_cycle_search(g, backend="tpu")
+    assert res.pop("engine") == "tpu"
+    assert all(v is None for v in res.values())
+
+    g2 = DepGraph()
+    g2.add_edge(5, 9, WW)
+    g2.add_edge(9, 5, WW)
+    res2 = standard_cycle_search(g2, backend="tpu")
+    assert res2["G0"] == [5, 9, 5] or res2["G0"] == [9, 5, 9]
+
+
+def test_no_rw_edges():
+    g = DepGraph()
+    g.add_edge(0, 1, WW)
+    g.add_edge(1, 2, WR)
+    res = standard_cycle_search(g, backend="tpu")
+    assert res["G-single"] is None and res["G2"] is None
+
+
+def test_g_single_needs_nonrw_return_path():
+    # rw edge 0->1 closed only by another rw edge 1->0: G2, not G-single
+    g = DepGraph()
+    g.add_edge(0, 1, RW)
+    g.add_edge(1, 0, RW)
+    res = standard_cycle_search(g, backend="tpu")
+    assert res["G-single"] is None
+    assert res["G2"] is not None
+    host = standard_cycle_search(g, backend="host")
+    assert host["G-single"] is None and host["G2"] is not None
+
+
+def test_over_capacity_falls_back_to_host():
+    g = DepGraph()
+    for i in range(20):
+        g.add_edge(i, (i + 1) % 20, WW)
+    assert cycle_queries(g, max_n=10) is None
+    res = standard_cycle_search(g, backend="tpu", max_n=10)
+    assert res["G0"] is not None  # host fallback still finds the cycle
+
+
+def test_append_checker_tpu_backend_parity():
+    """A list-append G-single fixture through both backends."""
+    ops = []
+    i = 0
+
+    def emit(value, typ="ok"):
+        nonlocal i
+        ops.append({"index": i, "type": "invoke", "f": "txn",
+                    "process": 0, "value": value, "time": i})
+        i += 1
+        ops.append({"index": i, "type": typ, "f": "txn", "process": 0,
+                    "value": value, "time": i})
+        i += 1
+
+    # T1 appends x=1; T2 reads x=[1] then appends y=1;
+    # T3 reads y=[1] and x=[] -> rw anti-dep to T1 closed by wr chain
+    emit([["append", "x", 1]])
+    emit([["r", "x", [1]], ["append", "y", 1]])
+    emit([["r", "y", [1]], ["r", "x", []]])
+    h = History(ops).index()
+    res_host = append.check(h, additional_graphs=("realtime",),
+                            cycle_backend="host")
+    res_tpu = append.check(h, additional_graphs=("realtime",),
+                           cycle_backend="tpu")
+    assert res_host["valid?"] == res_tpu["valid?"]
+    assert res_host["anomaly-types"] == res_tpu["anomaly-types"]
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_wr_checker_random_parity(seed):
+    """Random rw-register histories through both backends agree on the
+    full result surface (anomaly type sets)."""
+    gen = wr.WrGen(key_count=3, seed=seed)
+    rng = random.Random(seed)
+    ops = []
+    i = 0
+    for _ in range(60):
+        v = gen.txn()
+        ops.append({"index": i, "type": "invoke", "f": "txn",
+                    "process": rng.randrange(3), "value": v, "time": i})
+        i += 1
+        # scramble read results to provoke anomalies
+        done = []
+        for f, k, x in v:
+            if f == "r":
+                done.append([f, k, rng.choice([None, 1, 2, 3])])
+            else:
+                done.append([f, k, x])
+        ops.append({"index": i, "type": "ok", "f": "txn",
+                    "process": ops[-1]["process"], "value": done,
+                    "time": i})
+        i += 1
+    h = History(ops).index()
+    kw = dict(sequential_keys=True, additional_graphs=("realtime",))
+    res_host = wr.check(h, cycle_backend="host", **kw)
+    res_tpu = wr.check(h, cycle_backend="tpu", **kw)
+    assert res_host["valid?"] == res_tpu["valid?"]
+    assert set(res_host["anomaly-types"]) == set(res_tpu["anomaly-types"])
+
+
+@pytest.mark.parametrize("corrupt", [0.0, 0.25])
+def test_synth_list_append_parity(corrupt):
+    """Synthesized concurrent list-append histories (valid and
+    corrupted) agree across backends end-to-end."""
+    from jepsen_tpu.synth import list_append_history
+    h = list_append_history(300, seed=5, corrupt_p=corrupt)
+    kw = dict(additional_graphs=("realtime",))
+    res_h = append.check(h, cycle_backend="host", **kw)
+    res_t = append.check(h, cycle_backend="tpu", **kw)
+    assert res_h["valid?"] == res_t["valid?"]
+    assert res_h["anomaly-types"] == res_t["anomaly-types"]
+    if corrupt == 0.0:
+        assert res_h["valid?"] is True
+    else:
+        assert res_h["valid?"] is False
