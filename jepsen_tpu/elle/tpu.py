@@ -193,8 +193,17 @@ def standard_cycle_search(g: DepGraph, backend: str = "host",
     s0, s1, s2 = SUBSETS
     engine = backend
     if backend == "auto":
-        backend = "tpu" if (len(g.nodes) >= 512 and len(g) >= 512) \
-            else "host"
+        # The dense closure only pays off on a real accelerator: 12
+        # squarings of (4096)^3 matmuls are milliseconds on the MXU but
+        # minutes on a CPU host, where Tarjan wins at any size. A
+        # missing/broken jax install must not break the pure-host path.
+        try:
+            import jax
+            on_accel = jax.default_backend() not in ("cpu",)
+        except Exception:  # noqa: BLE001
+            on_accel = False
+        backend = "tpu" if (on_accel and len(g.nodes) >= 512
+                            and len(g) >= 512) else "host"
         engine = backend
     if backend == "tpu":
         res = cycle_queries(g, max_n=max_n)

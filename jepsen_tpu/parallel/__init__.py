@@ -9,6 +9,8 @@ axis, and the batch is laid out over a `jax.sharding.Mesh` so each device
 searches its own keys with zero cross-device communication.
 """
 
-from .batched import BatchEncoded, check_batched, default_mesh, encode_batch
+from .batched import (BatchEncoded, check_batched, check_streamed,
+                      default_mesh, encode_batch)
 
-__all__ = ["BatchEncoded", "check_batched", "default_mesh", "encode_batch"]
+__all__ = ["BatchEncoded", "check_batched", "check_streamed",
+           "default_mesh", "encode_batch"]

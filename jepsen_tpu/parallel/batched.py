@@ -102,13 +102,28 @@ def encode_batch(encs: Sequence[Encoded], batch_pad: int = 1) -> BatchEncoded:
 
 
 def _batch_capacities(bk: int, W: int, n_pad: int):
-    """Frontier K / memo H / backlog B per key, sized so the whole batch's
-    (Bk, K, W, 2W) successor intermediate stays within budget."""
-    budget = 128 * 1024 * 1024  # bool elements across the batch
-    K = max(128, min(2048, budget // max(1, bk * 2 * W * W)))
-    K = 1 << (K.bit_length() - 1)
-    H = 1 << 18 if n_pad > 2048 else 1 << 16
-    B = 1 << 13
+    """Frontier K / memo H / backlog B *per key*, mirroring the single-
+    history tuning in wgl._pick_capacities. Two measured facts drive
+    this (see wgl.check's fast-path note): (1) narrow frontiers explore
+    far fewer redundant configs — K=256 beats K=2048 by an order of
+    magnitude on valid histories; (2) the memo table must stay well
+    under ~60% load or probe dedup degrades into re-exploration (the
+    old per-lane H=2^16 thrashed at ~185k explored configs per lane and
+    blew the search up ~18x). Whole-batch caps: the (Bk, K, W, 2W)
+    successor intermediate stays under 128M bool elements, and the memo
+    tables (16 B/slot) under ~2 GB across the batch."""
+    if W <= 32:
+        K = 256
+    else:
+        budget = 128 * 1024 * 1024  # bool elements across the batch
+        K = max(16, min(1024, budget // max(1, bk * 2 * W * W)))
+        K = 1 << (K.bit_length() - 1)
+    H = 1 << 21 if n_pad > 2048 else 1 << 19
+    cap = max(1 << 16, 2**31 // (16 * max(1, bk)))
+    # both kernels mask probe indices with `& (H - 1)` — H MUST stay a
+    # power of two or most slots become unreachable
+    H = min(H, 1 << (cap.bit_length() - 1))
+    B = 1 << 14
     return K, H, B
 
 
@@ -116,13 +131,15 @@ def _batch_capacities(bk: int, W: int, n_pad: int):
 def _compiled_batched(n_pad: int, ic_pad: int, W: int, S: int, O: int,
                       K: int, H: int, B: int, chunk: int, probes: int):
     """vmap the shape-bucket kernel over the key axis and jit it.
-    Windows that fit a uint32 lane use the bitmask fast path."""
+    Windows that fit a uint32 lane use the bitmask fast path (W here is
+    already the trimmed W_eff, padded to a multiple of 8)."""
     import jax
 
     if W <= 32:
         from ..ops.wgl32 import _build_search32
         init_fn, chunk_fn = _build_search32(n_pad, ic_pad, S, O,
-                                            K, H, B, chunk, probes)
+                                            K, H, B, chunk, probes,
+                                            W=W)
     else:
         init_fn, chunk_fn = _build_search(n_pad, ic_pad, W, S, O,
                                           K, H, B, chunk, probes)
@@ -131,13 +148,103 @@ def _compiled_batched(n_pad: int, ic_pad: int, W: int, S: int, O: int,
     return vinit, vchunk
 
 
+def _oracle_fallback(model: Model, history: History,
+                     deadline: Optional[float], device_res: dict) -> dict:
+    """Re-check a device-"unknown" history with the host oracle inside
+    whatever time remains, annotating why the device declined
+    (competition semantics). Returns the device result untouched when
+    the deadline has already passed."""
+    remaining = (deadline - _time.monotonic()
+                 if deadline is not None else None)
+    if remaining is not None and remaining <= 0:
+        return device_res
+    ref = wgl_ref.check(model, history, time_limit=remaining)
+    ref.setdefault("device_cause", device_res.get("cause"))
+    return ref
+
+
+def check_streamed(model: Model, histories: Sequence[History],
+                   time_limit: Optional[float] = None,
+                   max_configs: int = 50_000_000,
+                   oracle_fallback: bool = True,
+                   encs: Optional[Sequence[Encoded]] = None
+                   ) -> list[dict]:
+    """Per-key single-kernel checks fanned out over the visible devices
+    by a thread pool (one worker per device, `jax.default_device`
+    pinning). This is the fast path for *large* per-key histories: the
+    per-round cost of the search kernel scales with frontier rows, and a
+    vmapped batch pays every lane's rows every round until the slowest
+    lane finishes — measured on 16 x 2k-op cas-register keys, streaming
+    singles beats the lockstep vmap batch by ~10x. The vmap path
+    (strategy="vmap") remains the right call for many tiny histories,
+    where per-call dispatch dominates and lanes finish together."""
+    import jax
+
+    from ..ops import wgl
+
+    deadline = _time.monotonic() + time_limit if time_limit else None
+    devices = jax.devices()
+    results: list[Optional[dict]] = [None] * len(histories)
+
+    def one(dev, i_hist):
+        remaining = None
+        if deadline is not None:
+            remaining = deadline - _time.monotonic()
+            if remaining <= 0:
+                return {"valid?": "unknown", "cause": "timeout",
+                        "op_count": len(histories[i_hist])}
+        with jax.default_device(dev):
+            res = wgl.check(model, histories[i_hist],
+                            time_limit=remaining,
+                            max_configs=max_configs,
+                            enc=encs[i_hist] if encs else None)
+            if res.get("valid?") == "unknown" and oracle_fallback:
+                res = _oracle_fallback(model, histories[i_hist],
+                                       deadline, res)
+            return res
+
+    if len(devices) == 1 or len(histories) == 1:
+        for i in range(len(histories)):
+            results[i] = one(devices[0], i)
+        return results  # type: ignore[return-value]
+
+    # One worker thread per device; each pulls the next unclaimed
+    # history (work-stealing), so uneven keys never serialize behind a
+    # statically pinned device.
+    import itertools
+    import threading
+    counter = itertools.count()
+
+    def worker(dev):
+        while True:
+            i = next(counter)
+            if i >= len(histories):
+                return
+            results[i] = one(dev, i)
+
+    threads = [threading.Thread(target=worker, args=(d,))
+               for d in devices]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return results  # type: ignore[return-value]
+
+
 def check_batched(model: Model, histories: Sequence[History],
                   time_limit: Optional[float] = None,
                   max_configs: int = 50_000_000,
                   mesh=None, oracle_fallback: bool = True,
-                  chunk: int = 1024) -> list[dict]:
-    """Check many independent histories against `model` in one sharded
-    device search. Returns one result dict per history, in order.
+                  chunk: int = 1024, strategy: str = "auto") -> list[dict]:
+    """Check many independent histories against `model` on the
+    accelerator. Returns one result dict per history, in order.
+
+    strategy: "vmap" — one mesh-sharded lockstep search over the whole
+    key batch (all lanes step until the slowest finishes; best when
+    histories are small and uniform, and the path the multi-chip dryrun
+    validates); "stream" — per-key single-kernel checks fanned over
+    devices (best for large histories; see check_streamed); "auto" —
+    stream when the biggest history exceeds ~512 completed ops.
 
     `max_configs` is a per-key exploration budget. With `oracle_fallback`,
     keys the device leaves "unknown" are re-checked by the host oracle
@@ -171,6 +278,25 @@ def check_batched(model: Model, histories: Sequence[History],
     if not encs:
         return results  # type: ignore[return-value]
 
+    if strategy == "auto":
+        # An explicitly passed mesh pins the caller to the mesh-sharded
+        # vmap path; otherwise large per-key histories stream (see
+        # check_streamed's rationale).
+        strategy = "stream" if (mesh is None
+                                and max(e.n_ok for e in encs) > 512) \
+            else "vmap"
+    if strategy == "stream":
+        streamed = check_streamed(
+            model, [histories[i] for i in lanes],
+            time_limit=time_limit, max_configs=max_configs,
+            oracle_fallback=oracle_fallback,
+            encs=encs)
+        for i, res in zip(lanes, streamed):
+            results[i] = res
+        return results  # type: ignore[return-value]
+    if strategy != "vmap":
+        raise ValueError(f"unknown strategy {strategy!r}")
+
     if mesh is None:
         mesh = default_mesh()
     axis = mesh.axis_names[0]
@@ -178,11 +304,28 @@ def check_batched(model: Model, histories: Sequence[History],
 
     batch = encode_batch(encs, batch_pad=nd)
     bk = batch.inv.shape[0]
-    K, H, B = _batch_capacities(bk, batch.window, batch.n_pad)
+    # Fast-path trimming, mirroring wgl.check: successor-row count
+    # R = K*(W_eff + ic_eff) drives probe traffic, so materialize only
+    # what the widest history in the batch needs.
+    w_raw = max(e.window_raw for e in encs)
+    inv_info, opcode_info = batch.inv_info, batch.opcode_info
+    ic_pad = batch.ic_pad
+    if w_raw <= 32:
+        W = max(8, _pad_to(w_raw, 8))
+        ic_eff = max(8, _pad_to(int(batch.n_info.max()), 8))
+        if ic_eff < ic_pad:
+            inv_info = inv_info[:, :ic_eff]
+            opcode_info = opcode_info[:, :ic_eff]
+            ic_pad = ic_eff
+        probes = 4
+    else:
+        W = batch.window
+        probes = 16
+    K, H, B = _batch_capacities(bk, W, batch.n_pad)
     vinit, vchunk = _compiled_batched(
-        n_pad=batch.n_pad, ic_pad=batch.ic_pad, W=batch.window,
+        n_pad=batch.n_pad, ic_pad=ic_pad, W=W,
         S=batch.table_s, O=batch.table_o, K=K, H=H, B=B,
-        chunk=chunk, probes=16)
+        chunk=chunk, probes=probes)
 
     def shard(x):
         spec = PartitionSpec(axis) if x.ndim else PartitionSpec()
@@ -191,7 +334,7 @@ def check_batched(model: Model, histories: Sequence[History],
     import jax.numpy as jnp
     consts = tuple(shard(jnp.asarray(a)) for a in (
         batch.inv, batch.ret, batch.opcode, batch.sufminret,
-        batch.inv_info, batch.opcode_info, batch.table,
+        inv_info, opcode_info, batch.table,
         batch.n_ok, batch.n_info,
         np.full(bk, max_configs, dtype=np.int32)))
     carry = jax.tree.map(shard, vinit(jnp.zeros(bk, dtype=jnp.int32)))
@@ -220,7 +363,7 @@ def check_batched(model: Model, histories: Sequence[History],
     for lane, hist_i in enumerate(lanes):
         e = encs[lane]
         n_total = int(e.n_ok + e.n_info)
-        detail = {"W": batch.window, "K": K,
+        detail = {"W": W, "K": K,
                   "configs_explored": int(stats[lane, 0]),
                   "batch_keys": batch.n_keys, "batch_wall_s": round(wall, 4)}
         if found[lane]:
@@ -233,13 +376,8 @@ def check_batched(model: Model, histories: Sequence[History],
                      else "config-limit" if budget[lane] else "timeout")
             res = {"valid?": "unknown", "cause": cause,
                    "op_count": n_total, **detail}
-            remaining = (deadline - _time.monotonic()
-                         if deadline is not None else None)
-            if oracle_fallback and not timed_out and (
-                    remaining is None or remaining > 0):
-                ref = wgl_ref.check(model, histories[hist_i],
-                                    time_limit=remaining)
-                ref.setdefault("device_cause", cause)
-                res = ref
+            if oracle_fallback and not timed_out:
+                res = _oracle_fallback(model, histories[hist_i],
+                                       deadline, res)
         results[hist_i] = res
     return results  # type: ignore[return-value]
