@@ -1,0 +1,12 @@
+#!/bin/sh
+# First boot: publish a cluster SSH key over the shared volume, then
+# idle so `bin/console` can exec in.
+set -e
+mkdir -p /root/.ssh /var/jepsen/shared
+if [ ! -f /root/.ssh/id_ed25519 ]; then
+    ssh-keygen -t ed25519 -N "" -f /root/.ssh/id_ed25519
+    cp /root/.ssh/id_ed25519.pub /var/jepsen/shared/authorized_keys
+    printf 'Host n*\n  StrictHostKeyChecking no\n  User root\n' \
+        > /root/.ssh/config
+fi
+exec sleep infinity

@@ -1,0 +1,92 @@
+#!/usr/bin/env python3
+"""Generate docker-compose.yml for a jepsen_tpu test cluster.
+
+The reference builds its compose file by concatenating awk-filled YAML
+fragments (docker/bin/build-docker-compose, docker/template/*.yml);
+here the generator is a plain function so the output is unit-testable
+and `bin/up -n 9` style reconfiguration is one flag.
+
+Topology (docker/README.md:1-41 semantics): one `control` container
+with the framework and SSH client keys, N `n1..nN` DB-node containers
+running sshd, all on one bridge network so nodes resolve each other by
+name.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+NETWORK = "jepsen"
+
+
+def node_block(name: str) -> str:
+    return f"""  {name}:
+    build: ./node
+    container_name: jepsen-{name}
+    hostname: {name}
+    networks:
+      - {NETWORK}
+    privileged: true
+    tmpfs:
+      - /run:size=100M
+      - /run/lock:size=100M
+    volumes:
+      - jepsen-shared:/var/jepsen/shared
+"""
+
+
+def build_compose(n_nodes: int = 5, dev: bool = False) -> str:
+    """The docker-compose.yml text for a control + n-node cluster."""
+    if n_nodes < 1:
+        raise ValueError("need at least one db node")
+    nodes = [f"n{i}" for i in range(1, n_nodes + 1)]
+    out = ["version: '3.7'", "", "volumes:", "  jepsen-shared:", "",
+           "networks:", f"  {NETWORK}:", "", "services:"]
+    control = [
+        "  control:",
+        "    build: ./control",
+        "    container_name: jepsen-control",
+        "    hostname: control",
+        "    depends_on:",
+    ]
+    control += [f"      - {n}" for n in nodes]
+    control += [
+        "    env_file: ./secret/control.env",
+        "    privileged: true",
+        "    ports:",
+        "      - \"8080:8080\"",
+        "    networks:",
+        f"      - {NETWORK}",
+        "    volumes:",
+        "      - jepsen-shared:/var/jepsen/shared",
+    ]
+    if dev:
+        control.append("      - ../:/jepsen")
+    out.append("\n".join(control))
+    out.append("")
+    for n in nodes:
+        out.append(node_block(n))
+    return "\n".join(out)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("-n", "--nodes", type=int, default=5,
+                   help="number of DB nodes (default 5)")
+    p.add_argument("--dev", action="store_true",
+                   help="mount the repo into the control container")
+    p.add_argument("-o", "--out", default="docker-compose.yml")
+    args = p.parse_args(argv)
+    text = build_compose(args.nodes, dev=args.dev)
+    if args.out == "-":
+        sys.stdout.write(text)
+    else:
+        with open(args.out, "w") as fh:
+            fh.write(text)
+        print(f"wrote {args.out} ({args.nodes} nodes)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,9 @@
+#!/bin/sh
+# Install the control node's key once it appears on the shared volume,
+# then run sshd in the foreground.
+set -e
+mkdir -p /root/.ssh
+( while [ ! -f /var/jepsen/shared/authorized_keys ]; do sleep 1; done
+  cp /var/jepsen/shared/authorized_keys /root/.ssh/authorized_keys
+  chmod 600 /root/.ssh/authorized_keys ) &
+exec /usr/sbin/sshd -D

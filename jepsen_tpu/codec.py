@@ -1,0 +1,27 @@
+"""Object <-> bytes serialization (jepsen/src/jepsen/codec.clj:9-29).
+
+The reference round-trips EDN text; this framework's store format is
+JSON-payload-based (store/format.py), so the codec speaks compact JSON
+with the same nil conventions: None encodes to zero bytes, and zero
+bytes (or None) decode to None.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Optional
+
+
+def encode(o: Any) -> bytes:
+    """Serialize an object to bytes (codec.clj:9-16)."""
+    if o is None:
+        return b""
+    return json.dumps(o, separators=(",", ":"), sort_keys=True,
+                      default=str).encode()
+
+
+def decode(data: Optional[bytes]) -> Any:
+    """Deserialize bytes to an object (codec.clj:18-29)."""
+    if data is None or len(data) == 0:
+        return None
+    return json.loads(bytes(data).decode())

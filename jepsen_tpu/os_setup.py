@@ -118,3 +118,57 @@ class CentOS(OS):
 
 
 centos = CentOS
+
+
+class SmartOS(OS):
+    """SmartOS/illumos preparation (os/smartos.clj:1-96): pkgin
+    packages, loopback hostname entry, and the ipfilter-based Net
+    backend instead of iptables (net.clj:113-145)."""
+
+    def __init__(self, packages: Sequence[str] = ()):
+        self.packages = list(packages)
+
+    def installed(self, pkgs: Sequence[str]) -> set:
+        """Subset of `pkgs` already installed (smartos.clj:46-58)."""
+        want = set(pkgs)
+        out = cu.meh(c.exec_, "pkgin", "-p", "list") or ""
+        have = set()
+        for line in out.splitlines():
+            name = line.split(";", 1)[0]
+            base = name.rsplit("-", 1)[0] if "-" in name else name
+            have.add(base)
+        return want & have
+
+    def install(self, pkgs: Sequence[str]) -> None:
+        have = self.installed(pkgs)
+        missing = [p for p in pkgs if p not in have]
+        if missing:
+            with c.su():
+                c.exec_("pkgin", "-y", "install", *missing)
+
+    def setup_hostfile(self) -> None:
+        """Append the local hostname to the loopback /etc/hosts line
+        (smartos.clj:12-25) — SmartOS zones resolve themselves, not the
+        whole cluster."""
+        name = c.exec_("hostname").strip()
+        hosts = c.exec_("cat", "/etc/hosts")
+        out = []
+        for line in hosts.splitlines():
+            fields = line.split()
+            if fields and fields[0] == "127.0.0.1" \
+                    and name not in fields[1:]:
+                line = f"{line} {name}"
+            out.append(line)
+        with c.su():
+            cu.write_file("\n".join(out) + "\n", "/etc/hosts")
+
+    def setup(self, test, node):
+        log.info("Setting up smartos on %s", node)
+        self.setup_hostfile()
+        with c.su():
+            cu.meh(c.exec_, "pkgin", "update")
+        self.install(["curl", "wget", "gtar", "unzip"]
+                     + self.packages)
+
+
+smartos = SmartOS

@@ -112,12 +112,10 @@ def _batch_capacities(bk: int, W: int, n_pad: int):
     blew the search up ~18x). Whole-batch caps: the (Bk, K, W, 2W)
     successor intermediate stays under 128M bool elements, and the memo
     tables (16 B/slot) under ~2 GB across the batch."""
-    if W <= 32:
-        K = 256
-    else:
-        budget = 128 * 1024 * 1024  # bool elements across the batch
-        K = max(16, min(1024, budget // max(1, bk * 2 * W * W)))
-        K = 1 << (K.bit_length() - 1)
+    budget = 128 * 1024 * 1024  # bool elements across the batch
+    cap = max(16, budget // max(1, bk * 2 * W * W))
+    K = min(256 if W <= 32 else 1024, cap)
+    K = 1 << (K.bit_length() - 1)
     H = 1 << 21 if n_pad > 2048 else 1 << 19
     cap = max(1 << 16, 2**31 // (16 * max(1, bk)))
     # both kernels mask probe indices with `& (H - 1)` — H MUST stay a
@@ -193,15 +191,22 @@ def check_streamed(model: Model, histories: Sequence[History],
             if remaining <= 0:
                 return {"valid?": "unknown", "cause": "timeout",
                         "op_count": len(histories[i_hist])}
-        with jax.default_device(dev):
-            res = wgl.check(model, histories[i_hist],
-                            time_limit=remaining,
-                            max_configs=max_configs,
-                            enc=encs[i_hist] if encs else None)
-            if res.get("valid?") == "unknown" and oracle_fallback:
-                res = _oracle_fallback(model, histories[i_hist],
-                                       deadline, res)
-            return res
+        try:
+            with jax.default_device(dev):
+                res = wgl.check(model, histories[i_hist],
+                                time_limit=remaining,
+                                max_configs=max_configs,
+                                enc=encs[i_hist] if encs else None)
+                if res.get("valid?") == "unknown" and oracle_fallback:
+                    res = _oracle_fallback(model, histories[i_hist],
+                                           deadline, res)
+                return res
+        except Exception as e:  # noqa: BLE001 — a device fault on one
+            # key must not void the whole batch (and must not leave a
+            # None hole when raised inside a worker thread)
+            return {"valid?": "unknown",
+                    "cause": f"error: {type(e).__name__}: {e}"[:300],
+                    "op_count": len(histories[i_hist])}
 
     if len(devices) == 1 or len(histories) == 1:
         for i in range(len(histories)):

@@ -1,0 +1,19 @@
+"""Helpers for poking at stored test runs from a Python shell
+(jepsen/src/jepsen/repl.clj:6-9).
+
+    >>> from jepsen_tpu import repl
+    >>> t = repl.latest_test()
+    >>> t["results"]["valid?"]
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from . import store
+
+
+def latest_test(store_root: str = store.BASE_DIR) -> Optional[dict]:
+    """The most recently run test, loaded lazily from the store
+    (repl.clj:6-9)."""
+    return store.load_latest(store_root)

@@ -1,0 +1,107 @@
+"""Coverage for the small auxiliary components: codec, report, repl,
+smartos OS, and the docker compose generator."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from jepsen_tpu import codec, control as c, os_setup, report, repl, store
+from jepsen_tpu.control.dummy import DummyRemote
+
+
+def test_codec_roundtrip():
+    for o in (None, 0, "x", [1, 2, {"a": True}], {"valid?": "unknown"}):
+        assert codec.decode(codec.encode(o)) == o
+    assert codec.encode(None) == b""
+    assert codec.decode(b"") is None
+    assert codec.decode(None) is None
+
+
+def test_codec_deterministic():
+    assert codec.encode({"b": 1, "a": 2}) == codec.encode({"a": 2, "b": 1})
+
+
+def test_report_to(tmp_path, capsys):
+    path = str(tmp_path / "sub" / "set.txt")
+    with report.to(path):
+        print("lost:", [1, 2])
+    assert open(path).read() == "lost: [1, 2]\n"
+    # the announcement goes to the real stdout, not the report
+    assert f"Report written to {path}" in capsys.readouterr().out
+
+
+def test_repl_latest_test(tmp_path):
+    t = {"name": "repl-t", "nodes": [],
+         "start_time": "20260730T000000",
+         "store_root": str(tmp_path)}
+    w = store.Writer(t)
+    w.save_0(t)
+    t["results"] = {"valid?": True}
+    w.save_1(t)
+    w.save_2(t)
+    w.close()
+    loaded = repl.latest_test(str(tmp_path))
+    assert loaded["name"] == "repl-t"
+    assert loaded["results"]["valid?"] is True
+
+
+class ScriptedRemote(DummyRemote):
+    """Dummy remote with canned outputs for smartos probing."""
+
+    def execute(self, context, action):
+        super().execute(context, action)
+        cmd = action.get("cmd", "")
+        if "hostname" in cmd and "hosts" not in cmd:
+            return {**action, "exit": 0, "out": "n1\n", "err": ""}
+        if "cat /etc/hosts" in cmd:
+            return {**action, "exit": 0,
+                    "out": "127.0.0.1\tlocalhost\n::1 ip6\n", "err": ""}
+        return {**action, "exit": 0, "out": "", "err": ""}
+
+
+def test_smartos_setup_dummy():
+    """SmartOS setup through a scripted remote: hostname appended to
+    the loopback line, pkgin update + install issued."""
+    log: list = []
+    remote = ScriptedRemote(log)
+    with c.with_remote(remote):
+        with c.on("n1"):
+            os_setup.SmartOS(packages=["rsync"]).setup(
+                {"nodes": ["n1"]}, "n1")
+    cmds = [x[1] for x in log if isinstance(x[1], str)]
+    joined = "\n".join(cmds)
+    assert "pkgin update" in joined
+    assert "pkgin -y install" in joined and "rsync" in joined
+    # the hostfile was rewritten (write_file rides upload)
+    uploads = [x[1] for x in log if isinstance(x[1], tuple)
+               and x[1][0] == "upload"]
+    assert any(u[2] == "/etc/hosts" for u in uploads)
+
+
+def test_gen_compose():
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "docker"))
+    import gen_compose
+    text = gen_compose.build_compose(3)
+    assert text.count("build: ./node") == 3
+    for frag in ("jepsen-n1", "jepsen-n3", "jepsen-control",
+                 "jepsen-shared:", "networks:", "depends_on:"):
+        assert frag in text
+    assert "- n3" in text and "- n4" not in text
+    assert "../:/jepsen" not in text
+    assert "../:/jepsen" in gen_compose.build_compose(1, dev=True)
+    with pytest.raises(ValueError):
+        gen_compose.build_compose(0)
+
+
+def test_gen_compose_cli(tmp_path):
+    out = tmp_path / "dc.yml"
+    r = subprocess.run(
+        [sys.executable,
+         os.path.join(os.path.dirname(__file__), "..", "docker",
+                      "gen_compose.py"), "-n", "2", "-o", str(out)],
+        capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    assert "n2" in out.read_text()
