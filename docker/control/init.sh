@@ -1,6 +1,7 @@
 #!/bin/sh
-# First boot: publish a cluster SSH key over the shared volume, then
-# idle so `bin/console` can exec in.
+# First boot: publish a cluster SSH key over the shared volume,
+# install the framework from the --dev mount if present, then idle so
+# `bin/console` can exec in.
 set -e
 mkdir -p /root/.ssh /var/jepsen/shared
 if [ ! -f /root/.ssh/id_ed25519 ]; then
@@ -8,5 +9,8 @@ if [ ! -f /root/.ssh/id_ed25519 ]; then
     cp /root/.ssh/id_ed25519.pub /var/jepsen/shared/authorized_keys
     printf 'Host n*\n  StrictHostKeyChecking no\n  User root\n' \
         > /root/.ssh/config
+fi
+if [ -f /jepsen/pyproject.toml ]; then
+    pip install --no-cache-dir -e /jepsen || true
 fi
 exec sleep infinity

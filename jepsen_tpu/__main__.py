@@ -79,5 +79,11 @@ COMMANDS = {
     **cli.serve_cmd(),
 }
 
-if __name__ == "__main__":
+
+def main() -> None:
+    """Console-script entry point (pyproject [project.scripts])."""
     cli.main(COMMANDS)
+
+
+if __name__ == "__main__":
+    main()

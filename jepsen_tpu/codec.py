@@ -13,11 +13,12 @@ from typing import Any, Optional
 
 
 def encode(o: Any) -> bytes:
-    """Serialize an object to bytes (codec.clj:9-16)."""
+    """Serialize an object to bytes (codec.clj:9-16). Non-JSON-native
+    values raise TypeError — silent str() coercion would break the
+    decode(encode(o)) == o round-trip."""
     if o is None:
         return b""
-    return json.dumps(o, separators=(",", ":"), sort_keys=True,
-                      default=str).encode()
+    return json.dumps(o, separators=(",", ":"), sort_keys=True).encode()
 
 
 def decode(data: Optional[bytes]) -> Any:

@@ -19,7 +19,9 @@ class DummyRemote(Remote):
         self.host = None
 
     def connect(self, conn_spec):
-        r = DummyRemote(self.log)
+        # type(self): scripted-subclass remotes (test stubs overriding
+        # execute) must survive the connect
+        r = type(self)(self.log)
         r.host = conn_spec.get("host")
         return r
 

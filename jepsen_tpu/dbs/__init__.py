@@ -3,9 +3,19 @@
 The reference ships 25 standalone per-database suites (tidb, yugabyte,
 zookeeper, ...: SURVEY.md §2.4), each wiring a DB lifecycle
 implementation, per-workload clients, a nemesis, and a CLI main into
-the shared framework. This package holds this framework's suites; the
-exemplar is `toykv` — a real networked key-value store driven end to
-end over the localexec remote, proving the whole L0-L6 stack against
-live processes (the role zookeeper plays as the reference's minimal
-single-file suite, `zookeeper/src/jepsen/zookeeper.clj:1-145`).
+the shared framework. This package holds this framework's suites:
+
+- `toykv` — a real networked key-value store driven end to end over
+  the localexec remote, proving the whole L0-L6 stack against live
+  processes (CI-run).
+- `etcd` — the tutorial exemplar: release-tarball install, static
+  initial-cluster daemon automation, full Process/Pause/Primary fault
+  surface, and a v3 JSON-gateway client (CI-run against a
+  wire-compatible stub).
+- `zookeeper` — the reference's minimal single-file exemplar
+  (`zookeeper/src/jepsen/zookeeper.clj:1-145`): distro-package
+  install, myid/zoo.cfg generation, and a znode CAS-register client
+  over zkCli (CI-run against a scripted remote).
+
+Run one with `python -m jepsen_tpu.dbs.<suite> test --nodes ...`.
 """

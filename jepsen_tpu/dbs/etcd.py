@@ -1,0 +1,303 @@
+"""etcd test suite: the tutorial exemplar DB (doc/tutorial semantics of
+the reference, jepsen docs build exactly this suite step by step).
+
+DB automation installs an etcd release tarball per node, runs the
+daemon with a static initial cluster over the test's nodes, and wires
+the full fault surface (db.clj:11-41 protocols: Process kill/start,
+Pause, Primary via leader status, LogFiles). The client speaks the
+etcd v3 JSON gateway (/v3/kv/range|put|txn) — reads, writes, and
+version-free value-compare CAS transactions, with the standard
+definite/indefinite error discipline (HTTP error = fail for reads,
+info for writes that may have applied).
+
+Reference surfaces: zookeeper/src/jepsen/zookeeper.clj:1-145 (suite
+shape), doc/tutorial/02-db.md..05-nemesis.md (etcd automation),
+jepsen/src/jepsen/db.clj:11-41 (protocols).
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+from typing import Callable, Optional
+
+try:
+    import requests
+except ImportError:  # surfaced at client construction, not per-op
+    requests = None  # type: ignore[assignment]
+
+from .. import checker as jchecker
+from .. import cli, client as jclient, control, db as jdb
+from .. import generator as gen
+from .. import net as jnet
+from .. import nemesis as jnemesis
+from ..control import nodeutil
+from ..independent import KV, tuple_
+from ..os_setup import Debian
+from ..workloads import linearizable_register
+
+VERSION = "3.5.14"
+CLIENT_PORT = 2379
+PEER_PORT = 2380
+DIR = "/opt/etcd"
+PIDFILE = f"{DIR}/etcd.pid"
+LOGFILE = f"{DIR}/etcd.log"
+DATA_DIR = f"{DIR}/data"
+
+
+def node_url(node: str, port: int) -> str:
+    """http://<node>:<port> (tutorial 02-db.md node-url)."""
+    return f"http://{node}:{port}"
+
+
+def peer_url(node: str) -> str:
+    return node_url(node, PEER_PORT)
+
+
+def client_url(node: str) -> str:
+    return node_url(node, CLIENT_PORT)
+
+
+def initial_cluster(test: dict) -> str:
+    """The --initial-cluster fragment: n1=http://n1:2380,...
+    (tutorial 02-db.md initial-cluster)."""
+    return ",".join(f"{n}={peer_url(n)}" for n in test["nodes"])
+
+
+def tarball_url(version: str) -> str:
+    return ("https://github.com/etcd-io/etcd/releases/download/"
+            f"v{version}/etcd-v{version}-linux-amd64.tar.gz")
+
+
+class EtcdDB(jdb.DB, jdb.Process, jdb.Pause, jdb.Primary, jdb.LogFiles):
+    """etcd lifecycle (tutorial 02-db.md db; db.clj:11-41)."""
+
+    def __init__(self, version: str = VERSION):
+        self.version = version
+
+    def _start(self, test, node):
+        nodeutil.start_daemon(
+            {"logfile": LOGFILE, "pidfile": PIDFILE, "chdir": DIR},
+            f"{DIR}/etcd",
+            "--name", node,
+            "--data-dir", DATA_DIR,
+            "--listen-peer-urls", peer_url(node),
+            "--initial-advertise-peer-urls", peer_url(node),
+            "--listen-client-urls",
+            f"http://0.0.0.0:{CLIENT_PORT}",
+            "--advertise-client-urls", client_url(node),
+            "--initial-cluster-state", "new",
+            "--initial-cluster", initial_cluster(test),
+            "--enable-v2=false")
+        nodeutil.await_tcp_port(CLIENT_PORT, timeout_s=60)
+
+    def setup(self, test, node):
+        with control.su():
+            nodeutil.install_archive(
+                tarball_url(self.version), DIR,
+                force=bool(test.get("force_reinstall")))
+        self._start(test, node)
+
+    def teardown(self, test, node):
+        nodeutil.stop_daemon(PIDFILE)
+        nodeutil.grepkill("etcd --name")
+        with control.su():
+            control.exec_("rm", "-rf", DATA_DIR, LOGFILE)
+
+    # -- db.Process --
+    def start(self, test, node):
+        self._start(test, node)
+        return "started"
+
+    def kill(self, test, node):
+        nodeutil.stop_daemon(PIDFILE)
+        nodeutil.grepkill("etcd --name")
+        return "killed"
+
+    # -- db.Pause --
+    def pause(self, test, node):
+        nodeutil.signal("etcd", "STOP")
+        return "paused"
+
+    def resume(self, test, node):
+        nodeutil.signal("etcd", "CONT")
+        return "resumed"
+
+    # -- db.Primary --
+    def primaries(self, test):
+        """Nodes reporting themselves leader via `etcdctl endpoint
+        status` (probed in parallel, meh'd: a dead node is simply not
+        primary)."""
+
+        def probe(t, node):
+            return nodeutil.meh(
+                control.exec_, f"{DIR}/etcdctl", "--endpoints",
+                client_url(node), "endpoint", "status",
+                "--write-out", "json")
+
+        out = []
+        for node, raw in control.on_nodes(test, probe).items():
+            try:
+                status = json.loads(raw)[0]
+                if status["Status"]["header"]["member_id"] == \
+                        status["Status"]["leader"]:
+                    out.append(node)
+            except (TypeError, ValueError, KeyError, IndexError):
+                continue
+        return out
+
+    def setup_primary(self, test, node):
+        return None
+
+    def log_files(self, test, node):
+        return [LOGFILE]
+
+
+class EtcdClient(jclient.Client):
+    """CAS-register client over the v3 JSON gateway. Values ride [k v]
+    independent tuples; keys are namespaced under /jepsen/.
+
+    `base_url_fn` maps a node name to its client URL — tests point it
+    at wire-compatible stub servers on localhost."""
+
+    def __init__(self, base_url_fn: Optional[Callable] = None,
+                 timeout: float = 5.0):
+        if requests is None:
+            raise ImportError(
+                "the etcd suite needs the 'requests' package "
+                "(pip install 'jepsen-tpu[etcd]')")
+        self.base_url_fn = base_url_fn or client_url
+        self.timeout = timeout
+        self.node: Optional[str] = None
+        self.http = None  # requests.Session, created per opened client
+
+    def open(self, test, node):
+        c = EtcdClient(self.base_url_fn, self.timeout)
+        c.node = node
+        c.http = requests.Session()  # keep-alive: one conn per worker
+        return c
+
+    # -- v3 gateway plumbing ------------------------------------------
+    def _post(self, path: str, body: dict) -> dict:
+        url = self.base_url_fn(self.node) + path
+        http = self.http or requests
+        r = http.post(url, json=body, timeout=self.timeout)
+        r.raise_for_status()
+        return r.json()
+
+    @staticmethod
+    def _b64(s) -> str:
+        return base64.b64encode(str(s).encode()).decode()
+
+    @staticmethod
+    def _unb64(s: str) -> str:
+        return base64.b64decode(s).decode()
+
+    def kv_range(self, key: str):
+        res = self._post("/v3/kv/range", {"key": self._b64(key)})
+        kvs = res.get("kvs") or []
+        return self._unb64(kvs[0]["value"]) if kvs else None
+
+    def kv_put(self, key: str, value) -> None:
+        self._post("/v3/kv/put", {"key": self._b64(key),
+                                  "value": self._b64(value)})
+
+    def kv_cas(self, key: str, old, new) -> bool:
+        """Value-compare transaction: succeeds iff key's current value
+        equals `old` (tutorial 03-client.md cas semantics)."""
+        res = self._post("/v3/kv/txn", {
+            "compare": [{"key": self._b64(key), "target": "VALUE",
+                         "result": "EQUAL", "value": self._b64(old)}],
+            "success": [{"requestPut": {"key": self._b64(key),
+                                        "value": self._b64(new)}}],
+            "failure": []})
+        return bool(res.get("succeeded"))
+
+    # -- jepsen client ------------------------------------------------
+    def invoke(self, test, op):
+        kv = op["value"]
+        if not isinstance(kv, KV):
+            raise ValueError(f"etcd wants [k v] tuple values, got {kv!r}")
+        k, v = kv
+        key = f"/jepsen/{k}"
+        f = op["f"]
+        try:
+            if f == "read":
+                cur = self.kv_range(key)
+                return {**op, "type": "ok",
+                        "value": tuple_(k, None if cur is None
+                                        else int(cur))}
+            if f == "write":
+                self.kv_put(key, v)
+                return {**op, "type": "ok"}
+            if f == "cas":
+                old, new = v
+                ok = self.kv_cas(key, old, new)
+                return {**op, "type": "ok" if ok else "fail"}
+            raise ValueError(f"unknown op {f!r}")
+        except requests.RequestException as e:
+            # indefinite for writes/cas; reads never applied anything
+            t = "fail" if f == "read" else "info"
+            return {**op, "type": t, "error": str(e)[:200]}
+
+    def close(self, test):
+        if self.http is not None:
+            self.http.close()
+
+
+def etcd_test(options: dict) -> dict:
+    """Full test map from CLI options (zookeeper.clj zk-test shape)."""
+    nodes = options["nodes"]
+    db = EtcdDB(options.get("version") or VERSION)
+    w = linearizable_register.workload(
+        {"nodes": nodes,
+         "concurrency": options["concurrency"],
+         "per_key_limit": options.get("per_key_limit") or 100,
+         "algorithm": "competition"})
+    interval = options.get("nemesis_interval") or 5.0
+    return {
+        "name": options.get("name") or "etcd",
+        "store_root": options.get("store_root") or "store",
+        "nodes": nodes,
+        "concurrency": options["concurrency"],
+        "ssh": options.get("ssh") or {},
+        "os": Debian(),
+        "db": db,
+        "net": jnet.iptables(),
+        "client": EtcdClient(),
+        "nemesis": jnemesis.partition_random_halves(),
+        # No gating stats checker: a short run where some op type
+        # never succeeds (e.g. every cas misses) would flap invalid.
+        "checker": jchecker.compose({
+            "independent": w["checker"],
+            "exceptions": jchecker.unhandled_exceptions(),
+        }),
+        "generator": gen.time_limit(
+            options.get("time_limit") or 30,
+            gen.nemesis(
+                gen.cycle([gen.sleep(interval),
+                           {"type": "info", "f": "start"},
+                           gen.sleep(interval),
+                           {"type": "info", "f": "stop"}]),
+                w["generator"])),
+    }
+
+
+ETCD_OPTS = [
+    cli.Opt("version", metavar="VERSION", default=VERSION,
+            help="etcd release to install"),
+    cli.Opt("per_key_limit", metavar="N", default=100, parse=int,
+            help="Ops per key"),
+    cli.Opt("nemesis_interval", metavar="SECONDS", default=5.0,
+            parse=float,
+            help="Seconds between partition start/stop"),
+]
+
+COMMANDS = {
+    **cli.single_test_cmd({"test_fn": etcd_test,
+                           "opt_spec": ETCD_OPTS}),
+    **cli.serve_cmd(),
+}
+
+if __name__ == "__main__":
+    cli.main(COMMANDS)

@@ -310,6 +310,7 @@ def toykv_test(options: dict) -> dict:
     db = ToyKVDB(volatile=volatile)
     w = linearizable_register.workload(
         {"nodes": nodes,
+         "concurrency": options["concurrency"],
          "per_key_limit": options.get("per_key_limit") or 40,
          "algorithm": "competition"})
     nem_interval = options.get("nemesis_interval") or 10.0

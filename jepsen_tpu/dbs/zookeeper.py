@@ -1,0 +1,221 @@
+"""ZooKeeper test suite — the reference's minimal single-file exemplar
+(zookeeper/src/jepsen/zookeeper.clj:1-145) rebuilt on this framework.
+
+DB automation installs the distro zookeeper packages, writes per-node
+`myid` and the cluster `zoo.cfg`, and drives the service; the client is
+a CAS register on the /jepsen znode. Where the reference rides the JVM
+avout/zk-atom client, this client shells out to `zkCli.sh` over the
+control plane — znode versions make CAS honest (`set /jepsen v <ver>`
+fails on a version mismatch), and the suite stays dependency-free.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Optional
+
+from .. import checker as jchecker
+from .. import cli, client as jclient, control, db as jdb
+from .. import generator as gen
+from .. import net as jnet
+from .. import nemesis as jnemesis
+from ..control import nodeutil
+from ..models import cas_register
+from ..os_setup import Debian
+
+VERSION = "3.4.13-2"
+CONF = "/etc/zookeeper/conf"
+LOG = "/var/log/zookeeper/zookeeper.log"
+ZKCLI = "/usr/share/zookeeper/bin/zkCli.sh"
+ZNODE = "/jepsen"
+PORT = 2181
+
+ZOO_CFG = """tickTime=2000
+initLimit=10
+syncLimit=5
+dataDir=/var/lib/zookeeper
+clientPort=2181
+"""
+
+
+def node_ids(test: dict) -> dict:
+    """node name -> numeric id (zookeeper.clj:20-31)."""
+    return {n: i for i, n in enumerate(test["nodes"])}
+
+
+def zoo_cfg_servers(test: dict) -> str:
+    """server.N lines for zoo.cfg (zookeeper.clj:33-39)."""
+    return "\n".join(f"server.{i}={n}:2888:3888"
+                     for n, i in node_ids(test).items())
+
+
+class ZkDB(jdb.DB, jdb.LogFiles):
+    """ZooKeeper lifecycle (zookeeper.clj:41-73)."""
+
+    def __init__(self, version: str = VERSION):
+        self.version = version
+
+    def setup(self, test, node):
+        os = Debian()
+        with control.su():
+            os.install([f"zookeeper={self.version}",
+                        f"zookeeper-bin={self.version}",
+                        f"zookeeperd={self.version}"])
+            nodeutil.write_file(str(node_ids(test)[node]),
+                                f"{CONF}/myid")
+            nodeutil.write_file(ZOO_CFG + "\n" + zoo_cfg_servers(test),
+                                f"{CONF}/zoo.cfg")
+            # restart often fails upstream; stop+start (zookeeper.clj:59-60)
+            nodeutil.meh(control.exec_, "service", "zookeeper", "stop")
+            control.exec_("service", "zookeeper", "start")
+        nodeutil.await_tcp_port(PORT, timeout_s=60)
+
+    def teardown(self, test, node):
+        with control.su():
+            nodeutil.meh(control.exec_, "service", "zookeeper", "stop")
+            control.exec_("rm", "-rf",
+                          control.lit("/var/lib/zookeeper/version-*"),
+                          control.lit("/var/log/zookeeper/*"))
+
+    def log_files(self, test, node):
+        return [LOG]
+
+
+class ZkClient(jclient.Client):
+    """CAS register on a znode via zkCli.sh (zookeeper.clj:75-110).
+
+    `get` yields the value and the Stat's dataVersion; `set` with an
+    explicit version is an atomic CAS (BadVersion on conflict) — the
+    same primitive avout's zk-atom swap!! uses underneath."""
+
+    def __init__(self, znode: str = ZNODE):
+        self.znode = znode
+        self.node: Optional[str] = None
+
+    def open(self, test, node):
+        c = ZkClient(self.znode)
+        c.node = node
+        return c
+
+    def setup(self, test):
+        """Create the register znode with initial value 0 (the
+        reference's zk-atom conn /jepsen 0)."""
+        with self._bound(test):
+            nodeutil.meh(self._cli, f"create {self.znode} 0")
+
+    def _bound(self, test):
+        """Bind this node's control session for the calling (worker)
+        thread — the client rides the control plane, and sessions are
+        thread-local."""
+        import contextlib
+        sess = (test.get("sessions") or {}).get(self.node)
+        if sess is None:
+            return contextlib.nullcontext()
+        return control.with_session(self.node, sess)
+
+    def _cli(self, command: str) -> str:
+        return control.exec_(ZKCLI, "-server",
+                             f"{self.node}:{PORT}", command)
+
+    def _get(self):
+        """(value, dataVersion) of the znode."""
+        out = self._cli(f"get {self.znode}")
+        m = re.search(r"^dataVersion = (\d+)$", out, re.M)
+        if m is None:
+            raise ValueError(f"unparseable get output: {out[-200:]!r}")
+        version = int(m.group(1))
+        # the data line is the last non-Stat line before cZxid
+        lines = out.splitlines()
+        data = None
+        for i, line in enumerate(lines):
+            if line.startswith("cZxid"):
+                data = lines[i - 1].strip() if i > 0 else ""
+                break
+        if data in (None, "", "null"):
+            return None, version
+        return int(data), version
+
+    def invoke(self, test, op):
+        f = op["f"]
+        try:
+            with self._bound(test):
+                return self._invoke(test, op)
+        except Exception as e:  # noqa: BLE001 — remote exec failed
+            t = "fail" if f == "read" else "info"
+            return {**op, "type": t, "error": str(e)[:200]}
+
+    def _invoke(self, test, op):
+        f = op["f"]
+        if f == "read":
+            value, _ = self._get()
+            return {**op, "type": "ok", "value": value}
+        if f == "write":
+            self._cli(f"set {self.znode} {op['value']}")
+            return {**op, "type": "ok"}
+        if f == "cas":
+            old, new = op["value"]
+            value, version = self._get()
+            if value != old:
+                return {**op, "type": "fail"}
+            out = self._cli(f"set {self.znode} {new} {version}")
+            if "version No is not valid" in out \
+                    or "BadVersion" in out:
+                return {**op, "type": "fail"}
+            return {**op, "type": "ok"}
+        raise ValueError(f"unknown op {f!r}")
+
+    def close(self, test):
+        return None
+
+
+# op generators shared with the register workload (seeded via gen.RNG,
+# so runs reproduce under a pinned seed)
+from ..workloads.linearizable_register import cas, r, w  # noqa: E402
+
+
+def zk_test(options: dict) -> dict:
+    """Test map from CLI options (zookeeper.clj:112-137)."""
+    nodes = options["nodes"]
+    return {
+        "name": options.get("name") or "zookeeper",
+        "store_root": options.get("store_root") or "store",
+        "nodes": nodes,
+        "concurrency": options["concurrency"],
+        "ssh": options.get("ssh") or {},
+        "os": Debian(),
+        "db": ZkDB(options.get("version") or VERSION),
+        "net": jnet.iptables(),
+        "client": ZkClient(),
+        "nemesis": jnemesis.partition_random_halves(),
+        # linear + perf, matching the reference exemplar
+        # (zookeeper.clj:133-137). Deliberately NOT stats: a short run
+        # where no cas happens to hit its expected value would flap the
+        # whole test invalid.
+        "checker": jchecker.compose({
+            "linear": jchecker.linearizable(
+                cas_register(0), algorithm="competition"),
+            "exceptions": jchecker.unhandled_exceptions(),
+        }),
+        "generator": gen.time_limit(
+            options.get("time_limit") or 15,
+            gen.nemesis(
+                gen.cycle([gen.sleep(5),
+                           {"type": "info", "f": "start"},
+                           gen.sleep(5),
+                           {"type": "info", "f": "stop"}]),
+                gen.stagger(1.0, gen.mix([r, w, cas])))),
+    }
+
+
+ZK_OPTS = [
+    cli.Opt("version", metavar="VERSION", default=VERSION,
+            help="zookeeper package version"),
+]
+
+COMMANDS = {
+    **cli.single_test_cmd({"test_fn": zk_test, "opt_spec": ZK_OPTS}),
+    **cli.serve_cmd(),
+}
+
+if __name__ == "__main__":
+    cli.main(COMMANDS)

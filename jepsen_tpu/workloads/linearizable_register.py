@@ -42,6 +42,10 @@ def workload(opts: dict) -> dict:
 
     nodes          list of nodes (only the count matters: 2n threads
                    serve each key, n of them reading)
+    concurrency    total worker threads available; when fewer than 2n,
+                   the per-key group shrinks to fit (the reference
+                   would assert instead — independent.clj:118-125 —
+                   which makes default "1n" CLI runs explode)
     model          model to check (default cas_register)
     algorithm      linearizable algorithm (default "competition")
     per_key_limit  max ops per key (randomized x0.9-1.0 per key)
@@ -52,9 +56,17 @@ def workload(opts: dict) -> dict:
     model = opts.get("model") or models.cas_register()
     per_key_limit = opts.get("per_key_limit")
     process_limit = opts.get("process_limit", 20)
+    group = 2 * n
+    if opts.get("concurrency"):
+        group = max(1, min(group, int(opts["concurrency"])))
+    readers = group // 2
 
     def fgen(k):
-        g = gen.reserve(n, r, gen.mix([w, cas, cas]))
+        if readers:
+            g = gen.reserve(readers, r, gen.mix([w, cas, cas]))
+        else:
+            # a single-thread group still needs reads to witness state
+            g = gen.mix([r, w, cas, cas])
         if per_key_limit:
             g = gen.limit(int((0.9 + gen.RNG.random() * 0.1)
                               * per_key_limit), g)
@@ -67,5 +79,5 @@ def workload(opts: dict) -> dict:
             "timeline": timeline.html(),
         })),
         "generator": independent.concurrent_generator(
-            2 * n, itertools.count(), fgen),
+            group, itertools.count(), fgen),
     }

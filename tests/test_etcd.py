@@ -1,0 +1,155 @@
+"""etcd suite tests: DB orchestration through the dummy remote (the
+reference's control-test style) and the v3-gateway client against a
+wire-compatible in-process stub — so the full suite runs end-to-end in
+CI with no etcd binaries."""
+
+import base64
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from jepsen_tpu import control as c, core
+from jepsen_tpu.control.dummy import DummyRemote
+from jepsen_tpu.dbs import etcd
+from jepsen_tpu.independent import tuple_
+
+
+# -- a tiny wire-compatible etcd v3 JSON gateway ---------------------------
+
+class EtcdStub(BaseHTTPRequestHandler):
+    data: dict = {}
+    lock = threading.Lock()
+
+    def log_message(self, *a):
+        pass
+
+    def _read_body(self):
+        n = int(self.headers.get("Content-Length", 0))
+        return json.loads(self.rfile.read(n) or b"{}")
+
+    def _reply(self, obj):
+        body = json.dumps(obj).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_POST(self):
+        b64 = lambda s: base64.b64encode(s.encode()).decode()  # noqa: E731
+        unb64 = lambda s: base64.b64decode(s).decode()  # noqa: E731
+        req = self._read_body()
+        with self.lock:
+            if self.path == "/v3/kv/put":
+                self.data[unb64(req["key"])] = unb64(req["value"])
+                self._reply({"header": {}})
+            elif self.path == "/v3/kv/range":
+                k = unb64(req["key"])
+                kvs = ([{"key": req["key"],
+                         "value": b64(self.data[k])}]
+                       if k in self.data else [])
+                self._reply({"header": {}, "kvs": kvs,
+                             "count": str(len(kvs))})
+            elif self.path == "/v3/kv/txn":
+                cmp = req["compare"][0]
+                k = unb64(cmp["key"])
+                want = unb64(cmp["value"])
+                ok = self.data.get(k) == want
+                if ok:
+                    put = req["success"][0]["requestPut"]
+                    self.data[unb64(put["key"])] = unb64(put["value"])
+                self._reply({"header": {}, "succeeded": ok})
+            else:
+                self.send_error(404)
+
+
+@pytest.fixture()
+def stub():
+    EtcdStub.data = {}
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), EtcdStub)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{srv.server_port}"
+    srv.shutdown()
+
+
+# -- client semantics against the stub -------------------------------------
+
+def test_client_read_write_cas(stub):
+    cl = etcd.EtcdClient(base_url_fn=lambda node: stub).open({}, "n1")
+    op = {"type": "invoke", "f": "read", "value": tuple_(7, None),
+          "process": 0}
+    assert cl.invoke({}, op)["value"] == tuple_(7, None)  # empty read
+
+    w = {"type": "invoke", "f": "write", "value": tuple_(7, 3),
+         "process": 0}
+    assert cl.invoke({}, w)["type"] == "ok"
+    assert cl.invoke({}, op)["value"] == tuple_(7, 3)
+
+    cas_ok = {"type": "invoke", "f": "cas", "value": tuple_(7, [3, 4]),
+              "process": 0}
+    cas_fail = {"type": "invoke", "f": "cas", "value": tuple_(7, [3, 5]),
+                "process": 0}
+    assert cl.invoke({}, cas_ok)["type"] == "ok"
+    assert cl.invoke({}, cas_fail)["type"] == "fail"
+    assert cl.invoke({}, op)["value"] == tuple_(7, 4)
+
+
+def test_client_down_node_errors_are_contained():
+    cl = etcd.EtcdClient(
+        base_url_fn=lambda node: "http://127.0.0.1:1",
+        timeout=0.2).open({}, "n1")
+    r = cl.invoke({}, {"type": "invoke", "f": "read",
+                       "value": tuple_(1, None), "process": 0})
+    assert r["type"] == "fail"  # reads never applied anything
+    w = cl.invoke({}, {"type": "invoke", "f": "write",
+                       "value": tuple_(1, 2), "process": 0})
+    assert w["type"] == "info"  # writes are indefinite
+
+
+# -- DB orchestration through the dummy remote ------------------------------
+
+def test_db_setup_teardown_commands():
+    test = {"nodes": ["n1", "n2", "n3"]}
+    log: list = []
+    db = etcd.EtcdDB()
+    with c.with_remote(DummyRemote(log)):
+        with c.on("n1"):
+            db.setup(test, "n1")
+            db.kill(test, "n1")
+            db.teardown(test, "n1")
+    cmds = [x[1] for x in log if isinstance(x[1], str)]
+    joined = "\n".join(cmds)
+    # install via (cached) archive fetch into /opt/etcd
+    assert "/opt/etcd" in joined
+    assert etcd.tarball_url(etcd.VERSION).split("/")[-1].split(".tar")[0] \
+        .startswith("etcd-v")
+    # daemon start carries the full static initial cluster
+    start = next(x for x in cmds if "--initial-cluster " in x)
+    for n in test["nodes"]:
+        assert f"{n}=http://{n}:2380" in start
+    assert "--name n1" in start
+    # teardown wipes data and log
+    assert any("rm -rf" in x and "/opt/etcd/data" in x for x in cmds)
+    assert db.log_files(test, "n1") == [etcd.LOGFILE]
+
+
+def test_full_suite_with_stub(stub, tmp_path):
+    """The entire L2-L5 stack: etcd_test's map run by core.run with a
+    dummy control plane and the stub gateway as the data plane."""
+    opts = {"nodes": ["n1", "n2"], "concurrency": 4,
+            "time_limit": 4, "per_key_limit": 15,
+            "store_root": str(tmp_path / "store"),
+            "ssh": {"dummy?": True}}
+    t = etcd.etcd_test(opts)
+    t["client"] = etcd.EtcdClient(base_url_fn=lambda node: stub)
+    t["name"] = "etcd-stub"
+    done = core.run(t)
+    assert done["results"]["valid?"] is True
+    indep = done["results"]["independent"]
+    assert indep["valid?"] is True
+    completions = [op for op in done["history"]
+                   if getattr(op, "type", None) in ("ok", "fail")]
+    assert completions

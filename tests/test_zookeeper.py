@@ -1,0 +1,133 @@
+"""ZooKeeper suite tests: DB orchestration and the zkCli-based CAS
+client against a scripted remote emulating zkCli.sh output — the
+whole suite runs in CI with no ZooKeeper installed."""
+
+import re
+import threading
+
+from jepsen_tpu import control as c, core
+from jepsen_tpu.control.dummy import DummyRemote
+from jepsen_tpu.dbs import zookeeper as zk
+
+
+def zk_get_output(value, version):
+    data = "null" if value is None else str(value)
+    return (f"{data}\n"
+            "cZxid = 0x100000002\n"
+            "ctime = Wed Jul 29 00:00:00 UTC 2026\n"
+            "mZxid = 0x100000009\n"
+            "mtime = Wed Jul 29 00:00:01 UTC 2026\n"
+            "pZxid = 0x100000002\n"
+            "cversion = 0\n"
+            f"dataVersion = {version}\n"
+            "aclVersion = 0\n"
+            "ephemeralOwner = 0x0\n"
+            "dataLength = 1\n"
+            "numChildren = 0\n")
+
+
+class ZkStubRemote(DummyRemote):
+    """Emulates the znode: parses zkCli.sh commands out of exec'd
+    shell strings and applies them to a shared versioned register."""
+
+    state = {"value": None, "version": 0}
+    lock = threading.Lock()
+
+    def execute(self, context, action):
+        super().execute(context, action)
+        cmd = action.get("cmd", "")
+        if "zkCli.sh" not in cmd:
+            return {**action, "exit": 0, "out": "", "err": ""}
+        m = re.search(r"zkCli\.sh -server \S+ [\"']?(create|get|set) "
+                      r"(\S+)\s*(.*?)[\"']?$", cmd)
+        assert m, cmd
+        verb, _znode, rest = m.group(1), m.group(2), m.group(3).split()
+        with self.lock:
+            st = type(self).state
+            if verb == "create":
+                st["value"], st["version"] = int(rest[0]), 0
+                return {**action, "exit": 0, "out": "Created", "err": ""}
+            if verb == "get":
+                return {**action, "exit": 0, "err": "",
+                        "out": zk_get_output(st["value"], st["version"])}
+            if verb == "set":
+                new = int(rest[0])
+                if len(rest) > 1:  # CAS with expected version
+                    if int(rest[1]) != st["version"]:
+                        return {**action, "exit": 0, "err": "",
+                                "out": "version No is not valid : "
+                                       f"{rest[1]}"}
+                st["value"] = new
+                st["version"] += 1
+                return {**action, "exit": 0, "err": "",
+                        "out": zk_get_output(new, st["version"])}
+        raise AssertionError(cmd)
+
+
+def test_zoo_cfg_fragments():
+    test = {"nodes": ["n1", "n2", "n3"]}
+    assert zk.node_ids(test) == {"n1": 0, "n2": 1, "n3": 2}
+    frag = zk.zoo_cfg_servers(test)
+    assert "server.0=n1:2888:3888" in frag
+    assert "server.2=n3:2888:3888" in frag
+
+
+def test_db_setup_commands():
+    test = {"nodes": ["n1", "n2"]}
+    log: list = []
+    db = zk.ZkDB()
+    with c.with_remote(DummyRemote(log)):
+        with c.on("n1"):
+            db.setup(test, "n1")
+            db.teardown(test, "n1")
+    cmds = [x[1] for x in log if isinstance(x[1], str)]
+    joined = "\n".join(cmds)
+    assert "zookeeperd=" in joined          # package install
+    assert "service zookeeper start" in joined
+    assert "/var/lib/zookeeper/version-*" in joined  # teardown wipe
+    uploads = [x[1] for x in log if isinstance(x[1], tuple)
+               and x[1][0] == "upload"]
+    dests = [u[2] for u in uploads]
+    assert f"{zk.CONF}/myid" in dests
+    assert f"{zk.CONF}/zoo.cfg" in dests
+    assert db.log_files(test, "n1") == [zk.LOG]
+
+
+def test_client_cas_semantics():
+    ZkStubRemote.state = {"value": None, "version": 0}
+    remote = ZkStubRemote()
+    with c.with_remote(remote):
+        with c.on("n1"):
+            cl = zk.ZkClient().open({}, "n1")
+            cl.setup({})
+            assert cl.invoke({}, {"f": "read", "value": None,
+                                  "process": 0})["value"] == 0
+            assert cl.invoke({}, {"f": "write", "value": 3,
+                                  "process": 0})["type"] == "ok"
+            ok = cl.invoke({}, {"f": "cas", "value": [3, 4],
+                                "process": 0})
+            fail = cl.invoke({}, {"f": "cas", "value": [3, 5],
+                                  "process": 0})
+            assert ok["type"] == "ok" and fail["type"] == "fail"
+            assert cl.invoke({}, {"f": "read", "value": None,
+                                  "process": 0})["value"] == 4
+
+
+def test_full_suite_with_stub(tmp_path):
+    """zk_test's map end-to-end: scripted control plane, linearizable
+    verdict over the real interpreter run."""
+    ZkStubRemote.state = {"value": None, "version": 0}
+    opts = {"nodes": ["n1", "n2"], "concurrency": 4, "time_limit": 4,
+            "store_root": str(tmp_path / "store")}
+    t = zk.zk_test(opts)
+    t["remote"] = ZkStubRemote()
+    # skip real OS/DB automation against the stub; client setup creates
+    # the znode
+    t["os"] = None
+    t["db"] = None
+    done = core.run(t)
+    assert done["results"]["valid?"] is True
+    assert done["results"]["linear"]["valid?"] is True
+    completions = [op for op in done["history"]
+                   if getattr(op, "type", None) in ("ok", "fail")]
+    assert completions
