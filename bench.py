@@ -160,8 +160,18 @@ def run_extras(budget: float, deadline: float) -> dict:
             time_limit=budget).check({}, hq, {})
 
     run("fifo_queue_100k", None, None, checker=fifo)
-    run("long_tail_900", cas_register(),
-        synth.long_tail_history(900, seed=7))
+    # Porcupine-style long tail: wide window (W=768). Runs through the
+    # production competition checker — the device search and the host
+    # oracle race, and whichever engine suits the shape wins (here the
+    # oracle's DFS, for which this history is nearly serial).
+    def long_tail():
+        from jepsen_tpu import checker as jchecker
+        ht = synth.long_tail_history(900, seed=7)
+        return jchecker.linearizable(
+            cas_register(), algorithm="competition",
+            time_limit=budget).check({}, ht, {})
+
+    run("long_tail_900", None, None, checker=long_tail)
 
     # Elle plane: list-append txn anomaly search, graph cycle queries
     # as batched closure matmuls on device (elle/tpu.py)

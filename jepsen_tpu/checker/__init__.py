@@ -236,24 +236,7 @@ class Linearizable(Checker):
             res = wgl_tpu.check_with_diagnostics(
                 self.model, h, time_limit=self.time_limit)
         elif algo == "competition":
-            try:
-                from ..ops import wgl as wgl_tpu
-                res = wgl_tpu.check_with_diagnostics(
-                    self.model, h, time_limit=self.time_limit)
-            except ImportError:
-                # no accelerator stack at all: the quiet, expected path
-                res = {"valid?": UNKNOWN}
-            except Exception:  # noqa: BLE001 — e.g. accelerator
-                # backend init failure on a machine without devices;
-                # competition semantics = the host oracle still decides
-                import logging
-                logging.getLogger(__name__).warning(
-                    "device WGL path failed; falling back to oracle",
-                    exc_info=True)
-                res = {"valid?": UNKNOWN}
-            if res.get("valid?") == UNKNOWN:
-                res = wgl_ref.check(self.model, h,
-                                    time_limit=self.time_limit)
+            res = _race_competition(self.model, h, self.time_limit)
         else:
             raise ValueError(f"unknown linearizability algorithm {algo!r}")
         # Truncate expensive diagnostics (checker.clj:213-216).
@@ -268,6 +251,69 @@ class Linearizable(Checker):
             if p:
                 res["counterexample-svg"] = p
         return res
+
+
+def _race_competition(model, h, time_limit):
+    """knossos.competition semantics: run the device search and the
+    host oracle CONCURRENTLY; the first definitive verdict wins and
+    cancels the loser (serial device-then-oracle left pathological
+    cases — e.g. wide-window histories trivial for the oracle's DFS —
+    paying the full device cost first)."""
+    import threading
+
+    from ..ops import wgl_ref
+
+    import queue
+
+    winner = threading.Event()
+    outcomes: queue.Queue = queue.Queue()
+
+    def arm(name, fn):
+        def run():
+            try:
+                r = fn()
+            except Exception:  # noqa: BLE001 — device init failure etc.
+                import logging
+                logging.getLogger(__name__).warning(
+                    "%s engine failed in competition", name,
+                    exc_info=True)
+                r = {"valid?": UNKNOWN, "cause": "engine-error"}
+            outcomes.put((name, r))
+            if r.get("valid?") != UNKNOWN:
+                winner.set()
+        # NON-daemon: the loser self-cancels at its next stop-poll
+        # (one chunk, bounded seconds) and interpreter shutdown joins
+        # it cleanly — a daemon thread killed mid-XLA-call aborts the
+        # whole process ("FATAL: exception not rethrown")
+        return threading.Thread(target=run, name=f"wgl-{name}")
+
+    def oracle():
+        return wgl_ref.check(model, h, time_limit=time_limit,
+                             stop=winner.is_set)
+
+    try:
+        from ..ops import wgl as wgl_tpu
+    except ImportError:
+        # no accelerator stack at all: the quiet, expected path — the
+        # oracle decides alone, no doomed thread, no warning spam
+        return wgl_ref.check(model, h, time_limit=time_limit)
+
+    def device():
+        return wgl_tpu.check_with_diagnostics(
+            model, h, time_limit=time_limit, stop=winner.is_set)
+
+    for t in (arm("device", device), arm("oracle", oracle)):
+        t.start()
+    unknowns: dict = {}
+    for _ in range(2):  # return on the FIRST definitive verdict
+        name, r = outcomes.get()
+        if r.get("valid?") != UNKNOWN:
+            r["engine"] = name
+            return r
+        unknowns[name] = r
+    # both unknown: prefer the oracle's cause (it carries diagnostics)
+    return unknowns.get("oracle") or unknowns.get("device") \
+        or {"valid?": UNKNOWN}
 
 
 def linearizable(model=None, algorithm: str = "competition",

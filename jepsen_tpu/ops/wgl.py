@@ -39,7 +39,7 @@ from __future__ import annotations
 
 import functools
 import time as _time
-from typing import Optional
+from typing import Callable, Optional
 
 import numpy as np
 
@@ -344,20 +344,46 @@ def _pick_capacities(W: int, ic_pad: int, n: int):
         H = 1 << 22
     else:
         H = 1 << 19
+    # Backlog absorbs beam spill; overflow degrades False -> unknown.
+    # The caller widens it for the fast path (where escalation to
+    # _K_BIG spills hard and a packed row is cheap); a general-kernel
+    # row is (W + ic_pad) unpacked bools, so stay at 2^16 there.
     B = 1 << 16
     return K, H, B
 
 
+# Beam escalation for the fast path: a valid history usually resolves
+# within ~depth rounds at the narrow K; past this many explored configs
+# the search is likely exhaustive, where breadth amortizes overhead.
+_ESCALATE_AT = 200_000
+_K_BIG = 512
+
+
+def _widen_frontier(carry, k_new: int):
+    """Pad the frontier arrays of a wgl32 carry from K to k_new rows
+    (zeros beyond fr_cnt are inert); backlog/memo/flags ride along."""
+    import jax.numpy as jnp
+
+    def pad(a):
+        width = [(0, k_new - a.shape[0])] + [(0, 0)] * (a.ndim - 1)
+        return jnp.pad(a, width)
+
+    return (pad(carry[0]), pad(carry[1]), pad(carry[2]), pad(carry[3]),
+            *carry[4:])
+
+
 def check(model: Model, history: History, time_limit: Optional[float] = None,
           max_configs: int = 200_000_000, frontier: Optional[int] = None,
-          enc: Optional[Encoded] = None) -> dict:
+          enc: Optional[Encoded] = None,
+          stop: Optional[Callable[[], bool]] = None) -> dict:
     """Decide linearizability on the accelerator.
 
     Returns {"valid?": True/False/"unknown", ...}. "unknown" (deadline,
     config budget, capacity overflow, or unsupported encoding) signals the
     caller to fall back to the host oracle. `enc` skips re-encoding when
     the caller already holds this history's Encoded (the streamed
-    per-key fan-out does).
+    per-key fan-out does). `stop` is polled between device chunks;
+    True cancels with cause "cancelled" (competition racing).
     """
     import jax.numpy as jnp
 
@@ -381,19 +407,25 @@ def check(model: Model, history: History, time_limit: Optional[float] = None,
     K, H, B = _pick_capacities(W, ic_pad, n)
     if enc.window_raw <= 32:
         # Fast-path sweet spot (measured on 10k-op cas-register
-        # histories): narrow frontiers explore far fewer redundant
-        # configs and per-round cost scales with K, so depth-first-ish
-        # beats breadth. Valid histories exit early; exhaustion cost is
-        # roughly K-independent.
-        K = 256
+        # histories): configs_explored scales ~linearly with K — the
+        # search finishes in ~depth rounds regardless of width, so a
+        # narrow beam does ~K/depth of the work (K=32 decides the 10k
+        # headline 6x faster than K=256). Exhaustive searches (invalid
+        # or near-invalid histories) instead want breadth to amortize
+        # per-round overhead — the loop below escalates K when
+        # exploration passes _ESCALATE_AT, migrating the carry (the
+        # memo table survives, so nothing is re-explored).
+        K = 32
     if frontier:
         K = frontier  # override breadth only; the memo table must still
         #               fit the config space (see _pick_capacities)
-    # Rounds per device call: the deadline/budget is only checked
-    # between calls, and a round costs ~5x more on the TPU than on CPU
-    # (scatter-bound), so 1024 keeps poll granularity a few seconds
-    # there while per-call dispatch stays negligible on both.
-    chunk = 1024
+    # Rounds per device call: the deadline/budget/stop signals are only
+    # checked between calls, and a round costs ~5x more on the TPU than
+    # on CPU (scatter-bound). 1024 keeps fast-path poll granularity a
+    # few seconds while per-call dispatch stays negligible; the wide-
+    # window general kernel's rounds are ~35 ms each, so it polls every
+    # 32 to stay cancellable (competition racing).
+    chunk = 1024 if enc.window_raw <= 32 else 32
     iinv, iopc = enc.inv_info, enc.opcode_info
     if enc.window_raw <= 32:
         # Bitmask fast path: window in one uint32 lane, sort-free dedup.
@@ -404,6 +436,7 @@ def check(model: Model, history: History, time_limit: Optional[float] = None,
         ic_eff = max(8, _pad_to_mult(enc.n_info, 8))
         ic_eff = min(ic_eff, ic_pad)
         iinv, iopc = iinv[:ic_eff], iopc[:ic_eff]
+        B = 1 << 18  # packed rows are cheap; escalation spills hard
         init_fn, chunk_jit = compiled_search32(
             n_pad=len(enc.inv), ic_pad=ic_eff,
             S=enc.table.shape[0], O=enc.table.shape[1],
@@ -429,6 +462,19 @@ def check(model: Model, history: History, time_limit: Optional[float] = None,
         found, overflow = bool(flags[0]), bool(flags[1])
         fr_cnt = int(carry[4])
         total_explored = int(stats[0])
+        if (not found and fr_cnt > 0 and not frontier
+                and enc.window_raw <= 32 and K < _K_BIG
+                and total_explored >= _ESCALATE_AT):
+            # Exhaustion regime: widen the beam so per-round overhead
+            # amortizes over more configs. The memo table rides along
+            # in the carry, so nothing is re-explored.
+            from .wgl32 import compiled_search32
+            _, chunk_jit = compiled_search32(
+                n_pad=len(enc.inv), ic_pad=ic_eff,
+                S=enc.table.shape[0], O=enc.table.shape[1],
+                K=_K_BIG, H=H, B=B, chunk=chunk, probes=4, W=W_eff)
+            carry = _widen_frontier(carry, _K_BIG)
+            K = _K_BIG
         detail = {"W": W, "K": K, "configs_explored": total_explored,
                   "wall_s": round(_time.monotonic() - t0, 4)}
         if found:
@@ -445,17 +491,24 @@ def check(model: Model, history: History, time_limit: Optional[float] = None,
         if deadline is not None and _time.monotonic() > deadline:
             return {"valid?": "unknown", "cause": "timeout",
                     "op_count": n + enc.n_info, **detail}
+        if stop is not None and stop():
+            return {"valid?": "unknown", "cause": "cancelled",
+                    "op_count": n + enc.n_info, **detail}
 
 
 def check_with_diagnostics(model: Model, history: History,
-                           time_limit: Optional[float] = None) -> dict:
+                           time_limit: Optional[float] = None,
+                           stop: Optional[Callable[[], bool]] = None
+                           ) -> dict:
     """TPU verdict; on False, re-run the host oracle briefly to extract
     counterexample diagnostics (final_paths / configs), matching the
     reference's expectation that invalid results explain themselves
     (checker.clj:205-212 renders linear.svg from them)."""
-    res = check(model, history, time_limit=time_limit)
-    if res.get("valid?") is False:
-        ref = wgl_ref.check(model, history, time_limit=30.0)
+    res = check(model, history, time_limit=time_limit, stop=stop)
+    if res.get("valid?") is False and not (stop is not None and stop()):
+        # stop still threads through: in a competition race the oracle
+        # runs concurrently anyway, and the loser must stay cancellable
+        ref = wgl_ref.check(model, history, time_limit=30.0, stop=stop)
         if ref.get("valid?") is False:
             for k in ("final_paths", "configs", "max_linearized"):
                 if k in ref:

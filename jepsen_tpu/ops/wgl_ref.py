@@ -20,7 +20,7 @@ cache is what makes WGL tractable (Lowe's "just-in-time linearization").
 from __future__ import annotations
 
 import time as _time
-from typing import Any, Optional
+from typing import Any, Callable, Optional
 
 from ..history import History
 from ..models.core import Model, is_inconsistent
@@ -37,13 +37,16 @@ def _bits(mask: int):
 
 
 def check(model: Model, history: History, time_limit: Optional[float] = None,
-          max_configs: int = 20_000_000) -> dict:
+          max_configs: int = 20_000_000,
+          stop: Optional[Callable[[], bool]] = None) -> dict:
     """Decide linearizability of `history` under `model`.
 
     Returns {"valid?": bool | "unknown", "op_count": n, ...}. On False,
     includes "final_paths" (sample linearization prefixes that got
     furthest) and "configs" (the stuck configurations). On "unknown",
-    includes "cause" ("timeout" or "config-limit").
+    includes "cause" ("timeout", "config-limit", or "cancelled" when
+    the `stop` callable — polled every 4096 configs — returns True;
+    competition racing uses it to cancel the losing engine).
     """
     ops = prepare(history)
     n = len(ops)
@@ -69,9 +72,12 @@ def check(model: Model, history: History, time_limit: Optional[float] = None,
     explored = 0
 
     while stack:
-        if deadline is not None and explored % 4096 == 0:
-            if _time.monotonic() > deadline:
+        if explored % 4096 == 0:
+            if deadline is not None and _time.monotonic() > deadline:
                 return {"valid?": "unknown", "cause": "timeout",
+                        "op_count": n, "configs_explored": explored}
+            if stop is not None and stop():
+                return {"valid?": "unknown", "cause": "cancelled",
                         "op_count": n, "configs_explored": explored}
         if explored > max_configs:
             return {"valid?": "unknown", "cause": "config-limit",

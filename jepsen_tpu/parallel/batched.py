@@ -114,7 +114,10 @@ def _batch_capacities(bk: int, W: int, n_pad: int):
     tables (16 B/slot) under ~2 GB across the batch."""
     budget = 128 * 1024 * 1024  # bool elements across the batch
     cap = max(16, budget // max(1, bk * 2 * W * W))
-    K = min(256 if W <= 32 else 1024, cap)
+    # 64 for the fast path: narrow beams do ~K/depth of the work on
+    # valid lanes (see wgl.check), but vmap lanes can't escalate, so
+    # keep some breadth for the occasional exhaustive key.
+    K = min(64 if W <= 32 else 1024, cap)
     K = 1 << (K.bit_length() - 1)
     H = 1 << 21 if n_pad > 2048 else 1 << 19
     cap = max(1 << 16, 2**31 // (16 * max(1, bk)))
