@@ -190,7 +190,12 @@ class Linearizable(Checker):
     algorithm:
       "wgl"      — pure-Python DFS with memoization (the oracle)
       "tpu-wgl"  — JAX lockstep-frontier search on TPU (the north star)
-      "competition" — try tpu-wgl, fall back to wgl on "unknown"
+      "linear"   — JIT linearization with a memoized config cache
+      "queue-poly" — polynomial FIFO-queue constraint peeling
+      "competition" — race tpu-wgl and wgl CONCURRENTLY; the first
+                   definitive verdict wins and cancels the loser
+                   (result carries "engine"); FIFOQueue models route
+                   to queue-poly first
     """
 
     def __init__(self, model: models.Model, algorithm: str = "competition",
@@ -291,29 +296,57 @@ def _race_competition(model, h, time_limit):
         return wgl_ref.check(model, h, time_limit=time_limit,
                              stop=winner.is_set)
 
-    try:
-        from ..ops import wgl as wgl_tpu
-    except ImportError:
+    import importlib.util
+    if importlib.util.find_spec("jax") is None:
         # no accelerator stack at all: the quiet, expected path — the
         # oracle decides alone, no doomed thread, no warning spam
+        # (ops.wgl itself imports jax lazily, so probing the module
+        # spec is the only reliable availability check)
         return wgl_ref.check(model, h, time_limit=time_limit)
 
-    def device():
-        return wgl_tpu.check_with_diagnostics(
-            model, h, time_limit=time_limit, stop=winner.is_set)
+    from ..ops import wgl as wgl_tpu
 
-    for t in (arm("device", device), arm("oracle", oracle)):
+    def device():
+        # bare verdict — diagnostics are enriched AFTER the race so a
+        # device False publishes (and cancels the oracle) immediately
+        return wgl_tpu.check(model, h, time_limit=time_limit,
+                             stop=winner.is_set)
+
+    threads = [arm("device", device), arm("oracle", oracle)]
+    for t in threads:
         t.start()
+    res: dict = {}
     unknowns: dict = {}
-    for _ in range(2):  # return on the FIRST definitive verdict
+    for _ in range(2):  # take the FIRST definitive verdict
         name, r = outcomes.get()
         if r.get("valid?") != UNKNOWN:
             r["engine"] = name
-            return r
+            res = r
+            break
         unknowns[name] = r
-    # both unknown: prefer the oracle's cause (it carries diagnostics)
-    return unknowns.get("oracle") or unknowns.get("device") \
-        or {"valid?": UNKNOWN}
+    else:
+        # both unknown: prefer the oracle's cause (it has diagnostics)
+        res = unknowns.get("oracle") or unknowns.get("device") \
+            or {"valid?": UNKNOWN}
+    # Collect the loser briefly — it self-cancels at its next stop
+    # poll; leaving it running would bleed CPU/device time into
+    # whatever the caller measures next. An uninterruptible first
+    # compile can outlive the timeout; flag it so timings downstream
+    # are explicable.
+    for t in threads:
+        t.join(timeout=2.0)
+        if t.is_alive():
+            res["loser_draining"] = t.name
+    if res.get("valid?") is False and res.get("engine") == "device" \
+            and "final_paths" not in res:
+        # post-race diagnostics enrichment (checker.clj:205-212 treats
+        # explanation as core); bounded so it can't dwarf the verdict
+        ref = wgl_ref.check(model, h, time_limit=10.0)
+        if ref.get("valid?") is False:
+            for k in ("final_paths", "configs", "max_linearized"):
+                if k in ref:
+                    res[k] = ref[k]
+    return res
 
 
 def linearizable(model=None, algorithm: str = "competition",

@@ -1,0 +1,188 @@
+"""Distributed-tracing spans for clients and nemeses.
+
+The reference's only SUT-side tracing lives in the dgraph suite
+(`dgraph/src/jepsen/dgraph/trace.clj:1-73`): OpenCensus scoped spans
+around client calls, span/trace ids captured into ops, export to a
+Jaeger collector. This module is the framework-level equivalent with
+no external collector dependency: spans carry trace/span/parent ids
+and wall-clock bounds, nest through a thread-local context, annotate
+ops via `context()`, and export as OTLP-flavored JSON lines — a file
+Jaeger/otel tooling can ingest, and the store can keep as a run
+artifact.
+
+    tracer = trace.Tracer(sampled=True)
+    with tracer.span("invoke", attrs={"f": "read"}):
+        ...
+        op = {**op, "span": tracer.context()}
+    tracer.export(os.path.join(run_dir, "trace.jsonl"))
+
+A disabled tracer (sampled=False, the default construction for tests
+without an endpoint — sampler semantics of trace.clj:9-14) makes every
+call a no-op so instrumented clients cost nothing.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import secrets
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+
+@dataclass
+class Span:
+    name: str
+    trace_id: str
+    span_id: str
+    parent_id: Optional[str]
+    start_s: float
+    end_s: Optional[float] = None
+    attrs: dict = field(default_factory=dict)
+    annotations: list = field(default_factory=list)
+
+    def to_json(self) -> dict:
+        return {
+            "name": self.name,
+            "traceId": self.trace_id,
+            "spanId": self.span_id,
+            "parentSpanId": self.parent_id,
+            "startTimeUnixNano": int(self.start_s * 1e9),
+            "endTimeUnixNano": (int(self.end_s * 1e9)
+                                if self.end_s else None),
+            "attributes": dict(self.attrs),
+            "events": list(self.annotations),
+        }
+
+
+class Tracer:
+    """Thread-safe span collector with thread-local nesting."""
+
+    def __init__(self, sampled: bool = True,
+                 service: str = "jepsen_tpu"):
+        self.sampled = sampled
+        self.service = service
+        self._local = threading.local()
+        self._lock = threading.Lock()
+        self.spans: list[Span] = []
+
+    # -- current-span plumbing ----------------------------------------
+    def _stack(self) -> list:
+        st = getattr(self._local, "stack", None)
+        if st is None:
+            st = self._local.stack = []
+        return st
+
+    def current(self) -> Optional[Span]:
+        st = self._stack()
+        return st[-1] if st else None
+
+    @contextlib.contextmanager
+    def span(self, name: str, attrs: Optional[dict] = None):
+        """Scoped span (with-trace, trace.clj:40-49): nested spans in
+        the same thread share the trace id and chain parent ids."""
+        if not self.sampled:
+            yield None
+            return
+        parent = self.current()
+        sp = Span(name=name,
+                  trace_id=(parent.trace_id if parent
+                            else secrets.token_hex(16)),
+                  span_id=secrets.token_hex(8),
+                  parent_id=parent.span_id if parent else None,
+                  start_s=time.time(),
+                  attrs=dict(attrs or {}))
+        self._stack().append(sp)
+        try:
+            yield sp
+        finally:
+            sp.end_s = time.time()
+            self._stack().pop()
+            with self._lock:
+                self.spans.append(sp)
+
+    # -- the trace.clj surface ----------------------------------------
+    def context(self) -> Optional[dict]:
+        """{"trace-id", "span-id"} of the current span, for stamping
+        into ops (trace.clj:51-58)."""
+        sp = self.current()
+        if sp is None:
+            return None
+        return {"trace-id": sp.trace_id, "span-id": sp.span_id}
+
+    def annotate(self, message: str) -> None:
+        """Timestamped event on the current span (trace.clj:60-64)."""
+        sp = self.current()
+        if sp is not None:
+            sp.annotations.append({"time": time.time(),
+                                   "message": str(message)})
+
+    def attribute(self, k: str, v: Any) -> None:
+        """Attribute on the current span (trace.clj:66-73 — string
+        values there; anything JSON-serializable here)."""
+        sp = self.current()
+        if sp is not None:
+            sp.attrs[str(k)] = v
+
+    # -- export --------------------------------------------------------
+    def export(self, path: str) -> int:
+        """Write collected spans as JSON lines; returns span count."""
+        parent = os.path.dirname(path)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+        with self._lock:
+            spans = list(self.spans)
+        with open(path, "w") as fh:
+            for sp in spans:
+                fh.write(json.dumps(
+                    {"resource": {"service.name": self.service},
+                     **sp.to_json()}) + "\n")
+        return len(spans)
+
+
+def tracing(endpoint: Optional[str] = None,
+            service: str = "jepsen_tpu") -> Tracer:
+    """Tracer enabled iff an export target is configured — the
+    sampler-by-endpoint semantics of trace.clj:9-14,34-38. `endpoint`
+    here is the artifact path (or any truthy value for in-memory)."""
+    return Tracer(sampled=bool(endpoint), service=service)
+
+
+from .client import Client as _Client  # noqa: E402
+
+
+class TracedClient(_Client):
+    """Client wrapper spanning every op (the dgraph suites wrap their
+    client bodies in with-trace; this does it generically): each
+    invoke gets an "invoke <f>" span, and the completed op carries
+    {"span": {"trace-id", "span-id"}}."""
+
+    def __init__(self, client, tracer: Tracer):
+        self.client = client
+        self.tracer = tracer
+
+    def open(self, test, node):
+        return TracedClient(self.client.open(test, node), self.tracer)
+
+    def setup(self, test):
+        with self.tracer.span("setup"):
+            return self.client.setup(test)
+
+    def invoke(self, test, op):
+        with self.tracer.span(f"invoke {op.get('f')}",
+                              attrs={"process": op.get("process")}):
+            ctx = self.tracer.context()
+            res = self.client.invoke(test, op)
+            if ctx is not None and isinstance(res, dict):
+                res = {**res, "span": ctx}
+            return res
+
+    def teardown(self, test):
+        with self.tracer.span("teardown"):
+            return self.client.teardown(test)
+
+    def close(self, test):
+        return self.client.close(test)

@@ -1,0 +1,89 @@
+"""Tracing spans (trace.py — the dgraph trace.clj equivalent)."""
+
+import json
+import threading
+
+from jepsen_tpu import core, generator as gen, trace
+from jepsen_tpu.fakes import AtomClient, SharedRegister, noop_test
+
+
+def test_span_nesting_and_context():
+    t = trace.Tracer()
+    assert t.context() is None
+    with t.span("outer") as outer:
+        ctx = t.context()
+        assert ctx["span-id"] == outer.span_id
+        with t.span("inner") as inner:
+            assert inner.trace_id == outer.trace_id
+            assert inner.parent_id == outer.span_id
+            t.annotate("halfway")
+            t.attribute("key", 7)
+    assert t.context() is None
+    by_name = {s.name: s for s in t.spans}
+    assert by_name["inner"].annotations[0]["message"] == "halfway"
+    assert by_name["inner"].attrs == {"key": 7}
+    assert by_name["outer"].end_s >= by_name["inner"].end_s
+
+
+def test_disabled_tracer_is_noop():
+    t = trace.tracing(None)  # no endpoint -> never sample
+    with t.span("x") as sp:
+        assert sp is None
+        assert t.context() is None
+        t.annotate("ignored")
+    assert t.spans == []
+
+
+def test_threads_get_separate_traces():
+    t = trace.Tracer()
+    ids = []
+
+    def work():
+        with t.span("w"):
+            ids.append(t.context()["trace-id"])
+
+    threads = [threading.Thread(target=work) for _ in range(4)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    assert len(set(ids)) == 4  # no cross-thread trace bleed
+    assert len(t.spans) == 4
+
+
+def test_export_jsonl(tmp_path):
+    t = trace.Tracer(service="svc")
+    with t.span("a", attrs={"f": "read"}):
+        pass
+    path = str(tmp_path / "sub" / "trace.jsonl")
+    assert t.export(path) == 1
+    row = json.loads(open(path).read())
+    assert row["name"] == "a"
+    assert row["resource"]["service.name"] == "svc"
+    assert row["endTimeUnixNano"] > row["startTimeUnixNano"]
+
+
+def test_traced_client_end_to_end(tmp_path):
+    """A full run with the traced fake client: every completion carries
+    a span context and the spans export."""
+    tracer = trace.Tracer()
+    reg = SharedRegister()
+    t = noop_test()
+    t.update({
+        "name": "traced", "store_root": str(tmp_path / "store"),
+        "ssh": {"dummy?": True},
+        "client": trace.TracedClient(AtomClient(reg), tracer),
+        "concurrency": 2, "time_limit": 1.5,
+        "generator": gen.limit(20, gen.clients(gen.mix(
+            [lambda t_, c: {"f": "read", "value": None},
+             lambda t_, c: {"f": "write",
+                            "value": gen.RNG.randrange(5)}]))),
+    })
+    done = core.run(t)
+    completions = [op for op in done["history"]
+                   if getattr(op, "type", None) in ("ok", "fail")]
+    assert completions
+    spans = [s for s in tracer.spans if s.name.startswith("invoke")]
+    assert len(spans) >= len(completions)
+    path = str(tmp_path / "trace.jsonl")
+    assert tracer.export(path) == len(tracer.spans)
