@@ -328,24 +328,19 @@ def _race_competition(model, h, time_limit):
         # both unknown: prefer the oracle's cause (it has diagnostics)
         res = unknowns.get("oracle") or unknowns.get("device") \
             or {"valid?": UNKNOWN}
-    # Collect the loser briefly — it self-cancels at its next stop
-    # poll; leaving it running would bleed CPU/device time into
-    # whatever the caller measures next. An uninterruptible first
-    # compile can outlive the timeout; flag it so timings downstream
+    # Reap the loser without gating the fast win (it self-cancels at
+    # its next stop poll; an uninterruptible first compile can outlive
+    # any wait) — flag a still-draining loser so downstream timings
     # are explicable.
     for t in threads:
-        t.join(timeout=2.0)
+        t.join(timeout=0.1)
         if t.is_alive():
             res["loser_draining"] = t.name
-    if res.get("valid?") is False and res.get("engine") == "device" \
-            and "final_paths" not in res:
-        # post-race diagnostics enrichment (checker.clj:205-212 treats
-        # explanation as core); bounded so it can't dwarf the verdict
-        ref = wgl_ref.check(model, h, time_limit=10.0)
-        if ref.get("valid?") is False:
-            for k in ("final_paths", "configs", "max_linearized"):
-                if k in ref:
-                    res[k] = ref[k]
+    if res.get("engine") == "device":
+        # post-race counterexample enrichment, bounded so it can't
+        # dwarf the verdict (shared helper with the tpu-wgl path)
+        res = wgl_tpu.enrich_diagnostics(model, h, res,
+                                         time_limit=10.0)
     return res
 
 

@@ -106,8 +106,15 @@ class Validate(Client):
 
 
 def is_validate_reusable(client, test) -> bool:
-    """Reusability of a possibly-Validate-wrapped client."""
-    c = client.client if isinstance(client, Validate) else client
+    """Reusability of a possibly-wrapped client: Validate and any other
+    wrapper exposing its inner client as `.client` (e.g.
+    trace.TracedClient) are unwrapped transitively."""
+    seen = set()
+    c = client
+    while id(c) not in seen and isinstance(getattr(c, "client", None),
+                                           Client):
+        seen.add(id(c))
+        c = c.client
     return is_reusable(c, test)
 
 

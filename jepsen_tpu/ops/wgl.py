@@ -496,21 +496,31 @@ def check(model: Model, history: History, time_limit: Optional[float] = None,
                     "op_count": n + enc.n_info, **detail}
 
 
-def check_with_diagnostics(model: Model, history: History,
-                           time_limit: Optional[float] = None,
-                           stop: Optional[Callable[[], bool]] = None
-                           ) -> dict:
-    """TPU verdict; on False, re-run the host oracle briefly to extract
-    counterexample diagnostics (final_paths / configs), matching the
-    reference's expectation that invalid results explain themselves
-    (checker.clj:205-212 renders linear.svg from them)."""
-    res = check(model, history, time_limit=time_limit, stop=stop)
-    if res.get("valid?") is False and not (stop is not None and stop()):
-        # stop still threads through: in a competition race the oracle
-        # runs concurrently anyway, and the loser must stay cancellable
-        ref = wgl_ref.check(model, history, time_limit=30.0, stop=stop)
+def enrich_diagnostics(model: Model, history: History, res: dict,
+                       time_limit: float = 30.0,
+                       stop: Optional[Callable[[], bool]] = None
+                       ) -> dict:
+    """On a device False verdict, re-run the host oracle briefly to
+    extract counterexample diagnostics (final_paths / configs),
+    matching the reference's expectation that invalid results explain
+    themselves (checker.clj:205-212 renders linear.svg from them)."""
+    if res.get("valid?") is False and "final_paths" not in res \
+            and not (stop is not None and stop()):
+        ref = wgl_ref.check(model, history, time_limit=time_limit,
+                            stop=stop)
         if ref.get("valid?") is False:
             for k in ("final_paths", "configs", "max_linearized"):
                 if k in ref:
                     res[k] = ref[k]
     return res
+
+
+def check_with_diagnostics(model: Model, history: History,
+                           time_limit: Optional[float] = None,
+                           stop: Optional[Callable[[], bool]] = None
+                           ) -> dict:
+    """TPU verdict + counterexample enrichment (enrich_diagnostics)."""
+    res = check(model, history, time_limit=time_limit, stop=stop)
+    # stop still threads through: in a competition race the oracle
+    # runs concurrently anyway, and the loser must stay cancellable
+    return enrich_diagnostics(model, history, res, stop=stop)

@@ -15,6 +15,8 @@ Mirrors the reference's `jepsen.tests.*` namespaces (renamed to
     jepsen.tests.cycle                           .cycle
     jepsen.tests.cycle.append                    .cycle_append
     jepsen.tests.cycle.wr                        .cycle_wr
+    tidb.sequential / cockroachdb sequential     .sequential
+    tidb.monotonic / faunadb monotonic           .monotonic
 
 Each module exposes a `workload(**opts) -> dict` returning at least
 {"generator": ..., "checker": ...}; suites merge that into their test
@@ -22,8 +24,9 @@ map and add a client.
 """
 
 from . import (adya, bank, causal, causal_reverse, cycle, cycle_append,
-               cycle_wr, linearizable_register, long_fork, sets)
+               cycle_wr, linearizable_register, long_fork, monotonic,
+               sequential, sets)
 
 __all__ = ["adya", "bank", "causal", "causal_reverse", "cycle",
            "cycle_append", "cycle_wr", "linearizable_register",
-           "long_fork", "sets"]
+           "long_fork", "monotonic", "sequential", "sets"]

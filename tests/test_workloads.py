@@ -435,3 +435,103 @@ def test_causal_workload_emits_canonical_order():
     vals = [getattr(o.get("value"), "v", None) for o in ops
             if o.get("process") != "nemesis"][:5]
     assert vals == [None, 1, None, 2, None]
+
+
+# -- sequential (tidb/sequential.clj parity) --------------------------------
+
+def test_sequential_trailing_nil():
+    from jepsen_tpu.workloads.sequential import trailing_nil
+    assert not trailing_nil([None, None, "a", "b"])
+    assert not trailing_nil([None, None, None])
+    assert not trailing_nil(["a", "b"])
+    assert trailing_nil(["a", None])
+    assert trailing_nil([None, "a", None, "b"])
+
+
+def test_sequential_checker_classification():
+    from jepsen_tpu.workloads import sequential
+    sk = sequential.subkeys(3, 7)            # 7_0, 7_1, 7_2
+    h = hist([
+        op("ok", 0, "read", [7, list(reversed(sk))]),     # all
+        op("ok", 0, "read", [8, [None, None, "8_0"]]),    # some
+        op("ok", 0, "read", [9, [None, None, None]]),     # none
+        op("ok", 0, "read", [10, ["10_2", None, None]]),  # BAD
+    ])
+    res = sequential.checker().check({"key_count": 3}, h, {})
+    assert res["valid?"] is False
+    assert res["all-count"] == 1
+    assert res["some-count"] == 3   # some-nil includes none and bad
+    assert res["none-count"] == 1
+    assert res["bad-count"] == 1
+    ok_res = sequential.checker().check(
+        {"key_count": 3},
+        hist([op("ok", 0, "read", [7, list(reversed(sk))])]), {})
+    assert ok_res["valid?"] is True
+
+
+def test_sequential_generator_shape():
+    from jepsen_tpu.workloads import sequential
+    w = sequential.workload({"n_writers": 2})
+    ops = testlib.quick(gen.limit(40, w["generator"]),
+                        ctx=testlib.n_nemesis_context(4))
+    writes = [o for o in ops if o["f"] == "write"]
+    reads = [o for o in ops if o["f"] == "read"]
+    assert writes and reads
+    ks = [o["value"] for o in writes]
+    assert ks == sorted(ks)          # sequential integer keys
+    # reads pick from the recency ring; the DSL may probe generators
+    # speculatively, so the ring can run slightly ahead of emitted
+    # writes (an unwritten key just reads all-nil)
+    for o in reads:
+        assert isinstance(o["value"][0], int) and o["value"][0] >= 0
+
+
+# -- monotonic (tidb/monotonic.clj parity) ----------------------------------
+
+def test_monotonic_valid():
+    from jepsen_tpu.workloads import monotonic
+    h = hist([
+        op("ok", 0, "inc", {0: 1}),
+        op("ok", 1, "read", {0: 1, 1: -1}),
+        op("ok", 0, "inc", {1: 1}),
+        op("ok", 1, "read", {0: 1, 1: 1}),
+        op("ok", 0, "inc", {0: 2}),
+        op("ok", 1, "read", {0: 2, 1: 1}),
+    ])
+    res = monotonic.checker().check({}, h, {})
+    assert res["valid?"] is True
+
+
+def test_monotonic_cycle_detected():
+    from jepsen_tpu.workloads import monotonic
+    # T_a sees x=1,y=2; T_b sees x=2,y=1: x says a->b, y says b->a
+    h = hist([
+        op("ok", 0, "read", {"x": 1, "y": 2}),
+        op("ok", 1, "read", {"x": 2, "y": 1}),
+    ])
+    res = monotonic.checker().check({}, h, {})
+    assert res["valid?"] is False
+    assert "observed key" in res["explanation"]
+
+
+def test_monotonic_generator_shape():
+    from jepsen_tpu.workloads import monotonic
+    w = monotonic.workload()
+    ops = testlib.quick(gen.limit(30, w["generator"]),
+                        ctx=testlib.n_nemesis_context(3))
+    assert any(o["f"] == "inc" for o in ops)
+    reads = [o for o in ops if o["f"] == "read"]
+    assert reads and all(len(o["value"]) <= 3 for o in reads)
+
+
+def test_monotonic_tied_values_dont_swallow_edges():
+    """Ops tied at the same observed value must still order against the
+    next distinct value group (adjacent-pair linking missed this)."""
+    from jepsen_tpu.workloads import monotonic
+    h = hist([
+        op("ok", 0, "read", {"x": 1, "y": 2}),
+        op("ok", 1, "read", {"x": 1}),          # tied with the first
+        op("ok", 2, "read", {"x": 2, "y": 1}),
+    ])
+    res = monotonic.checker().check({}, h, {})
+    assert res["valid?"] is False
