@@ -1,0 +1,107 @@
+---- MODULE toykv ----
+(***************************************************************************)
+(* A TLA+ model of the toykv store (jepsen_tpu/dbs/toykv.py): a sharded   *)
+(* register cluster where each node serializes its keys' operations under *)
+(* one lock and appends acknowledged writes to an fsync'd recovery log.   *)
+(* The suite's headline fault is kill -9 + restart; this spec states the  *)
+(* durability contract the linearizability checker enforces empirically:  *)
+(*                                                                        *)
+(*   Durable  mode: a crashed node restarts with exactly its log — every  *)
+(*                  ACKNOWLEDGED write survives, and the history stays    *)
+(*                  linearizable.                                         *)
+(*   Volatile mode: restart resets state; acknowledged writes may be      *)
+(*                  lost, and TLC finds the Durability violation — the    *)
+(*                  same anomaly tests/test_toykv.py observes with the    *)
+(*                  set workload against the live server (--volatile).   *)
+(*                                                                        *)
+(* Model-check with TLC:                                                  *)
+(*   CONSTANTS Keys = {k1}  Values = {1, 2}  Volatile = FALSE            *)
+(*   INVARIANT TypeOK  Durability                                        *)
+(* Flipping Volatile to TRUE produces a Durability counterexample        *)
+(* (write -> ack -> crash -> restart -> read loses the value).           *)
+(* Role model: aerospike/spec/aerospike.tla in the reference repo.       *)
+(***************************************************************************)
+
+EXTENDS Naturals, FiniteSets
+
+CONSTANTS Keys,      \* the key space (one node's shard)
+          Values,    \* writable values
+          Volatile   \* TRUE = no recovery log (--volatile)
+
+None == 0            \* "no value"; Values must not contain 0
+
+VARIABLES
+  mem,      \* key -> value: the serving node's in-memory state
+  log,      \* key -> value: the fsync'd recovery log's final state
+  acked,    \* set of <<key, value>> writes acknowledged to clients
+  up        \* is the node process alive?
+
+vars == <<mem, log, acked, up>>
+
+TypeOK ==
+  /\ mem \in [Keys -> Values \cup {None}]
+  /\ log \in [Keys -> Values \cup {None}]
+  /\ acked \subseteq (Keys \X Values)
+  /\ up \in BOOLEAN
+
+Init ==
+  /\ mem = [k \in Keys |-> None]
+  /\ log = [k \in Keys |-> None]
+  /\ acked = {}
+  /\ up = TRUE
+
+(* A write is applied in memory, persisted (unless volatile), and only  *)
+(* then acknowledged — the server's persist() runs before the reply.    *)
+Write(k, v) ==
+  /\ up
+  /\ mem' = [mem EXCEPT ![k] = v]
+  /\ log' = IF Volatile THEN log ELSE [log EXCEPT ![k] = v]
+  /\ acked' = acked \cup {<<k, v>>}
+  /\ UNCHANGED up
+
+(* CAS applies atomically under the node lock: visible state must match *)
+(* the expected value.                                                  *)
+Cas(k, old, new) ==
+  /\ up
+  /\ mem[k] = old
+  /\ mem' = [mem EXCEPT ![k] = new]
+  /\ log' = IF Volatile THEN log ELSE [log EXCEPT ![k] = new]
+  /\ acked' = acked \cup {<<k, new>>}
+  /\ UNCHANGED up
+
+(* kill -9: the process dies with whatever it had; memory is gone.      *)
+Crash ==
+  /\ up
+  /\ up' = FALSE
+  /\ UNCHANGED <<mem, log, acked>>
+
+(* Restart replays the recovery log (toykv_server.py replay()).         *)
+Restart ==
+  /\ ~up
+  /\ up' = TRUE
+  /\ mem' = log
+  /\ UNCHANGED <<log, acked>>
+
+Next ==
+  \/ \E k \in Keys, v \in Values : Write(k, v)
+  \/ \E k \in Keys, old \in Values \cup {None}, new \in Values :
+       Cas(k, old, new)
+  \/ Crash
+  \/ Restart
+
+Spec == Init /\ [][Next]_vars
+
+(***************************************************************************)
+(* Durability: while the node is up, every key that ever had an           *)
+(* acknowledged write holds SOME acknowledged value — an acknowledged     *)
+(* write may be superseded by a later one, but never silently vanish      *)
+(* back to None or to an unacknowledged value. Volatile = TRUE breaks     *)
+(* this at the first post-crash restart.                                  *)
+(***************************************************************************)
+Durability ==
+  up =>
+    \A k \in Keys :
+      (\E v \in Values : <<k, v>> \in acked)
+        => (\E v \in Values : <<k, v>> \in acked /\ mem[k] = v)
+
+====

@@ -48,11 +48,15 @@ class MonotonicChecker(jchecker.Checker):
                 if v is None:
                     continue
                 by_key.setdefault(k, []).append((v, op.index))
+        hub = -1  # synthetic hub ids are negative (history ids are >=0)
         for k, pairs in by_key.items():
             # group ops by distinct observed value: EVERY op at value v
             # precedes every op at the next distinct value (linking
             # only adjacent sorted pairs would let ties swallow edges
-            # and miss real cycles)
+            # and miss real cycles). Large tie groups route through a
+            # synthetic per-boundary hub node — O(|g1|+|g2|) edges with
+            # identical cycle semantics (hubs never order group members
+            # against each other) instead of O(|g1|*|g2|).
             groups: list = []
             for v, i in sorted(pairs):
                 if groups and groups[-1][0] == v:
@@ -60,16 +64,36 @@ class MonotonicChecker(jchecker.Checker):
                 else:
                     groups.append((v, [i]))
             for (v1, g1), (v2, g2) in zip(groups, groups[1:]):
-                for i1 in g1:
+                label = {"key": k, "value": v1, "value'": v2}
+                if len(g1) * len(g2) <= len(g1) + len(g2):
+                    for i1 in g1:
+                        for i2 in g2:
+                            g.add_edge(i1, i2, WW, label)
+                else:
+                    for i1 in g1:
+                        g.add_edge(i1, hub, WW, label)
                     for i2 in g2:
-                        g.add_edge(i1, i2, WW,
-                                   {"key": k, "value": v1,
-                                    "value'": v2})
+                        g.add_edge(hub, i2, WW, label)
+                    hub -= 1
         cyc = g.find_cycle(types={WW})
         if cyc is None:
             return {"valid?": True, "op-count": len(oks),
                     "key-count": len(by_key)}
-        steps = g.explain_cycle(cyc)
+        # Report over real ops only: a hub hop a -> h -> b carries the
+        # same label on both edges, so keep hub-exit steps and rewrite
+        # their "from" to the preceding real node.
+        raw = g.explain_cycle(cyc)
+        steps = []
+        prev_real = next(n for n in reversed(cyc[:-1]) if n >= 0)
+        for s in raw:
+            if s["to"] < 0:      # entering a hub: remember the source
+                prev_real = s["from"]
+                continue
+            if s["from"] < 0:    # leaving a hub: attribute to source
+                s = {**s, "from": prev_real}
+            steps.append(s)
+            prev_real = s["to"]
+        real_cycle = [n for n in cyc if n >= 0]
         lines = []
         for s in steps:
             det = s["detail"] or {}
@@ -78,7 +102,7 @@ class MonotonicChecker(jchecker.Checker):
                 f"T{s['from']} observed key {det.get('key')!r} at "
                 f"{det.get('value')!r} before T{s['to']} observed it "
                 f"at {v2!r}")
-        return {"valid?": False, "cycle": cyc, "steps": steps,
+        return {"valid?": False, "cycle": real_cycle, "steps": steps,
                 "explanation": "; ".join(lines)}
 
 

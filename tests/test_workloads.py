@@ -535,3 +535,17 @@ def test_monotonic_tied_values_dont_swallow_edges():
     ])
     res = monotonic.checker().check({}, h, {})
     assert res["valid?"] is False
+
+
+def test_monotonic_hub_edges_scale_and_explain():
+    """Large tie groups route through synthetic hubs (O(n) edges), and
+    cycles crossing a hub still report real ops only."""
+    from jepsen_tpu.workloads import monotonic
+    ops = [op("ok", 0, "read", {"x": 1, "y": 5})]
+    ops += [op("ok", 0, "read", {"x": 1}) for _ in range(39)]
+    ops += [op("ok", 1, "read", {"x": 2}) for _ in range(39)]
+    ops.append(op("ok", 1, "read", {"x": 2, "y": 3}))
+    res = monotonic.checker().check({}, hist(ops), {})
+    assert res["valid?"] is False
+    assert all(n >= 0 for n in res["cycle"])
+    assert "observed key" in res["explanation"]
