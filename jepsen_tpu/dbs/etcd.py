@@ -213,14 +213,86 @@ class EtcdClient(jclient.Client):
             "failure": []})
         return bool(res.get("succeeded"))
 
+    def kv_snapshot(self, keys: list) -> dict:
+        """key -> (value, mod_revision) via one read-only txn (the
+        success branch of a compare-less txn executes its ranges
+        atomically)."""
+        res = self._post("/v3/kv/txn", {
+            "compare": [],
+            "success": [{"requestRange": {"key": self._b64(k)}}
+                        for k in keys],
+            "failure": []})
+        out = {}
+        for k, rr in zip(keys, res.get("responses") or []):
+            # the real v3 JSON gateway emits snake_case field names;
+            # accept camelCase too (proto JSON printers vary)
+            rng = rr.get("response_range") or rr.get("responseRange") \
+                or {}
+            kvs = rng.get("kvs") or []
+            if kvs:
+                rev = kvs[0].get("mod_revision",
+                                 kvs[0].get("modRevision", 0))
+                out[k] = (self._unb64(kvs[0]["value"]), int(rev))
+            else:
+                out[k] = (None, 0)
+        return out
+
+    def txn_mops(self, mops: list, retries: int = 8) -> Optional[list]:
+        """Execute a micro-op txn ([["append", k, v] | ["r", k, None]])
+        atomically via optimistic concurrency: snapshot the involved
+        keys with their revisions, compute the appended lists, then
+        commit guarded by MOD-revision compares on every involved key —
+        the standard etcd software-transaction recipe. Returns the
+        completed mops (reads filled), or None if contention exhausted
+        the retries (indefinite: nothing committed)."""
+        from ..txn import APPEND
+        keys = sorted({f"/jepsen/{k}" for _f, k, _v in mops})
+        for _ in range(retries):
+            snap = self.kv_snapshot(keys)
+            state = {k: (json.loads(v) if v else [])
+                     for k, (v, _r) in snap.items()}
+            done = []
+            for f, k, v in mops:
+                kk = f"/jepsen/{k}"
+                if f == APPEND:
+                    state[kk] = state[kk] + [v]
+                    done.append([f, k, v])
+                else:
+                    done.append([f, k, list(state[kk])])
+            compare = [{"key": self._b64(k), "target": "MOD",
+                        "result": "EQUAL",
+                        "modRevision": str(snap[k][1])}
+                       for k in keys]
+            writes = {f"/jepsen/{k}" for f, k, _v in mops
+                      if f == APPEND}
+            success = [{"requestPut": {
+                "key": self._b64(k),
+                "value": self._b64(json.dumps(state[k]))}}
+                for k in sorted(writes)]
+            res = self._post("/v3/kv/txn", {
+                "compare": compare, "success": success, "failure": []})
+            if res.get("succeeded"):
+                return done
+        return None
+
     # -- jepsen client ------------------------------------------------
     def invoke(self, test, op):
+        f = op["f"]
+        if f == "txn":
+            # elle list-append txns (the append workload)
+            try:
+                done = self.txn_mops(op["value"])
+            except requests.RequestException as e:
+                return {**op, "type": "info", "error": str(e)[:200]}
+            if done is None:
+                return {**op, "type": "fail",
+                        "error": "txn contention: retries exhausted"}
+            return {**op, "type": "ok", "value": done}
         kv = op["value"]
         if not isinstance(kv, KV):
             raise ValueError(f"etcd wants [k v] tuple values, got {kv!r}")
         k, v = kv
         key = f"/jepsen/{k}"
-        f = op["f"]
         try:
             if f == "read":
                 cur = self.kv_range(key)
@@ -246,14 +318,25 @@ class EtcdClient(jclient.Client):
 
 
 def etcd_test(options: dict) -> dict:
-    """Full test map from CLI options (zookeeper.clj zk-test shape)."""
+    """Full test map from CLI options (zookeeper.clj zk-test shape).
+    `workload`: register (independent cas-register, the default) or
+    append (elle list-append over etcd software transactions)."""
     nodes = options["nodes"]
     db = EtcdDB(options.get("version") or VERSION)
-    w = linearizable_register.workload(
-        {"nodes": nodes,
-         "concurrency": options["concurrency"],
-         "per_key_limit": options.get("per_key_limit") or 100,
-         "algorithm": "competition"})
+    which = options.get("workload") or "register"
+    if which == "append":
+        from ..workloads import cycle_append
+        w = cycle_append.workload(
+            anomalies=("G0", "G1", "G2"),
+            additional_graphs=("realtime",))
+    elif which == "register":
+        w = linearizable_register.workload(
+            {"nodes": nodes,
+             "concurrency": options["concurrency"],
+             "per_key_limit": options.get("per_key_limit") or 100,
+             "algorithm": "competition"})
+    else:
+        raise ValueError(f"unknown workload {which!r}")
     interval = options.get("nemesis_interval") or 5.0
     return {
         "name": options.get("name") or "etcd",
@@ -269,7 +352,7 @@ def etcd_test(options: dict) -> dict:
         # No gating stats checker: a short run where some op type
         # never succeeds (e.g. every cas misses) would flap invalid.
         "checker": jchecker.compose({
-            "independent": w["checker"],
+            which: w["checker"],
             "exceptions": jchecker.unhandled_exceptions(),
         }),
         "generator": gen.time_limit(
@@ -286,6 +369,9 @@ def etcd_test(options: dict) -> dict:
 ETCD_OPTS = [
     cli.Opt("version", metavar="VERSION", default=VERSION,
             help="etcd release to install"),
+    cli.Opt("workload", metavar="NAME", default="register",
+            help="register (independent cas-register) or append "
+                 "(elle list-append over etcd transactions)"),
     cli.Opt("per_key_limit", metavar="N", default=100, parse=int,
             help="Ops per key"),
     cli.Opt("nemesis_interval", metavar="SECONDS", default=5.0,

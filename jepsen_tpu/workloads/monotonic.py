@@ -94,6 +94,10 @@ class MonotonicChecker(jchecker.Checker):
             steps.append(s)
             prev_real = s["to"]
         real_cycle = [n for n in cyc if n >= 0]
+        if real_cycle and real_cycle[0] != real_cycle[-1]:
+            # keep the closed [a, ..., a] shape every cycle result in
+            # the codebase uses (a hub at the cut point drops it)
+            real_cycle.append(real_cycle[0])
         lines = []
         for s in steps:
             det = s["detail"] or {}
