@@ -307,7 +307,11 @@ def check_batched(model: Model, histories: Sequence[History],
 
     if mesh is None:
         mesh = default_mesh()
-    axis = mesh.axis_names[0]
+    # Multi-axis meshes (e.g. ("hosts", "chips") on a multi-host pod)
+    # shard the key axis over the PRODUCT of all axes: per-key search
+    # needs no collectives, so DCN between hosts stays as idle as ICI.
+    axis = tuple(mesh.axis_names) if len(mesh.axis_names) > 1 \
+        else mesh.axis_names[0]
     nd = mesh.devices.size
 
     batch = encode_batch(encs, batch_pad=nd)

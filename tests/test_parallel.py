@@ -122,3 +122,18 @@ def test_independent_tpu_checker_matches_host():
     assert sorted(tpu["failures"]) == sorted(host["failures"])
     for k in independent.history_keys(hist):
         assert tpu["results"][k]["valid?"] == host["results"][k]["valid?"]
+
+
+def test_multihost_shaped_mesh():
+    """A 2-D (hosts, chips) mesh — the multi-host pod layout — shards
+    the key batch over the product of both axes."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    devs = np.asarray(jax.devices()).reshape(2, 4)
+    mesh = Mesh(devs, ("hosts", "chips"))
+    hists = [synth.cas_register_history(80, n_procs=3, seed=s)
+             for s in range(8)]
+    res = check_batched(models.cas_register(), hists, mesh=mesh)
+    assert [r["valid?"] for r in res] == [True] * 8
