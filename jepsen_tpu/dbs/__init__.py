@@ -12,6 +12,10 @@ the shared framework. This package holds this framework's suites:
   initial-cluster daemon automation, full Process/Pause/Primary fault
   surface, and a v3 JSON-gateway client (CI-run against a
   wire-compatible stub).
+- `redis` — the redis-protocol family (the reference's disque):
+  build-from-source automation, a from-scratch RESP2 codec, and CAS
+  as an atomic server-side Lua script (CI-run against an in-process
+  RESP stub).
 - `zookeeper` — the reference's minimal single-file exemplar
   (`zookeeper/src/jepsen/zookeeper.clj:1-145`): distro-package
   install, myid/zoo.cfg generation, and a znode CAS-register client

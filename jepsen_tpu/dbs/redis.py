@@ -1,0 +1,268 @@
+"""Redis test suite — the redis-protocol family exemplar (the
+reference ships disque, antirez's redis-derived queue:
+disque/src/jepsen/disque.clj; this suite speaks the same RESP wire
+protocol against stock redis).
+
+DB automation builds redis from a release tarball (the disque suite's
+clone-and-make pattern) and drives redis-server with a pidfile +
+logfile; the client is a from-scratch RESP2 codec over one TCP
+connection per worker — GET/SET for reads and writes, and CAS as an
+atomic server-side Lua script (EVAL compare-and-set), the idiomatic
+redis recipe. Ops ride [k v] independent tuples.
+"""
+
+from __future__ import annotations
+
+import socket
+from typing import Optional
+
+from .. import checker as jchecker
+from .. import cli, client as jclient, control, db as jdb
+from .. import generator as gen
+from .. import net as jnet
+from .. import nemesis as jnemesis
+from ..control import nodeutil
+from ..independent import KV, tuple_
+from ..os_setup import Debian
+from ..workloads import linearizable_register
+
+VERSION = "7.2.5"
+PORT = 6379
+DIR = "/opt/redis"
+PIDFILE = f"{DIR}/redis.pid"
+LOGFILE = f"{DIR}/redis.log"
+
+CAS_LUA = ("if redis.call('GET', KEYS[1]) == ARGV[1] then "
+           "redis.call('SET', KEYS[1], ARGV[2]); return 1 "
+           "else return 0 end")
+
+
+def tarball_url(version: str) -> str:
+    return f"https://download.redis.io/releases/redis-{version}.tar.gz"
+
+
+class RedisDB(jdb.DB, jdb.Process, jdb.LogFiles):
+    """Build-from-source install + daemon lifecycle (the disque
+    suite's pattern: wget/untar/make, then run the server with
+    explicit pidfile/logfile)."""
+
+    def __init__(self, version: str = VERSION):
+        self.version = version
+
+    def _start(self, test, node):
+        nodeutil.start_daemon(
+            {"logfile": LOGFILE, "pidfile": PIDFILE, "chdir": DIR},
+            f"{DIR}/src/redis-server",
+            "--port", str(PORT),
+            "--appendonly", "yes",
+            "--dir", DIR,
+            "--protected-mode", "no")
+        nodeutil.await_tcp_port(PORT, timeout_s=60)
+
+    def setup(self, test, node):
+        with control.su():
+            nodeutil.install_archive(tarball_url(self.version), DIR)
+            control.exec_("make", "-C", DIR, "-j2")
+        self._start(test, node)
+
+    def teardown(self, test, node):
+        nodeutil.stop_daemon(PIDFILE)
+        nodeutil.grepkill("redis-server")
+        with control.su():
+            # redis 7.x writes multi-part AOFs under appendonlydir/
+            control.exec_("rm", "-rf", f"{DIR}/appendonlydir",
+                          f"{DIR}/appendonly.aof", f"{DIR}/dump.rdb",
+                          LOGFILE)
+
+    def start(self, test, node):
+        self._start(test, node)
+        return "started"
+
+    def kill(self, test, node):
+        nodeutil.stop_daemon(PIDFILE)
+        nodeutil.grepkill("redis-server")
+        return "killed"
+
+    def log_files(self, test, node):
+        return [LOGFILE]
+
+
+# -- RESP2 wire codec -------------------------------------------------------
+
+def resp_encode(args: list) -> bytes:
+    """Client command as a RESP array of bulk strings."""
+    out = [f"*{len(args)}\r\n".encode()]
+    for a in args:
+        b = str(a).encode()
+        out.append(b"$" + str(len(b)).encode() + b"\r\n" + b + b"\r\n")
+    return b"".join(out)
+
+
+def resp_read(rf) -> object:
+    """One RESP2 reply from a buffered reader: simple string, error,
+    integer, bulk string (None for nil), or array."""
+    line = rf.readline()
+    if not line:
+        raise ConnectionError("server closed")
+    tag, rest = line[:1], line[1:].strip()
+    if tag == b"+":
+        return rest.decode()
+    if tag == b"-":
+        raise RedisError(rest.decode())
+    if tag == b":":
+        return int(rest)
+    if tag == b"$":
+        n = int(rest)
+        if n == -1:
+            return None
+        data = rf.read(n + 2)
+        if len(data) < n + 2:  # connection died mid-reply: a partial
+            # value must never complete an op as "ok"
+            raise ConnectionError("short read in bulk reply")
+        return data[:n].decode()
+    if tag == b"*":
+        n = int(rest)
+        if n == -1:
+            return None
+        return [resp_read(rf) for _ in range(n)]
+    raise ValueError(f"bad RESP tag {tag!r}")
+
+
+class RedisError(Exception):
+    pass
+
+
+class RedisConn:
+    """One blocking RESP connection."""
+
+    def __init__(self, host: str, port: int, timeout: float = 5.0):
+        self.sock = socket.create_connection((host, port),
+                                             timeout=timeout)
+        self.sock.settimeout(timeout)
+        self.rf = self.sock.makefile("rb")
+
+    def cmd(self, *args):
+        self.sock.sendall(resp_encode(list(args)))
+        return resp_read(self.rf)
+
+    def close(self):
+        try:
+            self.rf.close()
+            self.sock.close()
+        except OSError:
+            pass
+
+
+class RedisClient(jclient.Client):
+    """CAS-register client: GET/SET plus Lua compare-and-set. One
+    connection per opened client (per worker). `port_fn` maps a node
+    to its port — tests point it at in-process stubs."""
+
+    def __init__(self, port_fn=None, timeout: float = 5.0):
+        self.port_fn = port_fn or (lambda test, node: (node, PORT))
+        self.timeout = timeout
+        self.node: Optional[str] = None
+        self.conn: Optional[RedisConn] = None
+
+    def open(self, test, node):
+        c = RedisClient(self.port_fn, self.timeout)
+        c.node = node
+        return c
+
+    def _conn(self, test) -> RedisConn:
+        if self.conn is None:
+            host, port = self.port_fn(test, self.node)
+            self.conn = RedisConn(host, port, self.timeout)
+        return self.conn
+
+    def invoke(self, test, op):
+        kv = op["value"]
+        if not isinstance(kv, KV):
+            raise ValueError(f"redis wants [k v] tuples, got {kv!r}")
+        k, v = kv
+        key = f"jepsen:{k}"
+        f = op["f"]
+        try:
+            conn = self._conn(test)
+            if f == "read":
+                cur = conn.cmd("GET", key)
+                return {**op, "type": "ok",
+                        "value": tuple_(k, None if cur is None
+                                        else int(cur))}
+            if f == "write":
+                conn.cmd("SET", key, v)
+                return {**op, "type": "ok"}
+            if f == "cas":
+                old, new = v
+                won = conn.cmd("EVAL", CAS_LUA, 1, key, old, new)
+                return {**op, "type": "ok" if won == 1 else "fail"}
+            raise ValueError(f"unknown op {f!r}")
+        except (OSError, ConnectionError, RedisError) as e:
+            if self.conn is not None:
+                self.conn.close()
+                self.conn = None
+            t = "fail" if f == "read" else "info"
+            return {**op, "type": t, "error": str(e)[:200]}
+
+    def close(self, test):
+        if self.conn is not None:
+            self.conn.close()
+
+
+def redis_test(options: dict) -> dict:
+    """Test map from CLI options (disque.clj suite shape: register
+    workload under a kill/restart nemesis)."""
+    nodes = options["nodes"]
+    db = RedisDB(options.get("version") or VERSION)
+    w = linearizable_register.workload(
+        {"nodes": nodes,
+         "concurrency": options["concurrency"],
+         "per_key_limit": options.get("per_key_limit") or 100,
+         "algorithm": "competition"})
+    interval = options.get("nemesis_interval") or 10.0
+    return {
+        "name": options.get("name") or "redis",
+        "store_root": options.get("store_root") or "store",
+        "nodes": nodes,
+        "concurrency": options["concurrency"],
+        "ssh": options.get("ssh") or {},
+        "os": Debian(),
+        "db": db,
+        "net": jnet.iptables(),
+        "client": RedisClient(),
+        "nemesis": jnemesis.node_start_stopper(
+            lambda nodes: [gen.RNG.choice(nodes)],
+            lambda test, node: db.kill(test, node),
+            lambda test, node: db.start(test, node)),
+        "checker": jchecker.compose({
+            "register": w["checker"],
+            "exceptions": jchecker.unhandled_exceptions(),
+        }),
+        "generator": gen.time_limit(
+            options.get("time_limit") or 30,
+            gen.nemesis(
+                gen.cycle([gen.sleep(interval),
+                           {"type": "info", "f": "start"},
+                           gen.sleep(interval),
+                           {"type": "info", "f": "stop"}]),
+                w["generator"])),
+    }
+
+
+REDIS_OPTS = [
+    cli.Opt("version", metavar="VERSION", default=VERSION,
+            help="redis release to build"),
+    cli.Opt("per_key_limit", metavar="N", default=100, parse=int,
+            help="Ops per key"),
+    cli.Opt("nemesis_interval", metavar="SECONDS", default=10.0,
+            parse=float, help="Seconds between kill/restart cycles"),
+]
+
+COMMANDS = {
+    **cli.single_test_cmd({"test_fn": redis_test,
+                           "opt_spec": REDIS_OPTS}),
+    **cli.serve_cmd(),
+}
+
+if __name__ == "__main__":
+    cli.main(COMMANDS)

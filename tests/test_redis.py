@@ -1,0 +1,168 @@
+"""Redis suite tests: the from-scratch RESP codec and client against
+an in-process RESP2 stub speaking the GET/SET/EVAL subset, plus DB
+orchestration through the dummy remote — the whole suite runs in CI
+with no redis installed."""
+
+import io
+import socketserver
+import threading
+
+import pytest
+
+from jepsen_tpu import control as c, core
+from jepsen_tpu.control.dummy import DummyRemote
+from jepsen_tpu.dbs import redis
+from jepsen_tpu.independent import tuple_
+
+
+# -- in-process RESP2 server ------------------------------------------------
+
+class RespStub(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+    def __init__(self, *a, **kw):
+        super().__init__(*a, **kw)
+        self.data: dict = {}
+        self.store_lock = threading.Lock()
+
+
+class RespHandler(socketserver.StreamRequestHandler):
+    def handle(self):
+        while True:
+            try:
+                args = self._read_command()
+            except (ConnectionError, ValueError):
+                return
+            if args is None:
+                return
+            self.wfile.write(self._apply([str(a) for a in args]))
+            self.wfile.flush()
+
+    def _read_command(self):
+        line = self.rfile.readline()
+        if not line:
+            return None
+        assert line[:1] == b"*", line
+        n = int(line[1:].strip())
+        out = []
+        for _ in range(n):
+            hdr = self.rfile.readline()
+            assert hdr[:1] == b"$", hdr
+            ln = int(hdr[1:].strip())
+            out.append(self.rfile.read(ln + 2)[:ln].decode())
+        return out
+
+    def _apply(self, args) -> bytes:
+        srv = self.server
+        cmd = args[0].upper()
+        with srv.store_lock:
+            if cmd == "GET":
+                v = srv.data.get(args[1])
+                if v is None:
+                    return b"$-1\r\n"
+                b = str(v).encode()
+                return b"$%d\r\n%s\r\n" % (len(b), b)
+            if cmd == "SET":
+                srv.data[args[1]] = args[2]
+                return b"+OK\r\n"
+            if cmd == "EVAL":
+                # the suite's CAS script: EVAL <lua> 1 key old new
+                _lua, _nkeys, key, old, new = args[1:6]
+                if srv.data.get(key) == old:
+                    srv.data[key] = new
+                    return b":1\r\n"
+                return b":0\r\n"
+            return b"-ERR unknown command\r\n"
+
+
+@pytest.fixture()
+def resp_server():
+    srv = RespStub(("127.0.0.1", 0), RespHandler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    yield srv
+    srv.shutdown()
+
+
+# -- codec ------------------------------------------------------------------
+
+def test_resp_encode():
+    assert redis.resp_encode(["GET", "k"]) == \
+        b"*2\r\n$3\r\nGET\r\n$1\r\nk\r\n"
+
+
+def test_resp_read_types():
+    rf = io.BytesIO(b"+OK\r\n:42\r\n$3\r\nfoo\r\n$-1\r\n"
+                    b"*2\r\n:1\r\n$1\r\nx\r\n")
+    assert redis.resp_read(rf) == "OK"
+    assert redis.resp_read(rf) == 42
+    assert redis.resp_read(rf) == "foo"
+    assert redis.resp_read(rf) is None
+    assert redis.resp_read(rf) == [1, "x"]
+    with pytest.raises(redis.RedisError):
+        redis.resp_read(io.BytesIO(b"-ERR boom\r\n"))
+
+
+# -- client vs stub ---------------------------------------------------------
+
+def test_client_semantics(resp_server):
+    port = resp_server.server_address[1]
+    cl = redis.RedisClient(
+        port_fn=lambda test, node: ("127.0.0.1", port)).open({}, "n1")
+    rd = {"type": "invoke", "f": "read", "value": tuple_(3, None),
+          "process": 0}
+    assert cl.invoke({}, rd)["value"] == tuple_(3, None)
+    assert cl.invoke({}, {"f": "write", "value": tuple_(3, 7),
+                          "process": 0})["type"] == "ok"
+    assert cl.invoke({}, rd)["value"] == tuple_(3, 7)
+    assert cl.invoke({}, {"f": "cas", "value": tuple_(3, [7, 9]),
+                          "process": 0})["type"] == "ok"
+    assert cl.invoke({}, {"f": "cas", "value": tuple_(3, [7, 1]),
+                          "process": 0})["type"] == "fail"
+    assert cl.invoke({}, rd)["value"] == tuple_(3, 9)
+
+
+def test_client_down_server_contained():
+    cl = redis.RedisClient(
+        port_fn=lambda test, node: ("127.0.0.1", 1),
+        timeout=0.2).open({}, "n1")
+    r = cl.invoke({}, {"f": "read", "value": tuple_(1, None),
+                       "process": 0})
+    assert r["type"] == "fail"
+    w = cl.invoke({}, {"f": "write", "value": tuple_(1, 2),
+                       "process": 0})
+    assert w["type"] == "info"
+
+
+# -- DB orchestration -------------------------------------------------------
+
+def test_db_commands():
+    log: list = []
+    db = redis.RedisDB()
+    test = {"nodes": ["n1"]}
+    with c.with_remote(DummyRemote(log)):
+        with c.on("n1"):
+            db.setup(test, "n1")
+            db.kill(test, "n1")
+            db.teardown(test, "n1")
+    cmds = [x[1] for x in log if isinstance(x[1], str)]
+    joined = "\n".join(cmds)
+    assert "redis-" in joined and "make" in joined
+    assert "redis-server" in joined and "--appendonly yes" in joined
+    assert db.log_files(test, "n1") == [redis.LOGFILE]
+
+
+# -- full suite -------------------------------------------------------------
+
+def test_full_suite_with_stub(resp_server, tmp_path):
+    port = resp_server.server_address[1]
+    opts = {"nodes": ["n1", "n2"], "concurrency": 4, "time_limit": 4,
+            "per_key_limit": 15, "store_root": str(tmp_path / "store"),
+            "ssh": {"dummy?": True}}
+    t = redis.redis_test(opts)
+    t["client"] = redis.RedisClient(
+        port_fn=lambda test, node: ("127.0.0.1", port))
+    t["name"] = "redis-stub"
+    done = core.run(t)
+    assert done["results"]["valid?"] is True
+    assert done["results"]["register"]["valid?"] is True
