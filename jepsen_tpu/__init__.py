@@ -22,4 +22,4 @@ Layer map (mirrors SURVEY.md §1):
   L6  cli             entry points
 """
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"

@@ -406,16 +406,16 @@ def check(model: Model, history: History, time_limit: Optional[float] = None,
     ic_pad = len(enc.inv_info)
     K, H, B = _pick_capacities(W, ic_pad, n)
     if enc.window_raw <= 32:
-        # Fast-path sweet spot (measured on 10k-op cas-register
-        # histories): configs_explored scales ~linearly with K — the
-        # search finishes in ~depth rounds regardless of width, so a
-        # narrow beam does ~K/depth of the work (K=32 decides the 10k
-        # headline 6x faster than K=256). Exhaustive searches (invalid
-        # or near-invalid histories) instead want breadth to amortize
-        # per-round overhead — the loop below escalates K when
-        # exploration passes _ESCALATE_AT, migrating the carry (the
-        # memo table survives, so nothing is re-explored).
-        K = 32
+        # Fast-path sweet spot (measured on the BASELINE model matrix):
+        # configs_explored scales ~linearly with K — the search
+        # finishes in ~depth rounds regardless of width, so a narrow
+        # beam does ~K/depth of the work (K=16 beats K=32 by ~30% and
+        # K=256 by ~10x across register/cas/mutex configs). Exhaustive
+        # searches (invalid or near-invalid histories) instead want
+        # breadth to amortize per-round overhead — the loop below
+        # escalates K when exploration passes _ESCALATE_AT, migrating
+        # the carry (the memo table survives, nothing is re-explored).
+        K = 16
     if frontier:
         K = frontier  # override breadth only; the memo table must still
         #               fit the config space (see _pick_capacities)
