@@ -188,6 +188,19 @@ def run_extras(budget: float, deadline: float) -> dict:
 
     run("elle_append_3k", None, None, checker=elle_append, need=45)
 
+    def elle_wr():
+        from jepsen_tpu.elle import wr as elle_wr_mod
+        hist_w = synth.wr_register_history(3000, n_procs=5, seed=7)
+        res = elle_wr_mod.check(hist_w, linearizable_keys=True,
+                                additional_graphs=("realtime",),
+                                cycle_backend="auto")
+        return {"valid?": res["valid?"],
+                "op_count": len(hist_w) // 2,
+                "engine": res.get("cycle-engine"),
+                "cause": ",".join(res["anomaly-types"]) or None}
+
+    run("elle_wr_3k", None, None, checker=elle_wr, need=45)
+
     # independent 100 keys x 2k ops, batch-checked over the device mesh
     n_keys = int(os.environ.get("JEPSEN_TPU_BENCH_KEYS", "100"))
     per_key = int(os.environ.get("JEPSEN_TPU_BENCH_PER_KEY", "2000"))

@@ -222,26 +222,18 @@ def long_tail_history(n_quick: int, n_slow: int = 1, values: int = 5,
     return hist.index()
 
 
-def list_append_history(n_txns: int, n_procs: int = 5, key_count: int = 4,
-                        max_txn_length: int = 4, crash_p: float = 0.01,
-                        corrupt_p: float = 0.0,
-                        seed: int = 0) -> h.History:
-    """A concurrent list-append run for the elle checkers: each txn's
-    mops apply atomically at its completion instant against real
-    in-memory lists, so the history is serializable (and realtime-
-    consistent) by construction. `corrupt_p` drops a random element from
-    a random read's result to produce known-invalid histories.
+def _txn_scheduler(n_txns: int, n_procs: int, crash_p: float,
+                   rng, next_txn, apply_ok, apply_crash) -> h.History:
+    """Shared concurrent-txn simulation loop: random interleaving of
+    invocations and completions, txns applied atomically at completion
+    (serialization point inside the op window -> serializable AND
+    realtime-consistent by construction), crashes left :info with a
+    coin-flip apply, crashed processes retired for fresh pids
+    (interpreter.clj:233-236).
 
-    Shapes follow the reference generator (elle.list-append/gen via
-    tests/cycle/append.clj:28-31): rotating key pool, unique
-    monotonically increasing values per key."""
-    from .elle.append import AppendGen
-
-    rng = random.Random(seed)
-    gen = AppendGen(key_count=key_count, max_txn_length=max_txn_length,
-                    seed=seed)
+    next_txn() -> mops; apply_ok(txn) -> completed mops;
+    apply_crash(txn) -> None (the 'may have applied' branch)."""
     hist = h.History()
-    lists: dict = {}
     pending: dict = {}
     free = list(range(n_procs))
     next_pid = n_procs
@@ -253,7 +245,7 @@ def list_append_history(n_txns: int, n_procs: int = 5, key_count: int = 4,
             break
         if can_invoke and (not pending or rng.random() < 0.6):
             p = free.pop(rng.randrange(len(free)))
-            txn = gen.txn()
+            txn = next_txn()
             hist.append(h.invoke(p, "txn", txn, time=t))
             pending[p] = txn
             issued += 1
@@ -263,24 +255,94 @@ def list_append_history(n_txns: int, n_procs: int = 5, key_count: int = 4,
             if rng.random() < crash_p:
                 hist.append(h.info(p, "txn", txn, time=t))
                 if rng.random() < 0.5:  # may or may not have applied
-                    for f, k, v in txn:
-                        if f == "append":
-                            lists.setdefault(k, []).append(v)
+                    apply_crash(txn)
                 free.append(next_pid)
                 next_pid += 1
             else:
-                done = []
-                for f, k, v in txn:
-                    if f == "append":
-                        lists.setdefault(k, []).append(v)
-                        done.append([f, k, v])
-                    else:
-                        out = list(lists.get(k, []))
-                        if corrupt_p and out and \
-                                rng.random() < corrupt_p:
-                            out.pop(rng.randrange(len(out)))
-                        done.append([f, k, out])
-                hist.append(h.ok(p, "txn", done, time=t))
+                hist.append(h.ok(p, "txn", apply_ok(txn), time=t))
                 free.append(p)
         t += 1
     return hist.index()
+
+
+def list_append_history(n_txns: int, n_procs: int = 5, key_count: int = 4,
+                        max_txn_length: int = 4, crash_p: float = 0.01,
+                        corrupt_p: float = 0.0,
+                        seed: int = 0) -> h.History:
+    """A concurrent list-append run for the elle checkers (shared
+    scheduler: _txn_scheduler). `corrupt_p` drops a random element from
+    a random read's result to produce known-invalid histories.
+
+    Shapes follow the reference generator (elle.list-append/gen via
+    tests/cycle/append.clj:28-31): rotating key pool, unique
+    monotonically increasing values per key."""
+    from .elle.append import AppendGen
+
+    rng = random.Random(seed)
+    gen = AppendGen(key_count=key_count, max_txn_length=max_txn_length,
+                    seed=seed)
+    lists: dict = {}
+
+    def apply_write(txn):
+        for f, k, v in txn:
+            if f == "append":
+                lists.setdefault(k, []).append(v)
+
+    def apply_ok(txn):
+        done = []
+        for f, k, v in txn:
+            if f == "append":
+                lists.setdefault(k, []).append(v)
+                done.append([f, k, v])
+            else:
+                out = list(lists.get(k, []))
+                if corrupt_p and out and rng.random() < corrupt_p:
+                    out.pop(rng.randrange(len(out)))
+                done.append([f, k, out])
+        return done
+
+    return _txn_scheduler(n_txns, n_procs, crash_p, rng, gen.txn,
+                          apply_ok, apply_write)
+
+
+def wr_register_history(n_txns: int, n_procs: int = 5, key_count: int = 4,
+                        max_txn_length: int = 4, crash_p: float = 0.01,
+                        stale_p: float = 0.0,
+                        seed: int = 0) -> h.History:
+    """A concurrent write/read-register run for the elle wr checker
+    (shared scheduler: _txn_scheduler): unique writes per key (the
+    rw-register workload's invariant). `stale_p` makes a read return
+    the PREVIOUS value of its key, producing known anomalies.
+
+    Shapes follow the reference generator (tests/cycle/wr.clj:14-53
+    semantics via the shared WrGen key pool)."""
+    from .elle.wr import WrGen
+
+    rng = random.Random(seed)
+    gen = WrGen(key_count=key_count, max_txn_length=max_txn_length,
+                seed=seed)
+    regs: dict = {}
+    prev: dict = {}
+
+    def apply_write(txn):
+        for f, k, v in txn:
+            if f == "w":
+                prev[k] = regs.get(k)
+                regs[k] = v
+
+    def apply_ok(txn):
+        done = []
+        for f, k, v in txn:
+            if f == "w":
+                prev[k] = regs.get(k)
+                regs[k] = v
+                done.append([f, k, v])
+            else:
+                out = regs.get(k)
+                if stale_p and k in prev and rng.random() < stale_p:
+                    out = prev[k]
+                done.append([f, k, out])
+        return done
+
+    return _txn_scheduler(n_txns, n_procs, crash_p, rng, gen.txn,
+                          apply_ok, apply_write)

@@ -199,3 +199,17 @@ def test_synth_list_append_parity(corrupt):
         assert res_h["valid?"] is True
     else:
         assert res_h["valid?"] is False
+
+
+@pytest.mark.parametrize("stale", [0.0, 0.2])
+def test_synth_wr_register_parity(stale):
+    """Synthesized concurrent wr-register histories (valid and stale)
+    agree across backends end-to-end."""
+    from jepsen_tpu.synth import wr_register_history
+    h = wr_register_history(300, seed=5, stale_p=stale)
+    kw = dict(linearizable_keys=True, additional_graphs=("realtime",))
+    res_h = wr.check(h, cycle_backend="host", **kw)
+    res_t = wr.check(h, cycle_backend="tpu", **kw)
+    assert res_h["valid?"] == res_t["valid?"]
+    assert set(res_h["anomaly-types"]) == set(res_t["anomaly-types"])
+    assert res_h["valid?"] is (True if stale == 0.0 else False)
