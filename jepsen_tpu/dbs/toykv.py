@@ -291,6 +291,49 @@ class ToyKVSetClient(jclient.Client):
         self.kv.close(test)
 
 
+class ToyKVSeqClient(jclient.Client):
+    """Sequential workload client (workloads.sequential contract): a
+    write inserts key k's subkeys k_0..k_{n-1} IN ORDER as separate
+    per-node writes (sharded by subkey, so they land on different
+    servers); a read fetches them in REVERSE. Client order makes the
+    history sequentially consistent on a durable cluster; a volatile
+    node that loses an early subkey after acknowledging it surfaces as
+    a trailing-nil violation."""
+
+    def __init__(self):
+        self.kv = ToyKVClient()
+
+    def open(self, test, node):
+        return ToyKVSeqClient()
+
+    def invoke(self, test, op):
+        from ..workloads.sequential import DEFAULT_KEY_COUNT, subkeys
+        kc = test.get("key_count") or DEFAULT_KEY_COUNT
+        try:
+            if op["f"] == "write":
+                for sk in subkeys(kc, op["value"]):
+                    node = node_for_key(test, sk)
+                    self.kv._round_trip(test, node, f"W {sk} 1")
+                return {**op, "type": "ok"}
+            if op["f"] == "read":
+                k = op["value"][0]
+                out = []
+                for sk in reversed(subkeys(kc, k)):
+                    node = node_for_key(test, sk)
+                    got = self.kv._round_trip(test, node, f"R {sk}")
+                    val = got.split(" ", 1)[1]
+                    out.append(None if val == "nil" else sk)
+                return {**op, "type": "ok",
+                        "value": [k, out]}
+            raise ValueError(f"unknown op {op['f']!r}")
+        except (OSError, ConnectionError) as e:
+            t = "fail" if op["f"] == "read" else "info"
+            return {**op, "type": t, "error": str(e)}
+
+    def close(self, test):
+        self.kv.close(test)
+
+
 def kill_restart_nemesis(db: ToyKVDB):
     """Kill the server on a random node on :start, restart on :stop
     (node_start_stopper, nemesis.clj:452-495)."""
@@ -304,15 +347,30 @@ def kill_restart_nemesis(db: ToyKVDB):
 
 def toykv_test(options: dict) -> dict:
     """Build the full test map from CLI options (zookeeper.clj
-    zk-test)."""
+    zk-test). `workload`: register (default) or sequential."""
     nodes = options["nodes"]
     volatile = bool(options.get("volatile"))
     db = ToyKVDB(volatile=volatile)
-    w = linearizable_register.workload(
-        {"nodes": nodes,
-         "concurrency": options["concurrency"],
-         "per_key_limit": options.get("per_key_limit") or 40,
-         "algorithm": "competition"})
+    which = options.get("workload") or "register"
+    extra: dict = {}
+    if which == "sequential":
+        from ..workloads import sequential
+        # writers take half the worker threads, so at least one reader
+        # exists at any concurrency >= 2 (all-writer runs would make
+        # the checker pass vacuously)
+        n_writers = max(1, int(options["concurrency"]) // 2)
+        w = sequential.workload({"n_writers": n_writers})
+        client: jclient.Client = ToyKVSeqClient()
+        extra["key_count"] = w["key_count"]
+    elif which == "register":
+        w = linearizable_register.workload(
+            {"nodes": nodes,
+             "concurrency": options["concurrency"],
+             "per_key_limit": options.get("per_key_limit") or 40,
+             "algorithm": "competition"})
+        client = ToyKVClient()
+    else:
+        raise ValueError(f"unknown workload {which!r}")
     nem_interval = options.get("nemesis_interval") or 10.0
     return {
         "name": options.get("name") or "toykv",
@@ -323,10 +381,10 @@ def toykv_test(options: dict) -> dict:
                                    or "toykv-cluster"),
         "ssh": {"dummy?": False},
         "db": db,
-        "client": ToyKVClient(),
+        "client": client,
         "nemesis": kill_restart_nemesis(db),
         "checker": jchecker.compose({
-            "independent": w["checker"],
+            which: w["checker"],
             "stats": jchecker.unhandled_exceptions(),
             "logs": jchecker.log_file_pattern(r"Traceback", LOGFILE),
         }),
@@ -338,6 +396,7 @@ def toykv_test(options: dict) -> dict:
                            gen.sleep(nem_interval),
                            {"type": "info", "f": "stop"}]),
                 w["generator"])),
+        **extra,
     }
 
 
@@ -355,6 +414,9 @@ TOYKV_OPTS = [
             help="Run servers without the recovery log (kill -9 then "
                  "loses acknowledged writes; the checker should "
                  "catch it)"),
+    cli.Opt("workload", metavar="NAME", default="register",
+            help="register (independent cas-register) or sequential "
+                 "(ordered subkey visibility)"),
 ]
 
 def toykv_tests(options: dict):
