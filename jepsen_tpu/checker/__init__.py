@@ -258,12 +258,17 @@ class Linearizable(Checker):
         return res
 
 
-def _race_competition(model, h, time_limit):
+def _race_competition(model, h, time_limit, device=None,
+                      max_configs=None, enc=None):
     """knossos.competition semantics: run the device search and the
     host oracle CONCURRENTLY; the first definitive verdict wins and
     cancels the loser (serial device-then-oracle left pathological
     cases — e.g. wide-window histories trivial for the oracle's DFS —
-    paying the full device cost first)."""
+    paying the full device cost first).
+
+    `device` pins the device-engine thread (jax.default_device is
+    thread-local, so a caller's pin would not reach it otherwise);
+    `max_configs`/`enc` pass through to the device search."""
     import threading
 
     from ..ops import wgl_ref
@@ -306,13 +311,22 @@ def _race_competition(model, h, time_limit):
 
     from ..ops import wgl as wgl_tpu
 
-    def device():
+    def device_engine():
         # bare verdict — diagnostics are enriched AFTER the race so a
         # device False publishes (and cancels the oracle) immediately
-        return wgl_tpu.check(model, h, time_limit=time_limit,
-                             stop=winner.is_set)
+        import contextlib
 
-    threads = [arm("device", device), arm("oracle", oracle)]
+        import jax
+        kw = {}
+        if max_configs is not None:
+            kw["max_configs"] = max_configs
+        pin = (jax.default_device(device) if device is not None
+               else contextlib.nullcontext())
+        with pin:
+            return wgl_tpu.check(model, h, time_limit=time_limit,
+                                 stop=winner.is_set, enc=enc, **kw)
+
+    threads = [arm("device", device_engine), arm("oracle", oracle)]
     for t in threads:
         t.start()
     res: dict = {}

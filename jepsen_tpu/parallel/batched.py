@@ -168,8 +168,8 @@ def check_streamed(model: Model, histories: Sequence[History],
                    time_limit: Optional[float] = None,
                    max_configs: int = 50_000_000,
                    oracle_fallback: bool = True,
-                   encs: Optional[Sequence[Encoded]] = None
-                   ) -> list[dict]:
+                   encs: Optional[Sequence[Encoded]] = None,
+                   race: Optional[bool] = None) -> list[dict]:
     """Per-key single-kernel checks fanned out over the visible devices
     by a thread pool (one worker per device, `jax.default_device`
     pinning). This is the fast path for *large* per-key histories: the
@@ -186,6 +186,19 @@ def check_streamed(model: Model, histories: Sequence[History],
     deadline = _time.monotonic() + time_limit if time_limit else None
     devices = jax.devices()
     results: list[Optional[dict]] = [None] * len(histories)
+    if race and not oracle_fallback:
+        raise ValueError(
+            "race=True requires oracle_fallback (racing IS the oracle "
+            "running concurrently); pass race=False to see raw device "
+            "verdicts")
+    if race is None:
+        # On a real accelerator the host CPU is otherwise idle, so
+        # racing the per-key device search against the host oracle
+        # takes whichever engine wins each key for free; on a CPU
+        # backend both engines would contend for the same cores, so
+        # the direct device path (with oracle fallback) stays faster.
+        race = oracle_fallback and \
+            jax.default_backend() not in ("cpu",)
 
     def one(dev, i_hist):
         remaining = None
@@ -196,6 +209,12 @@ def check_streamed(model: Model, histories: Sequence[History],
                         "op_count": len(histories[i_hist])}
         try:
             with jax.default_device(dev):
+                if race:
+                    from ..checker import _race_competition
+                    return _race_competition(
+                        model, histories[i_hist], remaining,
+                        device=dev, max_configs=max_configs,
+                        enc=encs[i_hist] if encs else None)
                 res = wgl.check(model, histories[i_hist],
                                 time_limit=remaining,
                                 max_configs=max_configs,

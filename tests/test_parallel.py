@@ -137,3 +137,24 @@ def test_multihost_shaped_mesh():
              for s in range(8)]
     res = check_batched(models.cas_register(), hists, mesh=mesh)
     assert [r["valid?"] for r in res] == [True] * 8
+
+
+def test_streamed_race_mode():
+    """race=True streams each key through the competition race (the
+    accelerator-backend default); verdicts match the direct path."""
+    from jepsen_tpu.parallel import check_streamed
+
+    hists = [synth.cas_register_history(300, n_procs=3, seed=s,
+                                        lie_p=(0.05 if s == 1 else 0))
+             for s in range(3)]
+    res = check_streamed(models.cas_register(), hists, race=True)
+    assert [r["valid?"] for r in res] == [True, False, True]
+    assert all(r.get("engine") in ("device", "oracle") for r in res)
+
+
+def test_streamed_race_rejects_no_fallback():
+    from jepsen_tpu.parallel import check_streamed
+    with pytest.raises(ValueError):
+        check_streamed(models.cas_register(),
+                       [synth.cas_register_history(40, seed=0)],
+                       race=True, oracle_fallback=False)
