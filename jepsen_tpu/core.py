@@ -16,6 +16,7 @@ store (3-phase save), and logs a human verdict (core.clj:239-252).
 from __future__ import annotations
 
 import logging
+import os
 import time as _time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Optional
@@ -78,7 +79,6 @@ def snarf_logs(test: dict) -> None:
     store_dir = test.get("store_dir")
     if not isinstance(db, jdb.LogFiles) or not store_dir:
         return
-    import os
 
     def snarf(t, node):
         from .control import nodeutil as cu
@@ -205,6 +205,19 @@ def run(test: dict) -> dict:
                         writer.save_2(test)
         return log_results(test)
     finally:
+        # a test-map tracer's spans land in the run dir (the dgraph
+        # suites' span-export artifact, trace.clj + trace.py) — in the
+        # outer finally so crashed runs (when the trace matters most)
+        # still export, and guarded so a broken tracer can't void the
+        # run's other artifacts
+        tracer = test.get("tracer")
+        if tracer is not None and writer:
+            try:
+                n = tracer.export(os.path.join(writer.dir,
+                                               "trace.jsonl"))
+                log.info("Exported %d spans", n)
+            except Exception:  # noqa: BLE001
+                log.warning("trace export failed", exc_info=True)
         if writer:
             store.stop_logging()
             writer.close()

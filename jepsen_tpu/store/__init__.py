@@ -21,7 +21,7 @@ BASE_DIR = "store"
 # Test-map keys that are live objects, not data (store.clj:92-100).
 NONSERIALIZABLE_KEYS = ("db", "os", "net", "client", "nemesis", "checker",
                         "generator", "remote", "sessions", "store_writer",
-                        "model")
+                        "model", "tracer")
 
 
 def serializable_test(test: dict) -> dict:

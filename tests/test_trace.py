@@ -87,3 +87,24 @@ def test_traced_client_end_to_end(tmp_path):
     assert len(spans) >= len(completions)
     path = str(tmp_path / "trace.jsonl")
     assert tracer.export(path) == len(tracer.spans)
+
+
+def test_run_exports_tracer_artifact(tmp_path):
+    """A test map carrying a tracer gets trace.jsonl in the run dir."""
+    tracer = trace.Tracer()
+    reg = SharedRegister()
+    t = noop_test()
+    t.update({
+        "name": "traced-artifact",
+        "store_root": str(tmp_path / "store"),
+        "ssh": {"dummy?": True},
+        "tracer": tracer,
+        "client": trace.TracedClient(AtomClient(reg), tracer),
+        "concurrency": 2, "time_limit": 1.0,
+        "generator": gen.limit(10, gen.clients(gen.mix(
+            [lambda t_, c: {"f": "read", "value": None}]))),
+    })
+    done = core.run(t)
+    path = f"{done['store_dir']}/trace.jsonl"
+    rows = [json.loads(l) for l in open(path)]
+    assert rows and all("spanId" in r for r in rows)
