@@ -15,7 +15,9 @@ one gates the JAX WGL kernel behind `algorithm="tpu-wgl"`.
 
 from __future__ import annotations
 
+import logging
 import threading
+import time
 import traceback
 from typing import Any, Callable, Iterable, Optional
 
@@ -269,11 +271,62 @@ def _race_competition(model, h, time_limit, device=None,
     `device` pins the device-engine thread (jax.default_device is
     thread-local, so a caller's pin would not reach it otherwise);
     `max_configs`/`enc` pass through to the device search."""
+    import importlib.util
+    import queue
     import threading
 
     from ..ops import wgl_ref
 
-    import queue
+    if importlib.util.find_spec("jax") is None:
+        # no accelerator stack at all: the quiet, expected path — the
+        # oracle decides alone, no doomed thread, no warning spam
+        # (ops.wgl itself imports jax lazily, so probing the module
+        # spec is the only reliable availability check)
+        return wgl_ref.check(model, h, time_limit=time_limit)
+
+    from ..ops import wgl as wgl_tpu
+    from ..util import safe_backend
+
+    def run_device(budget, stop=None):
+        """The device engine under the caller's device pin — the single
+        place the pin/kwargs policy lives (raced AND serial paths)."""
+        import contextlib
+
+        import jax
+        kw = {}
+        if max_configs is not None:
+            kw["max_configs"] = max_configs
+        pin = (jax.default_device(device) if device is not None
+               else contextlib.nullcontext())
+        with pin:
+            return wgl_tpu.check(model, h, time_limit=budget,
+                                 stop=stop, enc=enc, **kw)
+
+    if safe_backend() == "cpu" and time_limit is not None:
+        # On a CPU backend both engines contend for the same cores (and
+        # the pure-Python oracle for the GIL), so racing buys nothing —
+        # the same policy batched.py applies to its per-key race. Run
+        # serially instead: device kernel on half the budget (it wins
+        # by orders of magnitude on narrow-window shapes), oracle on
+        # the remainder (it wins the wide/near-serial shapes the kernel
+        # declines or grinds on). `stop` stays None — nothing races.
+        t0 = time.monotonic()
+        try:
+            r = run_device(time_limit / 2)
+        except Exception:  # noqa: BLE001 — encode/step failures
+            logging.getLogger(__name__).warning(
+                "device engine failed in serial competition",
+                exc_info=True)
+            r = {"valid?": UNKNOWN, "cause": "engine-error"}
+        if r.get("valid?") != UNKNOWN:
+            r["engine"] = "device"
+            return wgl_tpu.enrich_diagnostics(model, h, r,
+                                              time_limit=10.0)
+        left = max(1.0, time_limit - (time.monotonic() - t0))
+        r = wgl_ref.check(model, h, time_limit=left)
+        if r.get("valid?") != UNKNOWN:
+            r["engine"] = "oracle"
+        return r
 
     winner = threading.Event()
     outcomes: queue.Queue = queue.Queue()
@@ -283,7 +336,6 @@ def _race_competition(model, h, time_limit, device=None,
             try:
                 r = fn()
             except Exception:  # noqa: BLE001 — device init failure etc.
-                import logging
                 logging.getLogger(__name__).warning(
                     "%s engine failed in competition", name,
                     exc_info=True)
@@ -301,30 +353,10 @@ def _race_competition(model, h, time_limit, device=None,
         return wgl_ref.check(model, h, time_limit=time_limit,
                              stop=winner.is_set)
 
-    import importlib.util
-    if importlib.util.find_spec("jax") is None:
-        # no accelerator stack at all: the quiet, expected path — the
-        # oracle decides alone, no doomed thread, no warning spam
-        # (ops.wgl itself imports jax lazily, so probing the module
-        # spec is the only reliable availability check)
-        return wgl_ref.check(model, h, time_limit=time_limit)
-
-    from ..ops import wgl as wgl_tpu
-
     def device_engine():
         # bare verdict — diagnostics are enriched AFTER the race so a
         # device False publishes (and cancels the oracle) immediately
-        import contextlib
-
-        import jax
-        kw = {}
-        if max_configs is not None:
-            kw["max_configs"] = max_configs
-        pin = (jax.default_device(device) if device is not None
-               else contextlib.nullcontext())
-        with pin:
-            return wgl_tpu.check(model, h, time_limit=time_limit,
-                                 stop=winner.is_set, enc=enc, **kw)
+        return run_device(time_limit, stop=winner.is_set)
 
     threads = [arm("device", device_engine), arm("oracle", oracle)]
     for t in threads:

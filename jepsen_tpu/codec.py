@@ -12,12 +12,30 @@ import json
 from typing import Any, Optional
 
 
+def _check_keys(o: Any) -> None:
+    """json.dumps silently coerces non-str dict keys (1 -> "1"), which
+    would break decode(encode(o)) == o without an error — reject them
+    up front instead."""
+    if isinstance(o, dict):
+        for k, v in o.items():
+            if not isinstance(k, str):
+                raise TypeError(
+                    f"codec.encode: non-string dict key {k!r} would not "
+                    "round-trip (json object keys are strings)")
+            _check_keys(v)
+    elif isinstance(o, (list, tuple)):
+        for v in o:
+            _check_keys(v)
+
+
 def encode(o: Any) -> bytes:
     """Serialize an object to bytes (codec.clj:9-16). Non-JSON-native
-    values raise TypeError — silent str() coercion would break the
+    values — including dicts with non-string keys, which json would
+    silently coerce — raise TypeError: silent coercion would break the
     decode(encode(o)) == o round-trip."""
     if o is None:
         return b""
+    _check_keys(o)
     return json.dumps(o, separators=(",", ":"), sort_keys=True).encode()
 
 

@@ -35,11 +35,15 @@ bf16 safety: matmul entries count paths (up to N); bf16 rounds integers
 above 256, but every addend is >= 0 and rounding is to-nearest, so a
 positive sum can never round to zero — and only (sum > 0) is consumed.
 
-Capacity: dense (S, N, N) closure is the right trade below ~8k txns
-(64 MB per subset matrix at 8192^2 bf16; one squaring is ~2 * 8192^3
-flops =~ 1.1 TFLOP, sub-10 ms on a v5e MXU). Histories past the cap —
-BASELINE's independent configs shard per key long before that — fall
-back to the host oracle, recorded in the result.
+Capacity: dense (S, N, N) closure is the right trade below ~8k txns.
+At the 8192 cap each bf16 subset matrix is 8192^2 * 2 B = 128 MiB, and
+the kernel holds S=3 of them plus the f32 einsum product and the
+mutual/transpose temporaries — peak live bytes ~1 GiB, comfortably
+inside a v5e's 16 GiB HBM. One squaring is ~2 * 3 * 8192^3 flops
+=~ 3.3 TFLOP across the batch, ~17 ms at v5e bf16 peak (197 TFLOP/s).
+Histories past the cap — BASELINE's independent configs shard per key
+long before that — fall back to the host oracle, recorded in the
+result.
 """
 
 from __future__ import annotations
@@ -195,13 +199,20 @@ def standard_cycle_search(g: DepGraph, backend: str = "host",
     if backend == "auto":
         # The dense closure only pays off on a real accelerator: 12
         # squarings of (4096)^3 matmuls are milliseconds on the MXU but
-        # minutes on a CPU host, where Tarjan wins at any size. A
-        # missing/broken jax install must not break the pure-host path.
-        try:
-            import jax
-            on_accel = jax.default_backend() not in ("cpu",)
-        except Exception:  # noqa: BLE001
-            on_accel = False
+        # minutes on a CPU host, where Tarjan wins at any size. The
+        # probe must never *initialize* a backend here — a wedged
+        # accelerator runtime hangs init rather than raising, and this
+        # is an in-process hot path — so it answers only from safe
+        # sources (env pin / already-initialized backend / explicit
+        # platform config) and defaults to host when unknown.
+        import importlib.util
+
+        from ..util import safe_backend
+        plat = safe_backend()
+        # a stale env pin must not route device-ward when jax itself is
+        # missing/broken — the pure-host path has no jax dependency
+        on_accel = (plat is not None and plat != "cpu"
+                    and importlib.util.find_spec("jax") is not None)
         backend = "tpu" if (on_accel and len(g.nodes) >= 512
                             and len(g) >= 512) else "host"
         engine = backend

@@ -66,6 +66,55 @@ def integer_interval_set_str(xs: Iterable) -> str:
     return "#{" + " ".join(parts) + "}"
 
 
+def safe_backend() -> Optional[str]:
+    """The jax default-backend platform, determined WITHOUT risking a
+    hung backend init.
+
+    ``jax.default_backend()`` initializes the default backend on first
+    call; when that default is a wedged accelerator runtime (the exact
+    failure bench.py probes for in a subprocess), init *hangs* rather
+    than raising — so callers on a hot path must never trigger it just
+    to ask "am I on an accelerator?". This helper answers from safe
+    sources only, in precedence order:
+
+      1. the ``JEPSEN_TPU_PLATFORM`` env pin, if set;
+      2. the already-initialized default backend, if any backend has
+         been initialized in this process (then ``default_backend()``
+         is a dict lookup, not an init);
+      3. an explicit ``jax.config.jax_platforms`` /  ``JAX_PLATFORMS``
+         pin (init would honor it, so the *name* is known without
+         initializing);
+      4. otherwise ``None`` — unknown; callers should take their
+         conservative path (elle auto-routing defaults to host).
+    """
+    import os
+
+    pin = os.environ.get("JEPSEN_TPU_PLATFORM")
+    if pin:
+        return pin.split(",")[0].strip() or None
+    try:
+        from jax._src import xla_bridge
+        # read the post-init module global directly — NEVER
+        # jax.default_backend(), which takes the backend-init lock and
+        # deadlocks when another thread is mid-init (or hung in it)
+        b = getattr(xla_bridge, "_default_backend", None)
+        if b is not None:
+            return str(b.platform)
+    except Exception:  # noqa: BLE001 — private API moved / no jax
+        pass
+    try:
+        import jax
+        cfg = jax.config.jax_platforms  # None unless explicitly pinned
+        if cfg:
+            return str(cfg).split(",")[0].strip() or None
+    except Exception:  # noqa: BLE001
+        pass
+    env = os.environ.get("JAX_PLATFORMS", "")
+    if env:
+        return env.split(",")[0].strip() or None
+    return None
+
+
 def real_pmap(f: Callable, coll: Sequence) -> list:
     """Apply f to every element in its own thread; wait for all; raise the
     most interesting exception if any failed (jepsen.util/real-pmap parity,

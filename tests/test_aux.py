@@ -23,6 +23,26 @@ def test_codec_deterministic():
     assert codec.encode({"b": 1, "a": 2}) == codec.encode({"a": 2, "b": 1})
 
 
+def test_codec_rejects_nonstr_dict_keys():
+    # json.dumps would silently coerce 1 -> "1", breaking the
+    # decode(encode(o)) == o contract; the codec must raise instead.
+    for bad in ({1: "a"}, {"ok": {2: "b"}}, [{"x": 1}, {(): "t"}]):
+        with pytest.raises(TypeError):
+            codec.encode(bad)
+
+
+def test_safe_backend_answers_without_init(monkeypatch):
+    from jepsen_tpu import util
+
+    # env pin wins over everything and never touches jax
+    monkeypatch.setenv("JEPSEN_TPU_PLATFORM", "tpu")
+    assert util.safe_backend() == "tpu"
+    monkeypatch.delenv("JEPSEN_TPU_PLATFORM")
+    # under the test conftest the cpu platform is pinned/initialized,
+    # so the probe resolves to cpu without a fresh init
+    assert util.safe_backend() == "cpu"
+
+
 def test_report_to(tmp_path, capsys):
     path = str(tmp_path / "sub" / "set.txt")
     with report.to(path):
