@@ -306,13 +306,25 @@ def _race_competition(model, h, time_limit, device=None,
         # On a CPU backend both engines contend for the same cores (and
         # the pure-Python oracle for the GIL), so racing buys nothing —
         # the same policy batched.py applies to its per-key race. Run
-        # serially instead: device kernel on half the budget (it wins
-        # by orders of magnitude on narrow-window shapes), oracle on
-        # the remainder (it wins the wide/near-serial shapes the kernel
-        # declines or grinds on). `stop` stays None — nothing races.
+        # serially instead: device kernel first on a quarter of the
+        # budget (when it wins it wins by orders of magnitude, so a
+        # slice suffices; cpu compiles are seconds, not the TPU's
+        # 20-40 s), then the oracle on at least half the nominal budget
+        # — so a shape the oracle could decide under the old
+        # full-budget race still gets a fair run. `stop` stays None.
         t0 = time.monotonic()
         try:
-            r = run_device(time_limit / 2)
+            # Wide windows are the cpu kernel's worst case (the
+            # (K, W, 2W) gather machinery is why the batched path
+            # routes wide shapes to the oracle on cpu too): don't
+            # burn the budget grinding a shape the device cannot win
+            # on this backend — the oracle's DFS takes it whole.
+            from ..ops.encode import encode as _enc
+            e = enc if enc is not None else _enc(model, h)
+            if e.window_raw > 128:
+                r = {"valid?": UNKNOWN, "cause": "cpu-wide-window"}
+            else:
+                r = run_device(time_limit / 4)
         except Exception:  # noqa: BLE001 — encode/step failures
             logging.getLogger(__name__).warning(
                 "device engine failed in serial competition",
@@ -322,7 +334,8 @@ def _race_competition(model, h, time_limit, device=None,
             r["engine"] = "device"
             return wgl_tpu.enrich_diagnostics(model, h, r,
                                               time_limit=10.0)
-        left = max(1.0, time_limit - (time.monotonic() - t0))
+        left = max(time_limit / 2,
+                   time_limit - (time.monotonic() - t0))
         r = wgl_ref.check(model, h, time_limit=left)
         if r.get("valid?") != UNKNOWN:
             r["engine"] = "oracle"

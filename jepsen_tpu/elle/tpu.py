@@ -80,7 +80,12 @@ def _bucket(n: int) -> int:
 @lru_cache(maxsize=32)
 def _compiled(n_pad: int, e_pad: int, q_pad: int, n_sub: int,
               iters: int):
-    """The jitted closure kernel for one shape bucket."""
+    """The closure kernel for one shape bucket, AOT-compiled so the
+    compile cost is measured here (once per bucket) and callers time
+    pure execution — no double-run for telemetry. Returns
+    (compiled_fn, compile_s)."""
+    import time as _t
+
     import jax
     import jax.numpy as jnp
 
@@ -109,7 +114,14 @@ def _compiled(n_pad: int, e_pad: int, q_pad: int, n_sub: int,
         closed = rb[:, q_dst, q_src]
         return labels.astype(jnp.int32), closed
 
-    return jax.jit(kernel)
+    specs = (jax.ShapeDtypeStruct((e_pad,), jnp.int32),
+             jax.ShapeDtypeStruct((e_pad,), jnp.int32),
+             jax.ShapeDtypeStruct((n_sub, e_pad), jnp.float32),
+             jax.ShapeDtypeStruct((q_pad,), jnp.int32),
+             jax.ShapeDtypeStruct((q_pad,), jnp.int32))
+    t0 = _t.monotonic()
+    compiled = jax.jit(kernel).lower(*specs).compile()
+    return compiled, _t.monotonic() - t0
 
 
 def cycle_queries(g: DepGraph,
@@ -163,8 +175,26 @@ def cycle_queries(g: DepGraph,
     q_dst_p = pad(q_dst, q_pad, n_pad - 2)
 
     iters = max(1, math.ceil(math.log2(n_pad)))
-    kernel = _compiled(n_pad, e_pad, q_pad, n_sub, iters)
-    labels, closed = kernel(src_p, dst_p, w_p, q_src_p, q_dst_p)
+    kernel, compile_s = _compiled(n_pad, e_pad, q_pad, n_sub, iters)
+    import time as _t
+
+    import jax
+    t0 = _t.monotonic()
+    labels, closed = kernel(np.asarray(src_p, np.int32),
+                            np.asarray(dst_p, np.int32),
+                            np.asarray(w_p, np.float32),
+                            np.asarray(q_src_p, np.int32),
+                            np.asarray(q_dst_p, np.int32))
+    jax.block_until_ready((labels, closed))
+    kernel_s = _t.monotonic() - t0
+    # Achieved matmul throughput vs the flop model in the module
+    # docstring: iters squarings x n_sub batched (n_pad)^3 matmuls.
+    flops = 2.0 * n_sub * iters * float(n_pad) ** 3
+    util = {"n_pad": n_pad, "iters": iters,
+            "kernel_s": round(kernel_s, 4),
+            "compile_s": round(compile_s, 3),
+            "achieved_tflops": round(flops / 1e12 / max(kernel_s, 1e-9),
+                                     2)}
     labels = np.asarray(labels)[:, :n]
     closed = np.asarray(closed)[:, :len(rw_edges)]
 
@@ -177,7 +207,8 @@ def cycle_queries(g: DepGraph,
                 comps.setdefault(lab, [int(nodes[lab])]).append(
                     int(nodes[i]))
         sccs.append([sorted(c) for c in comps.values()])
-    return {"sccs": sccs, "rw_edges": rw_edges, "rw_closed": closed}
+    return {"sccs": sccs, "rw_edges": rw_edges, "rw_closed": closed,
+            "util": util}
 
 
 def standard_cycle_search(g: DepGraph, backend: str = "host",
@@ -221,7 +252,7 @@ def standard_cycle_search(g: DepGraph, backend: str = "host",
         if res is None:
             backend = engine = "host-fallback"  # over capacity
         else:
-            out: dict = {"engine": "tpu"}
+            out: dict = {"engine": "tpu", "util": res["util"]}
             for name, si, sub in (("G0", 0, s0), ("G1c", 1, s1)):
                 cyc = None
                 for comp in res["sccs"][si]:

@@ -107,6 +107,8 @@ def check(history: History, anomalies: Iterable[str] = DEFAULT_ANOMALIES,
            "cycle-engine": cycles.get("engine"),
            "not": sorted({MODEL_VIOLATIONS[a] for a in reported
                           if a in MODEL_VIOLATIONS})}
+    if cycles.get("util"):
+        out["cycle-util"] = cycles["util"]
     if silent:
         out["unchecked-anomaly-types"] = sorted(silent)
     return out

@@ -104,7 +104,9 @@ def _build_search(n_pad: int, ic_pad: int, W: int, S: int, O: int,
         bk_cnt = jnp.int32(0)
         table = jnp.zeros((H, 4), dtype=jnp.uint32)
         flags = jnp.zeros(3, dtype=bool)  # found, overflow, exhausted
-        stats = jnp.zeros(3, dtype=jnp.int32)  # explored, rounds, max_base
+        # explored, rounds-in-chunk, max_base, memo_hits, inserted,
+        # rounds_total — the last three feed the result's util block
+        stats = jnp.zeros(6, dtype=jnp.int32)
         return (fr_base, fr_win, fr_info, fr_mst, fr_cnt,
                 bk_base, bk_win, bk_info, bk_mst, bk_cnt,
                 table, flags, stats)
@@ -282,7 +284,14 @@ def _build_search(n_pad: int, ic_pad: int, W: int, S: int, O: int,
         nstats = jnp.stack([
             stats[0] + fr_cnt,
             stats[1] + 1,
-            jnp.maximum(stats[2], jnp.max(jnp.where(legal, base_s, 0)))])
+            jnp.maximum(stats[2], jnp.max(jnp.where(legal, base_s, 0))),
+            # dedup hits: memo-table "seen" plus same-round duplicates
+            # removed by the sort (all-equal-length paths arrive in the
+            # same round, so sort-dedup is the hot dedup path here)
+            stats[3] + jnp.sum(seen.astype(jnp.int32))
+            + jnp.sum((ex_s & samep).astype(jnp.int32)),
+            stats[4] + total,
+            stats[5] + 1])
         return (nfr_base, nfr_win, nfr_info, nfr_mst, nfr_cnt,
                 bk_base, bk_win, bk_info, bk_mst, nbk_cnt,
                 table, nflags, nstats)
@@ -327,28 +336,43 @@ def _pad_to_mult(n: int, m: int) -> int:
 
 
 def _pick_capacities(W: int, ic_pad: int, n: int):
-    """Frontier capacity K and memo-table size H scaled to the problem.
-    The (K, W, 2W) successor intermediate is the memory driver for the
-    general kernel; the memo table must stay well under ~60% load or
-    probe-based dedup degrades into re-exploration (each slot is 16
-    bytes, so even 2^23 slots is only 128 MB)."""
-    budget = 32 * 1024 * 1024  # bool elements
-    # Wide windows (Porcupine-style long tails, W up to 1024) shrink the
-    # frontier instead of overflowing memory: the backlog absorbs the
-    # lost breadth, so only throughput degrades, never soundness.
+    """Frontier capacity K, memo-table size H, backlog B scaled to the
+    problem AND the platform. The (K, W, 2W) successor intermediate is
+    the memory driver for the general kernel; the memo table must stay
+    well under ~60% load or probe-based dedup degrades into
+    re-exploration (each slot is 16 bytes, so even 2^23 slots is only
+    128 MB)."""
+    from ..util import safe_backend
+
+    # An accelerator's HBM affords a much wider beam than host RAM —
+    # and beam width is the general kernel's throughput knob (configs
+    # decided per round scale ~linearly with K at fixed round cost on
+    # the TPU, where the (K, W, 2W) gathers are bandwidth-cheap).
+    accel = safe_backend() not in (None, "cpu")
+    budget = (256 if accel else 32) * 1024 * 1024  # bool elements
     K = max(16, min(4096, budget // max(1, 2 * W * W)))
     K = 1 << (K.bit_length() - 1)
-    if n > 5000:
+    if W > 32 or n > 5000:
+        # Wide windows: reachable-config count scales with the
+        # window's branching power (2^concurrency), not op count — a
+        # 200-op adversarial history reaches millions of configs. An
+        # undersized table degrades into ~2x re-exploration (measured
+        # on the wave benchmark: H=2^19 at 850k configs).
         H = 1 << 23
     elif n > 2000:
         H = 1 << 22
     else:
         H = 1 << 19
     # Backlog absorbs beam spill; overflow degrades False -> unknown.
-    # The caller widens it for the fast path (where escalation to
-    # _K_BIG spills hard and a packed row is cheap); a general-kernel
-    # row is (W + ic_pad) unpacked bools, so stay at 2^16 there.
-    B = 1 << 16
+    # Wide windows carry wide BFS wavefronts (C(w, w/2)-scale), so the
+    # backlog scales with a byte budget over the row width (a general-
+    # kernel row is (W + ic_pad) unpacked bools); the fast path's
+    # packed rows are cheap and its caller widens B separately.
+    if W > 32:
+        B = min(1 << 19, max(1 << 16, (64 << 20) // max(W, 1)))
+        B = 1 << (B.bit_length() - 1)
+    else:
+        B = 1 << 16
     return K, H, B
 
 
@@ -437,11 +461,13 @@ def check(model: Model, history: History, time_limit: Optional[float] = None,
         ic_eff = min(ic_eff, ic_pad)
         iinv, iopc = iinv[:ic_eff], iopc[:ic_eff]
         B = 1 << 18  # packed rows are cheap; escalation spills hard
+        probes_used, row_cols = 4, W_eff + ic_eff
         init_fn, chunk_jit = compiled_search32(
             n_pad=len(enc.inv), ic_pad=ic_eff,
             S=enc.table.shape[0], O=enc.table.shape[1],
             K=K, H=H, B=B, chunk=chunk, probes=4, W=W_eff)
     else:
+        probes_used, row_cols = 16, W + ic_pad
         init_fn, chunk_jit = _compiled_search(
             n_pad=len(enc.inv), ic_pad=ic_pad, W=W,
             S=enc.table.shape[0], O=enc.table.shape[1],
@@ -475,8 +501,29 @@ def check(model: Model, history: History, time_limit: Optional[float] = None,
                 K=_K_BIG, H=H, B=B, chunk=chunk, probes=4, W=W_eff)
             carry = _widen_frontier(carry, _K_BIG)
             K = _K_BIG
+        wall = _time.monotonic() - t0
+        rounds_total = int(stats[5])
+        memo_hits, inserted = int(stats[3]), int(stats[4])
+        # Utilization accounting (what the device actually did): the
+        # kernel is gather/scatter-bound, so the roofline currency is
+        # successor rows processed and memo-table bytes touched per
+        # round, not FLOPs. R = K * row_cols rows/round; each row costs
+        # ~probes x 16 B of table traffic (the dominant stream) plus
+        # its own pack/hash/sort. frontier_fill is the average fraction
+        # of the beam occupied (approximate across escalation).
+        util = {
+            "configs_per_s": int(total_explored / max(wall, 1e-9)),
+            "rounds": rounds_total,
+            "frontier_fill": round(
+                total_explored / max(rounds_total * K, 1), 4),
+            "memo_hit_rate": round(
+                memo_hits / max(memo_hits + inserted, 1), 4),
+            "succ_rows_per_round": K * row_cols,
+            "est_table_mb_per_round": round(
+                K * row_cols * 16 * probes_used / 1e6, 3),
+        }
         detail = {"W": W, "K": K, "configs_explored": total_explored,
-                  "wall_s": round(_time.monotonic() - t0, 4)}
+                  "wall_s": round(wall, 4), "util": util}
         if found:
             return {"valid?": True, "op_count": n + enc.n_info, **detail}
         if fr_cnt == 0:

@@ -92,7 +92,9 @@ def _build_search32(n_pad: int, ic_pad: int, S: int, O: int,
         bk_cnt = jnp.int32(0)
         table = jnp.zeros((H, 4), dtype=jnp.uint32)
         flags = jnp.zeros(3, dtype=bool)   # found, overflow, exhausted
-        stats = jnp.zeros(3, dtype=jnp.int32)  # explored, rounds, max_base
+        # explored, rounds-in-chunk, max_base, memo_hits, inserted,
+        # rounds_total — the last three feed the result's util block
+        stats = jnp.zeros(6, dtype=jnp.int32)
         return (fr_base, fr_win, fr_info, fr_mst, fr_cnt,
                 bk_base, bk_win, bk_info, bk_mst, bk_cnt,
                 table, flags, stats)
@@ -256,7 +258,10 @@ def _build_search32(n_pad: int, ic_pad: int, S: int, O: int,
         nstats = jnp.stack([
             stats[0] + fr_cnt,
             stats[1] + 1,
-            jnp.maximum(stats[2], jnp.max(jnp.where(legal, base_s, 0)))])
+            jnp.maximum(stats[2], jnp.max(jnp.where(legal, base_s, 0))),
+            stats[3] + jnp.sum(seen.astype(jnp.int32)),
+            stats[4] + total,
+            stats[5] + 1])
         return (nfr_base, nfr_win, nfr_info, nfr_mst, nfr_cnt,
                 bk_base, bk_win, bk_info, bk_mst, nbk_cnt,
                 table, nflags, nstats)

@@ -376,7 +376,7 @@ def check_batched(model: Model, histories: Sequence[History],
     while True:
         carry = vchunk(consts, carry)
         flags = np.asarray(carry[11])       # (Bk, 3)
-        stats = np.asarray(carry[12])       # (Bk, 3)
+        stats = np.asarray(carry[12])       # (Bk, 6)
         fr_cnt = np.asarray(carry[4])       # (Bk,)
         found = flags[:, 0]
         empty = fr_cnt == 0
@@ -394,9 +394,17 @@ def check_batched(model: Model, histories: Sequence[History],
     for lane, hist_i in enumerate(lanes):
         e = encs[lane]
         n_total = int(e.n_ok + e.n_info)
+        hits, ins = int(stats[lane, 3]), int(stats[lane, 4])
+        rounds = int(stats[lane, 5])
         detail = {"W": W, "K": K,
                   "configs_explored": int(stats[lane, 0]),
-                  "batch_keys": batch.n_keys, "batch_wall_s": round(wall, 4)}
+                  "batch_keys": batch.n_keys, "batch_wall_s": round(wall, 4),
+                  "util": {
+                      "rounds": rounds,
+                      "frontier_fill": round(
+                          int(stats[lane, 0]) / max(rounds * K, 1), 4),
+                      "memo_hit_rate": round(
+                          hits / max(hits + ins, 1), 4)}}
         if found[lane]:
             res = {"valid?": True, "op_count": n_total, **detail}
         elif empty[lane] and not overflow[lane]:

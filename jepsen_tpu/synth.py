@@ -222,6 +222,79 @@ def long_tail_history(n_quick: int, n_slow: int = 1, values: int = 5,
     return hist.index()
 
 
+def adversarial_wave_history(n_waves: int, width: int = 14,
+                             span: int = 5, seed: int = 0,
+                             invalid: bool = True) -> h.History:
+    """The device-or-nothing benchmark shape: a history whose decision
+    REQUIRES mass state-space exhaustion, engineered so the reachable
+    config count exceeds what a host DFS can visit in the 60 s budget
+    while staying inside the device kernel's capacities.
+
+    Structure (Porcupine-adversarial family, BASELINE.md "long-tail
+    histories"; cf. the reference's truncated-analysis warning at
+    jepsen/src/jepsen/checker.clj:213-216 — the JVM checker gives up on
+    exactly this regime):
+
+      * `n_waves` waves of `width` CONCURRENT blind writes of distinct
+        values. Blind writes make every interleaving legal, so an
+        exhaustive verdict must visit ~width * 2^(width-1) configs per
+        wave (window-mask subsets x last-writer states). Waves are
+        real-time ordered, so the space is the SUM over waves, not the
+        product — total configs are tuned linearly by `n_waves`.
+      * a straggler read held open across `span` waves stretches the
+        WGL window to ~span*width+1 ops (> 32 forces the general
+        wide-window kernel, not the uint32 fast path) without adding
+        branching of its own.
+      * `invalid` appends a final read of a never-written value, so
+        NO search can shortcut: proving False means exhausting every
+        reachable config — the fair fight between engines.
+
+    At the defaults, width=14 gives ~135k configs/wave (measured;
+    host oracle ~25-30k configs/s, i.e. DNF past ~14 waves), and the
+    wavefront (~C(14,7)*14 = 48k live configs) fits the general
+    kernel's scaled backlog (ops/wgl.py _pick_capacities)."""
+    rng = random.Random(seed)
+    hist = h.History()
+    t = 0
+    val = 0
+    strag_pid = width  # dedicated straggler process id
+    strag_open_since: Optional[int] = None
+    last_wave_val: Optional[int] = None
+    for wv in range(n_waves):
+        if strag_open_since is None:
+            hist.append(h.invoke(strag_pid, "read", None, time=t))
+            t += 1
+            strag_open_since = wv
+        order = list(range(width))
+        rng.shuffle(order)
+        wave_vals = []
+        for p in order:
+            v = val
+            val += 1
+            hist.append(h.invoke(p, "write", v, time=t))
+            t += 1
+            wave_vals.append((p, v))
+        rng.shuffle(wave_vals)
+        for p, v in wave_vals:
+            hist.append(h.ok(p, "write", v, time=t))
+            t += 1
+            last_wave_val = v
+        if wv - strag_open_since + 1 >= span:
+            # straggler returns the last write of this wave — legal
+            # (linearize the read right here), so it constrains nothing
+            hist.append(h.ok(strag_pid, "read", last_wave_val, time=t))
+            t += 1
+            strag_open_since = None
+    if strag_open_since is not None:
+        hist.append(h.ok(strag_pid, "read", last_wave_val, time=t))
+        t += 1
+    hist.append(h.invoke(0, "read", None, time=t))
+    t += 1
+    hist.append(h.ok(0, "read",
+                     -1 if invalid else last_wave_val, time=t))
+    return hist.index()
+
+
 def _txn_scheduler(n_txns: int, n_procs: int, crash_p: float,
                    rng, next_txn, apply_ok, apply_crash) -> h.History:
     """Shared concurrent-txn simulation loop: random interleaving of

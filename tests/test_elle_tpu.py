@@ -86,6 +86,8 @@ def test_empty_and_tiny_graphs():
     g = DepGraph()
     res = standard_cycle_search(g, backend="tpu")
     assert res.pop("engine") == "tpu"
+    util = res.pop("util")
+    assert util["kernel_s"] >= 0 and util["achieved_tflops"] >= 0
     assert all(v is None for v in res.values())
 
     g2 = DepGraph()

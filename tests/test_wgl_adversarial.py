@@ -1,0 +1,61 @@
+"""The device-or-nothing adversarial wave shape (synth.
+adversarial_wave_history): wide-window histories whose decision
+requires mass exhaustion. Differential coverage host-oracle vs device
+general kernel at CI-sized instances; the bench runs the 2M-config
+version where the oracle DNFs (BASELINE.md adversarial long tails)."""
+
+import pytest
+
+from jepsen_tpu import synth
+from jepsen_tpu.models import cas_register
+from jepsen_tpu.ops import wgl, wgl_ref
+from jepsen_tpu.ops.encode import encode
+
+
+def test_window_is_span_times_width():
+    hh = synth.adversarial_wave_history(6, width=10, span=4, seed=1)
+    enc = encode(cas_register(), hh)
+    assert enc.window_raw == 4 * 10 + 1  # straggler + span waves
+
+
+def test_wide_window_capacities_scale():
+    # config count scales with branching power, not op count: the memo
+    # table and backlog must scale with the window (measured overflow
+    # at H=2^19/B=2^16 on the 6-wave w=14 instance)
+    K, H, B = wgl._pick_capacities(71, 8, 200)
+    assert H == 1 << 23
+    assert B >= 1 << 18
+
+
+def test_adversarial_exhaustive_differential():
+    # small instance: ~26k configs, W=41 > 32 forces the general
+    # kernel; False must be PROVEN by exhausting the space, so the
+    # explored counts of two correct engines agree exactly
+    hh = synth.adversarial_wave_history(4, width=10, span=4, seed=3)
+    enc = encode(cas_register(), hh)
+    assert enc.window_raw > 32
+    dev = wgl.check(cas_register(), hh, time_limit=120)
+    ora = wgl_ref.check(cas_register(), hh, time_limit=120)
+    assert dev["valid?"] is False
+    assert ora["valid?"] is False
+    assert dev["configs_explored"] == ora["configs_explored"]
+    assert dev["util"]["memo_hit_rate"] > 0  # dedup engaged
+
+
+def test_adversarial_valid_variant():
+    hh = synth.adversarial_wave_history(4, width=8, span=3, seed=5,
+                                        invalid=False)
+    dev = wgl.check(cas_register(), hh, time_limit=120)
+    assert dev["valid?"] is True
+
+
+@pytest.mark.slow
+def test_adversarial_bench_shape_oracle_rate():
+    # the bench-sized instance must exceed the oracle's 60 s budget:
+    # verify the per-wave config mass on a 2-wave instance and
+    # extrapolate (full 16-wave run would take minutes on CI)
+    hh = synth.adversarial_wave_history(2, width=14, span=5, seed=7)
+    ora = wgl_ref.check(cas_register(), hh, time_limit=300)
+    assert ora["valid?"] is False
+    per_wave = ora["configs_explored"] / 2
+    assert per_wave * 16 > 2_000_000  # 16 waves: past any 60 s host run
