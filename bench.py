@@ -383,6 +383,10 @@ def run_bench() -> tuple[dict, int]:
     # customization pre-imports jax before this script runs.
     jax.config.update("jax_platforms", plat)
 
+    from jepsen_tpu.util import enable_compilation_cache
+    cache_dir = enable_compilation_cache()
+    print(f"compilation cache: {cache_dir}", file=sys.stderr)
+
     from jepsen_tpu.models import cas_register
     from jepsen_tpu.ops import wgl
     from jepsen_tpu.synth import cas_register_history

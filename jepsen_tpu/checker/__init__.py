@@ -367,6 +367,22 @@ def _race_competition(model, h, time_limit, device=None,
                              stop=winner.is_set)
 
     def device_engine():
+        # The engine's FIRST device call would trigger backend init,
+        # which on a wedged accelerator runtime hangs forever rather
+        # than raising — and a hung non-daemon engine thread blocks
+        # interpreter exit even after the oracle's verdict (observed
+        # live on a CLI run). So init waits behind the shared daemon
+        # probe with a bounded timeout; on timeout this engine bows
+        # out and the oracle decides alone.
+        from ..util import backend_ready
+        init_budget = min(60.0, time_limit) if time_limit else 60.0
+        deadline = time.monotonic() + init_budget
+        while not backend_ready(0.25):
+            if winner.is_set():  # oracle already decided: stand down
+                return {"valid?": UNKNOWN, "cause": "cancelled"}
+            if time.monotonic() > deadline:
+                return {"valid?": UNKNOWN,
+                        "cause": "backend-init-timeout"}
         # bare verdict — diagnostics are enriched AFTER the race so a
         # device False publishes (and cancels the oracle) immediately
         return run_device(time_limit, stop=winner.is_set)

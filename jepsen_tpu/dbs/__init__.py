@@ -10,16 +10,42 @@ the shared framework. This package holds this framework's suites:
   processes (CI-run).
 - `etcd` — the tutorial exemplar: release-tarball install, static
   initial-cluster daemon automation, full Process/Pause/Primary fault
-  surface, and a v3 JSON-gateway client (CI-run against a
-  wire-compatible stub).
-- `redis` — the redis-protocol family (the reference's disque):
-  build-from-source automation, a from-scratch RESP2 codec, and CAS
-  as an atomic server-side Lua script (CI-run against an in-process
-  RESP stub).
+  surface, a v3 JSON-gateway client, and the tidb-style test-all
+  matrix: 6 workloads (register, append, wr, bank, sets, long-fork)
+  x 4 nemeses (partition, kill, pause, none) — CI-run against a
+  wire-compatible stub.
+- `redis` — the redis-protocol family (the reference's disque): a
+  from-scratch RESP2 codec and CAS as an atomic server-side Lua
+  script, with two server modes — `source` builds real redis from the
+  release tarball; `mini` (default) runs LIVE in-repo RESP servers
+  with fsync'd AOFs as subprocesses over the localexec remote, so CI
+  exercises install -> real-TCP workload -> kill -9 -> AOF replay ->
+  checker against live processes.
 - `zookeeper` — the reference's minimal single-file exemplar
   (`zookeeper/src/jepsen/zookeeper.clj:1-145`): distro-package
   install, myid/zoo.cfg generation, and a znode CAS-register client
   over zkCli (CI-run against a scripted remote).
 
-Run one with `python -m jepsen_tpu.dbs.<suite> test --nodes ...`.
+Run one with `python -m jepsen_tpu.dbs.<suite> test --nodes ...`;
+sweep a suite's matrix with `... test-all`.
 """
+
+from __future__ import annotations
+
+
+def node_port(test: dict, node: str, base_port: int,
+              ports_key: str) -> int:
+    """Per-node port for localexec-style single-host clusters: an
+    explicit test[ports_key] map wins; otherwise base_port + node
+    index. Shared by every suite that runs one server per node on
+    localhost (toykv, redis-mini)."""
+    return test.get(ports_key, {}).get(
+        node, base_port + test["nodes"].index(node))
+
+
+def node_for_key(test: dict, k) -> str:
+    """Key -> owning node (hash sharding): every client of a key talks
+    to the same standalone server, the arrangement under which per-key
+    linearizability is the right claim to check."""
+    nodes = test["nodes"]
+    return nodes[hash(str(k)) % len(nodes)]

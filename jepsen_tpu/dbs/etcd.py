@@ -172,7 +172,8 @@ class EtcdClient(jclient.Client):
         self.http = None  # requests.Session, created per opened client
 
     def open(self, test, node):
-        c = EtcdClient(self.base_url_fn, self.timeout)
+        # type(self): subclasses (bank/set clients) share this open
+        c = type(self)(self.base_url_fn, self.timeout)
         c.node = node
         c.http = requests.Session()  # keep-alive: one conn per worker
         return c
@@ -238,33 +239,46 @@ class EtcdClient(jclient.Client):
         return out
 
     def txn_mops(self, mops: list, retries: int = 8) -> Optional[list]:
-        """Execute a micro-op txn ([["append", k, v] | ["r", k, None]])
-        atomically via optimistic concurrency: snapshot the involved
-        keys with their revisions, compute the appended lists, then
-        commit guarded by MOD-revision compares on every involved key —
-        the standard etcd software-transaction recipe. Returns the
-        completed mops (reads filled), or None if contention exhausted
-        the retries (indefinite: nothing committed)."""
-        from ..txn import APPEND
+        """Execute a micro-op txn atomically via optimistic
+        concurrency: snapshot the involved keys with their revisions,
+        compute the post-state, then commit guarded by MOD-revision
+        compares on every involved key — the standard etcd
+        software-transaction recipe. Handles all three mop verbs
+        (txn.py): "append" (list append, elle list-append workload),
+        "w" (register write, elle wr / long-fork workloads), "r"
+        (read: appends see lists, registers see scalars). Values are
+        stored as JSON, so one key namespace serves every txn
+        workload. Returns the completed mops (reads filled), or None
+        if contention exhausted the retries (indefinite: nothing
+        committed)."""
+        from ..txn import APPEND, R, W
         keys = sorted({f"/jepsen/{k}" for _f, k, _v in mops})
         for _ in range(retries):
             snap = self.kv_snapshot(keys)
-            state = {k: (json.loads(v) if v else [])
+            state = {k: (json.loads(v) if v else None)
                      for k, (v, _r) in snap.items()}
             done = []
+            writes = set()
             for f, k, v in mops:
                 kk = f"/jepsen/{k}"
                 if f == APPEND:
-                    state[kk] = state[kk] + [v]
+                    state[kk] = (state[kk] or []) + [v]
+                    writes.add(kk)
                     done.append([f, k, v])
+                elif f == W:
+                    state[kk] = v
+                    writes.add(kk)
+                    done.append([f, k, v])
+                elif f == R:
+                    cur = state[kk]
+                    done.append([f, k, list(cur)
+                                 if isinstance(cur, list) else cur])
                 else:
-                    done.append([f, k, list(state[kk])])
+                    raise ValueError(f"unknown mop verb {f!r}")
             compare = [{"key": self._b64(k), "target": "MOD",
                         "result": "EQUAL",
                         "modRevision": str(snap[k][1])}
                        for k in keys]
-            writes = {f"/jepsen/{k}" for f, k, _v in mops
-                      if f == APPEND}
             success = [{"requestPut": {
                 "key": self._b64(k),
                 "value": self._b64(json.dumps(state[k]))}}
@@ -276,10 +290,17 @@ class EtcdClient(jclient.Client):
         return None
 
     # -- jepsen client ------------------------------------------------
+    @staticmethod
+    def _is_mops(v) -> bool:
+        from ..txn import is_mop
+        return (isinstance(v, list) and len(v) > 0
+                and all(is_mop(m) for m in v))
+
     def invoke(self, test, op):
         f = op["f"]
-        if f == "txn":
-            # elle list-append txns (the append workload)
+        if f == "txn" or self._is_mops(op.get("value")):
+            # micro-op txns: elle list-append ("txn"), elle wr ("txn"),
+            # and long-fork ("write"/"read" carrying mop lists)
             try:
                 done = self.txn_mops(op["value"])
             except requests.RequestException as e:
@@ -317,29 +338,224 @@ class EtcdClient(jclient.Client):
             self.http.close()
 
 
+class EtcdBankClient(EtcdClient):
+    """Bank workload client: balances as JSON ints under
+    /jepsen/bank/<acct>. Reads snapshot every account in ONE read-only
+    txn (atomic, so the checker sees consistent totals); transfers
+    commit guarded by MOD compares on both accounts with the standard
+    retry loop. setup() initializes balances — it runs per node client
+    BEFORE the interpreter starts (core.py open_and_setup), and every
+    client writes the same values, so the race is idempotent."""
+
+    @staticmethod
+    def _acct_key(a) -> str:
+        return f"/jepsen/bank/{a}"
+
+    def setup(self, test):
+        accounts = test["accounts"]
+        total = test["total-amount"]
+        per, rem = divmod(total, len(accounts))
+        for i, a in enumerate(accounts):
+            # the first `rem` accounts carry the remainder, so initial
+            # balances sum EXACTLY to total-amount (the checker's
+            # conservation invariant)
+            self.kv_put(self._acct_key(a),
+                        json.dumps(per + (1 if i < rem else 0)))
+
+    def invoke(self, test, op):
+        accounts = test["accounts"]
+        keys = [self._acct_key(a) for a in accounts]
+        try:
+            if op["f"] == "read":
+                snap = self.kv_snapshot(keys)
+                return {**op, "type": "ok",
+                        "value": {a: (json.loads(snap[k][0])
+                                      if snap[k][0] else None)
+                                  for a, k in zip(accounts, keys)}}
+            if op["f"] == "transfer":
+                t = op["value"]
+                src, dst = self._acct_key(t["from"]), \
+                    self._acct_key(t["to"])
+                for _ in range(8):
+                    snap = self.kv_snapshot([src, dst])
+                    cur_s = json.loads(snap[src][0] or "0")
+                    cur_d = json.loads(snap[dst][0] or "0")
+                    if cur_s - t["amount"] < 0 and \
+                            not test.get("negative-balances"):
+                        return {**op, "type": "fail",
+                                "error": "insufficient funds"}
+                    res = self._post("/v3/kv/txn", {
+                        "compare": [
+                            {"key": self._b64(k), "target": "MOD",
+                             "result": "EQUAL",
+                             "modRevision": str(snap[k][1])}
+                            for k in (src, dst)],
+                        "success": [
+                            {"requestPut": {
+                                "key": self._b64(src),
+                                "value": self._b64(json.dumps(
+                                    cur_s - t["amount"]))}},
+                            {"requestPut": {
+                                "key": self._b64(dst),
+                                "value": self._b64(json.dumps(
+                                    cur_d + t["amount"]))}}],
+                        "failure": []})
+                    if res.get("succeeded"):
+                        return {**op, "type": "ok"}
+                return {**op, "type": "fail",
+                        "error": "transfer contention"}
+            raise ValueError(f"unknown op {op['f']!r}")
+        except requests.RequestException as e:
+            t = "fail" if op["f"] == "read" else "info"
+            return {**op, "type": t, "error": str(e)[:200]}
+
+
+class EtcdSetClient(EtcdClient):
+    """Set workload client: one JSON list at /jepsen/set, adds via the
+    MOD-compare retry loop, the final read returns the whole list."""
+
+    SET_KEY = "/jepsen/set"
+
+    def invoke(self, test, op):
+        try:
+            if op["f"] == "add":
+                for _ in range(16):
+                    snap = self.kv_snapshot([self.SET_KEY])
+                    cur = json.loads(snap[self.SET_KEY][0] or "[]")
+                    res = self._post("/v3/kv/txn", {
+                        "compare": [
+                            {"key": self._b64(self.SET_KEY),
+                             "target": "MOD", "result": "EQUAL",
+                             "modRevision":
+                                 str(snap[self.SET_KEY][1])}],
+                        "success": [{"requestPut": {
+                            "key": self._b64(self.SET_KEY),
+                            "value": self._b64(json.dumps(
+                                cur + [op["value"]]))}}],
+                        "failure": []})
+                    if res.get("succeeded"):
+                        return {**op, "type": "ok"}
+                return {**op, "type": "fail",
+                        "error": "add contention"}
+            if op["f"] == "read":
+                cur = self.kv_range(self.SET_KEY)
+                return {**op, "type": "ok",
+                        "value": json.loads(cur) if cur else []}
+            raise ValueError(f"unknown op {op['f']!r}")
+        except requests.RequestException as e:
+            t = "fail" if op["f"] == "read" else "info"
+            return {**op, "type": t, "error": str(e)[:200]}
+
+
+# The workload matrix (tidb/src/tidb/core.clj:32-45 pattern: a map of
+# name -> workload builder; each returns {"checker", "generator",
+# "client", extra-test-keys...}). `wrap_time` = False when the
+# workload's generator manages its own phases (sets: add-then-read).
+def _w_register(options):
+    w = linearizable_register.workload(
+        {"nodes": options["nodes"],
+         "concurrency": options["concurrency"],
+         "per_key_limit": options.get("per_key_limit") or 100,
+         "algorithm": "competition"})
+    return {**w, "client": EtcdClient()}
+
+
+def _w_append(options):
+    from ..workloads import cycle_append
+    w = cycle_append.workload(anomalies=("G0", "G1", "G2"),
+                              additional_graphs=("realtime",))
+    return {**w, "client": EtcdClient()}
+
+
+def _w_wr(options):
+    from ..workloads import cycle_wr
+    w = cycle_wr.workload(linearizable_keys=True)
+    return {**w, "client": EtcdClient()}
+
+
+def _w_bank(options):
+    from ..workloads import bank
+    w = bank.workload(options)
+    return {**w, "client": EtcdBankClient()}
+
+
+def _w_sets(options):
+    from ..workloads import sets
+    w = sets.workload({"time_limit":
+                       max(1, (options.get("time_limit") or 30) - 2)})
+    return {**w, "client": EtcdSetClient(), "wrap_time": False}
+
+
+def _w_long_fork(options):
+    from ..workloads import long_fork
+    w = long_fork.workload()
+    return {**w, "client": EtcdClient()}
+
+
+WORKLOADS = {
+    "register": _w_register,
+    "append": _w_append,
+    "wr": _w_wr,
+    "bank": _w_bank,
+    "sets": _w_sets,
+    "long-fork": _w_long_fork,
+}
+
+NEMESES = {
+    "partition": lambda db: jnemesis.partition_random_halves(),
+    "kill": lambda db: jnemesis.node_start_stopper(
+        lambda nodes: [gen.RNG.choice(nodes)],
+        lambda test, node: db.kill(test, node),
+        lambda test, node: db.start(test, node)),
+    "pause": lambda db: jnemesis.node_start_stopper(
+        lambda nodes: [gen.RNG.choice(nodes)],
+        lambda test, node: db.pause(test, node),
+        lambda test, node: db.resume(test, node)),
+    "none": lambda db: jnemesis.Nemesis(),
+}
+
+
 def etcd_test(options: dict) -> dict:
     """Full test map from CLI options (zookeeper.clj zk-test shape).
-    `workload`: register (independent cas-register, the default) or
-    append (elle list-append over etcd software transactions)."""
+    `workload`: one of WORKLOADS (register, append, wr, bank, sets,
+    long-fork); `nemesis`: one of NEMESES (partition, kill, pause,
+    none) — the tidb-style matrix both axes of `test-all` sweep."""
     nodes = options["nodes"]
     db = EtcdDB(options.get("version") or VERSION)
     which = options.get("workload") or "register"
-    if which == "append":
-        from ..workloads import cycle_append
-        w = cycle_append.workload(
-            anomalies=("G0", "G1", "G2"),
-            additional_graphs=("realtime",))
-    elif which == "register":
-        w = linearizable_register.workload(
-            {"nodes": nodes,
-             "concurrency": options["concurrency"],
-             "per_key_limit": options.get("per_key_limit") or 100,
-             "algorithm": "competition"})
-    else:
-        raise ValueError(f"unknown workload {which!r}")
+    try:
+        w = WORKLOADS[which](options)
+    except KeyError:
+        raise ValueError(f"unknown workload {which!r}; have "
+                         f"{sorted(WORKLOADS)}") from None
+    nem_name = options.get("nemesis") or "partition"
+    try:
+        nemesis = NEMESES[nem_name](db)
+    except KeyError:
+        raise ValueError(f"unknown nemesis {nem_name!r}; have "
+                         f"{sorted(NEMESES)}") from None
     interval = options.get("nemesis_interval") or 5.0
+    workload_gen = w["generator"]
+    time_limit = options.get("time_limit") or 30
+    if nem_name != "none":
+        nem_gen = gen.cycle([gen.sleep(interval),
+                             {"type": "info", "f": "start"},
+                             gen.sleep(interval),
+                             {"type": "info", "f": "stop"}])
+        if not w.get("wrap_time", True):
+            # the workload manages its own phases (sets: add-then-
+            # final-read) so no outer time_limit bounds the run — the
+            # infinite nemesis cycle must bound itself or the test
+            # never ends
+            nem_gen = gen.time_limit(time_limit, nem_gen)
+        workload_gen = gen.nemesis(nem_gen, workload_gen)
+    if w.get("wrap_time", True):
+        workload_gen = gen.time_limit(time_limit, workload_gen)
+    extra = {k: v for k, v in w.items()
+             if k not in ("checker", "generator", "client",
+                          "wrap_time")}
     return {
-        "name": options.get("name") or "etcd",
+        "name": options.get("name") or f"etcd-{which}-{nem_name}",
         "store_root": options.get("store_root") or "store",
         "nodes": nodes,
         "concurrency": options["concurrency"],
@@ -347,31 +563,47 @@ def etcd_test(options: dict) -> dict:
         "os": Debian(),
         "db": db,
         "net": jnet.iptables(),
-        "client": EtcdClient(),
-        "nemesis": jnemesis.partition_random_halves(),
+        "client": w["client"],
+        "nemesis": nemesis,
         # No gating stats checker: a short run where some op type
         # never succeeds (e.g. every cas misses) would flap invalid.
         "checker": jchecker.compose({
             which: w["checker"],
             "exceptions": jchecker.unhandled_exceptions(),
         }),
-        "generator": gen.time_limit(
-            options.get("time_limit") or 30,
-            gen.nemesis(
-                gen.cycle([gen.sleep(interval),
-                           {"type": "info", "f": "start"},
-                           gen.sleep(interval),
-                           {"type": "info", "f": "stop"}]),
-                w["generator"])),
+        "generator": workload_gen,
+        **extra,
     }
 
 
+def etcd_tests(options: dict):
+    """tests_fn for `test-all`: the cartesian workload x nemesis sweep
+    (tidb/src/tidb/core.clj:46-120 test-all pattern). `--workload` /
+    `--nemesis` restrict either axis; defaults sweep everything."""
+    workloads = ([options["workload"]] if options.get("workload")
+                 else sorted(WORKLOADS))
+    nemeses = ([options["nemesis"]] if options.get("nemesis")
+               else sorted(NEMESES))
+    for which in workloads:
+        for nem in nemeses:
+            opts = dict(options, workload=which, nemesis=nem)
+            opts["name"] = (f"{options.get('name') or 'etcd'}"
+                            f"-{which}-{nem}")
+            yield etcd_test(opts)
+
+
 ETCD_OPTS = [
+    cli.Opt("name", metavar="NAME", default=None),
+    cli.Opt("store_root", metavar="DIR", default="store",
+            help="Where to write results"),
     cli.Opt("version", metavar="VERSION", default=VERSION,
             help="etcd release to install"),
-    cli.Opt("workload", metavar="NAME", default="register",
-            help="register (independent cas-register) or append "
-                 "(elle list-append over etcd transactions)"),
+    cli.Opt("workload", metavar="NAME", default=None,
+            help=f"one of {', '.join(sorted(WORKLOADS))} "
+                 "(test: default register; test-all: sweeps all)"),
+    cli.Opt("nemesis", metavar="NAME", default=None,
+            help=f"one of {', '.join(sorted(NEMESES))} "
+                 "(test: default partition; test-all: sweeps all)"),
     cli.Opt("per_key_limit", metavar="N", default=100, parse=int,
             help="Ops per key"),
     cli.Opt("nemesis_interval", metavar="SECONDS", default=5.0,
@@ -382,6 +614,8 @@ ETCD_OPTS = [
 COMMANDS = {
     **cli.single_test_cmd({"test_fn": etcd_test,
                            "opt_spec": ETCD_OPTS}),
+    **cli.test_all_cmd({"tests_fn": etcd_tests,
+                        "opt_spec": ETCD_OPTS}),
     **cli.serve_cmd(),
 }
 

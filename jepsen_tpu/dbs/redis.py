@@ -9,6 +9,18 @@ logfile; the client is a from-scratch RESP2 codec over one TCP
 connection per worker — GET/SET for reads and writes, and CAS as an
 atomic server-side Lua script (EVAL compare-and-set), the idiomatic
 redis recipe. Ops ride [k v] independent tuples.
+
+Two server modes:
+
+- ``source`` — the production path: wget/untar/make real redis on each
+  (SSH/docker) node.
+- ``mini`` (default when no cluster is configured) — a LIVE subprocess
+  per node running the in-repo mini-redis (`MINIREDIS_SRC`): a real
+  RESP2 server with an fsync'd append-only file, started/killed
+  through the same DB automation over the localexec sandbox remote —
+  so CI exercises install -> daemon start -> real TCP workload ->
+  kill -9 nemesis -> AOF replay -> checker against live processes
+  (the toykv pattern), speaking the genuine wire protocol end to end.
 """
 
 from __future__ import annotations
@@ -21,7 +33,7 @@ from .. import cli, client as jclient, control, db as jdb
 from .. import generator as gen
 from .. import net as jnet
 from .. import nemesis as jnemesis
-from ..control import nodeutil
+from ..control import localexec, nodeutil
 from ..independent import KV, tuple_
 from ..os_setup import Debian
 from ..workloads import linearizable_register
@@ -39,6 +51,192 @@ CAS_LUA = ("if redis.call('GET', KEYS[1]) == ARGV[1] then "
 
 def tarball_url(version: str) -> str:
     return f"https://download.redis.io/releases/redis-{version}.tar.gz"
+
+
+# -- mini-redis: the in-repo live server ------------------------------------
+
+MINI_BASE_PORT = 22350
+MINI_PIDFILE = "miniredis.pid"
+MINI_LOGFILE = "miniredis.log"
+
+# A real RESP2 server, not a line-protocol toy: commands arrive as RESP
+# arrays, replies use the full tag set, and writes append the encoded
+# SET to an fsync'd AOF that replays on boot (redis's appendonly
+# design). EVAL supports exactly the suite's CAS script — recognized by
+# text and executed atomically server-side, which is the semantics the
+# suite depends on (general Lua would need an interpreter; anything
+# else errors like a syntax-checking redis would).
+MINIREDIS_SRC = r'''
+import argparse, os, socketserver, threading
+
+p = argparse.ArgumentParser()
+p.add_argument("--port", type=int, required=True)
+p.add_argument("--appendonly", default="yes")
+p.add_argument("--dir", default=".")
+args = p.parse_args()
+
+AOF = os.path.join(args.dir, "appendonly.aof")
+DATA, LOCK = {}, threading.Lock()
+CAS_LUA = "__CAS_LUA__"
+
+def read_resp(rf):
+    line = rf.readline()
+    if not line:
+        return None
+    if line[:1] != b"*":
+        raise ValueError("expected RESP array, got %r" % line[:16])
+    out = []
+    for _ in range(int(line[1:].strip())):
+        hdr = rf.readline()
+        if hdr[:1] != b"$":
+            raise ValueError("expected bulk string, got %r" % hdr[:16])
+        n = int(hdr[1:].strip())
+        body = rf.read(n + 2)
+        if len(body) < n + 2:
+            raise ValueError("short bulk read")
+        out.append(body[:n].decode())
+    return out
+
+def enc_cmd(args_):
+    out = [b"*%d\r\n" % len(args_)]
+    for a in args_:
+        b = str(a).encode()
+        out.append(b"$%d\r\n%s\r\n" % (len(b), b))
+    return b"".join(out)
+
+def replay():
+    if args.appendonly != "yes" or not os.path.exists(AOF):
+        return
+    with open(AOF, "rb") as fh:
+        while True:
+            try:
+                cmd = read_resp(fh)
+            except ValueError:
+                break  # torn tail after a crash: ignore, like redis
+            if cmd is None:
+                break
+            if cmd and cmd[0].upper() == "SET":
+                DATA[cmd[1]] = cmd[2]
+
+def persist(key, val):
+    if args.appendonly != "yes":
+        return
+    with open(AOF, "ab") as fh:
+        fh.write(enc_cmd(["SET", key, val]))
+        fh.flush()
+        os.fsync(fh.fileno())
+
+class Handler(socketserver.StreamRequestHandler):
+    def handle(self):
+        while True:
+            try:
+                cmd = read_resp(self.rfile)
+            except ValueError:
+                self.wfile.write(b"-ERR protocol error\r\n")
+                return
+            if cmd is None:
+                return
+            self.wfile.write(self.apply(cmd))
+            self.wfile.flush()
+
+    def apply(self, cmd):
+        op = cmd[0].upper()
+        with LOCK:
+            if op == "PING":
+                return b"+PONG\r\n"
+            if op == "GET":
+                v = DATA.get(cmd[1])
+                if v is None:
+                    return b"$-1\r\n"
+                b = v.encode()
+                return b"$%d\r\n%s\r\n" % (len(b), b)
+            if op == "SET":
+                DATA[cmd[1]] = cmd[2]
+                persist(cmd[1], cmd[2])
+                return b"+OK\r\n"
+            if op == "DEL":
+                n = sum(1 for k in cmd[1:] if DATA.pop(k, None)
+                        is not None)
+                return b":%d\r\n" % n
+            if op == "EVAL":
+                if cmd[1] != CAS_LUA:
+                    return b"-ERR unsupported script\r\n"
+                key, old, new = cmd[3], cmd[4], cmd[5]
+                if DATA.get(key) == old:
+                    DATA[key] = new
+                    persist(key, new)
+                    return b":1\r\n"
+                return b":0\r\n"
+            return b"-ERR unknown command '%s'\r\n" % op.encode()
+
+class Server(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+replay()
+print("miniredis serving on", args.port, flush=True)
+Server(("127.0.0.1", args.port), Handler).serve_forever()
+'''
+
+# One source of truth for the script text: the server recognizes the
+# suite's CAS script by EXACT text, so the embedded copy must be the
+# module constant, not a duplicate that can drift.
+MINIREDIS_SRC = MINIREDIS_SRC.replace("__CAS_LUA__", CAS_LUA)
+
+
+def mini_node_port(test: dict, node: str) -> int:
+    from . import node_port as _shared
+    return _shared(test, node, MINI_BASE_PORT, "redis_ports")
+
+
+def node_for_key(test: dict, k) -> str:
+    from . import node_for_key as _shared
+    return _shared(test, k)
+
+
+class MiniRedisDB(jdb.DB, jdb.Process, jdb.LogFiles):
+    """Upload + daemon lifecycle for the in-repo mini-redis: the same
+    protocol surface as `RedisDB` but installable on any node with
+    python3 — which is what lets CI run the whole suite against live
+    processes (localexec remote)."""
+
+    def _start(self, test, node):
+        nodeutil.start_daemon(
+            {"logfile": MINI_LOGFILE, "pidfile": MINI_PIDFILE,
+             "exec": "/usr/bin/python3",
+             "chdir": control.lit("$PWD")},
+            "/usr/bin/python3", "miniredis.py",
+            "--port", str(mini_node_port(test, node)),
+            "--appendonly", "yes", "--dir", ".")
+        nodeutil.await_tcp_port(mini_node_port(test, node), timeout_s=30)
+
+    def setup(self, test, node):
+        nodeutil.grepkill(f"miniredis.py --port "
+                          f"{mini_node_port(test, node)}")
+        control.exec_("bash", "-c",
+                      f"cat > miniredis.py <<'MINIREDIS_EOF'\n"
+                      f"{MINIREDIS_SRC}\nMINIREDIS_EOF")
+        control.exec_("rm", "-f", "appendonly.aof")
+        self._start(test, node)
+
+    def teardown(self, test, node):
+        nodeutil.stop_daemon(MINI_PIDFILE)
+        nodeutil.grepkill(f"miniredis.py --port "
+                          f"{mini_node_port(test, node)}")
+        control.exec_("rm", "-f", "appendonly.aof", "miniredis.py")
+
+    def start(self, test, node):
+        self._start(test, node)
+        return "started"
+
+    def kill(self, test, node):
+        nodeutil.stop_daemon(MINI_PIDFILE)
+        nodeutil.grepkill(f"miniredis.py --port "
+                          f"{mini_node_port(test, node)}")
+        return "killed"
+
+    def log_files(self, test, node):
+        return [MINI_LOGFILE]
 
 
 class RedisDB(jdb.DB, jdb.Process, jdb.LogFiles):
@@ -154,26 +352,32 @@ class RedisConn:
 
 
 class RedisClient(jclient.Client):
-    """CAS-register client: GET/SET plus Lua compare-and-set. One
-    connection per opened client (per worker). `port_fn` maps a node
-    to its port — tests point it at in-process stubs."""
+    """CAS-register client: GET/SET plus Lua compare-and-set. One lazy
+    connection per target node. `port_fn` maps a node to (host, port) —
+    tests point it at in-process stubs; `route_fn(test, k)` picks the
+    node owning key k (hash sharding for standalone-server clusters);
+    without it every op goes to the worker's own node."""
 
-    def __init__(self, port_fn=None, timeout: float = 5.0):
+    def __init__(self, port_fn=None, timeout: float = 5.0,
+                 route_fn=None):
         self.port_fn = port_fn or (lambda test, node: (node, PORT))
+        self.route_fn = route_fn
         self.timeout = timeout
         self.node: Optional[str] = None
-        self.conn: Optional[RedisConn] = None
+        self.conns: dict = {}
 
     def open(self, test, node):
-        c = RedisClient(self.port_fn, self.timeout)
+        c = RedisClient(self.port_fn, self.timeout, self.route_fn)
         c.node = node
         return c
 
-    def _conn(self, test) -> RedisConn:
-        if self.conn is None:
-            host, port = self.port_fn(test, self.node)
-            self.conn = RedisConn(host, port, self.timeout)
-        return self.conn
+    def _conn(self, test, node) -> RedisConn:
+        conn = self.conns.get(node)
+        if conn is None:
+            host, port = self.port_fn(test, node)
+            conn = RedisConn(host, port, self.timeout)
+            self.conns[node] = conn
+        return conn
 
     def invoke(self, test, op):
         kv = op["value"]
@@ -182,8 +386,10 @@ class RedisClient(jclient.Client):
         k, v = kv
         key = f"jepsen:{k}"
         f = op["f"]
+        node = (self.route_fn(test, k) if self.route_fn
+                else self.node)
         try:
-            conn = self._conn(test)
+            conn = self._conn(test, node)
             if f == "read":
                 cur = conn.cmd("GET", key)
                 return {**op, "type": "ok",
@@ -198,38 +404,60 @@ class RedisClient(jclient.Client):
                 return {**op, "type": "ok" if won == 1 else "fail"}
             raise ValueError(f"unknown op {f!r}")
         except (OSError, ConnectionError, RedisError) as e:
-            if self.conn is not None:
-                self.conn.close()
-                self.conn = None
+            stale = self.conns.pop(node, None)
+            if stale is not None:
+                stale.close()
             t = "fail" if f == "read" else "info"
             return {**op, "type": t, "error": str(e)[:200]}
 
     def close(self, test):
-        if self.conn is not None:
-            self.conn.close()
+        for conn in self.conns.values():
+            conn.close()
 
 
 def redis_test(options: dict) -> dict:
     """Test map from CLI options (disque.clj suite shape: register
-    workload under a kill/restart nemesis)."""
+    workload under a kill/restart nemesis).
+
+    `server` option: "mini" (default — live in-repo mini-redis
+    subprocesses over the localexec sandbox remote, key-sharded
+    standalone servers) or "source" (build real redis from the release
+    tarball on SSH/docker nodes, each worker driving its own node)."""
     nodes = options["nodes"]
-    db = RedisDB(options.get("version") or VERSION)
+    mode = options.get("server") or "mini"
     w = linearizable_register.workload(
         {"nodes": nodes,
          "concurrency": options["concurrency"],
          "per_key_limit": options.get("per_key_limit") or 100,
          "algorithm": "competition"})
     interval = options.get("nemesis_interval") or 10.0
+    if mode == "mini":
+        db: jdb.DB = MiniRedisDB()
+        extra = {
+            "remote": localexec.remote(options.get("sandbox")
+                                       or "redis-cluster"),
+            "ssh": {"dummy?": False},
+            "client": RedisClient(
+                port_fn=lambda test, node:
+                    ("127.0.0.1", mini_node_port(test, node)),
+                route_fn=node_for_key),
+        }
+    elif mode == "source":
+        db = RedisDB(options.get("version") or VERSION)
+        extra = {
+            "ssh": options.get("ssh") or {},
+            "os": Debian(),
+            "net": jnet.iptables(),
+            "client": RedisClient(),
+        }
+    else:
+        raise ValueError(f"unknown server mode {mode!r}")
     return {
-        "name": options.get("name") or "redis",
+        "name": options.get("name") or f"redis-{mode}",
         "store_root": options.get("store_root") or "store",
         "nodes": nodes,
         "concurrency": options["concurrency"],
-        "ssh": options.get("ssh") or {},
-        "os": Debian(),
         "db": db,
-        "net": jnet.iptables(),
-        "client": RedisClient(),
         "nemesis": jnemesis.node_start_stopper(
             lambda nodes: [gen.RNG.choice(nodes)],
             lambda test, node: db.kill(test, node),
@@ -246,12 +474,22 @@ def redis_test(options: dict) -> dict:
                            gen.sleep(interval),
                            {"type": "info", "f": "stop"}]),
                 w["generator"])),
+        **extra,
     }
 
 
 REDIS_OPTS = [
+    cli.Opt("name", metavar="NAME", default=None),
+    cli.Opt("store_root", metavar="DIR", default="store",
+            help="Where to write results"),
+    cli.Opt("server", metavar="MODE", default="mini",
+            help="mini (live in-repo RESP servers, localexec) or "
+                 "source (build real redis from tarball)"),
     cli.Opt("version", metavar="VERSION", default=VERSION,
-            help="redis release to build"),
+            help="redis release to build (server=source)"),
+    cli.Opt("sandbox", metavar="DIR", default="redis-cluster",
+            help="Node sandbox dir for the localexec remote "
+                 "(server=mini)"),
     cli.Opt("per_key_limit", metavar="N", default=100, parse=int,
             help="Ops per key"),
     cli.Opt("nemesis_interval", metavar="SECONDS", default=10.0,

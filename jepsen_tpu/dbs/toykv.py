@@ -121,13 +121,13 @@ LOGFILE = "server.log"
 
 
 def node_port(test: dict, node: str) -> int:
-    return test.get("toykv_ports", {}).get(
-        node, BASE_PORT + test["nodes"].index(node))
+    from . import node_port as _shared
+    return _shared(test, node, BASE_PORT, "toykv_ports")
 
 
 def node_for_key(test: dict, k) -> str:
-    nodes = test["nodes"]
-    return nodes[hash(str(k)) % len(nodes)]
+    from . import node_for_key as _shared
+    return _shared(test, k)
 
 
 class ToyKVDB(jdb.DB, jdb.Process, jdb.LogFiles):

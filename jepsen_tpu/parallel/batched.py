@@ -149,6 +149,32 @@ def _compiled_batched(n_pad: int, ic_pad: int, W: int, S: int, O: int,
     return vinit, vchunk
 
 
+def _backend_ready_or_fallback(time_limit: Optional[float]) -> bool:
+    """Bounded wait for jax backend init (util.backend_ready): the
+    first device call on a wedged accelerator runtime hangs the
+    calling thread forever, and these entry points run on the MAIN
+    thread. The wait is capped at HALF the caller's budget so the
+    host-oracle fallback keeps a real share. False -> the caller must
+    take the host path."""
+    from ..util import backend_ready
+    return backend_ready(min(60.0, time_limit / 2) if time_limit
+                         else None)
+
+
+def _all_host(model: Model, histories: Sequence[History],
+              deadline: Optional[float],
+              oracle_fallback: bool) -> list[dict]:
+    """Device plane unavailable (init timeout): decide every key with
+    the host oracle inside the remaining budget, or report why not."""
+    out = []
+    for h in histories:
+        base = {"valid?": "unknown", "cause": "backend-init-timeout",
+                "op_count": len(h)}
+        out.append(_oracle_fallback(model, h, deadline, base)
+                   if oracle_fallback else base)
+    return out
+
+
 def _oracle_fallback(model: Model, history: History,
                      deadline: Optional[float], device_res: dict) -> dict:
     """Re-check a device-"unknown" history with the host oracle inside
@@ -184,6 +210,8 @@ def check_streamed(model: Model, histories: Sequence[History],
     from ..ops import wgl
 
     deadline = _time.monotonic() + time_limit if time_limit else None
+    if not _backend_ready_or_fallback(time_limit):
+        return _all_host(model, histories, deadline, oracle_fallback)
     devices = jax.devices()
     results: list[Optional[dict]] = [None] * len(histories)
     if race and not oracle_fallback:
@@ -323,6 +351,14 @@ def check_batched(model: Model, histories: Sequence[History],
         return results  # type: ignore[return-value]
     if strategy != "vmap":
         raise ValueError(f"unknown strategy {strategy!r}")
+
+    deadline0 = _time.monotonic() + time_limit if time_limit else None
+    if not _backend_ready_or_fallback(time_limit):
+        host = _all_host(model, [histories[i] for i in lanes],
+                         deadline0, oracle_fallback)
+        for i, res in zip(lanes, host):
+            results[i] = res
+        return results  # type: ignore[return-value]
 
     if mesh is None:
         mesh = default_mesh()

@@ -1,7 +1,8 @@
 """Redis suite tests: the from-scratch RESP codec and client against
-an in-process RESP2 stub speaking the GET/SET/EVAL subset, plus DB
-orchestration through the dummy remote — the whole suite runs in CI
-with no redis installed."""
+an in-process RESP2 stub, DB orchestration through the dummy remote,
+AND the full suite end-to-end against LIVE mini-redis subprocess
+servers (real RESP over real TCP, fsync'd AOF, kill -9 nemesis)
+through the localexec remote — no stock redis needed in CI."""
 
 import io
 import socketserver
@@ -155,10 +156,13 @@ def test_db_commands():
 # -- full suite -------------------------------------------------------------
 
 def test_full_suite_with_stub(resp_server, tmp_path):
+    # the source-mode suite shape, driven against the in-process stub
+    # (DB automation goes to the dummy remote; the wire contract is
+    # what's under test here)
     port = resp_server.server_address[1]
     opts = {"nodes": ["n1", "n2"], "concurrency": 4, "time_limit": 4,
             "per_key_limit": 15, "store_root": str(tmp_path / "store"),
-            "ssh": {"dummy?": True}}
+            "server": "source", "ssh": {"dummy?": True}}
     t = redis.redis_test(opts)
     t["client"] = redis.RedisClient(
         port_fn=lambda test, node: ("127.0.0.1", port))
@@ -166,3 +170,77 @@ def test_full_suite_with_stub(resp_server, tmp_path):
     done = core.run(t)
     assert done["results"]["valid?"] is True
     assert done["results"]["register"]["valid?"] is True
+
+
+# -- full suite, LIVE processes ---------------------------------------------
+
+def test_full_suite_live_mini(tmp_path):
+    """install -> daemon start -> real-TCP RESP workload -> kill/
+    restart nemesis -> AOF replay -> checker, all against live
+    mini-redis subprocesses (the second live-process suite beside
+    toykv; VERDICT r2 #4)."""
+    import os
+
+    opts = {"nodes": ["r1", "r2"], "concurrency": 4, "time_limit": 6,
+            "per_key_limit": 12, "nemesis_interval": 2.0,
+            "store_root": str(tmp_path / "store"),
+            "sandbox": str(tmp_path / "cluster")}
+    done = core.run(redis.redis_test(opts))
+    assert done["results"]["valid?"] is True
+    assert done["results"]["register"]["valid?"] is True
+    run_dir = done["store_dir"]
+    # node logs snarfed; the nemesis really killed at least one server
+    logs = "".join(
+        open(os.path.join(run_dir, n, redis.MINI_LOGFILE)).read()
+        for n in ("r1", "r2"))
+    assert logs.count("miniredis serving on") >= 3
+
+
+def test_mini_aof_survives_kill(tmp_path):
+    """Durability probe without the suite: start one mini server,
+    write over real RESP, kill -9, restart, read the value back from
+    the replayed AOF."""
+    import signal
+    import subprocess
+    import sys
+    import time
+
+    srv_py = tmp_path / "miniredis.py"
+    srv_py.write_text(redis.MINIREDIS_SRC)
+    port = 22999
+
+    def start():
+        return subprocess.Popen(
+            [sys.executable, str(srv_py), "--port", str(port),
+             "--appendonly", "yes", "--dir", str(tmp_path)],
+            cwd=tmp_path)
+
+    proc = start()
+    try:
+        deadline = time.monotonic() + 10
+        conn = None
+        while conn is None:
+            try:
+                conn = redis.RedisConn("127.0.0.1", port, timeout=2)
+            except OSError:
+                assert time.monotonic() < deadline, "server never up"
+                time.sleep(0.1)
+        assert conn.cmd("SET", "k", "42") == "OK"
+        assert conn.cmd("EVAL", redis.CAS_LUA, 1, "k", "42", "43") == 1
+        conn.close()
+        proc.send_signal(signal.SIGKILL)
+        proc.wait(timeout=10)
+        proc = start()
+        deadline = time.monotonic() + 10
+        conn = None
+        while conn is None:
+            try:
+                conn = redis.RedisConn("127.0.0.1", port, timeout=2)
+            except OSError:
+                assert time.monotonic() < deadline, "no restart"
+                time.sleep(0.1)
+        assert conn.cmd("GET", "k") == "43"
+        conn.close()
+    finally:
+        proc.kill()
+        proc.wait(timeout=10)
