@@ -279,13 +279,19 @@ def run_extras(budget: float, deadline: float) -> dict:
     run("long_tail_900", None, None, checker=long_tail)
 
     # Elle plane: list-append txn anomaly search, graph cycle queries
-    # as batched closure matmuls on device (elle/tpu.py)
+    # as batched closure matmuls on device (elle/tpu.py). On an
+    # accelerator the device backend is FORCED (not auto) so the MXU
+    # plane is always exercised and its TFLOP/s recorded.
+    import jax as _jax
+    cycle_backend = ("tpu" if _jax.default_backend() != "cpu"
+                     else "auto")
+
     def elle_append():
         from jepsen_tpu.elle import append as elle_append_mod
         hist_a = synth.list_append_history(3000, n_procs=5, seed=7)
         res = elle_append_mod.check(hist_a,
                                     additional_graphs=("realtime",),
-                                    cycle_backend="auto")
+                                    cycle_backend=cycle_backend)
         return {"valid?": res["valid?"],
                 "op_count": len(hist_a) // 2,
                 "engine": res.get("cycle-engine"),
@@ -299,7 +305,7 @@ def run_extras(budget: float, deadline: float) -> dict:
         hist_w = synth.wr_register_history(3000, n_procs=5, seed=7)
         res = elle_wr_mod.check(hist_w, linearizable_keys=True,
                                 additional_graphs=("realtime",),
-                                cycle_backend="auto")
+                                cycle_backend=cycle_backend)
         return {"valid?": res["valid?"],
                 "op_count": len(hist_w) // 2,
                 "engine": res.get("cycle-engine"),

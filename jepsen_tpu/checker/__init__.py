@@ -374,10 +374,13 @@ def _race_competition(model, h, time_limit, device=None,
         # live on a CLI run). So init waits behind the shared daemon
         # probe with a bounded timeout; on timeout this engine bows
         # out and the oracle decides alone.
-        from ..util import backend_ready
+        from ..util import backend_failed, backend_ready
         init_budget = min(60.0, time_limit) if time_limit else 60.0
         deadline = time.monotonic() + init_budget
         while not backend_ready(0.25):
+            if backend_failed():  # init raised: don't spin the poll
+                return {"valid?": UNKNOWN,
+                        "cause": "backend-init-error"}
             if winner.is_set():  # oracle already decided: stand down
                 return {"valid?": UNKNOWN, "cause": "cancelled"}
             if time.monotonic() > deadline:

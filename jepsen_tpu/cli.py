@@ -538,8 +538,4 @@ def main(subcommands: dict, argv: Optional[Sequence[str]] = None) -> None:
     logging.basicConfig(
         level=logging.INFO,
         format="%(asctime)s %(levelname)s [%(name)s] %(message)s")
-    # analysis kernels recompile per shape bucket; the persistent cache
-    # makes repeat CLI runs skip straight to the search
-    from .util import enable_compilation_cache
-    enable_compilation_cache()
     sys.exit(run_cli(subcommands, sys.argv[1:] if argv is None else argv))

@@ -131,6 +131,11 @@ def run_case(test: dict) -> list:
 def analyze(test: dict) -> dict:
     """Index the history, run the checker (core.clj:221-237)."""
     log.info("Analyzing...")
+    # analysis kernels recompile per shape bucket; the persistent
+    # cache makes repeat runs skip straight to the search (lazy here
+    # — not CLI startup — so jax-free commands never import jax)
+    from .util import enable_compilation_cache
+    enable_compilation_cache()
     history = test["history"]
     if not isinstance(history, History):
         history = History(history)

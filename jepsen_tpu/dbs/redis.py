@@ -115,14 +115,19 @@ def replay():
                 break  # torn tail after a crash: ignore, like redis
             if cmd is None:
                 break
-            if cmd and cmd[0].upper() == "SET":
+            if not cmd:
+                continue
+            if cmd[0].upper() == "SET":
                 DATA[cmd[1]] = cmd[2]
+            elif cmd[0].upper() == "DEL":
+                for k in cmd[1:]:
+                    DATA.pop(k, None)
 
-def persist(key, val):
+def persist(*cmd):
     if args.appendonly != "yes":
         return
     with open(AOF, "ab") as fh:
-        fh.write(enc_cmd(["SET", key, val]))
+        fh.write(enc_cmd(list(cmd)))
         fh.flush()
         os.fsync(fh.fileno())
 
@@ -152,11 +157,13 @@ class Handler(socketserver.StreamRequestHandler):
                 return b"$%d\r\n%s\r\n" % (len(b), b)
             if op == "SET":
                 DATA[cmd[1]] = cmd[2]
-                persist(cmd[1], cmd[2])
+                persist("SET", cmd[1], cmd[2])
                 return b"+OK\r\n"
             if op == "DEL":
                 n = sum(1 for k in cmd[1:] if DATA.pop(k, None)
                         is not None)
+                if n:  # acknowledged deletes must survive kill -9 too
+                    persist("DEL", *cmd[1:])
                 return b":%d\r\n" % n
             if op == "EVAL":
                 if cmd[1] != CAS_LUA:
@@ -164,7 +171,7 @@ class Handler(socketserver.StreamRequestHandler):
                 key, old, new = cmd[3], cmd[4], cmd[5]
                 if DATA.get(key) == old:
                     DATA[key] = new
-                    persist(key, new)
+                    persist("SET", key, new)
                     return b":1\r\n"
                 return b":0\r\n"
             return b"-ERR unknown command '%s'\r\n" % op.encode()
@@ -419,12 +426,16 @@ def redis_test(options: dict) -> dict:
     """Test map from CLI options (disque.clj suite shape: register
     workload under a kill/restart nemesis).
 
-    `server` option: "mini" (default — live in-repo mini-redis
-    subprocesses over the localexec sandbox remote, key-sharded
-    standalone servers) or "source" (build real redis from the release
-    tarball on SSH/docker nodes, each worker driving its own node)."""
+    `server` option: "mini" (live in-repo mini-redis subprocesses over
+    the localexec sandbox remote, key-sharded standalone servers) or
+    "source" (build real redis from the release tarball on SSH/docker
+    nodes, each worker driving its own node). Default: "source" when
+    an ssh config is provided (a real cluster is being pointed at —
+    silently toy-testing localhost instead would report a verdict
+    about nothing), else "mini"."""
     nodes = options["nodes"]
-    mode = options.get("server") or "mini"
+    mode = options.get("server") or \
+        ("source" if options.get("ssh") else "mini")
     w = linearizable_register.workload(
         {"nodes": nodes,
          "concurrency": options["concurrency"],
@@ -482,9 +493,10 @@ REDIS_OPTS = [
     cli.Opt("name", metavar="NAME", default=None),
     cli.Opt("store_root", metavar="DIR", default="store",
             help="Where to write results"),
-    cli.Opt("server", metavar="MODE", default="mini",
+    cli.Opt("server", metavar="MODE", default=None,
             help="mini (live in-repo RESP servers, localexec) or "
-                 "source (build real redis from tarball)"),
+                 "source (build real redis from tarball); default "
+                 "source with an --ssh config, else mini"),
     cli.Opt("version", metavar="VERSION", default=VERSION,
             help="redis release to build (server=source)"),
     cli.Opt("sandbox", metavar="DIR", default="redis-cluster",

@@ -415,6 +415,10 @@ def check(model: Model, history: History, time_limit: Optional[float] = None,
     # on a wedged accelerator runtime (this environment's default
     # platform pin makes that reachable from any unpinned process) —
     # bound the wait and let callers fall back to the host oracle.
+    # The init wait spends the CALLER'S budget (deadline is anchored
+    # here, not after the wait): a 60 s time_limit must mean 60 s of
+    # wall, matching the batched entry points' accounting.
+    t_enter = _time.monotonic()
     if not backend_ready(min(60.0, time_limit) if time_limit
                          else None):
         return {"valid?": "unknown", "cause": "backend-init-timeout",
@@ -490,7 +494,7 @@ def check(model: Model, history: History, time_limit: Optional[float] = None,
               jnp.asarray(enc.table), jnp.int32(n), jnp.int32(enc.n_info),
               jnp.int32(min(max_configs, 2**31 - 1)))
     carry = init_fn(0)
-    deadline = _time.monotonic() + time_limit if time_limit else None
+    deadline = t_enter + time_limit if time_limit else None
     t0 = _time.monotonic()
     first_call_s = None
     while True:

@@ -166,6 +166,14 @@ def backend_ready(timeout: Optional[float] = None) -> bool:
     return ev.wait(timeout) and bool(_backend_probe.get("ok"))
 
 
+def backend_failed() -> bool:
+    """True once the shared init probe has recorded a DEFINITIVE
+    failure (jax.devices() raised). Lets pollers distinguish
+    failed-fast from still-initializing instead of spinning out their
+    full timeout."""
+    return _backend_probe.get("ok") is False
+
+
 def enable_compilation_cache(path: Optional[str] = None
                              ) -> Optional[str]:
     """Point XLA's persistent compilation cache at a stable directory

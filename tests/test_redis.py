@@ -227,6 +227,8 @@ def test_mini_aof_survives_kill(tmp_path):
                 time.sleep(0.1)
         assert conn.cmd("SET", "k", "42") == "OK"
         assert conn.cmd("EVAL", redis.CAS_LUA, 1, "k", "42", "43") == 1
+        assert conn.cmd("SET", "gone", "1") == "OK"
+        assert conn.cmd("DEL", "gone") == 1
         conn.close()
         proc.send_signal(signal.SIGKILL)
         proc.wait(timeout=10)
@@ -240,6 +242,8 @@ def test_mini_aof_survives_kill(tmp_path):
                 assert time.monotonic() < deadline, "no restart"
                 time.sleep(0.1)
         assert conn.cmd("GET", "k") == "43"
+        # acknowledged deletes survive the crash too (AOF replays DEL)
+        assert conn.cmd("GET", "gone") is None
         conn.close()
     finally:
         proc.kill()

@@ -1,10 +1,13 @@
-"""Opt-in randomized differential soak: device WGL vs host oracle over
-hundreds of randomized histories (the reference gates its perf tier
-behind lein selectors, project.clj:42-47; this gates behind an env
-var). Run with JEPSEN_TPU_SOAK=1 [JEPSEN_TPU_SOAK_S=120].
+"""Randomized differential soak: device WGL vs host oracle over
+randomized histories, ON BY DEFAULT with a bounded wall-clock budget
+(default 45 s; JEPSEN_TPU_SOAK_S overrides) so kernel regressions
+cannot hide behind the fixed seeds elsewhere in the suite. Opt OUT
+with JEPSEN_TPU_SOAK=0 (the reference gates its perf tier behind lein
+selectors, project.clj:42-47; this inverts the gate per VERDICT r2
+#10). A deep run is JEPSEN_TPU_SOAK_S=300.
 
-Last full run: 881 histories across cas/register/mutex with mixed
-lie/crash rates, 0 verdict mismatches."""
+Last full 120 s run: 881 histories across cas/register/mutex with
+mixed lie/crash rates, 0 verdict mismatches."""
 
 import os
 import random
@@ -17,10 +20,10 @@ from jepsen_tpu.models import cas_register, mutex
 from jepsen_tpu.ops import wgl, wgl_ref
 
 
-@pytest.mark.skipif(not os.environ.get("JEPSEN_TPU_SOAK"),
-                    reason="soak tier: set JEPSEN_TPU_SOAK=1")
+@pytest.mark.skipif(os.environ.get("JEPSEN_TPU_SOAK", "1") == "0",
+                    reason="soak tier disabled: JEPSEN_TPU_SOAK=0")
 def test_differential_soak():
-    budget = float(os.environ.get("JEPSEN_TPU_SOAK_S", "120"))
+    budget = float(os.environ.get("JEPSEN_TPU_SOAK_S", "45"))
     rng = random.Random(int(os.environ.get("JEPSEN_TPU_SOAK_SEED",
                                            "2026")))
     mismatches = []
