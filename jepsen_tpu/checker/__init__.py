@@ -495,14 +495,20 @@ def set_checker() -> Checker:
 
 def expand_queue_drain_ops(history: History) -> History:
     """Expand :drain ops (value = list of drained elements) into dequeue
-    invoke/ok pairs (checker.clj:594-627)."""
+    invoke/ok pairs (checker.clj:594-627). An INCOMPLETE drain (:info
+    carrying the elements drained before the failure) expands the same
+    way — those elements were acknowledged off the server and must be
+    accounted — but its incompleteness taints any "lost" verdict
+    (TotalQueue downgrades lost -> unknown when a drain didn't
+    finish). A crashed drain with no element list is unanswerable and
+    still raises."""
     out = History()
     for op in history:
         if op.f != "drain":
             out.append(op)
         elif op.is_invoke or op.is_fail:
             continue
-        elif op.is_ok:
+        elif op.is_ok or (op.is_info and isinstance(op.value, list)):
             for el in (op.value or []):
                 out.append(op.with_(type="invoke", f="dequeue", value=None))
                 out.append(op.with_(type="ok", f="dequeue", value=el))
@@ -516,6 +522,11 @@ class TotalQueue(Checker):
     enqueues/dequeues, checker.clj:628-687)."""
 
     def check(self, test, history, opts=None):
+        # an info drain means the queue was never provably emptied:
+        # leftovers are indistinguishable from losses
+        incomplete_drain = any(o.f == "drain" and o.is_info
+                               and isinstance(o.value, list)
+                               for o in history)
         history = expand_queue_drain_ops(history)
         attempts = Multiset(o.value for o in history
                             if o.is_invoke and o.f == "enqueue")
@@ -528,8 +539,17 @@ class TotalQueue(Checker):
         duplicated = dequeues.minus(attempts).minus(unexpected)
         lost = enqueues.minus(dequeues)
         recovered = ok.minus(enqueues)
+        if len(unexpected):
+            valid: Any = False
+        elif len(lost):
+            # undrained-but-present is indistinguishable from lost
+            # when a drain never finished
+            valid = UNKNOWN if incomplete_drain else False
+        else:
+            valid = True
         return {
-            "valid?": len(lost) == 0 and len(unexpected) == 0,
+            "valid?": valid,
+            "incomplete-drain": incomplete_drain,
             "attempt-count": len(attempts),
             "acknowledged-count": len(enqueues),
             "ok-count": len(ok),

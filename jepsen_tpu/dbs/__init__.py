@@ -21,6 +21,13 @@ the shared framework. This package holds this framework's suites:
   with fsync'd AOFs as subprocesses over the localexec remote, so CI
   exercises install -> real-TCP workload -> kill -9 -> AOF replay ->
   checker against live processes.
+- `disque` — the reference's queue-safety exemplar
+  (`disque/src/jepsen/disque.clj`): enqueue/dequeue/drain with
+  total-queue multiset accounting. `mini` mode (default) runs a LIVE
+  in-repo RESP job-queue server per node — at-least-once redelivery,
+  fsync'd AOF, kill -9 recovery — over localexec; `source` mode
+  clone-and-makes real disque. CI drives the live path, including a
+  deterministic volatile-loss counterexample.
 - `zookeeper` — the reference's minimal single-file exemplar
   (`zookeeper/src/jepsen/zookeeper.clj:1-145`): distro-package
   install, myid/zoo.cfg generation, and a znode CAS-register client

@@ -37,6 +37,7 @@ from ..control import localexec, nodeutil
 from ..independent import KV, tuple_
 from ..os_setup import Debian
 from ..workloads import linearizable_register
+from . import miniserver
 
 VERSION = "7.2.5"
 PORT = 6379
@@ -78,31 +79,7 @@ args = p.parse_args()
 AOF = os.path.join(args.dir, "appendonly.aof")
 DATA, LOCK = {}, threading.Lock()
 CAS_LUA = "__CAS_LUA__"
-
-def read_resp(rf):
-    line = rf.readline()
-    if not line:
-        return None
-    if line[:1] != b"*":
-        raise ValueError("expected RESP array, got %r" % line[:16])
-    out = []
-    for _ in range(int(line[1:].strip())):
-        hdr = rf.readline()
-        if hdr[:1] != b"$":
-            raise ValueError("expected bulk string, got %r" % hdr[:16])
-        n = int(hdr[1:].strip())
-        body = rf.read(n + 2)
-        if len(body) < n + 2:
-            raise ValueError("short bulk read")
-        out.append(body[:n].decode())
-    return out
-
-def enc_cmd(args_):
-    out = [b"*%d\r\n" % len(args_)]
-    for a in args_:
-        b = str(a).encode()
-        out.append(b"$%d\r\n%s\r\n" % (len(b), b))
-    return b"".join(out)
+__RESP_COMMON__
 
 def replay():
     if args.appendonly != "yes" or not os.path.exists(AOF):
@@ -187,8 +164,10 @@ Server(("127.0.0.1", args.port), Handler).serve_forever()
 
 # One source of truth for the script text: the server recognizes the
 # suite's CAS script by EXACT text, so the embedded copy must be the
-# module constant, not a duplicate that can drift.
-MINIREDIS_SRC = MINIREDIS_SRC.replace("__CAS_LUA__", CAS_LUA)
+# module constant, not a duplicate that can drift. The shared RESP
+# codec splices in the same way (miniserver.build_src).
+MINIREDIS_SRC = miniserver.build_src(
+    MINIREDIS_SRC.replace("__CAS_LUA__", CAS_LUA))
 
 
 def mini_node_port(test: dict, node: str) -> int:
@@ -201,49 +180,24 @@ def node_for_key(test: dict, k) -> str:
     return _shared(test, k)
 
 
-class MiniRedisDB(jdb.DB, jdb.Process, jdb.LogFiles):
+class MiniRedisDB(miniserver.MiniServerDB):
     """Upload + daemon lifecycle for the in-repo mini-redis: the same
     protocol surface as `RedisDB` but installable on any node with
     python3 — which is what lets CI run the whole suite against live
-    processes (localexec remote)."""
+    processes (localexec remote). Lifecycle shared with every mini
+    server (miniserver.MiniServerDB)."""
 
-    def _start(self, test, node):
-        nodeutil.start_daemon(
-            {"logfile": MINI_LOGFILE, "pidfile": MINI_PIDFILE,
-             "exec": "/usr/bin/python3",
-             "chdir": control.lit("$PWD")},
-            "/usr/bin/python3", "miniredis.py",
-            "--port", str(mini_node_port(test, node)),
-            "--appendonly", "yes", "--dir", ".")
-        nodeutil.await_tcp_port(mini_node_port(test, node), timeout_s=30)
+    script = "miniredis.py"
+    src = MINIREDIS_SRC
+    pidfile = MINI_PIDFILE
+    logfile = MINI_LOGFILE
+    data_files = ("appendonly.aof",)
 
-    def setup(self, test, node):
-        nodeutil.grepkill(f"miniredis.py --port "
-                          f"{mini_node_port(test, node)}")
-        control.exec_("bash", "-c",
-                      f"cat > miniredis.py <<'MINIREDIS_EOF'\n"
-                      f"{MINIREDIS_SRC}\nMINIREDIS_EOF")
-        control.exec_("rm", "-f", "appendonly.aof")
-        self._start(test, node)
+    def port(self, test, node):
+        return mini_node_port(test, node)
 
-    def teardown(self, test, node):
-        nodeutil.stop_daemon(MINI_PIDFILE)
-        nodeutil.grepkill(f"miniredis.py --port "
-                          f"{mini_node_port(test, node)}")
-        control.exec_("rm", "-f", "appendonly.aof", "miniredis.py")
-
-    def start(self, test, node):
-        self._start(test, node)
-        return "started"
-
-    def kill(self, test, node):
-        nodeutil.stop_daemon(MINI_PIDFILE)
-        nodeutil.grepkill(f"miniredis.py --port "
-                          f"{mini_node_port(test, node)}")
-        return "killed"
-
-    def log_files(self, test, node):
-        return [MINI_LOGFILE]
+    def extra_args(self, test, node):
+        return ["--appendonly", "yes", "--dir", "."]
 
 
 class RedisDB(jdb.DB, jdb.Process, jdb.LogFiles):
@@ -426,16 +380,16 @@ def redis_test(options: dict) -> dict:
     """Test map from CLI options (disque.clj suite shape: register
     workload under a kill/restart nemesis).
 
-    `server` option: "mini" (live in-repo mini-redis subprocesses over
-    the localexec sandbox remote, key-sharded standalone servers) or
-    "source" (build real redis from the release tarball on SSH/docker
-    nodes, each worker driving its own node). Default: "source" when
-    an ssh config is provided (a real cluster is being pointed at —
-    silently toy-testing localhost instead would report a verdict
-    about nothing), else "mini"."""
+    `server` option: "mini" (the default — live in-repo mini-redis
+    subprocesses over the localexec sandbox remote, key-sharded
+    standalone servers; ssh/nodes options are ignored) or "source"
+    (build real redis from the release tarball on the SSH/docker
+    cluster you point it at, each worker driving its own node). The
+    default is static and documented rather than sniffed from the ssh
+    options, because the CLI always materializes an ssh dict — pass
+    --server source to drive a real cluster."""
     nodes = options["nodes"]
-    mode = options.get("server") or \
-        ("source" if options.get("ssh") else "mini")
+    mode = options.get("server") or "mini"
     w = linearizable_register.workload(
         {"nodes": nodes,
          "concurrency": options["concurrency"],
@@ -493,10 +447,10 @@ REDIS_OPTS = [
     cli.Opt("name", metavar="NAME", default=None),
     cli.Opt("store_root", metavar="DIR", default="store",
             help="Where to write results"),
-    cli.Opt("server", metavar="MODE", default=None,
-            help="mini (live in-repo RESP servers, localexec) or "
-                 "source (build real redis from tarball); default "
-                 "source with an --ssh config, else mini"),
+    cli.Opt("server", metavar="MODE", default="mini",
+            help="mini (default: live in-repo RESP servers over "
+                 "localexec) or source (build real redis from the "
+                 "tarball on your --ssh cluster)"),
     cli.Opt("version", metavar="VERSION", default=VERSION,
             help="redis release to build (server=source)"),
     cli.Opt("sandbox", metavar="DIR", default="redis-cluster",
