@@ -28,6 +28,12 @@ the shared framework. This package holds this framework's suites:
   fsync'd AOF, kill -9 recovery — over localexec; `source` mode
   clone-and-makes real disque. CI drives the live path, including a
   deterministic volatile-loss counterexample.
+- `sqlite` — the SQL/ACID family exemplar (standing in for galera /
+  percona / stolon / postgres-rds): a LIVE server wrapping stdlib
+  sqlite3 behind the shared RESP wire — micro-op txns in one
+  serializable BEGIN IMMEDIATE, WAL + synchronous=FULL crash safety —
+  driven by elle append/wr and bank workloads under a primary-kill
+  nemesis, all CI-run against live processes.
 - `zookeeper` — the reference's minimal single-file exemplar
   (`zookeeper/src/jepsen/zookeeper.clj:1-145`): distro-package
   install, myid/zoo.cfg generation, and a znode CAS-register client

@@ -389,6 +389,12 @@ def disque_test(options: dict) -> dict:
     # dict, so sniffing it would mis-route): --server source drives a
     # real cluster
     mode = options.get("server") or "mini"
+    if mode == "mini":
+        import logging
+        logging.getLogger(__name__).info(
+            "server=mini: running in-repo mini-disque servers over "
+            "localexec (ssh/nodes are local names); pass "
+            "--server source to drive a real cluster")
     volatile = bool(options.get("volatile"))
     if mode == "mini":
         db: jdb.DB = MiniDisqueDB(volatile=volatile)

@@ -390,6 +390,15 @@ def redis_test(options: dict) -> dict:
     --server source to drive a real cluster."""
     nodes = options["nodes"]
     mode = options.get("server") or "mini"
+    if mode == "mini":
+        # loud, because a user pointing --ssh at a real cluster
+        # without --server source would otherwise silently get a
+        # verdict about toy localhost servers
+        import logging
+        logging.getLogger(__name__).info(
+            "server=mini: running in-repo mini-redis servers over "
+            "localexec (ssh/nodes are local names); pass "
+            "--server source to drive a real cluster")
     w = linearizable_register.workload(
         {"nodes": nodes,
          "concurrency": options["concurrency"],

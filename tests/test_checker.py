@@ -119,6 +119,42 @@ def test_total_queue_drain_expansion():
     assert res["valid?"] is True
 
 
+def test_total_queue_incomplete_drain_accounts_partial():
+    # an :info drain carries the elements acked off the server before
+    # the failure: they must be accounted as dequeues
+    h = H([invoke(0, "enqueue", 1), ok(0, "enqueue", 1),
+           invoke(0, "enqueue", 2), ok(0, "enqueue", 2),
+           invoke(1, "drain", None), info(1, "drain", [1, 2])])
+    res = c.total_queue().check({}, h, {})
+    assert res["valid?"] is True
+    assert res["incomplete-drain"] is True
+    assert res["lost-count"] == 0
+
+
+def test_total_queue_incomplete_drain_downgrades_lost():
+    # leftovers are indistinguishable from losses when a drain never
+    # finished: lost -> unknown, never a hard False
+    h = H([invoke(0, "enqueue", 1), ok(0, "enqueue", 1),
+           invoke(0, "enqueue", 2), ok(0, "enqueue", 2),
+           invoke(1, "drain", None), info(1, "drain", [1])])
+    res = c.total_queue().check({}, h, {})
+    assert res["valid?"] == "unknown"
+    assert res["lost"] == [2]
+    # unexpected elements stay a hard False even with an info drain
+    h2 = H([invoke(0, "enqueue", 1), ok(0, "enqueue", 1),
+            invoke(1, "drain", None), info(1, "drain", [1, 99])])
+    assert c.total_queue().check({}, h2, {})["valid?"] is False
+
+
+def test_total_queue_crashed_drain_without_list_raises():
+    import pytest
+
+    h = H([invoke(0, "enqueue", 1), ok(0, "enqueue", 1),
+           invoke(1, "drain", None), info(1, "drain", None)])
+    with pytest.raises(ValueError):
+        c.expand_queue_drain_ops(h)
+
+
 def test_queue_checker():
     h = H([invoke(0, "enqueue", 1), ok(0, "enqueue", 1),
            invoke(1, "dequeue", None), ok(1, "dequeue", 1)])
