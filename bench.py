@@ -40,7 +40,7 @@ init failure is then an error, never a silent cpu fallback),
 JEPSEN_TPU_BENCH_PROBE_S (default 180, per-attempt backend-probe
 timeout), JEPSEN_TPU_BENCH_PROBE_TOTAL_S (default 330, total probe
 budget across attempts), JEPSEN_TPU_BENCH_EXTRAS (default 1; 0 =
-headline only), JEPSEN_TPU_BENCH_TOTAL_S (default 600, global wall
+headline only), JEPSEN_TPU_BENCH_TOTAL_S (default 780, global wall
 budget — extra configs that would start too close to it are recorded
 as skipped; SIGTERM mid-run still emits the partial JSON line),
 JEPSEN_TPU_BENCH_KEYS / _PER_KEY (independent config, default 100x2000).
@@ -376,7 +376,11 @@ def run_bench() -> tuple[dict, int]:
     n_ops = int(os.environ.get("JEPSEN_TPU_BENCH_OPS", "10000"))
     budget = float(os.environ.get("JEPSEN_TPU_BENCH_BUDGET_S", "120"))
     extras = os.environ.get("JEPSEN_TPU_BENCH_EXTRAS", "1") != "0"
-    total_s = float(os.environ.get("JEPSEN_TPU_BENCH_TOTAL_S", "600"))
+    # budget: worst-case probing (~335 s incl. late re-probe) + the
+    # headline + the adversarial dual-engine config (~125 s) + extras;
+    # configs that would overrun are skipped-and-recorded, and SIGTERM
+    # still emits the partial line if the driver's own budget is less
+    total_s = float(os.environ.get("JEPSEN_TPU_BENCH_TOTAL_S", "780"))
     deadline = time.monotonic() + total_s
 
     probe_diags: list = []

@@ -34,6 +34,10 @@ the shared framework. This package holds this framework's suites:
   serializable BEGIN IMMEDIATE, WAL + synchronous=FULL crash safety —
   driven by elle append/wr and bank workloads under a primary-kill
   nemesis, all CI-run against live processes.
+- `consul` — the HTTP-KV exemplar (consul/src/jepsen/consul.clj):
+  v1/kv client with the reference's two-step INDEX-based CAS recipe,
+  agent automation with primary bootstrap + retry-join (CI-run
+  against a wire-compatible stub).
 - `zookeeper` — the reference's minimal single-file exemplar
   (`zookeeper/src/jepsen/zookeeper.clj:1-145`): distro-package
   install, myid/zoo.cfg generation, and a znode CAS-register client

@@ -1,0 +1,264 @@
+"""Consul test suite — the HTTP-KV exemplar with INDEX-based CAS
+(reference: consul/src/jepsen/consul.clj, consul/client.clj,
+consul/db.clj).
+
+Consul's KV API compares-and-sets on the key's ModifyIndex, not its
+value — so the client's cas is the reference's two-step recipe
+(client.clj:66-80): read the current value AND index, verify the
+value matches, then PUT guarded by ``?cas=<index>``. A concurrent
+write between the read and the guarded PUT bumps the index and the
+CAS honestly fails — the pattern that makes this suite a distinct
+wire contract from etcd's value-compare transactions.
+
+DB automation follows consul/db.clj: release-zip install, one agent
+per node (`-server`, primary bootstraps, the rest `-retry-join` the
+primary), pidfile/logfile daemon, data-dir wipe. CI runs the client
+against a wire-compatible stub (tests/test_consul.py) since no consul
+binary ships in this environment; the register workload rides the
+same independent-tuple machinery as every KV suite.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+from typing import Callable, Optional
+
+try:
+    import requests
+except ImportError:  # surfaced at client construction, not per-op
+    requests = None  # type: ignore[assignment]
+
+from .. import checker as jchecker
+from .. import cli, client as jclient, control, db as jdb
+from .. import generator as gen
+from .. import net as jnet
+from .. import nemesis as jnemesis
+from ..control import nodeutil
+from ..independent import KV, tuple_
+from ..os_setup import Debian
+from ..workloads import linearizable_register
+
+VERSION = "1.6.1"  # consul.clj:70
+HTTP_PORT = 8500
+DIR = "/opt"
+BINARY = f"{DIR}/consul"
+PIDFILE = "/var/run/consul.pid"
+LOGFILE = "/var/log/consul.log"
+DATA_DIR = "/var/lib/consul"
+
+
+def zip_url(version: str) -> str:
+    return (f"https://releases.hashicorp.com/consul/{version}/"
+            f"consul_{version}_linux_amd64.zip")
+
+
+def kv_url(node: str) -> str:
+    return f"http://{node}:{HTTP_PORT}/v1/kv/"
+
+
+class ConsulDB(jdb.DB, jdb.Process, jdb.LogFiles):
+    """Agent lifecycle (consul/db.clj:23-60): the primary bootstraps,
+    the rest retry-join it."""
+
+    def __init__(self, version: str = VERSION):
+        self.version = version
+
+    def _start(self, test, node):
+        primary = test["nodes"][0]
+        args = ["agent", "-server", "-log-level", "debug",
+                "-client", "0.0.0.0", "-bind", node,
+                "-data-dir", DATA_DIR, "-node", node,
+                "-retry-interval", "5s"]
+        if node == primary:
+            args.append("-bootstrap")
+        else:
+            args += ["-retry-join", primary]
+        nodeutil.start_daemon(
+            {"logfile": LOGFILE, "pidfile": PIDFILE, "chdir": DIR},
+            BINARY, *args)
+        nodeutil.await_tcp_port(HTTP_PORT, timeout_s=60)
+
+    def setup(self, test, node):
+        with control.su():
+            nodeutil.install_archive(zip_url(self.version), DIR)
+        self._start(test, node)
+
+    def teardown(self, test, node):
+        nodeutil.stop_daemon(PIDFILE)
+        nodeutil.grepkill("consul agent")
+        with control.su():
+            control.exec_("rm", "-rf", DATA_DIR, LOGFILE)
+
+    def start(self, test, node):
+        self._start(test, node)
+        return "started"
+
+    def kill(self, test, node):
+        nodeutil.stop_daemon(PIDFILE)
+        nodeutil.grepkill("consul agent")
+        return "killed"
+
+    def log_files(self, test, node):
+        return [LOGFILE]
+
+
+class ConsulClient(jclient.Client):
+    """Register client over the v1 KV HTTP API with index-CAS
+    (client.clj:47-80 semantics). `base_url_fn` maps a node to its KV
+    base URL — tests point it at stub servers; `consistency` adds the
+    reference's query-param consistency mode ("consistent"/"stale")."""
+
+    def __init__(self, base_url_fn: Optional[Callable] = None,
+                 consistency: Optional[str] = None,
+                 timeout: float = 5.0):
+        if requests is None:
+            raise ImportError(
+                "the consul suite needs the 'requests' package")
+        self.base_url_fn = base_url_fn or kv_url
+        self.consistency = consistency
+        self.timeout = timeout
+        self.node: Optional[str] = None
+        self.http = None
+
+    def open(self, test, node):
+        c = type(self)(self.base_url_fn, self.consistency,
+                       self.timeout)
+        c.node = node
+        c.http = requests.Session()
+        return c
+
+    def _params(self, extra: Optional[dict] = None) -> dict:
+        p = dict(extra or {})
+        if self.consistency:
+            p[self.consistency] = ""
+        return p
+
+    def kv_get(self, key: str):
+        """(value, modify_index): (None, 0) for a missing key."""
+        http = self.http or requests
+        r = http.get(self.base_url_fn(self.node) + key,
+                     params=self._params(), timeout=self.timeout)
+        if r.status_code == 404:
+            return None, 0
+        r.raise_for_status()
+        body = r.json()[0]
+        raw = body.get("Value")
+        val = (None if raw is None
+               else base64.b64decode(raw).decode())
+        return val, int(body["ModifyIndex"])
+
+    def kv_put(self, key: str, value, cas: Optional[int] = None
+               ) -> bool:
+        http = self.http or requests
+        params = self._params({"cas": cas} if cas is not None else {})
+        r = http.put(self.base_url_fn(self.node) + key,
+                     data=str(value), params=params,
+                     timeout=self.timeout)
+        r.raise_for_status()
+        return r.text.strip() == "true"
+
+    def kv_cas(self, key: str, old, new) -> bool:
+        """The index-CAS recipe (client.clj:66-80): read value+index,
+        value must match, then PUT ?cas=index."""
+        val, index = self.kv_get(key)
+        if val != str(old):
+            return False
+        return self.kv_put(key, new, cas=index)
+
+    def invoke(self, test, op):
+        kv = op["value"]
+        if not isinstance(kv, KV):
+            raise ValueError(f"consul wants [k v] tuples, got {kv!r}")
+        k, v = kv
+        key = f"jepsen/{k}"
+        f = op["f"]
+        try:
+            if f == "read":
+                val, _idx = self.kv_get(key)
+                return {**op, "type": "ok",
+                        "value": tuple_(k, None if val is None
+                                        else int(val))}
+            if f == "write":
+                self.kv_put(key, v)
+                return {**op, "type": "ok"}
+            if f == "cas":
+                old, new = v
+                won = self.kv_cas(key, old, new)
+                return {**op, "type": "ok" if won else "fail"}
+            raise ValueError(f"unknown op {f!r}")
+        except requests.RequestException as e:
+            t = "fail" if f == "read" else "info"
+            return {**op, "type": t, "error": str(e)[:200]}
+
+    def close(self, test):
+        if self.http is not None:
+            self.http.close()
+
+
+def consul_test(options: dict) -> dict:
+    """Test map (consul.clj:23-60 shape): register workload under
+    partition-random-halves, heal, settle, final reads."""
+    nodes = options["nodes"]
+    db = ConsulDB(options.get("version") or VERSION)
+    w = linearizable_register.workload(
+        {"nodes": nodes,
+         "concurrency": options["concurrency"],
+         "per_key_limit": options.get("ops_per_key") or 200,
+         "algorithm": "competition"})
+    interval = options.get("nemesis_interval") or 10.0
+    rate = options.get("rate") or 10.0
+    return {
+        "name": options.get("name")
+            or f"consul-{options.get('version') or VERSION}",
+        "store_root": options.get("store_root") or "store",
+        "nodes": nodes,
+        "concurrency": options["concurrency"],
+        "ssh": options.get("ssh") or {},
+        "os": Debian(),
+        "db": db,
+        "net": jnet.iptables(),
+        "client": ConsulClient(
+            consistency=options.get("consistency")),
+        "nemesis": jnemesis.partition_random_halves(),
+        "checker": jchecker.compose({
+            "register": w["checker"],
+            "exceptions": jchecker.unhandled_exceptions(),
+        }),
+        "generator": gen.time_limit(
+            options.get("time_limit") or 30,
+            gen.nemesis(
+                gen.cycle([gen.sleep(interval),
+                           {"type": "info", "f": "start"},
+                           gen.sleep(interval),
+                           {"type": "info", "f": "stop"}]),
+                gen.stagger(1.0 / rate, w["generator"]))),
+    }
+
+
+CONSUL_OPTS = [
+    cli.Opt("name", metavar="NAME", default=None),
+    cli.Opt("store_root", metavar="DIR", default="store",
+            help="Where to write results"),
+    cli.Opt("version", metavar="VERSION", default=VERSION,
+            help="consul release to install"),
+    cli.Opt("consistency", metavar="LEVEL", default=None,
+            help="KV consistency query param: consistent or stale "
+                 "(empty = consul default)"),
+    cli.Opt("rate", metavar="HZ", default=10.0, parse=float,
+            help="Approximate requests/sec per thread"),
+    cli.Opt("ops_per_key", metavar="N", default=200, parse=int,
+            help="Max operations per key"),
+    cli.Opt("nemesis_interval", metavar="SECONDS", default=10.0,
+            parse=float,
+            help="Seconds between partition start/stop"),
+]
+
+COMMANDS = {
+    **cli.single_test_cmd({"test_fn": consul_test,
+                           "opt_spec": CONSUL_OPTS}),
+    **cli.serve_cmd(),
+}
+
+if __name__ == "__main__":
+    cli.main(COMMANDS)

@@ -71,9 +71,6 @@ def connect():
 
 DB = connect()
 
-def bulkb(s):
-    return bulk(s)
-
 class Handler(socketserver.StreamRequestHandler):
     def handle(self):
         while True:
@@ -95,12 +92,16 @@ class Handler(socketserver.StreamRequestHandler):
             # in-progress transaction on the shared connection
             try:
                 return self.apply_locked(op, cmd)
-            except sqlite3.Error as e:
+            except Exception as e:
+                # ANY failure mid-command must roll back while still
+                # holding the lock, or the shared connection is left
+                # inside an open write transaction for the next thread
                 try:
                     DB.rollback()
                 except sqlite3.Error:
                     pass
-                return b"-ERR sqlite: %s\r\n" % str(e)[:80].encode()
+                return b"-ERR %s: %s\r\n" % (
+                    type(e).__name__.encode(), str(e)[:80].encode())
 
     def apply_locked(self, op, cmd):
             if op == "PING":
@@ -111,6 +112,13 @@ class Handler(socketserver.StreamRequestHandler):
                 DB.execute("BEGIN IMMEDIATE")
                 done = []
                 for f, k, v in mops:
+                    if f == "w":  # blind write: no read needed
+                        DB.execute(
+                            "INSERT INTO kv (k, v) VALUES (?, ?) "
+                            "ON CONFLICT(k) DO UPDATE SET v=excluded.v",
+                            (str(k), json.dumps(v)))
+                        done.append([f, k, v])
+                        continue
                     row = DB.execute(
                         "SELECT v FROM kv WHERE k = ?",
                         (str(k),)).fetchone()
@@ -122,16 +130,10 @@ class Handler(socketserver.StreamRequestHandler):
                             "ON CONFLICT(k) DO UPDATE SET v=excluded.v",
                             (str(k), json.dumps(cur)))
                         done.append([f, k, v])
-                    elif f == "w":
-                        DB.execute(
-                            "INSERT INTO kv (k, v) VALUES (?, ?) "
-                            "ON CONFLICT(k) DO UPDATE SET v=excluded.v",
-                            (str(k), json.dumps(v)))
-                        done.append([f, k, v])
                     else:  # r
                         done.append([f, k, cur])
                 DB.commit()
-                return bulkb(json.dumps(done))
+                return bulk(json.dumps(done))
             if op == "BANKINIT":
                 balances = json.loads(cmd[1])
                 DB.execute("BEGIN IMMEDIATE")
@@ -146,7 +148,7 @@ class Handler(socketserver.StreamRequestHandler):
                 rows = DB.execute(
                     "SELECT acct, bal FROM bank").fetchall()
                 DB.commit()
-                return bulkb(json.dumps(dict(rows)))
+                return bulk(json.dumps(dict(rows)))
             if op == "XFER":
                 src, dst, amt = cmd[1], cmd[2], int(cmd[3])
                 DB.execute("BEGIN IMMEDIATE")
@@ -271,6 +273,12 @@ class SqliteBankClient(SqliteClient):
         try:
             self._conn(test).cmd("BANKINIT", json.dumps(balances))
         except (OSError, ConnectionError, RedisError):
+            # surfaced loudly: an uninitialized bank reads as a false
+            # wrong-total "data loss"; another client's setup may
+            # still succeed (INSERT OR IGNORE is idempotent)
+            import logging
+            logging.getLogger(__name__).warning(
+                "bank setup failed on %s", self.node, exc_info=True)
             self._drop_conn()
 
 

@@ -89,8 +89,11 @@ def _compiled(n_pad: int, e_pad: int, q_pad: int, n_sub: int,
     import jax
     import jax.numpy as jnp
 
-    dtype = jnp.bfloat16 if jax.default_backend() == "tpu" \
-        else jnp.float32
+    from ..util import safe_backend
+
+    # lock-free platform probe: jax.default_backend() would trigger
+    # backend init itself, ahead of the bounded-wait policy upstream
+    dtype = jnp.bfloat16 if safe_backend() == "tpu" else jnp.float32
 
     def kernel(src, dst, w, q_src, q_dst):
         # adjacency per subset: (S, N, N); padded edges carry w == 0
@@ -211,6 +214,35 @@ def cycle_queries(g: DepGraph,
             "util": util}
 
 
+# auto-routing's once-per-process device decision: a platform can be
+# *configured* as an accelerator yet hang at init (this environment's
+# site pin), so configuration alone must never route device-ward
+_AUTO_DECISION: dict = {}
+
+
+def _device_available() -> bool:
+    """Can the auto path safely use the device backend? Requires a
+    non-cpu platform AND a backend that PROVES it can initialize
+    within a short bounded wait (util.backend_ready's shared daemon
+    probe — a wedged init would otherwise hang this main-thread hot
+    path). The verdict is cached per process: the host path is never
+    more than one bounded probe away, and bench/dryrun force
+    backend="tpu" explicitly where the device plane must run."""
+    if "ok" in _AUTO_DECISION:
+        return _AUTO_DECISION["ok"]
+    import importlib.util
+    import os
+
+    from ..util import backend_ready, safe_backend
+    plat = safe_backend()
+    ok = (plat is not None and plat != "cpu"
+          and importlib.util.find_spec("jax") is not None
+          and backend_ready(float(os.environ.get(
+              "JEPSEN_TPU_ELLE_INIT_TIMEOUT_S", "10"))))
+    _AUTO_DECISION["ok"] = ok
+    return ok
+
+
 def standard_cycle_search(g: DepGraph, backend: str = "host",
                           max_n: int = DEFAULT_MAX_N) -> dict:
     """The four-query battery both elle checkers run, on either
@@ -230,21 +262,9 @@ def standard_cycle_search(g: DepGraph, backend: str = "host",
     if backend == "auto":
         # The dense closure only pays off on a real accelerator: 12
         # squarings of (4096)^3 matmuls are milliseconds on the MXU but
-        # minutes on a CPU host, where Tarjan wins at any size. The
-        # probe must never *initialize* a backend here — a wedged
-        # accelerator runtime hangs init rather than raising, and this
-        # is an in-process hot path — so it answers only from safe
-        # sources (env pin / already-initialized backend / explicit
-        # platform config) and defaults to host when unknown.
-        import importlib.util
-
-        from ..util import safe_backend
-        plat = safe_backend()
-        # a stale env pin must not route device-ward when jax itself is
-        # missing/broken — the pure-host path has no jax dependency
-        on_accel = (plat is not None and plat != "cpu"
-                    and importlib.util.find_spec("jax") is not None)
-        backend = "tpu" if (on_accel and len(g.nodes) >= 512
+        # minutes on a CPU host, where Tarjan wins at any size.
+        backend = "tpu" if (_device_available()
+                            and len(g.nodes) >= 512
                             and len(g) >= 512) else "host"
         engine = backend
     if backend == "tpu":

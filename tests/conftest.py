@@ -10,6 +10,10 @@ runs — so we set the platform through jax.config, not just os.environ.
 import os
 
 os.environ["JAX_PLATFORMS"] = "cpu"
+# tests are same-process (jit caches suffice) and the XLA:CPU AOT
+# loader warns loudly on tuning-flag mismatches — keep CI output
+# deterministic and quiet
+os.environ.setdefault("JEPSEN_TPU_NO_CACHE", "1")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

@@ -1,0 +1,147 @@
+"""Consul suite tests: the index-CAS client against a wire-compatible
+v1/kv stub (GET returns the JSON array + ModifyIndex, PUT honors
+?cas=<index>), DB orchestration through the dummy remote, and the
+full suite stack end-to-end over the stub."""
+
+import base64
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+import pytest
+
+from jepsen_tpu import control as c, core
+from jepsen_tpu.control.dummy import DummyRemote
+from jepsen_tpu.dbs import consul
+from jepsen_tpu.independent import tuple_
+
+
+class ConsulStub(BaseHTTPRequestHandler):
+    """The KV subset the suite speaks: per-key value + ModifyIndex,
+    index-guarded CAS puts."""
+
+    data: dict = {}
+    index = [0]
+    lock = threading.Lock()
+
+    def log_message(self, *a):
+        pass
+
+    def _reply(self, code, body: bytes,
+               content_type="application/json"):
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.send_header("X-Consul-Index", str(self.index[0]))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        key = urlparse(self.path).path[len("/v1/kv/"):]
+        with self.lock:
+            ent = self.data.get(key)
+            if ent is None:
+                self._reply(404, b"")
+                return
+            val, idx = ent
+            body = json.dumps([{
+                "CreateIndex": idx, "ModifyIndex": idx, "Key": key,
+                "Flags": 0,
+                "Value": base64.b64encode(
+                    str(val).encode()).decode()}]).encode()
+            self._reply(200, body)
+
+    def do_PUT(self):
+        parsed = urlparse(self.path)
+        key = parsed.path[len("/v1/kv/"):]
+        params = parse_qs(parsed.query, keep_blank_values=True)
+        n = int(self.headers.get("Content-Length") or 0)
+        val = self.rfile.read(n).decode()
+        with self.lock:
+            cur = self.data.get(key)
+            if "cas" in params:
+                want = int(params["cas"][0])
+                have = cur[1] if cur else 0
+                if want != have:
+                    self._reply(200, b"false")
+                    return
+            self.index[0] += 1
+            self.data[key] = (val, self.index[0])
+            self._reply(200, b"true")
+
+
+@pytest.fixture()
+def stub():
+    ConsulStub.data = {}
+    ConsulStub.index = [0]
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), ConsulStub)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    yield f"http://127.0.0.1:{srv.server_address[1]}/v1/kv/"
+    srv.shutdown()
+
+
+def _client(stub):
+    return consul.ConsulClient(
+        base_url_fn=lambda node: stub).open({}, "n1")
+
+
+def test_read_write_cas(stub):
+    cl = _client(stub)
+    rd = {"type": "invoke", "f": "read", "value": tuple_(1, None),
+          "process": 0}
+    assert cl.invoke({}, rd)["value"] == tuple_(1, None)
+    assert cl.invoke({}, {"f": "write", "value": tuple_(1, 4),
+                          "process": 0})["type"] == "ok"
+    assert cl.invoke({}, rd)["value"] == tuple_(1, 4)
+    assert cl.invoke({}, {"f": "cas", "value": tuple_(1, [4, 9]),
+                          "process": 0})["type"] == "ok"
+    assert cl.invoke({}, {"f": "cas", "value": tuple_(1, [4, 2]),
+                          "process": 0})["type"] == "fail"
+    assert cl.invoke({}, rd)["value"] == tuple_(1, 9)
+
+
+def test_index_cas_detects_interleaved_write(stub):
+    """The reference recipe's safety property: a write between the
+    read and the guarded PUT bumps the index, so the CAS fails even
+    though the VALUE matches again (ABA is caught by the index)."""
+    cl = _client(stub)
+    cl.kv_put("k", 1)
+    val, idx = cl.kv_get("k")
+    assert (val, idx > 0) == ("1", True)
+    # interleaved writer: 1 -> 2 -> 1 (value restored, index bumped)
+    cl.kv_put("k", 2)
+    cl.kv_put("k", 1)
+    assert cl.kv_put("k", 3, cas=idx) is False
+    assert cl.kv_get("k")[0] == "1"
+
+
+def test_db_commands():
+    log: list = []
+    db = consul.ConsulDB()
+    test = {"nodes": ["n1", "n2"]}
+    with c.with_remote(DummyRemote(log)):
+        with c.on("n1"):
+            db.setup(test, "n1")
+        with c.on("n2"):
+            db.setup(test, "n2")
+        with c.on("n1"):
+            db.teardown(test, "n1")
+    cmds = [x[1] for x in log if isinstance(x[1], str)]
+    joined = "\n".join(cmds)
+    assert "consul" in joined and "-bootstrap" in joined
+    assert "-retry-join n1" in joined  # non-primary joins the primary
+    assert db.log_files(test, "n1") == [consul.LOGFILE]
+
+
+def test_full_suite_with_stub(stub, tmp_path):
+    opts = {"nodes": ["n1", "n2"], "concurrency": 4, "time_limit": 4,
+            "ops_per_key": 15, "rate": 200.0,
+            "store_root": str(tmp_path / "store"),
+            "ssh": {"dummy?": True}}
+    t = consul.consul_test(opts)
+    t["client"] = consul.ConsulClient(base_url_fn=lambda node: stub)
+    t["name"] = "consul-stub"
+    done = core.run(t)
+    assert done["results"]["valid?"] is True
+    assert done["results"]["register"]["valid?"] is True
