@@ -18,12 +18,18 @@ def _resolve(node: str) -> str:
 def ip(node: str) -> str:
     """The IP address for a node name. Resolved on the control node first
     (cheap); falls back to `getent` on the current session's host
-    (control/net.clj's ip)."""
+    (control/net.clj's ip). Unresolvable names come back unchanged —
+    best effort: scripted/dummy remotes have no resolver, and a real
+    cluster with broken DNS should surface the daemon's own bind error
+    rather than a harness crash."""
     try:
         return _resolve(node)
     except OSError:
-        out = exec_("getent", "hosts", node)
-        return out.split()[0]
+        try:
+            out = exec_("getent", "hosts", node)
+            return out.split()[0]
+        except Exception:  # noqa: BLE001 — no resolver on this remote
+            return node
 
 
 def local_ip() -> str:

@@ -21,7 +21,6 @@ same independent-tuple machinery as every KV suite.
 from __future__ import annotations
 
 import base64
-import json
 from typing import Callable, Optional
 
 try:
@@ -65,15 +64,19 @@ class ConsulDB(jdb.DB, jdb.Process, jdb.LogFiles):
         self.version = version
 
     def _start(self, test, node):
+        from ..control import netinfo
+
         primary = test["nodes"][0]
+        # consul requires real IPs for -bind / -retry-join (db.clj
+        # resolves via net/ip); hostnames make the agent exit at boot
         args = ["agent", "-server", "-log-level", "debug",
-                "-client", "0.0.0.0", "-bind", node,
+                "-client", "0.0.0.0", "-bind", netinfo.ip(node),
                 "-data-dir", DATA_DIR, "-node", node,
                 "-retry-interval", "5s"]
         if node == primary:
             args.append("-bootstrap")
         else:
-            args += ["-retry-join", primary]
+            args += ["-retry-join", netinfo.ip(primary)]
         nodeutil.start_daemon(
             {"logfile": LOGFILE, "pidfile": PIDFILE, "chdir": DIR},
             BINARY, *args)
