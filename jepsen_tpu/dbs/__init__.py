@@ -34,6 +34,12 @@ the shared framework. This package holds this framework's suites:
   serializable BEGIN IMMEDIATE, WAL + synchronous=FULL crash safety —
   driven by elle append/wr and bank workloads under a primary-kill
   nemesis, all CI-run against live processes.
+- `mongodb` — the document-store family (mongodb-rocks /
+  mongodb-smartos): a from-scratch BSON subset codec + OP_MSG wire
+  framing, document-CAS via conditional updates (nModified decides),
+  write-concern knobs, deb install + replica-set initiation issued
+  over the suite's own wire client (CI-run against a wire-compatible
+  OP_MSG stub).
 - `consul` — the HTTP-KV exemplar (consul/src/jepsen/consul.clj):
   v1/kv client with the reference's two-step INDEX-based CAS recipe,
   agent automation with primary bootstrap + retry-join (CI-run

@@ -1,0 +1,402 @@
+"""MongoDB test suite — the document-store family exemplar
+(reference: mongodb-rocks/src/jepsen/mongodb_rocks.clj and
+mongodb-smartos/src/jepsen/mongodb_smartos/document_cas.clj).
+
+The wire layer is from scratch: a BSON subset codec (int32/int64/
+double/string/document/array/bool/null — everything the suite's
+commands touch) and OP_MSG framing (the modern mongo wire protocol:
+message header + flagBits + one kind-0 body section). On top of it,
+the reference's document-CAS semantics (document_cas.clj:50-82):
+
+- read  — `find` by _id (primary read preference),
+- write — `update` by _id with upsert,
+- cas   — `update` filtered on {_id, value: old}: nModified tells
+  whether the compare won (0 = fail, 1 = ok) — mongo's conditional
+  update IS the compare-and-set.
+
+Write/read concerns ride the command documents (`writeConcern:
+{w: majority}`), matching the reference's WriteConcern knobs. Ops use
+[k v] independent tuples (one document per key in jepsen.registers).
+
+DB automation: deb-package install (mongodb_rocks.clj:29-38 pattern),
+mongod --replSet daemon per node, and replica-set initiation issued
+over this module's own wire client as `replSetInitiate` against the
+primary (the reference drives the same command through monger). CI
+runs the client against a wire-compatible OP_MSG stub
+(tests/test_mongodb.py); no mongod ships in this environment.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+from typing import Optional
+
+from .. import checker as jchecker
+from .. import cli, client as jclient, control, db as jdb
+from .. import generator as gen
+from .. import net as jnet
+from .. import nemesis as jnemesis
+from ..control import nodeutil
+from ..independent import KV, tuple_
+from ..os_setup import Debian
+from ..workloads import linearizable_register
+
+VERSION = "3.2.0"
+PORT = 27017
+DEB_URL = ("https://repo.mongodb.org/apt/debian/dists/jessie/mongodb-org"
+           "/{v}/main/binary-amd64/mongodb-org-server_{v}_amd64.deb")
+PIDFILE = "/var/run/mongod.pid"
+LOGFILE = "/var/log/mongodb/mongod.log"
+DATA_DIR = "/var/lib/mongodb"
+REPL_SET = "jepsen"
+
+
+# -- BSON subset codec ------------------------------------------------------
+
+def _enc_elem(name: str, v) -> bytes:
+    nb = name.encode() + b"\x00"
+    if isinstance(v, bool):  # before int: bool is an int subclass
+        return b"\x08" + nb + (b"\x01" if v else b"\x00")
+    if isinstance(v, int):
+        if -(2**31) <= v < 2**31:
+            return b"\x10" + nb + struct.pack("<i", v)
+        return b"\x12" + nb + struct.pack("<q", v)
+    if isinstance(v, float):
+        return b"\x01" + nb + struct.pack("<d", v)
+    if isinstance(v, str):
+        sb = v.encode() + b"\x00"
+        return b"\x02" + nb + struct.pack("<i", len(sb)) + sb
+    if v is None:
+        return b"\x0a" + nb
+    if isinstance(v, dict):
+        return b"\x03" + nb + bson_encode(v)
+    if isinstance(v, (list, tuple)):
+        doc = {str(i): x for i, x in enumerate(v)}
+        return b"\x04" + nb + bson_encode(doc)
+    raise TypeError(f"bson: unsupported type {type(v).__name__}")
+
+
+def bson_encode(doc: dict) -> bytes:
+    body = b"".join(_enc_elem(str(k), v) for k, v in doc.items())
+    return struct.pack("<i", len(body) + 5) + body + b"\x00"
+
+
+def _dec_elem(buf: bytes, off: int):
+    tag = buf[off]
+    off += 1
+    end = buf.index(b"\x00", off)
+    name = buf[off:end].decode()
+    off = end + 1
+    if tag == 0x10:
+        return name, struct.unpack_from("<i", buf, off)[0], off + 4
+    if tag == 0x12:
+        return name, struct.unpack_from("<q", buf, off)[0], off + 8
+    if tag == 0x01:
+        return name, struct.unpack_from("<d", buf, off)[0], off + 8
+    if tag == 0x02:
+        n = struct.unpack_from("<i", buf, off)[0]
+        s = buf[off + 4:off + 4 + n - 1].decode()
+        return name, s, off + 4 + n
+    if tag == 0x08:
+        return name, buf[off] == 1, off + 1
+    if tag == 0x0A:
+        return name, None, off
+    if tag in (0x03, 0x04):
+        n = struct.unpack_from("<i", buf, off)[0]
+        sub, _ = bson_decode(buf[off:off + n])
+        if tag == 0x04:
+            return name, [sub[k] for k in sorted(sub, key=int)], off + n
+        return name, sub, off + n
+    raise ValueError(f"bson: unsupported tag 0x{tag:02x}")
+
+
+def bson_decode(buf: bytes) -> tuple[dict, int]:
+    """Decode one document; returns (doc, bytes consumed)."""
+    n = struct.unpack_from("<i", buf, 0)[0]
+    out: dict = {}
+    off = 4
+    while buf[off] != 0:
+        name, v, off = _dec_elem(buf, off)
+        out[name] = v
+    return out, n
+
+
+# -- OP_MSG framing ---------------------------------------------------------
+
+OP_MSG = 2013
+
+
+def encode_op_msg(doc: dict, request_id: int) -> bytes:
+    body = struct.pack("<I", 0) + b"\x00" + bson_encode(doc)
+    header = struct.pack("<iiii", 16 + len(body), request_id, 0, OP_MSG)
+    return header + body
+
+
+def read_op_msg(rf) -> dict:
+    header = rf.read(16)
+    if len(header) < 16:
+        raise ConnectionError("short read in message header")
+    length, _rid, _rto, opcode = struct.unpack("<iiii", header)
+    body = rf.read(length - 16)
+    if len(body) < length - 16:
+        raise ConnectionError("short read in message body")
+    if opcode != OP_MSG:
+        raise ValueError(f"unsupported opcode {opcode}")
+    # flagBits (4) + section kind byte (1) + BSON body
+    if body[4] != 0:
+        raise ValueError(f"unsupported section kind {body[4]}")
+    doc, _ = bson_decode(body[5:])
+    return doc
+
+
+class MongoError(Exception):
+    pass
+
+
+class MongoConn:
+    """One blocking OP_MSG connection."""
+
+    def __init__(self, host: str, port: int, timeout: float = 5.0):
+        self.sock = socket.create_connection((host, port),
+                                             timeout=timeout)
+        self.sock.settimeout(timeout)
+        self.rf = self.sock.makefile("rb")
+        self._rid = 0
+        self._lock = threading.Lock()
+
+    def cmd(self, doc: dict) -> dict:
+        with self._lock:
+            self._rid += 1
+            self.sock.sendall(encode_op_msg(doc, self._rid))
+            reply = read_op_msg(self.rf)
+        if reply.get("ok") != 1:
+            raise MongoError(reply.get("errmsg") or f"not ok: {reply}")
+        return reply
+
+    def close(self):
+        try:
+            self.rf.close()
+            self.sock.close()
+        except OSError:
+            pass
+
+
+# -- DB automation ----------------------------------------------------------
+
+class MongoDB(jdb.DB, jdb.Process, jdb.LogFiles):
+    """deb install + mongod --replSet daemon + replica-set initiation
+    from the primary, issued over this module's own wire client
+    (mongodb_rocks.clj:29-38 install; core.clj rs-initiate)."""
+
+    def __init__(self, version: str = VERSION):
+        self.version = version
+
+    def _start(self, test, node):
+        nodeutil.start_daemon(
+            {"logfile": LOGFILE, "pidfile": PIDFILE, "chdir": "/"},
+            "mongod",
+            "--replSet", REPL_SET,
+            "--dbpath", DATA_DIR,
+            "--port", str(PORT),
+            "--bind_ip", "0.0.0.0",
+            "--fork", "--logpath", LOGFILE,
+            "--pidfilepath", PIDFILE)
+        nodeutil.await_tcp_port(PORT, timeout_s=120)
+
+    def setup(self, test, node):
+        with control.su():
+            deb = DEB_URL.format(v=self.version)
+            control.exec_("bash", "-c",
+                          f"test -f /tmp/mongodb.deb || "
+                          f"wget -O /tmp/mongodb.deb {deb}")
+            control.exec_("dpkg", "-i", "--force-confnew",
+                          "/tmp/mongodb.deb")
+            control.exec_("mkdir", "-p", DATA_DIR,
+                          "/var/log/mongodb")
+        self._start(test, node)
+        if node == test["nodes"][0]:
+            # the primary initiates the replica set over the wire
+            try:
+                conn = MongoConn("127.0.0.1", PORT, timeout=30)
+                try:
+                    conn.cmd({"replSetInitiate": {
+                        "_id": REPL_SET,
+                        "members": [{"_id": i, "host": f"{n}:{PORT}"}
+                                    for i, n in
+                                    enumerate(test["nodes"])]},
+                        "$db": "admin"})
+                except MongoError:
+                    pass  # already initiated (re-setup after teardown)
+                finally:
+                    conn.close()
+            except OSError as e:
+                # scripted/dummy remotes have no live daemon to dial;
+                # on a real cluster await_tcp_port already proved the
+                # port, so log loudly rather than kill the setup
+                import logging
+                logging.getLogger(__name__).warning(
+                    "replSetInitiate connection failed: %s", e)
+
+    def teardown(self, test, node):
+        nodeutil.stop_daemon(PIDFILE)
+        nodeutil.grepkill("mongod")
+        with control.su():
+            control.exec_("rm", "-rf", DATA_DIR, LOGFILE)
+
+    def start(self, test, node):
+        self._start(test, node)
+        return "started"
+
+    def kill(self, test, node):
+        nodeutil.stop_daemon(PIDFILE)
+        nodeutil.grepkill("mongod")
+        return "killed"
+
+    def log_files(self, test, node):
+        return [LOGFILE]
+
+
+# -- client -----------------------------------------------------------------
+
+class MongoClient(jclient.Client):
+    """Document-CAS register client (document_cas.clj:50-82): one
+    document per key in jepsen.registers; cas = conditional update,
+    nModified decides. `addr_fn` maps a node to (host, port) — tests
+    point it at the stub; `write_concern` rides every update."""
+
+    DB_NAME = "jepsen"
+    COLL = "registers"
+
+    def __init__(self, addr_fn=None, write_concern: str = "majority",
+                 timeout: float = 5.0):
+        self.addr_fn = addr_fn or (lambda test, node: (node, PORT))
+        self.write_concern = write_concern
+        self.timeout = timeout
+        self.node: Optional[str] = None
+        self.conn: Optional[MongoConn] = None
+
+    def open(self, test, node):
+        c = type(self)(self.addr_fn, self.write_concern, self.timeout)
+        c.node = node
+        return c
+
+    def _conn(self, test) -> MongoConn:
+        if self.conn is None:
+            host, port = self.addr_fn(test, self.node)
+            self.conn = MongoConn(host, port, self.timeout)
+        return self.conn
+
+    def _update(self, test, q: dict, u: dict, upsert: bool) -> dict:
+        return self._conn(test).cmd({
+            "update": self.COLL, "$db": self.DB_NAME,
+            "updates": [{"q": q, "u": u, "upsert": upsert}],
+            "writeConcern": {"w": self.write_concern}})
+
+    def invoke(self, test, op):
+        kv = op["value"]
+        if not isinstance(kv, KV):
+            raise ValueError(f"mongodb wants [k v] tuples, got {kv!r}")
+        k, v = kv
+        f = op["f"]
+        if f not in ("read", "write", "cas"):
+            raise ValueError(f"unknown op {f!r}")
+        try:
+            if f == "read":
+                reply = self._conn(test).cmd({
+                    "find": self.COLL, "$db": self.DB_NAME,
+                    "filter": {"_id": int(k)}, "limit": 1,
+                    "$readPreference": {"mode": "primary"}})
+                batch = reply["cursor"]["firstBatch"]
+                cur = batch[0]["value"] if batch else None
+                return {**op, "type": "ok", "value": tuple_(k, cur)}
+            if f == "write":
+                self._update(test, {"_id": int(k)},
+                             {"_id": int(k), "value": v}, upsert=True)
+                return {**op, "type": "ok"}
+            if f == "cas":
+                old, new = v
+                reply = self._update(
+                    test, {"_id": int(k), "value": old},
+                    {"_id": int(k), "value": new}, upsert=False)
+                n = reply.get("nModified", reply.get("n", 0))
+                if n not in (0, 1):
+                    raise MongoError(f"cas touched {n} documents")
+                return {**op, "type": "ok" if n == 1 else "fail"}
+        except (OSError, ConnectionError, MongoError, KeyError) as e:
+            if self.conn is not None:
+                self.conn.close()
+                self.conn = None
+            # reads never applied anything -> definite fail; writes
+            # and cas may have applied -> indefinite info
+            # (document_cas.clj:51-52 error discipline)
+            t = "fail" if f == "read" else "info"
+            return {**op, "type": t, "error": str(e)[:200]}
+
+    def close(self, test):
+        if self.conn is not None:
+            self.conn.close()
+
+
+def mongodb_test(options: dict) -> dict:
+    """Register workload under partition-random-halves (the
+    document_cas suite shape)."""
+    nodes = options["nodes"]
+    db = MongoDB(options.get("version") or VERSION)
+    w = linearizable_register.workload(
+        {"nodes": nodes,
+         "concurrency": options["concurrency"],
+         "per_key_limit": options.get("per_key_limit") or 100,
+         "algorithm": "competition"})
+    interval = options.get("nemesis_interval") or 10.0
+    return {
+        "name": options.get("name") or f"mongodb-{VERSION}",
+        "store_root": options.get("store_root") or "store",
+        "nodes": nodes,
+        "concurrency": options["concurrency"],
+        "ssh": options.get("ssh") or {},
+        "os": Debian(),
+        "db": db,
+        "net": jnet.iptables(),
+        "client": MongoClient(
+            write_concern=options.get("write_concern") or "majority"),
+        "nemesis": jnemesis.partition_random_halves(),
+        "checker": jchecker.compose({
+            "register": w["checker"],
+            "exceptions": jchecker.unhandled_exceptions(),
+        }),
+        "generator": gen.time_limit(
+            options.get("time_limit") or 30,
+            gen.nemesis(
+                gen.cycle([gen.sleep(interval),
+                           {"type": "info", "f": "start"},
+                           gen.sleep(interval),
+                           {"type": "info", "f": "stop"}]),
+                w["generator"])),
+    }
+
+
+MONGODB_OPTS = [
+    cli.Opt("name", metavar="NAME", default=None),
+    cli.Opt("store_root", metavar="DIR", default="store",
+            help="Where to write results"),
+    cli.Opt("version", metavar="VERSION", default=VERSION,
+            help="mongodb-org-server deb version"),
+    cli.Opt("write_concern", metavar="W", default="majority",
+            help="write concern for updates (majority, 1, ...)"),
+    cli.Opt("per_key_limit", metavar="N", default=100, parse=int,
+            help="Ops per key"),
+    cli.Opt("nemesis_interval", metavar="SECONDS", default=10.0,
+            parse=float,
+            help="Seconds between partition start/stop"),
+]
+
+COMMANDS = {
+    **cli.single_test_cmd({"test_fn": mongodb_test,
+                           "opt_spec": MONGODB_OPTS}),
+    **cli.serve_cmd(),
+}
+
+if __name__ == "__main__":
+    cli.main(COMMANDS)

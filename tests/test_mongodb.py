@@ -1,0 +1,173 @@
+"""MongoDB suite tests: the from-scratch BSON/OP_MSG codec
+(round-trips + golden bytes), the document-CAS client against a
+wire-compatible OP_MSG stub, DB orchestration through the dummy
+remote, and the full suite stack end-to-end over the stub."""
+
+import socketserver
+import struct
+import threading
+
+import pytest
+
+from jepsen_tpu import control as c, core
+from jepsen_tpu.control.dummy import DummyRemote
+from jepsen_tpu.dbs import mongodb as mdb
+from jepsen_tpu.independent import tuple_
+
+
+# -- codec ------------------------------------------------------------------
+
+def test_bson_roundtrip():
+    doc = {"int": 7, "big": 2**40, "s": "hi", "b": True, "n": None,
+           "d": {"x": 1}, "a": [1, "two", {"y": False}],
+           "f": 2.5}
+    out, n = mdb.bson_decode(mdb.bson_encode(doc))
+    assert out == doc
+    assert n == len(mdb.bson_encode(doc))
+
+
+def test_bson_golden_bytes():
+    # {"a": 1} -> int32 len=12, 0x10 'a' 00, int32 1, 00
+    assert mdb.bson_encode({"a": 1}) == \
+        b"\x0c\x00\x00\x00\x10a\x00\x01\x00\x00\x00\x00"
+
+
+def test_op_msg_roundtrip():
+    import io
+    msg = mdb.encode_op_msg({"ping": 1, "$db": "admin"}, 42)
+    length, rid, rto, opcode = struct.unpack("<iiii", msg[:16])
+    assert (length, rid, opcode) == (len(msg), 42, 2013)
+    doc = mdb.read_op_msg(io.BytesIO(msg))
+    assert doc == {"ping": 1, "$db": "admin"}
+
+
+# -- wire-compatible stub ---------------------------------------------------
+
+class MongoStub(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+    def __init__(self, *a, **kw):
+        super().__init__(*a, **kw)
+        self.colls: dict = {}
+        self.lock = threading.Lock()
+        self.commands: list = []
+
+
+class MongoStubHandler(socketserver.StreamRequestHandler):
+    def handle(self):
+        while True:
+            try:
+                hdr = self.rfile.peek(4)
+                doc = mdb.read_op_msg(self.rfile)
+            except (ConnectionError, ValueError, struct.error):
+                return
+            if not doc:
+                return
+            reply = self.apply(doc)
+            self.wfile.write(mdb.encode_op_msg(reply, 0))
+            self.wfile.flush()
+
+    def apply(self, doc):
+        srv = self.server
+        with srv.lock:
+            srv.commands.append(doc)
+            if "find" in doc:
+                coll = srv.colls.get(doc["find"], {})
+                flt = doc.get("filter") or {}
+                batch = [d for d in coll.values()
+                         if all(d.get(k) == v for k, v in flt.items())]
+                return {"ok": 1, "cursor": {"id": 0,
+                                            "firstBatch": batch}}
+            if "update" in doc:
+                coll = srv.colls.setdefault(doc["update"], {})
+                n = modified = 0
+                for u in doc["updates"]:
+                    q, new = u["q"], u["u"]
+                    hits = [d for d in coll.values()
+                            if all(d.get(k) == v
+                                   for k, v in q.items())]
+                    if hits:
+                        for d in hits:
+                            coll[d["_id"]] = dict(new)
+                            n += 1
+                            modified += 1
+                    elif u.get("upsert"):
+                        coll[new["_id"]] = dict(new)
+                        n += 1
+                return {"ok": 1, "n": n, "nModified": modified}
+            if "replSetInitiate" in doc:
+                return {"ok": 1}
+            return {"ok": 0, "errmsg": f"no such command: {doc}"}
+
+
+@pytest.fixture()
+def stub():
+    srv = MongoStub(("127.0.0.1", 0), MongoStubHandler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    yield srv
+    srv.shutdown()
+
+
+def _client(stub):
+    port = stub.server_address[1]
+    return mdb.MongoClient(
+        addr_fn=lambda test, node: ("127.0.0.1", port)).open({}, "n1")
+
+
+def test_document_cas_semantics(stub):
+    cl = _client(stub)
+    rd = {"type": "invoke", "f": "read", "value": tuple_(1, None),
+          "process": 0}
+    assert cl.invoke({}, rd)["value"] == tuple_(1, None)
+    assert cl.invoke({}, {"f": "write", "value": tuple_(1, 3),
+                          "process": 0})["type"] == "ok"
+    assert cl.invoke({}, rd)["value"] == tuple_(1, 3)
+    assert cl.invoke({}, {"f": "cas", "value": tuple_(1, [3, 5]),
+                          "process": 0})["type"] == "ok"
+    assert cl.invoke({}, {"f": "cas", "value": tuple_(1, [3, 7]),
+                          "process": 0})["type"] == "fail"
+    assert cl.invoke({}, rd)["value"] == tuple_(1, 5)
+    # write concern rides every update command
+    upd = [d for d in stub.commands if "update" in d]
+    assert all(d["writeConcern"] == {"w": "majority"} for d in upd)
+
+
+def test_client_down_server_contained():
+    cl = mdb.MongoClient(
+        addr_fn=lambda test, node: ("127.0.0.1", 1),
+        timeout=0.2).open({}, "n1")
+    assert cl.invoke({}, {"f": "read", "value": tuple_(1, None),
+                          "process": 0})["type"] == "fail"
+    assert cl.invoke({}, {"f": "write", "value": tuple_(1, 2),
+                          "process": 0})["type"] == "info"
+
+
+def test_db_commands():
+    log: list = []
+    db = mdb.MongoDB()
+    test = {"nodes": ["n1"]}
+    with c.with_remote(DummyRemote(log)):
+        with c.on("n1"):
+            db.kill(test, "n1")
+            db.teardown(test, "n1")
+    cmds = [x[1] for x in log if isinstance(x[1], str)]
+    joined = "\n".join(cmds)
+    assert "mongod" in joined
+    assert any("rm -rf" in x and "/var/lib/mongodb" in x for x in cmds)
+    assert db.log_files(test, "n1") == [mdb.LOGFILE]
+
+
+def test_full_suite_with_stub(stub, tmp_path):
+    port = stub.server_address[1]
+    opts = {"nodes": ["n1", "n2"], "concurrency": 4, "time_limit": 4,
+            "per_key_limit": 15,
+            "store_root": str(tmp_path / "store"),
+            "ssh": {"dummy?": True}}
+    t = mdb.mongodb_test(opts)
+    t["client"] = mdb.MongoClient(
+        addr_fn=lambda test, node: ("127.0.0.1", port))
+    t["name"] = "mongodb-stub"
+    done = core.run(t)
+    assert done["results"]["valid?"] is True
+    assert done["results"]["register"]["valid?"] is True
