@@ -34,6 +34,12 @@ the shared framework. This package holds this framework's suites:
   serializable BEGIN IMMEDIATE, WAL + synchronous=FULL crash safety —
   driven by elle append/wr and bank workloads under a primary-kill
   nemesis, all CI-run against live processes.
+- `postgres` — the external-SQL-endpoint exemplar (postgres-rds;
+  stolon's workloads): a from-scratch pgwire v3 codec (startup
+  handshake, simple query protocol, text format), register CAS via
+  UPDATE command tags, bank transfers and elle list-append txns in
+  BEGIN..COMMIT transactions; CI drives all three against a
+  pgwire-framed stub backed by a real SQL engine.
 - `mongodb` — the document-store family (mongodb-rocks /
   mongodb-smartos): a from-scratch BSON subset codec + OP_MSG wire
   framing, document-CAS via conditional updates (nModified decides),
