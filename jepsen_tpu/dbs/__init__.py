@@ -11,7 +11,8 @@ the shared framework. This package holds this framework's suites:
 - `etcd` — the tutorial exemplar: release-tarball install, static
   initial-cluster daemon automation, full Process/Pause/Primary fault
   surface, a v3 JSON-gateway client, and the tidb-style test-all
-  matrix: 6 workloads (register, append, wr, bank, sets, long-fork)
+  matrix: 8 workloads (register, append, wr, bank, sets,
+  long-fork, monotonic, sequential — tidb's workload list)
   x 4 nemeses (partition, kill, pause, none) — CI-run against a
   wire-compatible stub.
 - `redis` — the redis-protocol family (the reference's disque): a

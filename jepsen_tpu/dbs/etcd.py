@@ -492,6 +492,20 @@ def _w_long_fork(options):
     return {**w, "client": EtcdClient()}
 
 
+def _w_monotonic(options):
+    from ..workloads import monotonic
+    w = monotonic.workload()
+    return {**w, "client": EtcdMonotonicClient()}
+
+
+def _w_sequential(options):
+    from ..workloads import sequential
+    # writers take half the worker threads so readers always exist
+    n_writers = max(1, int(options["concurrency"]) // 2)
+    w = sequential.workload({"n_writers": n_writers})
+    return {**w, "client": EtcdSeqClient()}
+
+
 WORKLOADS = {
     "register": _w_register,
     "append": _w_append,
@@ -499,6 +513,8 @@ WORKLOADS = {
     "bank": _w_bank,
     "sets": _w_sets,
     "long-fork": _w_long_fork,
+    "monotonic": _w_monotonic,
+    "sequential": _w_sequential,
 }
 
 NEMESES = {
@@ -515,11 +531,90 @@ NEMESES = {
 }
 
 
+class EtcdMonotonicClient(EtcdClient):
+    """Monotonic workload client (tidb/monotonic.clj contract): inc is
+    a read-modify-write over a key group, committed atomically behind
+    MOD-revision compares (the optimistic recipe); reads snapshot the
+    group in one txn."""
+
+    @staticmethod
+    def _key(k) -> str:
+        return f"/jepsen/mono/{k}"
+
+    def invoke(self, test, op):
+        ks = sorted(op["value"])
+        keys = [self._key(k) for k in ks]
+        try:
+            if op["f"] == "inc":
+                for _ in range(8):
+                    snap = self.kv_snapshot(keys)
+                    new = {k: (int(snap[kk][0]) if snap[kk][0]
+                               else 0) + 1
+                           for k, kk in zip(ks, keys)}
+                    res = self._post("/v3/kv/txn", {
+                        "compare": [
+                            {"key": self._b64(kk), "target": "MOD",
+                             "result": "EQUAL",
+                             "modRevision": str(snap[kk][1])}
+                            for kk in keys],
+                        "success": [{"requestPut": {
+                            "key": self._b64(self._key(k)),
+                            "value": self._b64(new[k])}}
+                            for k in ks],
+                        "failure": []})
+                    if res.get("succeeded"):
+                        return {**op, "type": "ok", "value": new}
+                return {**op, "type": "fail",
+                        "error": "inc contention"}
+            if op["f"] == "read":
+                snap = self.kv_snapshot(keys)
+                # missing -> -1 (the workload contract): an "absent"
+                # observation must still order against later values —
+                # None would be skipped by the checker entirely
+                return {**op, "type": "ok",
+                        "value": {k: (int(snap[kk][0])
+                                      if snap[kk][0] else -1)
+                                  for k, kk in zip(ks, keys)}}
+            raise ValueError(f"unknown op {op['f']!r}")
+        except requests.RequestException as e:
+            t = "fail" if op["f"] == "read" else "info"
+            return {**op, "type": t, "error": str(e)[:200]}
+
+
+class EtcdSeqClient(EtcdClient):
+    """Sequential workload client (workloads.sequential contract,
+    tidb/sequential.clj): writes insert key k's subkeys IN ORDER as
+    separate puts; reads fetch them in REVERSE — a store that shows a
+    later subkey without an earlier one violates sequential
+    consistency (trailing-nil)."""
+
+    def invoke(self, test, op):
+        from ..workloads.sequential import DEFAULT_KEY_COUNT, subkeys
+        kc = test.get("key_count") or DEFAULT_KEY_COUNT
+        try:
+            if op["f"] == "write":
+                for sk in subkeys(kc, op["value"]):
+                    self.kv_put(f"/jepsen/seq/{sk}", 1)
+                return {**op, "type": "ok"}
+            if op["f"] == "read":
+                k = op["value"][0]
+                out = []
+                for sk in reversed(subkeys(kc, k)):
+                    cur = self.kv_range(f"/jepsen/seq/{sk}")
+                    out.append(None if cur is None else sk)
+                return {**op, "type": "ok", "value": [k, out]}
+            raise ValueError(f"unknown op {op['f']!r}")
+        except requests.RequestException as e:
+            t = "fail" if op["f"] == "read" else "info"
+            return {**op, "type": t, "error": str(e)[:200]}
+
+
 def etcd_test(options: dict) -> dict:
     """Full test map from CLI options (zookeeper.clj zk-test shape).
     `workload`: one of WORKLOADS (register, append, wr, bank, sets,
-    long-fork); `nemesis`: one of NEMESES (partition, kill, pause,
-    none) — the tidb-style matrix both axes of `test-all` sweep."""
+    long-fork, monotonic, sequential — tidb's workload list);
+    `nemesis`: one of NEMESES (partition, kill, pause, none) — the
+    tidb-style matrix both axes of `test-all` sweep."""
     nodes = options["nodes"]
     db = EtcdDB(options.get("version") or VERSION)
     which = options.get("workload") or "register"

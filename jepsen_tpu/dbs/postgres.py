@@ -301,10 +301,10 @@ class PgBankClient(PgClientBase):
                     f"({int(a)}, {per + (1 if i < rem else 0)}) "
                     f"ON CONFLICT (id) DO NOTHING")
         except (OSError, ConnectionError, PgError):
-            import logging
-            logging.getLogger(__name__).warning(
-                "bank setup failed on %s", self.node, exc_info=True)
+            # an unseeded bank would read as a FALSE wrong-total
+            # "data loss": abort the run loudly instead
             self._drop()
+            raise
 
     def invoke(self, test, op):
         try:

@@ -273,13 +273,10 @@ class SqliteBankClient(SqliteClient):
         try:
             self._conn(test).cmd("BANKINIT", json.dumps(balances))
         except (OSError, ConnectionError, RedisError):
-            # surfaced loudly: an uninitialized bank reads as a false
-            # wrong-total "data loss"; another client's setup may
-            # still succeed (INSERT OR IGNORE is idempotent)
-            import logging
-            logging.getLogger(__name__).warning(
-                "bank setup failed on %s", self.node, exc_info=True)
+            # an uninitialized bank would read as a FALSE wrong-total
+            # "data loss": abort the run loudly instead
             self._drop_conn()
+            raise
 
 
 def _w_append(options):

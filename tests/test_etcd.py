@@ -306,3 +306,20 @@ def test_tests_fn_sweeps_matrix(tmp_path):
     only = [t["name"] for t in
             etcd.etcd_tests({**opts, "workload": "register"})]
     assert len(only) == len(etcd.NEMESES)
+
+
+def test_monotonic_suite_with_stub(stub, tmp_path):
+    done = _run_suite(stub, tmp_path, "monotonic",
+                      etcd.EtcdMonotonicClient)
+    assert done["results"]["valid?"] is True, \
+        done["results"]["monotonic"]
+    incs = [op for op in done["history"]
+            if getattr(op, "type", None) == "ok"
+            and getattr(op, "f", None) == "inc"]
+    assert incs  # values really increment through the gateway
+
+
+def test_sequential_suite_with_stub(stub, tmp_path):
+    done = _run_suite(stub, tmp_path, "sequential", etcd.EtcdSeqClient)
+    assert done["results"]["valid?"] is True, \
+        done["results"]["sequential"]
