@@ -47,6 +47,12 @@ the shared framework. This package holds this framework's suites:
   write-concern knobs, deb install + replica-set initiation issued
   over the suite's own wire client (CI-run against a wire-compatible
   OP_MSG stub).
+- `elasticsearch` — the search-engine family
+  (elasticsearch/src/jepsen/elasticsearch/sets.clj): set workload
+  over the document REST API with the refresh-before-read visibility
+  gate, deb install + unicast-discovery automation; CI proves both
+  the valid path and the famous acknowledged-insert-loss
+  counterexample against a wire-compatible stub.
 - `consul` — the HTTP-KV exemplar (consul/src/jepsen/consul.clj):
   v1/kv client with the reference's two-step INDEX-based CAS recipe,
   agent automation with primary bootstrap + retry-join (CI-run

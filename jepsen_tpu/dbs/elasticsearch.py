@@ -1,0 +1,232 @@
+"""Elasticsearch test suite — the search-engine family exemplar
+(reference: elasticsearch/src/jepsen/elasticsearch/{core,sets,
+dirty_read}.clj — the suite whose set workload famously exposed
+inserted-document loss during partitions).
+
+REST client over the document API (the reference drives the same
+endpoints through elastisch): `set` adds index one document per
+element (PUT /jepsen/_doc/<v>), the final read refreshes the index
+and scans it (_refresh + _search with a size bound), and the
+set/set-full checkers account for every acknowledged element.
+`dirty-read` semantics ride the same surface: a `read` of a single
+document by id (GET /jepsen/_doc/<v>) observes whether an
+acknowledged-but-unrefreshed write is visible.
+
+DB automation (core.clj shape): deb-package install, the service
+started with a cluster config listing every node as a unicast host,
+readiness = HTTP port + cluster-health wait. CI runs the client
+against a wire-compatible REST stub (tests/test_elasticsearch.py).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+try:
+    import requests
+except ImportError:  # surfaced at client construction, not per-op
+    requests = None  # type: ignore[assignment]
+
+from .. import checker as jchecker
+from .. import cli, client as jclient, control, db as jdb
+from .. import generator as gen
+from .. import net as jnet
+from .. import nemesis as jnemesis
+from ..control import nodeutil
+from ..os_setup import Debian
+
+VERSION = "1.5.0"  # the era the reference tested (core.clj)
+HTTP_PORT = 9200
+DEB_URL = ("https://download.elastic.co/elasticsearch/elasticsearch/"
+           "elasticsearch-{v}.deb")
+PIDFILE = "/var/run/elasticsearch.pid"
+LOGFILE = "/var/log/elasticsearch/elasticsearch.log"
+DATA_DIR = "/var/lib/elasticsearch"
+INDEX = "jepsen"
+
+
+def base_url(node: str) -> str:
+    return f"http://{node}:{HTTP_PORT}"
+
+
+class ElasticsearchDB(jdb.DB, jdb.Process, jdb.LogFiles):
+    """deb install + service daemon with unicast discovery over the
+    test's nodes (core.clj install/configure shape)."""
+
+    def __init__(self, version: str = VERSION):
+        self.version = version
+
+    def _start(self, test, node):
+        # ES 1.x array sysprops are BARE comma lists (brackets/quotes
+        # would be taken literally and fail DNS); the framework's
+        # start-stop-daemon writes the pidfile, so no -p here
+        hosts = ",".join(test["nodes"])
+        nodeutil.start_daemon(
+            {"logfile": LOGFILE, "pidfile": PIDFILE, "chdir": "/"},
+            "/usr/share/elasticsearch/bin/elasticsearch",
+            "-Des.cluster.name=jepsen",
+            f"-Des.node.name={node}",
+            "-Des.discovery.zen.ping.multicast.enabled=false",
+            f"-Des.discovery.zen.ping.unicast.hosts={hosts}",
+            f"-Des.path.data={DATA_DIR}")
+        nodeutil.await_tcp_port(HTTP_PORT, timeout_s=120)
+
+    def setup(self, test, node):
+        with control.su():
+            deb = nodeutil.cached_wget(DEB_URL.format(v=self.version))
+            control.exec_("dpkg", "-i", "--force-confnew", deb)
+            control.exec_("mkdir", "-p", DATA_DIR,
+                          "/var/log/elasticsearch")
+        self._start(test, node)
+
+    def teardown(self, test, node):
+        nodeutil.stop_daemon(PIDFILE)
+        nodeutil.grepkill("elasticsearch")
+        with control.su():
+            control.exec_("rm", "-rf", DATA_DIR, LOGFILE)
+
+    def start(self, test, node):
+        self._start(test, node)
+        return "started"
+
+    def kill(self, test, node):
+        nodeutil.stop_daemon(PIDFILE)
+        nodeutil.grepkill("elasticsearch")
+        return "killed"
+
+    def log_files(self, test, node):
+        return [LOGFILE]
+
+
+class EsSetClient(jclient.Client):
+    """Set workload over the document API (sets.clj CreateSetClient):
+    add = create one document per element (definite on 2xx,
+    indefinite on everything else); the final read refreshes then
+    scans the index."""
+
+    def __init__(self, base_url_fn: Optional[Callable] = None,
+                 timeout: float = 5.0):
+        if requests is None:
+            raise ImportError(
+                "the elasticsearch suite needs the 'requests' package")
+        self.base_url_fn = base_url_fn or base_url
+        self.timeout = timeout
+        self.node: Optional[str] = None
+        self.http = None
+
+    def open(self, test, node):
+        c = type(self)(self.base_url_fn, self.timeout)
+        c.node = node
+        c.http = requests.Session()
+        return c
+
+    def _url(self, path: str) -> str:
+        return self.base_url_fn(self.node) + path
+
+    def invoke(self, test, op):
+        http = self.http or requests
+        try:
+            if op["f"] == "add":
+                v = op["value"]
+                r = http.put(self._url(f"/{INDEX}/_doc/{int(v)}"),
+                             json={"num": int(v)},
+                             timeout=self.timeout)
+                if r.status_code in (200, 201):
+                    return {**op, "type": "ok"}
+                return {**op, "type": "info",
+                        "error": f"http {r.status_code}"}
+            if op["f"] == "read":
+                # refresh first: an unrefreshed search lawfully misses
+                # acknowledged docs; AFTER refresh, a miss is loss
+                # (sets.clj refreshes before its final read). A FAILED
+                # refresh must fail the read — a stale scan reported
+                # as ok would count acknowledged adds as lost.
+                rr = http.post(self._url(f"/{INDEX}/_refresh"),
+                               timeout=self.timeout)
+                rr.raise_for_status()
+                if rr.json().get("_shards", {}).get("failed", 0):
+                    return {**op, "type": "fail",
+                            "error": "refresh failed on some shards"}
+                r = http.get(self._url(f"/{INDEX}/_search"),
+                             params={"size": 100000},
+                             timeout=self.timeout)
+                r.raise_for_status()
+                hits = r.json()["hits"]["hits"]
+                return {**op, "type": "ok",
+                        "value": sorted(h["_source"]["num"]
+                                        for h in hits)}
+            raise ValueError(f"unknown op {op['f']!r}")
+        except requests.RequestException as e:
+            t = "fail" if op["f"] == "read" else "info"
+            return {**op, "type": t, "error": str(e)[:200]}
+
+    def close(self, test):
+        if self.http is not None:
+            self.http.close()
+
+
+def elasticsearch_test(options: dict) -> dict:
+    """Set workload under partition-random-halves (sets.clj shape:
+    adds for the time limit, HEAL the cluster, settle, then every
+    thread reads the index back — final reads against a
+    still-partitioned cluster would report false loss)."""
+    from ..workloads import sets
+
+    nodes = options["nodes"]
+    db = ElasticsearchDB(options.get("version") or VERSION)
+    time_limit = options.get("time_limit") or 30
+    w = sets.workload()  # checker only; phases built explicitly below
+    interval = options.get("nemesis_interval") or 10.0
+    add_phase = gen.nemesis(
+        gen.time_limit(time_limit,
+                       gen.cycle([gen.sleep(interval),
+                                  {"type": "info", "f": "start"},
+                                  gen.sleep(interval),
+                                  {"type": "info", "f": "stop"}])),
+        gen.time_limit(max(1, time_limit - 2),
+                       gen.clients(sets.adds())))
+    return {
+        "name": options.get("name") or f"elasticsearch-{VERSION}",
+        "store_root": options.get("store_root") or "store",
+        "nodes": nodes,
+        "concurrency": options["concurrency"],
+        "ssh": options.get("ssh") or {},
+        "os": Debian(),
+        "db": db,
+        "net": jnet.iptables(),
+        "client": EsSetClient(),
+        "nemesis": jnemesis.partition_random_halves(),
+        "checker": jchecker.compose({
+            "sets": w["checker"],
+            "exceptions": jchecker.unhandled_exceptions(),
+        }),
+        "generator": gen.phases(
+            add_phase,
+            # heal + settle BEFORE the final reads (sets.clj recovers
+            # the cluster first)
+            gen.nemesis(gen.once(
+                lambda test, ctx: {"type": "info", "f": "stop"})),
+            gen.sleep(2.0),
+            gen.clients(gen.each_thread(gen.once(sets.final_read)))),
+    }
+
+
+ELASTICSEARCH_OPTS = [
+    cli.Opt("name", metavar="NAME", default=None),
+    cli.Opt("store_root", metavar="DIR", default="store",
+            help="Where to write results"),
+    cli.Opt("version", metavar="VERSION", default=VERSION,
+            help="elasticsearch deb version"),
+    cli.Opt("nemesis_interval", metavar="SECONDS", default=10.0,
+            parse=float,
+            help="Seconds between partition start/stop"),
+]
+
+COMMANDS = {
+    **cli.single_test_cmd({"test_fn": elasticsearch_test,
+                           "opt_spec": ELASTICSEARCH_OPTS}),
+    **cli.serve_cmd(),
+}
+
+if __name__ == "__main__":
+    cli.main(COMMANDS)

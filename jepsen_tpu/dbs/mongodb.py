@@ -207,12 +207,10 @@ class MongoDB(jdb.DB, jdb.Process, jdb.LogFiles):
 
     def setup(self, test, node):
         with control.su():
-            deb = DEB_URL.format(v=self.version)
-            control.exec_("bash", "-c",
-                          f"test -f /tmp/mongodb.deb || "
-                          f"wget -O /tmp/mongodb.deb {deb}")
-            control.exec_("dpkg", "-i", "--force-confnew",
-                          "/tmp/mongodb.deb")
+            # atomic node-local download cache: a partial wget must
+            # not poison later setups
+            deb = nodeutil.cached_wget(DEB_URL.format(v=self.version))
+            control.exec_("dpkg", "-i", "--force-confnew", deb)
             control.exec_("mkdir", "-p", DATA_DIR,
                           "/var/log/mongodb")
         self._start(test, node)

@@ -1,0 +1,151 @@
+"""Elasticsearch suite tests: the set-workload REST client against a
+wire-compatible stub (document create, _refresh visibility gate,
+_search scan), including a lossy-stub counterexample — the anomaly
+the reference suite is famous for."""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from jepsen_tpu import control as c, core
+from jepsen_tpu.control.dummy import DummyRemote
+from jepsen_tpu.dbs import elasticsearch as es
+
+
+class EsStub(BaseHTTPRequestHandler):
+    """Documents become searchable only after _refresh — the real
+    engine's near-real-time behavior, which the client's
+    refresh-before-read must mask. `lossy` drops every Nth
+    acknowledged doc (the reference's famous partition bug,
+    compressed)."""
+
+    docs: dict = {}
+    searchable: set = set()
+    lock = threading.Lock()
+    lossy_every = 0
+    acked = [0]
+
+    def log_message(self, *a):
+        pass
+
+    def _reply(self, code, obj):
+        body = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_PUT(self):
+        parts = self.path.strip("/").split("/")
+        n = int(self.headers.get("Content-Length") or 0)
+        doc = json.loads(self.rfile.read(n) or b"{}")
+        with self.lock:
+            self.acked[0] += 1
+            drop = (self.lossy_every
+                    and self.acked[0] % self.lossy_every == 0)
+            if not drop:
+                EsStub.docs[parts[-1]] = doc
+            self._reply(201, {"result": "created"})
+
+    def do_POST(self):
+        if self.path.endswith("/_refresh"):
+            with self.lock:
+                EsStub.searchable = set(EsStub.docs)
+            self._reply(200, {"_shards": {"failed": 0}})
+            return
+        self._reply(400, {"error": "unsupported"})
+
+    def do_GET(self):
+        if "/_search" in self.path:
+            with self.lock:
+                hits = [{"_id": k, "_source": EsStub.docs[k]}
+                        for k in sorted(EsStub.searchable)]
+            self._reply(200, {"hits": {"total": len(hits),
+                                       "hits": hits}})
+            return
+        self._reply(404, {"found": False})
+
+
+@pytest.fixture()
+def stub():
+    EsStub.docs = {}
+    EsStub.searchable = set()
+    EsStub.lossy_every = 0
+    EsStub.acked = [0]
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), EsStub)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    yield f"http://127.0.0.1:{srv.server_address[1]}"
+    srv.shutdown()
+
+
+def _client(stub):
+    return es.EsSetClient(
+        base_url_fn=lambda node: stub).open({}, "n1")
+
+
+def test_add_and_refresh_scan(stub):
+    cl = _client(stub)
+    for v in (3, 1, 2):
+        assert cl.invoke({}, {"f": "add", "value": v,
+                              "process": 0})["type"] == "ok"
+    r = cl.invoke({}, {"f": "read", "value": None, "process": 0})
+    assert r["type"] == "ok" and r["value"] == [1, 2, 3]
+
+
+def test_unrefreshed_docs_invisible_until_read(stub):
+    # the stub models NRT search: without the client's refresh, adds
+    # are invisible — the read path MUST refresh first
+    cl = _client(stub)
+    cl.invoke({}, {"f": "add", "value": 9, "process": 0})
+    import requests
+    raw = requests.get(stub + "/jepsen/_search",
+                       params={"size": 10}, timeout=2).json()
+    assert raw["hits"]["hits"] == []  # not yet searchable
+    r = cl.invoke({}, {"f": "read", "value": None, "process": 0})
+    assert r["value"] == [9]  # client refreshed, then scanned
+
+
+def test_db_commands():
+    log: list = []
+    db = es.ElasticsearchDB()
+    test = {"nodes": ["n1", "n2"]}
+    with c.with_remote(DummyRemote(log)):
+        with c.on("n1"):
+            db.setup(test, "n1")
+            db.teardown(test, "n1")
+    cmds = [x[1] for x in log if isinstance(x[1], str)]
+    joined = "\n".join(cmds)
+    assert "elasticsearch" in joined
+    # the hosts list survives shell escaping; match escape-agnostic
+    assert "unicast.hosts" in joined and "n2" in joined
+
+
+def test_full_suite_with_stub(stub, tmp_path):
+    opts = {"nodes": ["n1", "n2"], "concurrency": 4, "time_limit": 4,
+            "store_root": str(tmp_path / "store"),
+            "ssh": {"dummy?": True}}
+    t = es.elasticsearch_test(opts)
+    t["client"] = es.EsSetClient(base_url_fn=lambda node: stub)
+    t["name"] = "es-stub"
+    done = core.run(t)
+    assert done["results"]["valid?"] is True
+    assert done["results"]["sets"]["valid?"] is True
+
+
+def test_lossy_stub_caught(stub, tmp_path):
+    """Acknowledged-but-dropped documents — the anomaly this suite
+    exists to catch — surface as lost elements in the set checker."""
+    EsStub.lossy_every = 5
+    opts = {"nodes": ["n1"], "concurrency": 2, "time_limit": 3,
+            "store_root": str(tmp_path / "store"),
+            "ssh": {"dummy?": True}}
+    t = es.elasticsearch_test(opts)
+    t["client"] = es.EsSetClient(base_url_fn=lambda node: stub)
+    t["name"] = "es-lossy"
+    done = core.run(t)
+    sets_res = done["results"]["sets"]
+    assert sets_res["valid?"] is False
+    assert sets_res["set"]["lost-count"] > 0
