@@ -303,28 +303,28 @@ def _race_competition(model, h, time_limit, device=None,
                                  stop=stop, enc=enc, **kw)
 
     if safe_backend() == "cpu" and time_limit is not None:
-        # On a CPU backend both engines contend for the same cores (and
-        # the pure-Python oracle for the GIL), so racing buys nothing —
-        # the same policy batched.py applies to its per-key race. Run
-        # serially instead: device kernel first on a quarter of the
-        # budget (when it wins it wins by orders of magnitude, so a
-        # slice suffices; cpu compiles are seconds, not the TPU's
-        # 20-40 s), then the oracle on at least half the nominal budget
-        # — so a shape the oracle could decide under the old
-        # full-budget race still gets a fair run. `stop` stays None.
+        # On a CPU backend both engines contend for the same cores
+        # (and the pure-Python oracle for the GIL), so racing buys
+        # nothing — the same policy batched.py applies to its per-key
+        # race. Run a serial LADDER instead:
+        #   1. oracle on a short slice — near-serial shapes (wide
+        #      long tails) decide in milliseconds, and paying kernel
+        #      compile for them would be pure waste;
+        #   2. device on most of the remainder — the packed wide-
+        #      window kernel (wgln.py) decides adversarial shapes the
+        #      oracle cannot (2.2M configs in ~50 s cold on cpu), and
+        #      the narrow fast path wins by orders of magnitude;
+        #   3. oracle on whatever is left, in case the device came up
+        #      unknown with budget remaining.
         t0 = time.monotonic()
+        slice1 = min(5.0, time_limit / 6)
+        r = wgl_ref.check(model, h, time_limit=slice1)
+        if r.get("valid?") != UNKNOWN:
+            r["engine"] = "oracle"
+            return r
+        left = max(1.0, time_limit - (time.monotonic() - t0))
         try:
-            # Wide windows are the cpu kernel's worst case (the
-            # (K, W, 2W) gather machinery is why the batched path
-            # routes wide shapes to the oracle on cpu too): don't
-            # burn the budget grinding a shape the device cannot win
-            # on this backend — the oracle's DFS takes it whole.
-            from ..ops.encode import encode as _enc
-            e = enc if enc is not None else _enc(model, h)
-            if e.window_raw > 128:
-                r = {"valid?": UNKNOWN, "cause": "cpu-wide-window"}
-            else:
-                r = run_device(time_limit / 4)
+            r = run_device(left * 0.75)
         except Exception:  # noqa: BLE001 — encode/step failures
             logging.getLogger(__name__).warning(
                 "device engine failed in serial competition",
@@ -334,8 +334,7 @@ def _race_competition(model, h, time_limit, device=None,
             r["engine"] = "device"
             return wgl_tpu.enrich_diagnostics(model, h, r,
                                               time_limit=10.0)
-        left = max(time_limit / 2,
-                   time_limit - (time.monotonic() - t0))
+        left = max(1.0, time_limit - (time.monotonic() - t0))
         r = wgl_ref.check(model, h, time_limit=left)
         if r.get("valid?") != UNKNOWN:
             r["engine"] = "oracle"

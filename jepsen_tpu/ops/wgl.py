@@ -38,6 +38,7 @@ documented here rather than hidden.
 from __future__ import annotations
 
 import functools
+import os
 import time as _time
 from typing import Callable, Optional
 
@@ -459,12 +460,10 @@ def check(model: Model, history: History, time_limit: Optional[float] = None,
         K = frontier  # override breadth only; the memo table must still
         #               fit the config space (see _pick_capacities)
     # Rounds per device call: the deadline/budget/stop signals are only
-    # checked between calls, and a round costs ~5x more on the TPU than
-    # on CPU (scatter-bound). 1024 keeps fast-path poll granularity a
-    # few seconds while per-call dispatch stays negligible; the wide-
-    # window general kernel's rounds are ~35 ms each, so it polls every
-    # 32 to stay cancellable (competition racing).
-    chunk = 1024 if enc.window_raw <= 32 else 32
+    # checked between calls. 1024 keeps fast-path poll granularity a
+    # few seconds while per-call dispatch stays negligible; the packed
+    # wide-window branch below sets its own (128).
+    chunk = 1024
     iinv, iopc = enc.inv_info, enc.opcode_info
     if enc.window_raw <= 32:
         # Bitmask fast path: window in one uint32 lane, sort-free dedup.
@@ -482,11 +481,47 @@ def check(model: Model, history: History, time_limit: Optional[float] = None,
             S=enc.table.shape[0], O=enc.table.shape[1],
             K=K, H=H, B=B, chunk=chunk, probes=4, W=W_eff)
     else:
-        probes_used, row_cols = 16, W + ic_pad
-        init_fn, chunk_jit = _compiled_search(
-            n_pad=len(enc.inv), ic_pad=ic_pad, W=W,
+        # Packed multi-lane kernel (wgln.py): window as L uint32
+        # lanes. Successors are bit math + funnel shifts instead of
+        # (K, W, 2W) bool gathers, dedup is probe-only instead of a
+        # 3-key sort — measured ~11x over the bool kernel at W=71 on
+        # cpu. The (K, W, L) u32 successor tensor is the memory
+        # driver, so the beam scales with a byte budget over it.
+        from ..util import safe_backend
+        from .wgln import compiled_searchN
+        W_eff = _pad_to_mult(enc.window_raw, 32)
+        L = W_eff // 32
+        ic_eff = max(8, _pad_to_mult(enc.n_info, 8))
+        ic_eff = min(ic_eff, ic_pad)
+        iinv, iopc = iinv[:ic_eff], iopc[:ic_eff]
+        accel = safe_backend() not in (None, "cpu")
+        budget_bytes = (1024 if accel else 128) * 1024 * 1024
+        K = max(64, min(4096, budget_bytes // (W_eff * L * 4 * 3)))
+        # XLA:CPU compile time scales with K (~3 s at 512, ~14 s at
+        # 4096); JEPSEN_TPU_MAX_FRONTIER lets CI cap the beam so its
+        # many small shape buckets don't pay production-size compiles
+        cap = int(os.environ.get("JEPSEN_TPU_MAX_FRONTIER", "0"))
+        if cap:
+            K = min(K, cap)
+        K = 1 << (K.bit_length() - 1)
+        if frontier:
+            K = frontier
+        # packed backlog rows are (L + Il) u32s: a 2^20-row backlog at
+        # L=3 is ~12 MB; scale down as lanes widen (measured: 2^18
+        # overflowed the 16-wave adversarial shape's ~1.5M-config
+        # wavefront where the byte-budget backlog did not)
+        B = min(1 << 20, max(1 << 18, (32 << 20) // (L * 4)))
+        B = 1 << (B.bit_length() - 1)
+        W = W_eff
+        # probes=4 like the fast path: the H=2^23 table stays under
+        # ~30% load at the encode cap, and fewer probe rounds measured
+        # ~1.5x on search time (failed inserts re-explore soundly)
+        probes_used, row_cols = 4, W_eff + ic_eff
+        chunk = 128  # rounds are light; poll a few times a second
+        init_fn, chunk_jit = compiled_searchN(
+            n_pad=len(enc.inv), ic_pad=ic_eff,
             S=enc.table.shape[0], O=enc.table.shape[1],
-            K=K, H=H, B=B, chunk=chunk, probes=16)
+            K=K, H=H, B=B, chunk=chunk, probes=4, W=W_eff, L=L)
 
     consts = (jnp.asarray(enc.inv), jnp.asarray(enc.ret),
               jnp.asarray(enc.opcode), jnp.asarray(enc.sufminret),

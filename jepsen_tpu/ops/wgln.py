@@ -1,0 +1,309 @@
+"""Packed multi-lane WGL kernel: windows of 32 < W <= 1024 as L
+uint32 lanes.
+
+The general kernel (`wgl.py _build_search`) keeps the window as a
+(K, W) bool tensor; profiling puts its per-round cost in the
+(K, W, 2W) renormalization gathers and the 3-key sort over all
+R = K*(W + ic) successor rows. The uint32 fast path (`wgl32.py`)
+showed both costs are artifacts of the representation: with the
+window packed into bit lanes, successor construction is elementwise
+bit math and dedup is probe-only (racing twins detected at insert
+time) — no sort, no W^2 intermediates.
+
+This kernel generalizes the packing to L lanes:
+
+  * window bit j lives in lane j//32, bit j%32; setting it is
+    `win | set_mask[j]` with a host-precomputed (W, L) mask table —
+    the successor tensor is (K, W, L) uint32, 8x smaller than the
+    bool kernel's (K, W, 2W) machinery at W=512.
+  * renormalization (advance base past the linearized prefix) is a
+    cross-lane funnel shift: t = q*32 + r trailing ones, where q is
+    the first lane with a zero bit and r its trailing-ones count;
+    the shifted window is `(lane[l+q] >> r) | (lane[l+q+1] <<
+    (32-r))` with gathers clamped past L.
+  * dedup, backlog spill/refill, flags and stats are wgl32's,
+    unchanged — same CONSTS contract as `_build_search`, so the host
+    driver (`wgl.check`) dispatches by window width alone. The carry
+    differs (packed (K, L) uint32 windows vs (K, W) bool), so the
+    mesh-sharded vmap batch path (`parallel/batched.py`) still
+    builds the bool kernel for wide lanes; its auto strategy routes
+    wide-window keys to the streamed path, which lands here.
+
+Measured (cpu backend, adversarial_wave 6x14 span 5, W=71 -> L=3):
+the bool kernel decides 811k configs in ~103 s; this kernel in ~9 s
+— enough to decide the 2.2M-config bench shape inside the 60 s
+budget ON CPU, where the host oracle DNFs.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+from .wgl32 import _ctz32, _fnv_words
+
+INF = np.int32(2**31 - 1)
+
+
+def _build_searchN(n_pad: int, ic_pad: int, S: int, O: int,
+                   K: int, H: int, B: int, chunk: int, probes: int,
+                   W: int, L: int):
+    """Build (init_fn, chunk_fn) for the packed L-lane kernel.
+    W == 32*L is the materialized window width."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    assert W == 32 * L and L >= 2
+    Il = max(1, (ic_pad + 31) // 32)
+
+    # host-precomputed tables
+    j_arr = np.arange(W)
+    lane_of_j = (j_arr // 32).astype(np.int32)          # (W,)
+    shift_of_j = (j_arr % 32).astype(np.uint32)         # (W,)
+    set_mask = np.zeros((W, L), dtype=np.uint32)
+    set_mask[j_arr, lane_of_j] = np.uint32(1) << shift_of_j
+    info_word = np.arange(ic_pad) // 32
+    info_bit = (np.uint32(1) << (np.arange(ic_pad) % 32))
+    info_set_mask = np.zeros((ic_pad, Il), dtype=np.uint32)
+    info_set_mask[np.arange(ic_pad), info_word] = info_bit
+
+    def init_fn(mstate0):
+        fr_base = jnp.zeros(K, dtype=jnp.int32)
+        fr_win = jnp.zeros((K, L), dtype=jnp.uint32)
+        fr_info = jnp.zeros((K, Il), dtype=jnp.uint32)
+        fr_mst = jnp.zeros(K, dtype=jnp.int32).at[0].set(mstate0)
+        fr_cnt = jnp.int32(1)
+        bk_base = jnp.zeros(B, dtype=jnp.int32)
+        bk_win = jnp.zeros((B, L), dtype=jnp.uint32)
+        bk_info = jnp.zeros((B, Il), dtype=jnp.uint32)
+        bk_mst = jnp.zeros(B, dtype=jnp.int32)
+        bk_cnt = jnp.int32(0)
+        table = jnp.zeros((H, 4), dtype=jnp.uint32)
+        flags = jnp.zeros(3, dtype=bool)   # found, overflow, exhausted
+        # explored, rounds-in-chunk, max_base, memo_hits, inserted,
+        # rounds_total (util contract, wgl.py)
+        stats = jnp.zeros(6, dtype=jnp.int32)
+        return (fr_base, fr_win, fr_info, fr_mst, fr_cnt,
+                bk_base, bk_win, bk_info, bk_mst, bk_cnt,
+                table, flags, stats)
+
+    jlane = jnp.asarray(lane_of_j)
+    jshift = jnp.asarray(shift_of_j)
+    jset = jnp.asarray(set_mask)
+    jinfo_word = jnp.asarray(info_word.astype(np.int32))
+    jinfo_bit = jnp.asarray(info_bit)
+    jinfo_set = jnp.asarray(info_set_mask)
+
+    def round_body(consts, carry):
+        (inv, ret, opc, suf, iinv, iopc, T, n_ok, n_info, max_cfg) = consts
+        (fr_base, fr_win, fr_info, fr_mst, fr_cnt,
+         bk_base, bk_win, bk_info, bk_mst, bk_cnt,
+         table, flags, stats) = carry
+
+        alive = jnp.arange(K, dtype=jnp.int32) < fr_cnt
+        j = jnp.arange(W, dtype=jnp.int32)
+        # linearized flag of window slot j: bit j%32 of lane j//32
+        winw = fr_win[:, jlane]                           # (K, W)
+        linearized = ((winw >> jshift[None, :])
+                      & jnp.uint32(1)) == 1
+
+        # --- candidate discovery (identical shape to wgl32) ----------
+        pos = fr_base[:, None] + j                        # (K, W)
+        posc = jnp.minimum(pos, n_pad - 1)
+        retw = jnp.where(linearized | (pos >= n_ok), INF, ret[posc])
+        minret = jnp.min(retw, axis=1)
+        tail = suf[jnp.minimum(fr_base + W, n_pad)]
+        minret = jnp.minimum(minret, tail)                # (K,)
+
+        invw = inv[posc]
+        cand_ok = (~linearized) & (pos < n_ok) \
+            & (invw < minret[:, None]) & alive[:, None]
+        opw = opc[posc]
+        nst_ok = T[fr_mst[:, None], opw]                  # (K, W)
+        legal_ok = cand_ok & (nst_ok >= 0)
+
+        m = jnp.arange(ic_pad, dtype=jnp.int32)
+        info_words = fr_info[:, jinfo_word]               # (K, ic)
+        info_set = (info_words & jinfo_bit[None, :]) != 0
+        cand_info = (~info_set) & (m[None, :] < n_info) \
+            & (iinv[None, :] < minret[:, None]) & alive[:, None]
+        nst_info = T[fr_mst[:, None], iopc[None, :]]      # (K, ic)
+        legal_info = cand_info & (nst_info >= 0)
+
+        # --- ok successors: set bit j, then funnel-shift right -------
+        win_ok = fr_win[:, None, :] | jset[None, :, :]    # (K, W, L)
+        full = win_ok == jnp.uint32(0xFFFFFFFF)           # (K, W, L)
+        # q: first lane with a zero bit (L if none — fully drained)
+        q = jnp.argmin(full, axis=2).astype(jnp.int32)    # (K, W)
+        all_full = jnp.all(full, axis=2)
+        q = jnp.where(all_full, L, q)
+        lane_q = jnp.take_along_axis(
+            win_ok, jnp.minimum(q, L - 1)[:, :, None],
+            axis=2)[:, :, 0]                              # (K, W)
+        r = _ctz32(~lane_q)                               # (K, W) u32
+        r = jnp.where(all_full, jnp.uint32(0), r)
+        t = q * 32 + r.astype(jnp.int32)                  # (K, W)
+
+        # shifted[l] = (win[l+q] >> r) | (win[l+q+1] << (32-r))
+        lidx = jnp.arange(L, dtype=jnp.int32)             # (L,)
+        src0 = lidx[None, None, :] + q[:, :, None]        # (K, W, L)
+        src1 = src0 + 1
+        gather0 = jnp.take_along_axis(
+            jnp.concatenate([win_ok,
+                             jnp.zeros((K, W, L), jnp.uint32)],
+                            axis=2),
+            jnp.minimum(src0, 2 * L - 1), axis=2)
+        gather1 = jnp.take_along_axis(
+            jnp.concatenate([win_ok,
+                             jnp.zeros((K, W, L), jnp.uint32)],
+                            axis=2),
+            jnp.minimum(src1, 2 * L - 1), axis=2)
+        ru = r[:, :, None]
+        shifted = jnp.where(
+            ru == 0, gather0,
+            (gather0 >> ru) | (gather1 << (jnp.uint32(32) - ru)))
+        base_ok = fr_base[:, None] + t                    # (K, W)
+
+        # --- info successors: set info bit m, window unchanged -------
+        info_new = fr_info[:, None, :] | jinfo_set[None, :, :]
+        win_i = jnp.broadcast_to(fr_win[:, None, :], (K, ic_pad, L))
+        info_ok = jnp.broadcast_to(fr_info[:, None, :], (K, W, Il))
+
+        base_s = jnp.concatenate(
+            [base_ok.reshape(-1),
+             jnp.broadcast_to(fr_base[:, None], (K, ic_pad)).reshape(-1)])
+        win_s = jnp.concatenate(
+            [shifted.reshape(-1, L), win_i.reshape(-1, L)])  # (R, L)
+        info_s = jnp.concatenate(
+            [info_ok.reshape(-1, Il), info_new.reshape(-1, Il)])
+        mst_s = jnp.concatenate(
+            [nst_ok.reshape(-1), nst_info.reshape(-1)])
+        legal = jnp.concatenate(
+            [legal_ok.reshape(-1), legal_info.reshape(-1)])  # (R,)
+        R = legal.shape[0]
+
+        success = legal & (base_s >= n_ok) \
+            & jnp.all(win_s == 0, axis=1)
+        found = jnp.any(success)
+        explore = legal & ~success
+
+        # --- hash + probe dedup (wgl32's, L window words) ------------
+        words = ([base_s.astype(jnp.uint32)]
+                 + [win_s[:, i] for i in range(L)]
+                 + [mst_s.astype(jnp.uint32)]
+                 + [info_s[:, i] for i in range(Il)])
+        s0 = _fnv_words(words, 0x811C9DC5) | jnp.uint32(1)
+        s1 = _fnv_words(words, 0x01000193)
+        s2 = _fnv_words(words, 0xDEADBEEF)
+        myrow = jnp.arange(R, dtype=jnp.uint32)
+        step = s1 | jnp.uint32(1)
+        mysig = jnp.stack([s0, s1, s2], axis=1)           # (R, 3)
+
+        def probe(_, st):
+            table, pending, seen, pr = st
+            idx = ((s0 + pr * step) & jnp.uint32(H - 1)).astype(jnp.int32)
+            slot = table[idx]
+            occupied = slot[:, 0] != 0
+            sig_eq = jnp.all(slot[:, :3] == mysig, axis=1)
+            equal = occupied & sig_eq
+            seen = seen | (pending & equal)
+            claim = pending & ~occupied
+            widx = jnp.where(claim, idx, H)
+            entry = jnp.concatenate([mysig, myrow[:, None]], axis=1)
+            table = table.at[widx].set(entry, mode="drop")
+            slot2 = table[idx]
+            sig_eq2 = jnp.all(slot2[:, :3] == mysig, axis=1)
+            won = claim & sig_eq2 & (slot2[:, 3] == myrow)
+            twin = claim & sig_eq2 & ~won
+            seen = seen | twin
+            pending = pending & ~(equal | won | twin)
+            pr = pr + pending.astype(jnp.uint32)
+            return table, pending, seen, pr
+
+        table, pending, seen, _ = lax.fori_loop(
+            0, probes, probe,
+            (table, explore, jnp.zeros(R, dtype=bool),
+             jnp.zeros(R, dtype=jnp.uint32)))
+        new = explore & ~seen
+
+        # --- compact survivors into frontier + backlog ---------------
+        posn = jnp.cumsum(new.astype(jnp.int32)) - 1
+        total = jnp.sum(new.astype(jnp.int32))
+
+        to_front = new & (posn < K)
+        fidx = jnp.where(to_front, posn, K)
+        nfr_base = jnp.zeros(K, dtype=jnp.int32).at[fidx].set(
+            base_s, mode="drop")
+        nfr_win = jnp.zeros((K, L), dtype=jnp.uint32).at[fidx].set(
+            win_s, mode="drop")
+        nfr_info = jnp.zeros((K, Il), dtype=jnp.uint32).at[fidx].set(
+            info_s, mode="drop")
+        nfr_mst = jnp.zeros(K, dtype=jnp.int32).at[fidx].set(
+            mst_s, mode="drop")
+        nfr_cnt = jnp.minimum(total, K)
+
+        spill = new & (posn >= K)
+        sidx = jnp.where(spill, bk_cnt + posn - K, B)
+        overflow = jnp.any(spill & (sidx >= B))
+        sidx = jnp.minimum(sidx, B)
+        bk_base = bk_base.at[sidx].set(base_s, mode="drop")
+        bk_win = bk_win.at[sidx].set(win_s, mode="drop")
+        bk_info = bk_info.at[sidx].set(info_s, mode="drop")
+        bk_mst = bk_mst.at[sidx].set(mst_s, mode="drop")
+        nbk_cnt = jnp.minimum(bk_cnt + jnp.maximum(total - K, 0), B)
+
+        room = K - nfr_cnt
+        take = jnp.minimum(room, nbk_cnt)
+        kidx = jnp.arange(K, dtype=jnp.int32)
+        taking = kidx < take
+        src = jnp.where(taking, jnp.maximum(nbk_cnt - 1 - kidx, 0), 0)
+        dst = jnp.where(taking, nfr_cnt + kidx, K)
+        nfr_base = nfr_base.at[dst].set(bk_base[src], mode="drop")
+        nfr_win = nfr_win.at[dst].set(bk_win[src], mode="drop")
+        nfr_info = nfr_info.at[dst].set(bk_info[src], mode="drop")
+        nfr_mst = nfr_mst.at[dst].set(bk_mst[src], mode="drop")
+        nfr_cnt = nfr_cnt + take
+        nbk_cnt = nbk_cnt - take
+
+        nflags = jnp.stack([flags[0] | found,
+                            flags[1] | overflow,
+                            nfr_cnt == 0])
+        nstats = jnp.stack([
+            stats[0] + fr_cnt,
+            stats[1] + 1,
+            jnp.maximum(stats[2], jnp.max(jnp.where(legal, base_s, 0))),
+            stats[3] + jnp.sum(seen.astype(jnp.int32)),
+            stats[4] + total,
+            stats[5] + 1])
+        return (nfr_base, nfr_win, nfr_info, nfr_mst, nfr_cnt,
+                bk_base, bk_win, bk_info, bk_mst, nbk_cnt,
+                table, nflags, nstats)
+
+    def chunk_fn(consts, carry):
+        max_cfg = consts[-1]
+
+        def cond(c):
+            flags, stats = c[11], c[12]
+            return (~flags[0]) & (c[4] > 0) \
+                & (stats[1] < chunk) & (stats[0] < max_cfg)
+
+        def body(c):
+            return round_body(consts, c)
+
+        stats = carry[12]
+        carry = carry[:12] + (stats.at[1].set(0),)
+        return lax.while_loop(cond, body, carry)
+
+    return init_fn, chunk_fn
+
+
+@functools.lru_cache(maxsize=32)
+def compiled_searchN(n_pad: int, ic_pad: int, S: int, O: int,
+                     K: int, H: int, B: int, chunk: int, probes: int,
+                     W: int, L: int):
+    import jax
+
+    init_fn, chunk_fn = _build_searchN(n_pad, ic_pad, S, O,
+                                       K, H, B, chunk, probes, W, L)
+    return init_fn, jax.jit(chunk_fn, donate_argnums=(1,))

@@ -14,6 +14,9 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 # loader warns loudly on tuning-flag mismatches — keep CI output
 # deterministic and quiet
 os.environ.setdefault("JEPSEN_TPU_NO_CACHE", "1")
+# cap the packed wide-window kernel's beam: XLA:CPU compile time
+# scales with K, and CI compiles many small shape buckets
+os.environ.setdefault("JEPSEN_TPU_MAX_FRONTIER", "512")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

@@ -49,6 +49,47 @@ def test_adversarial_valid_variant():
     assert dev["valid?"] is True
 
 
+def test_packed_kernel_randomized_differential():
+    # the packed L-lane kernel (wgln.py) vs the host oracle over
+    # randomized wide-window shapes: valid, invalid, and crashed-op
+    # variants — verdicts AND exhaustive explored-counts must agree
+    import random
+
+    rng = random.Random(99)
+    hit_packed = 0
+    for trial in range(4):
+        waves = rng.choice([3, 4])
+        width = rng.choice([11, 12])
+        span = rng.choice([3, 4])
+        invalid = rng.random() < 0.5
+        hh = synth.adversarial_wave_history(
+            waves, width=width, span=span, seed=rng.randrange(10**6),
+            invalid=invalid)
+        enc = encode(cas_register(), hh)
+        dev = wgl.check(cas_register(), hh, time_limit=120)
+        ora = wgl_ref.check(cas_register(), hh, time_limit=120)
+        assert dev["valid?"] == ora["valid?"] == (not invalid), \
+            (trial, waves, width, span, invalid, dev, ora)
+        if invalid and enc.window_raw > 32:
+            hit_packed += 1
+            # exhaustive searches agree up to sound re-exploration
+            # from failed memo inserts (a handful of configs)
+            assert abs(dev["configs_explored"]
+                       - ora["configs_explored"]) <= 64
+    # the parameter ranges MUST drive the packed (W > 32) kernel on
+    # invalid shapes, or this test silently stops covering wgln.py
+    assert hit_packed >= 1
+
+
+def test_packed_kernel_long_tail_valid():
+    # wide-window VALID history through the packed kernel directly
+    ht = synth.long_tail_history(120, seed=3)
+    enc = encode(cas_register(), ht)
+    assert enc.window_raw > 32
+    dev = wgl.check(cas_register(), ht, time_limit=120)
+    assert dev["valid?"] is True
+
+
 @pytest.mark.slow
 def test_adversarial_bench_shape_oracle_rate():
     # the bench-sized instance must exceed the oracle's 60 s budget:
