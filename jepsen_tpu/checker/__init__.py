@@ -332,8 +332,14 @@ def _race_competition(model, h, time_limit, device=None,
             r = {"valid?": UNKNOWN, "cause": "engine-error"}
         if r.get("valid?") != UNKNOWN:
             r["engine"] = "device"
-            return wgl_tpu.enrich_diagnostics(model, h, r,
-                                              time_limit=10.0)
+            # enrichment rides the REMAINING budget only — a fixed
+            # slice here could overrun time_limit after the device
+            # already spent most of it
+            spare = time_limit - (time.monotonic() - t0)
+            if spare > 0.1:
+                r = wgl_tpu.enrich_diagnostics(
+                    model, h, r, time_limit=min(10.0, spare))
+            return r
         left = max(1.0, time_limit - (time.monotonic() - t0))
         r = wgl_ref.check(model, h, time_limit=left)
         if r.get("valid?") != UNKNOWN:
@@ -389,6 +395,7 @@ def _race_competition(model, h, time_limit, device=None,
         # device False publishes (and cancels the oracle) immediately
         return run_device(time_limit, stop=winner.is_set)
 
+    t_race0 = time.monotonic()
     threads = [arm("device", device_engine), arm("oracle", oracle)]
     for t in threads:
         t.start()
@@ -414,10 +421,14 @@ def _race_competition(model, h, time_limit, device=None,
         if t.is_alive():
             res["loser_draining"] = t.name
     if res.get("engine") == "device":
-        # post-race counterexample enrichment, bounded so it can't
-        # dwarf the verdict (shared helper with the tpu-wgl path)
-        res = wgl_tpu.enrich_diagnostics(model, h, res,
-                                         time_limit=10.0)
+        # post-race counterexample enrichment, bounded by the REMAINING
+        # budget (same policy as the serial ladder) so a device verdict
+        # landing near the deadline can't overrun time_limit
+        spare = (time_limit - (time.monotonic() - t_race0)
+                 if time_limit is not None else 10.0)
+        if spare > 0.1:
+            res = wgl_tpu.enrich_diagnostics(
+                model, h, res, time_limit=min(10.0, spare))
     return res
 
 

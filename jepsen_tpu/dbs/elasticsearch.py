@@ -5,12 +5,15 @@ inserted-document loss during partitions).
 
 REST client over the document API (the reference drives the same
 endpoints through elastisch): `set` adds index one document per
-element (PUT /jepsen/_doc/<v>), the final read refreshes the index
-and scans it (_refresh + _search with a size bound), and the
-set/set-full checkers account for every acknowledged element.
+element (PUT /jepsen/number/<v> — the *typed* 1.x path; the pinned
+1.5.0 era rejects the typeless ES 7+ /_doc surface), the final read
+refreshes the index and scans it (_refresh + _search with a size
+bound), and the set/set-full checkers account for every acknowledged
+element.  The index is created up-front with an explicit mapping,
+as sets.clj does, so dynamic-mapping races can't drop fields.
 `dirty-read` semantics ride the same surface: a `read` of a single
-document by id (GET /jepsen/_doc/<v>) observes whether an
-acknowledged-but-unrefreshed write is visible.
+document by id observes whether an acknowledged-but-unrefreshed
+write is visible.
 
 DB automation (core.clj shape): deb-package install, the service
 started with a cluster config listing every node as a unicast host,
@@ -43,6 +46,10 @@ PIDFILE = "/var/run/elasticsearch.pid"
 LOGFILE = "/var/log/elasticsearch/elasticsearch.log"
 DATA_DIR = "/var/lib/elasticsearch"
 INDEX = "jepsen"
+DOC_TYPE = "number"  # 1.x mapping type (sets.clj index-name/type)
+INDEX_MAPPING = {
+    "mappings": {DOC_TYPE: {"properties": {"num": {"type": "integer",
+                                                   "store": True}}}}}
 
 
 def base_url(node: str) -> str:
@@ -118,6 +125,21 @@ class EsSetClient(jclient.Client):
         c = type(self)(self.base_url_fn, self.timeout)
         c.node = node
         c.http = requests.Session()
+        try:
+            # idempotent: 200 on create, IndexAlreadyExists on the
+            # workers that lose the race — both fine, adds will land.
+            # Any OTHER rejection means the explicit mapping was NOT
+            # applied and dynamic mapping would silently take over, so
+            # it must at least leave a trace.
+            r = c.http.put(c._url(f"/{INDEX}"), json=INDEX_MAPPING,
+                           timeout=c.timeout)
+            if not r.ok and "AlreadyExists" not in r.text:
+                import logging
+                logging.getLogger(__name__).warning(
+                    "index mapping rejected (http %s): %.200s",
+                    r.status_code, r.text)
+        except requests.RequestException:
+            pass  # node unreachable now; ops surface their own errors
         return c
 
     def _url(self, path: str) -> str:
@@ -128,7 +150,7 @@ class EsSetClient(jclient.Client):
         try:
             if op["f"] == "add":
                 v = op["value"]
-                r = http.put(self._url(f"/{INDEX}/_doc/{int(v)}"),
+                r = http.put(self._url(f"/{INDEX}/{DOC_TYPE}/{int(v)}"),
                              json={"num": int(v)},
                              timeout=self.timeout)
                 if r.status_code in (200, 201):

@@ -173,6 +173,17 @@ class MongoConn:
             reply = read_op_msg(self.rf)
         if reply.get("ok") != 1:
             raise MongoError(reply.get("errmsg") or f"not ok: {reply}")
+        # ok:1 does not mean durably applied: writeConcernError means the
+        # write wasn't majority-acknowledged (rollback-eligible), writeErrors
+        # means it wasn't applied at all.  Surface both as exceptions so the
+        # client maps mutations to :info / :fail instead of a false :ok
+        # (document_cas.clj parse-result discipline).
+        wce = reply.get("writeConcernError")
+        wes = reply.get("writeErrors")
+        if wce:
+            raise MongoError(f"writeConcernError: {wce.get('errmsg', wce)}")
+        if wes:
+            raise MongoError(f"writeErrors: {wes}")
         return reply
 
     def close(self):
@@ -318,7 +329,11 @@ class MongoClient(jclient.Client):
                 reply = self._update(
                     test, {"_id": int(k), "value": old},
                     {"_id": int(k), "value": new}, upsert=False)
-                n = reply.get("nModified", reply.get("n", 0))
+                # Decide on the matched count n (getN): when old == new the
+                # update matches but modifies 0 docs, yet the CAS *won*
+                # (document_cas.clj getN discipline).  nModified only as a
+                # fallback for ancient servers that omit n.
+                n = reply.get("n", reply.get("nModified", 0))
                 if n not in (0, 1):
                     raise MongoError(f"cas touched {n} documents")
                 return {**op, "type": "ok" if n == 1 else "fail"}

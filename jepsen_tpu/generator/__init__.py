@@ -989,7 +989,12 @@ class FlipFlop(Generator):
         return (o, FlipFlop(gens, (self.i + 1) % len(gens)))
 
     def update(self, test, ctx, event):
-        return self
+        # Pure-update contract: every child sees every event, as the
+        # reference's flip-flop does by delegating to its gens vector
+        # (generator.clj:1485-1501) — a stateful child (e.g. until-ok)
+        # nested inside must keep receiving completions.
+        return FlipFlop([update(g, test, ctx, event) for g in self.gens],
+                        self.i)
 
 
 def flip_flop(a, b):

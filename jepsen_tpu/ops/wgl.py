@@ -475,6 +475,7 @@ def check(model: Model, history: History, time_limit: Optional[float] = None,
         ic_eff = min(ic_eff, ic_pad)
         iinv, iopc = iinv[:ic_eff], iopc[:ic_eff]
         B = 1 << 18  # packed rows are cheap; escalation spills hard
+        W = W_eff  # the width the kernel actually runs at
         probes_used, row_cols = 4, W_eff + ic_eff
         init_fn, chunk_jit = compiled_search32(
             n_pad=len(enc.inv), ic_pad=ic_eff,
@@ -579,7 +580,10 @@ def check(model: Model, history: History, time_limit: Optional[float] = None,
                 K * row_cols * 16 * probes_used / 1e6, 3),
             "first_call_s": round(first_call_s, 3),
         }
-        detail = {"W": W, "K": K, "configs_explored": total_explored,
+        # W is the history's actual window; W_pad the kernel's padded
+        # width (equal for the narrow path, 32-padded for wide lanes)
+        detail = {"W": enc.window_raw, "W_pad": W, "K": K,
+                  "configs_explored": total_explored,
                   "wall_s": round(wall, 4), "util": util}
         if found:
             return {"valid?": True, "op_count": n + enc.n_info, **detail}

@@ -437,7 +437,9 @@ def check_batched(model: Model, histories: Sequence[History],
         n_total = int(e.n_ok + e.n_info)
         hits, ins = int(stats[lane, 3]), int(stats[lane, 4])
         rounds = int(stats[lane, 5])
-        detail = {"W": W, "K": K,
+        # "W" matches wgl.py's convention: the lane's actual window;
+        # "W_pad" is the batch-shared padded kernel width
+        detail = {"W": e.window_raw, "W_pad": W, "K": K,
                   "configs_explored": int(stats[lane, 0]),
                   "batch_keys": batch.n_keys, "batch_wall_s": round(wall, 4),
                   "util": {

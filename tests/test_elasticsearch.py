@@ -22,6 +22,7 @@ class EsStub(BaseHTTPRequestHandler):
     compressed)."""
 
     docs: dict = {}
+    indices: set = set()
     searchable: set = set()
     lock = threading.Lock()
     lossy_every = 0
@@ -42,6 +43,14 @@ class EsStub(BaseHTTPRequestHandler):
         parts = self.path.strip("/").split("/")
         n = int(self.headers.get("Content-Length") or 0)
         doc = json.loads(self.rfile.read(n) or b"{}")
+        if len(parts) == 1:  # index creation with mapping
+            with self.lock:
+                if parts[0] in EsStub.indices:
+                    self._reply(400, {"error": "IndexAlreadyExists"})
+                else:
+                    EsStub.indices.add(parts[0])
+                    self._reply(200, {"acknowledged": True})
+            return
         with self.lock:
             self.acked[0] += 1
             drop = (self.lossy_every
@@ -72,6 +81,7 @@ class EsStub(BaseHTTPRequestHandler):
 @pytest.fixture()
 def stub():
     EsStub.docs = {}
+    EsStub.indices = set()
     EsStub.searchable = set()
     EsStub.lossy_every = 0
     EsStub.acked = [0]

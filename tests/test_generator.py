@@ -197,6 +197,22 @@ def test_flip_flop():
     assert [o["f"] for o in out] == ["a", "b", "a", "b", "a"]
 
 
+def test_flip_flop_propagates_updates():
+    # a stateful child nested inside flip_flop must see completions:
+    # until_ok stops after its first ok even when it is one arm of a
+    # flip_flop (regression: FlipFlop.update used to drop events)
+    a = gen.until_ok(gen.repeat({"f": "w"}))
+    b = gen.repeat({"f": "r"})
+    out = gt.imperfect(gen.limit(40, gen.flip_flop(a, b)))
+    w_oks = [o["time"] for o in out
+             if o["f"] == "w" and o["type"] == "ok"]
+    assert w_oks  # at least one write succeeded
+    first_ok = min(w_oks)
+    late_w = [o for o in out if o["f"] == "w" and o["type"] == "invoke"
+              and o["time"] > first_ok]
+    assert late_w == []
+
+
 def test_process_limit():
     # with perfect_info every op crashes, retiring its process; after n
     # distinct processes the generator stops (generator_test.clj parity:
