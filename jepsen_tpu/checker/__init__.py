@@ -302,6 +302,18 @@ def _race_competition(model, h, time_limit, device=None,
             return wgl_tpu.check(model, h, time_limit=budget,
                                  stop=stop, enc=enc, **kw)
 
+    def enrich_spare(r, t_start):
+        """Post-verdict counterexample enrichment riding only the
+        REMAINING budget — a fixed slice could overrun time_limit
+        after the engine already spent most of it. Shared by the
+        serial ladder and the threaded race."""
+        spare = (time_limit - (time.monotonic() - t_start)
+                 if time_limit is not None else 10.0)
+        if spare > 0.1:
+            r = wgl_tpu.enrich_diagnostics(model, h, r,
+                                           time_limit=min(10.0, spare))
+        return r
+
     if safe_backend() == "cpu" and time_limit is not None:
         # On a CPU backend both engines contend for the same cores
         # (and the pure-Python oracle for the GIL), so racing buys
@@ -332,14 +344,7 @@ def _race_competition(model, h, time_limit, device=None,
             r = {"valid?": UNKNOWN, "cause": "engine-error"}
         if r.get("valid?") != UNKNOWN:
             r["engine"] = "device"
-            # enrichment rides the REMAINING budget only — a fixed
-            # slice here could overrun time_limit after the device
-            # already spent most of it
-            spare = time_limit - (time.monotonic() - t0)
-            if spare > 0.1:
-                r = wgl_tpu.enrich_diagnostics(
-                    model, h, r, time_limit=min(10.0, spare))
-            return r
+            return enrich_spare(r, t0)
         left = max(1.0, time_limit - (time.monotonic() - t0))
         r = wgl_ref.check(model, h, time_limit=left)
         if r.get("valid?") != UNKNOWN:
@@ -421,14 +426,7 @@ def _race_competition(model, h, time_limit, device=None,
         if t.is_alive():
             res["loser_draining"] = t.name
     if res.get("engine") == "device":
-        # post-race counterexample enrichment, bounded by the REMAINING
-        # budget (same policy as the serial ladder) so a device verdict
-        # landing near the deadline can't overrun time_limit
-        spare = (time_limit - (time.monotonic() - t_race0)
-                 if time_limit is not None else 10.0)
-        if spare > 0.1:
-            res = wgl_tpu.enrich_diagnostics(
-                model, h, res, time_limit=min(10.0, spare))
+        res = enrich_spare(res, t_race0)
     return res
 
 

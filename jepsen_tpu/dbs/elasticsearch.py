@@ -120,27 +120,41 @@ class EsSetClient(jclient.Client):
         self.timeout = timeout
         self.node: Optional[str] = None
         self.http = None
+        self._index_ok = False
 
     def open(self, test, node):
         c = type(self)(self.base_url_fn, self.timeout)
         c.node = node
         c.http = requests.Session()
+        c._index_ok = False
+        c._ensure_index()
+        return c
+
+    def _ensure_index(self):
+        """Create the index with its explicit mapping (sets.clj
+        create-index discipline). Retried from invoke() until it
+        lands, so a node unreachable at open() — the window where
+        an add would otherwise auto-create the index with dynamic
+        mapping — can't silently void the mapping guarantee."""
+        if self._index_ok:
+            return
         try:
             # idempotent: 200 on create, IndexAlreadyExists on the
             # workers that lose the race — both fine, adds will land.
             # Any OTHER rejection means the explicit mapping was NOT
             # applied and dynamic mapping would silently take over, so
             # it must at least leave a trace.
-            r = c.http.put(c._url(f"/{INDEX}"), json=INDEX_MAPPING,
-                           timeout=c.timeout)
-            if not r.ok and "AlreadyExists" not in r.text:
+            r = self.http.put(self._url(f"/{INDEX}"),
+                              json=INDEX_MAPPING, timeout=self.timeout)
+            if r.ok or "AlreadyExists" in r.text:
+                self._index_ok = True
+            else:
                 import logging
                 logging.getLogger(__name__).warning(
                     "index mapping rejected (http %s): %.200s",
                     r.status_code, r.text)
         except requests.RequestException:
-            pass  # node unreachable now; ops surface their own errors
-        return c
+            pass  # node unreachable now; retried on the next invoke
 
     def _url(self, path: str) -> str:
         return self.base_url_fn(self.node) + path
@@ -149,6 +163,7 @@ class EsSetClient(jclient.Client):
         http = self.http or requests
         try:
             if op["f"] == "add":
+                self._ensure_index()  # no-op once it has landed
                 v = op["value"]
                 r = http.put(self._url(f"/{INDEX}/{DOC_TYPE}/{int(v)}"),
                              json={"num": int(v)},

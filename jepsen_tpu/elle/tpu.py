@@ -77,23 +77,12 @@ def _bucket(n: int) -> int:
     return max(1, 1 << (int(n) - 1).bit_length())
 
 
-@lru_cache(maxsize=32)
-def _compiled(n_pad: int, e_pad: int, q_pad: int, n_sub: int,
-              iters: int):
-    """The closure kernel for one shape bucket, AOT-compiled so the
-    compile cost is measured here (once per bucket) and callers time
-    pure execution — no double-run for telemetry. Returns
-    (compiled_fn, compile_s)."""
-    import time as _t
-
+def make_closure_kernel(n_pad: int, n_sub: int, iters: int, dtype):
+    """The closure-by-squaring kernel as a plain traceable function —
+    shared by the runtime path below and the AOT TPU-evidence path
+    (ops/aot.py), which lowers it for a v5e topology in bf16."""
     import jax
     import jax.numpy as jnp
-
-    from ..util import safe_backend
-
-    # lock-free platform probe: jax.default_backend() would trigger
-    # backend init itself, ahead of the bounded-wait policy upstream
-    dtype = jnp.bfloat16 if safe_backend() == "tpu" else jnp.float32
 
     def kernel(src, dst, w, q_src, q_dst):
         # adjacency per subset: (S, N, N); padded edges carry w == 0
@@ -116,6 +105,28 @@ def _compiled(n_pad: int, e_pad: int, q_pad: int, n_sub: int,
         # rw-closure queries: path q_dst -> q_src under each subset
         closed = rb[:, q_dst, q_src]
         return labels.astype(jnp.int32), closed
+
+    return kernel
+
+
+@lru_cache(maxsize=32)
+def _compiled(n_pad: int, e_pad: int, q_pad: int, n_sub: int,
+              iters: int):
+    """The closure kernel for one shape bucket, AOT-compiled so the
+    compile cost is measured here (once per bucket) and callers time
+    pure execution — no double-run for telemetry. Returns
+    (compiled_fn, compile_s)."""
+    import time as _t
+
+    import jax
+    import jax.numpy as jnp
+
+    from ..util import safe_backend
+
+    # lock-free platform probe: jax.default_backend() would trigger
+    # backend init itself, ahead of the bounded-wait policy upstream
+    dtype = jnp.bfloat16 if safe_backend() == "tpu" else jnp.float32
+    kernel = make_closure_kernel(n_pad, n_sub, iters, dtype)
 
     specs = (jax.ShapeDtypeStruct((e_pad,), jnp.int32),
              jax.ShapeDtypeStruct((e_pad,), jnp.int32),

@@ -989,10 +989,12 @@ class FlipFlop(Generator):
         return (o, FlipFlop(gens, (self.i + 1) % len(gens)))
 
     def update(self, test, ctx, event):
-        # Pure-update contract: every child sees every event, as the
-        # reference's flip-flop does by delegating to its gens vector
-        # (generator.clj:1485-1501) — a stateful child (e.g. until-ok)
-        # nested inside must keep receiving completions.
+        # DELIBERATE divergence from the reference: its flip-flop
+        # ignores updates outright (generator.clj:1485-1501 "Updates
+        # are ignored."), so a stateful child (e.g. until-ok) nested
+        # inside never sees completions and generates forever. Here
+        # every child sees every event — the pure-update contract the
+        # rest of this DSL honors.
         return FlipFlop([update(g, test, ctx, event) for g in self.gens],
                         self.i)
 

@@ -1,0 +1,263 @@
+"""Ahead-of-time TPU compilation evidence — no hardware required.
+
+Three rounds of this build ran on a machine whose TPU runtime is wedged
+(the `axon` tunnel hangs at backend init), so every measured number is
+XLA:CPU.  This module closes the evidence gap from the *compiler* side:
+jax's topology API (`jax.experimental.topologies.get_topology_desc`)
+loads the real libtpu compiler and AOT-compiles our kernels for a TPU
+v5e topology without touching any device.  That yields
+
+  * a serialized TPU executable (proof the kernels lower and compile
+    for the MXU target, committed as StableHLO + optimized-HLO text),
+  * the compiler's own cost analysis (FLOPs, bytes accessed), and
+  * a roofline model: v5e peak (197 TFLOP/s bf16, 819 GB/s HBM) turns
+    cost analysis into a modeled per-call time, modeled MFU, and a
+    modeled configs/s for the search kernels — published in BENCH next
+    to the measured CPU numbers.
+
+The reference's north star is wall-clock analysis budget
+(jepsen/src/jepsen/checker.clj:185-216 gates on a 60 s default); the
+modeled numbers below say what that budget buys once a chip shows up.
+
+Kernels covered:
+  * `wgl32`   — narrow-window bitmask search (ops/wgl32.py), the
+                headline cas-register shape;
+  * `wgln`    — packed multi-lane wide-window search (ops/wgln.py), the
+                adversarial 2.2M-config shape (W=71 -> 96, L=3);
+  * `elle`    — Elle closure-by-squaring (elle/tpu.py) in bf16, the
+                dtype the kernel itself selects on a TPU backend.
+"""
+
+from __future__ import annotations
+
+import gzip
+import json
+import os
+import time
+from typing import Any, Optional
+
+# TPU v5e (v5 lite) single-chip peaks, public spec sheet numbers.
+V5E_PEAK_BF16_FLOPS = 197e12
+V5E_PEAK_HBM_BYTES = 819e9
+V5E_NAME = "tpu v5e (v5 lite)"
+
+_TOPOLOGY = "v5e:2x2"  # smallest layout divisible by the 2x2x1 host
+
+
+def tpu_topology(name: str = _TOPOLOGY):
+    """A TPU TopologyDescription from libtpu, or None when the
+    compiler stack can't provide one (no libtpu in the image).  Pure
+    host work: never initializes a backend, so it is safe on the
+    wedged-axon machine (see util.backend_ready docs)."""
+    try:
+        from jax.experimental import topologies
+        return topologies.get_topology_desc(platform="tpu",
+                                            topology_name=name)
+    except Exception:  # noqa: BLE001 — absence of libtpu, bad name…
+        return None
+
+
+def _single_chip_sharding(topo):
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+    mesh = Mesh(np.array(topo.devices[:1]), ("d",))
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def aot_compile(fn, arg_specs: tuple, label: str,
+                out_dir: Optional[str] = None,
+                topo=None) -> dict:
+    """AOT-compile `fn(*arg_specs)` for one v5e chip; return the
+    compiler's verdict and cost analysis, optionally writing the
+    StableHLO and optimized-HLO artifacts (gzipped) to out_dir."""
+    import jax
+    t0 = time.monotonic()
+    topo = topo or tpu_topology()
+    if topo is None:
+        return {"label": label, "ok": False,
+                "error": "no TPU topology available (libtpu missing)"}
+    sh = _single_chip_sharding(topo)
+    try:
+        n_args = len(arg_specs)
+        lowered = jax.jit(fn, in_shardings=(sh,) * n_args,
+                          out_shardings=sh).lower(*arg_specs)
+        compiled = lowered.compile()
+    except Exception as e:  # noqa: BLE001 — a kernel that fails to
+        #                     lower for TPU is exactly what to report
+        return {"label": label, "ok": False,
+                "error": f"{type(e).__name__}: {e}"[:400]}
+    compile_s = time.monotonic() - t0
+    ca = compiled.cost_analysis() or {}
+    flops = float(ca.get("flops", 0.0))
+    byts = float(ca.get("bytes accessed", 0.0))
+    t_compute = flops / V5E_PEAK_BF16_FLOPS
+    t_memory = byts / V5E_PEAK_HBM_BYTES
+    t_bound = max(t_compute, t_memory)
+    res: dict[str, Any] = {
+        "label": label, "ok": True,
+        "target": V5E_NAME,
+        "device_kind": topo.devices[0].device_kind,
+        "compile_s": round(compile_s, 2),
+        # Verbatim compiler cost analysis.  Two caveats, verified by
+        # compiling the same kernel at chunk=1/64/1024 (identical
+        # numbers): HloCostAnalysis counts a while-loop body ONCE, and
+        # it charges gathers/scatters at full-operand width — so for
+        # the search kernels these are per-ROUND numbers and `bytes`
+        # is a conservative upper bound on real traffic.
+        "compiler_flops": flops,
+        "compiler_bytes_accessed": byts,
+        "compiler_note": ("loop body counted once; scatter/gather "
+                          "charged at full-operand width"),
+        "arithmetic_intensity": round(flops / max(byts, 1.0), 6),
+        "compiler_roofline_time_s": t_bound,
+        "roofline_bound": ("compute" if t_compute >= t_memory
+                           else "memory"),
+    }
+    if out_dir:
+        try:
+            os.makedirs(out_dir, exist_ok=True)
+            stablehlo = lowered.as_text()
+            hlo = compiled.as_text()
+            for suffix, text in (("stablehlo.mlir", stablehlo),
+                                 ("optimized.hlo", hlo)):
+                path = os.path.join(out_dir, f"{label}.{suffix}.gz")
+                with gzip.open(path, "wt") as f:
+                    f.write(text)
+            res["artifacts"] = sorted(
+                p for p in os.listdir(out_dir) if p.startswith(label))
+        except OSError as e:
+            # a full disk must not discard the compile verdict itself
+            res["artifacts_error"] = str(e)[:200]
+    return res
+
+
+# -- kernel-specific spec builders ------------------------------------------
+
+def _wgl_consts_spec(n_pad: int, ic_pad: int, S: int, O: int):
+    import jax
+    import jax.numpy as jnp
+    v = lambda shape: jax.ShapeDtypeStruct(shape, jnp.int32)  # noqa: E731
+    return (v((n_pad,)), v((n_pad,)), v((n_pad,)), v((n_pad,)),
+            v((ic_pad,)), v((ic_pad,)), v((S, O)), v(()), v(()), v(()))
+
+
+def _wgl_analytic(K: int, W: int, ic: int, probes: int = 4) -> dict:
+    """Roofline in the kernel's OWN traffic currency (the same one the
+    runtime util blocks report): per round the search processes K
+    beam rows x (W + ic) successor columns, each costing ~probes x 16 B
+    of memo-table traffic — the dominant stream (ops/wgl.py util
+    accounting).  Bandwidth-bound time per round against v5e HBM gives
+    a modeled configs/s CEILING (real rounds also pay sort/dispatch)."""
+    bytes_per_round = K * (W + ic) * probes * 16
+    t_round = bytes_per_round / V5E_PEAK_HBM_BYTES
+    return {"analytic_bytes_per_round": bytes_per_round,
+            "analytic_round_time_s": t_round,
+            "modeled_configs_per_s_ceiling": int(K / t_round)}
+
+
+def wgl32_case(n_pad: int = 16384, ic_pad: int = 8, S: int = 1024,
+               O: int = 16, K: int = 16, H: int = 1 << 23,
+               B: int = 1 << 18, chunk: int = 1024, W: int = 8) -> tuple:
+    """The headline shape: a 10k-op cas-register history (n_pad 2^14,
+    register state space, narrow window) through the bitmask kernel."""
+    import jax
+    from .wgl32 import _build_search32
+    init_fn, chunk_fn = _build_search32(n_pad, ic_pad, S, O, K, H, B,
+                                        chunk, probes=4, W=W)
+    carry_spec = jax.eval_shape(init_fn, 0)
+    return chunk_fn, (_wgl_consts_spec(n_pad, ic_pad, S, O), carry_spec), \
+        {"K": K, "W": W, "chunk": chunk,
+         **_wgl_analytic(K, W, ic_pad)}
+
+
+def wgln_case(n_pad: int = 4096, ic_pad: int = 8, S: int = 256,
+              O: int = 16, K: int = 1024, H: int = 1 << 23,
+              B: int = 1 << 20, chunk: int = 128, W: int = 96,
+              L: int = 3) -> tuple:
+    """The adversarial-wave shape: W raw 71 -> 96 padded, 3 uint32
+    lanes, production beam — the 2.2M-config bench config's kernel."""
+    import jax
+    from .wgln import _build_searchN
+    init_fn, chunk_fn = _build_searchN(n_pad, ic_pad, S, O, K, H, B,
+                                       chunk, probes=4, W=W, L=L)
+    carry_spec = jax.eval_shape(init_fn, 0)
+    return chunk_fn, (_wgl_consts_spec(n_pad, ic_pad, S, O), carry_spec), \
+        {"K": K, "W": W, "L": L, "chunk": chunk,
+         **_wgl_analytic(K, W, ic_pad)}
+
+
+def elle_case(n_pad: int = 4096, e_pad: int = 16384, q_pad: int = 256,
+              n_sub: int = 4) -> tuple:
+    """Closure-by-squaring at the capacity its docstring sizes (8k txns
+    -> n_pad 4096 per shard bucket), bf16 on the MXU — the dtype the
+    kernel itself picks for a TPU backend (elle/tpu.py:96)."""
+    import jax
+    import jax.numpy as jnp
+    from ..elle.tpu import make_closure_kernel
+    iters = max(1, (n_pad - 1).bit_length())
+    kernel = make_closure_kernel(n_pad, n_sub, iters, jnp.bfloat16)
+    specs = (jax.ShapeDtypeStruct((e_pad,), jnp.int32),
+             jax.ShapeDtypeStruct((e_pad,), jnp.int32),
+             jax.ShapeDtypeStruct((n_sub, e_pad), jnp.float32),
+             jax.ShapeDtypeStruct((q_pad,), jnp.int32),
+             jax.ShapeDtypeStruct((q_pad,), jnp.int32))
+    # The closure is iters dense (n_sub, N, N) @ (N, N) squarings —
+    # pure MXU work.  The compiler counts one fori_loop iteration;
+    # multiplying back out gives the full-call model.
+    total_flops = 2.0 * n_sub * iters * n_pad ** 3
+    t_full = total_flops / V5E_PEAK_BF16_FLOPS
+    return kernel, specs, {
+        "n_pad": n_pad, "n_sub": n_sub, "iters": iters,
+        "analytic_matmul_flops": total_flops,
+        "modeled_full_call_time_s": round(t_full, 5),
+        "modeled_mfu_if_mxu_bound": 1.0,
+        "modeled_tflops_at_peak": round(V5E_PEAK_BF16_FLOPS / 1e12, 1)}
+
+
+def evidence(out_dir: Optional[str] = None,
+             include_wgln: bool = True) -> dict:
+    """AOT-compile the flagship kernels for TPU v5e and return the
+    BENCH `tpu_aot` block.  ~1-2 min of pure host compile work."""
+    topo = tpu_topology()
+    if topo is None:
+        return {"ok": False,
+                "error": "no TPU topology available (libtpu missing)"}
+    out: dict[str, Any] = {"ok": True, "topology": _TOPOLOGY,
+                           "device_kind": topo.devices[0].device_kind,
+                           "peaks": {"bf16_flops": V5E_PEAK_BF16_FLOPS,
+                                     "hbm_bytes_per_s": V5E_PEAK_HBM_BYTES},
+                           "kernels": {}}
+    cases = [("wgl32_headline", wgl32_case)]
+    if include_wgln:
+        cases.append(("wgln_adversarial", wgln_case))
+    cases.append(("elle_closure_8k", elle_case))
+    for label, case in cases:
+        try:
+            fn, specs, meta = case()
+        except Exception as e:  # noqa: BLE001
+            out["kernels"][label] = {"ok": False,
+                                     "error": f"build: {e}"[:300]}
+            continue
+        try:
+            r = aot_compile(fn, specs, label, out_dir=out_dir, topo=topo)
+        except Exception as e:  # noqa: BLE001 — one kernel's failure
+            r = {"ok": False,     # must not discard the others' results
+                 "error": f"{type(e).__name__}: {e}"[:300]}
+        r.update(meta)
+        out["kernels"][label] = r
+    out["all_ok"] = all(k.get("ok") for k in out["kernels"].values())
+    return out
+
+
+def main() -> int:
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    out_dir = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))), "artifacts",
+        "tpu_aot")
+    print(json.dumps(evidence(out_dir=out_dir), indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

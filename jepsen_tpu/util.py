@@ -174,6 +174,30 @@ def backend_failed() -> bool:
     return _backend_probe.get("ok") is False
 
 
+def machine_fingerprint() -> str:
+    """A short stable fingerprint of the host's ISA surface: arch +
+    a hash of the CPU feature flags. XLA:CPU persists AOT results
+    compiled against the COMPILE machine's features — replayed on a
+    host missing one (observed live: +prefer-no-gather et al. when
+    the repo moved machines) the loader warns of possible SIGILL.
+    Scoping the cache by this fingerprint makes foreign entries
+    invisible instead of dangerous."""
+    import hashlib
+    import platform
+
+    flags = ""
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith(("flags", "Features")):
+                    flags = " ".join(sorted(line.split(":", 1)[1].split()))
+                    break
+    except OSError:
+        pass
+    digest = hashlib.sha256(flags.encode()).hexdigest()[:12]
+    return f"{platform.machine()}-{digest}"
+
+
 def enable_compilation_cache(path: Optional[str] = None
                              ) -> Optional[str]:
     """Point XLA's persistent compilation cache at a stable directory
@@ -181,18 +205,26 @@ def enable_compilation_cache(path: Optional[str] = None
     compile tax (~2 s/bucket on cpu, 20-40 s on TPU) drops to a
     deserialization (~0.4 s measured on the register bucket).
 
-    Default dir: $JEPSEN_TPU_CACHE_DIR or ~/.cache/jepsen_tpu/xla.
-    Opt out with JEPSEN_TPU_NO_CACHE=1 (XLA:CPU AOT loads warn when
-    the compile machine's tuning flags differ from the host's; the
-    cache still loads and runs, but the stderr noise may matter to
-    some callers). Returns the cache dir, or None when disabled or
-    jax is unavailable."""
+    Default dir: ($JEPSEN_TPU_CACHE_DIR or ~/.cache/jepsen_tpu/xla)
+    + a machine fingerprint segment, so AOT artifacts compiled on one
+    host are never loaded on a different one (cross-host loads warn
+    of possible SIGILL — see machine_fingerprint). An EXPLICIT `path`
+    argument is honored verbatim — a caller shipping a pre-seeded
+    cache dir owns that risk knowingly. A provenance.json in the dir
+    records who compiled the entries. Opt out with
+    JEPSEN_TPU_NO_CACHE=1. Returns the cache dir, or None when
+    disabled or jax is unavailable."""
+    import json
     import os
+    import platform
 
     if os.environ.get("JEPSEN_TPU_NO_CACHE"):
         return None
-    path = (path or os.environ.get("JEPSEN_TPU_CACHE_DIR")
-            or os.path.expanduser("~/.cache/jepsen_tpu/xla"))
+    fingerprint = machine_fingerprint()
+    if path is None:
+        base = (os.environ.get("JEPSEN_TPU_CACHE_DIR")
+                or os.path.expanduser("~/.cache/jepsen_tpu/xla"))
+        path = os.path.join(base, fingerprint)
     try:
         import jax
         jax.config.update("jax_compilation_cache_dir", path)
@@ -203,6 +235,17 @@ def enable_compilation_cache(path: Optional[str] = None
                           -1)
     except Exception:  # noqa: BLE001 — no jax / option renamed
         return None
+    try:
+        os.makedirs(path, exist_ok=True)
+        prov = os.path.join(path, "provenance.json")
+        if not os.path.exists(prov):
+            with open(prov, "w") as f:
+                json.dump({"host": platform.node(),
+                           "machine": platform.machine(),
+                           "fingerprint": fingerprint,
+                           "jax": jax.__version__}, f)
+    except OSError:
+        pass  # cache still works without provenance
     return path
 
 

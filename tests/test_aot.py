@@ -1,0 +1,53 @@
+"""Compile-level TPU evidence tests (ops/aot.py): the flagship kernels
+must AOT-compile for a real TPU v5e topology via libtpu — no hardware,
+no backend init — and report the compiler's cost analysis.  This is the
+artifact chain BENCH publishes as `tpu_aot`."""
+
+import glob
+import os
+
+import pytest
+
+from jepsen_tpu.ops import aot
+
+pytestmark = pytest.mark.skipif(
+    aot.tpu_topology() is None,
+    reason="libtpu topology API unavailable in this image")
+
+
+def test_topology_is_v5e():
+    topo = aot.tpu_topology()
+    assert "TPU" in topo.devices[0].device_kind
+
+
+def test_wgl32_kernel_compiles_for_tpu(tmp_path):
+    # small shape so CI pays seconds, not the production compile
+    fn, specs, meta = aot.wgl32_case(n_pad=128, S=64, H=1 << 14,
+                                     B=1 << 10, chunk=8)
+    r = aot.aot_compile(fn, specs, "wgl32_ci", out_dir=str(tmp_path))
+    assert r["ok"], r
+    assert r["compiler_bytes_accessed"] > 0
+    assert r["roofline_bound"] in ("compute", "memory")
+    # both artifact kinds written and non-empty
+    arts = glob.glob(str(tmp_path / "wgl32_ci.*"))
+    assert len(arts) == 2
+    assert all(os.path.getsize(a) > 100 for a in arts)
+
+
+def test_elle_closure_compiles_bf16_for_tpu():
+    fn, specs, meta = aot.elle_case(n_pad=256, e_pad=512, q_pad=32,
+                                    n_sub=2)
+    r = aot.aot_compile(fn, specs, "elle_ci")
+    assert r["ok"], r
+    # dense squarings: unmistakably compute-heavy on the MXU
+    assert r["compiler_flops"] > 1e6
+    assert meta["analytic_matmul_flops"] > r["compiler_flops"]
+
+
+def test_evidence_block_shape(tmp_path):
+    out = aot.evidence(out_dir=str(tmp_path), include_wgln=False)
+    assert out["ok"] and out["all_ok"], out
+    assert set(out["kernels"]) == {"wgl32_headline", "elle_closure_8k"}
+    for k in out["kernels"].values():
+        assert k["ok"]
+        assert k["compile_s"] > 0

@@ -200,7 +200,9 @@ def test_flip_flop():
 def test_flip_flop_propagates_updates():
     # a stateful child nested inside flip_flop must see completions:
     # until_ok stops after its first ok even when it is one arm of a
-    # flip_flop (regression: FlipFlop.update used to drop events)
+    # flip_flop (regression: FlipFlop.update used to drop events).
+    # Deliberately BETTER than the reference, whose flip-flop ignores
+    # updates and would let the nested until-ok run forever.
     a = gen.until_ok(gen.repeat({"f": "w"}))
     b = gen.repeat({"f": "r"})
     out = gt.imperfect(gen.limit(40, gen.flip_flop(a, b)))
