@@ -175,8 +175,9 @@ def _timed(fn, *args, **kw):
 def _config_entry(res: dict, wall: float) -> dict:
     out = {"verdict": res.get("valid?"), "wall_s": round(wall, 3),
            "op_count": res.get("op_count")}
-    for k in ("W", "K", "configs_explored", "cause", "engine", "util",
-              "device_row", "oracle_row"):
+    for k in ("W", "W_pad", "K", "configs_explored", "cause", "engine",
+              "route_reason", "shape", "util", "device_row",
+              "oracle_row"):
         if res.get(k) is not None:
             out[k] = res[k]
     return out
@@ -189,7 +190,7 @@ def run_extras(budget: float, deadline: float) -> dict:
     JSON line on a driver timeout."""
     from jepsen_tpu.models import (cas_register, fifo_queue, mutex,
                                    register)
-    from jepsen_tpu.ops import wgl
+    from jepsen_tpu.ops import route, wgl
     from jepsen_tpu import synth
 
     configs = {}
@@ -206,7 +207,11 @@ def run_extras(budget: float, deadline: float) -> dict:
         try:
             t0 = time.monotonic()
             if checker is None:
-                res = wgl.check(model, hist, time_limit=budget)
+                # shape-aware routing: near-serial / model-pruned
+                # shapes decide on the jitlin sweep, branchy ones on
+                # the device kernel — each entry records engine +
+                # route_reason (ops/route.py)
+                res = route.check_routed(model, hist, time_limit=budget)
             else:
                 res = checker()
             configs[name] = _config_entry(res, time.monotonic() - t0)

@@ -1,0 +1,133 @@
+"""Shape-aware engine routing for single-history linearizability.
+
+The device beam kernel earns its keep on branchy state spaces — wide
+frontiers amortize its per-round dispatch. On NEAR-SERIAL histories the
+frontier never fills (BENCH r3 `mutex_1k`: frontier_fill 0.136,
+memo_hit_rate 0.0 — a beam of 16 doing a serial walk with vector
+overhead) and the JIT-linearization sweep (`ops/jitlin.py`, the
+knossos `linear` algorithm) decides in milliseconds.
+
+Serial-ness is only partly visible from the history's interval
+structure: `mutex_1k` and `register_500` have near-identical
+concurrency depth (~3.6 vs ~4.0 mean pending ops), yet the mutex
+frontier stays empty because the MODEL prunes almost every
+interleaving (acquire-while-held is inconsistent) while the register
+admits most of them (fill 0.88). So static interval stats cannot
+route alone; this module measures shape statically AND probes
+dynamically:
+
+  1. a bounded jitlin PROBE (default 0.35 s / 30k configs): on
+     near-serial or heavily-pruned shapes the sweep simply finishes —
+     that IS the routing decision, and the verdict is already in hand;
+  2. otherwise the device kernel runs with the remaining budget
+     (branchy shapes blow the probe's config cap almost immediately,
+     so the detour costs milliseconds);
+  3. a device "unknown" falls back to the host oracle, competition
+     style.
+
+Every result carries `engine` and `route_reason`, plus the static
+`shape` stats, so BENCH configs explain their engine choice
+(VERDICT r3 #8: no config should sit on the device engine with
+frontier_fill < 0.3).
+"""
+
+from __future__ import annotations
+
+import time as _time
+from typing import Optional
+
+import numpy as np
+
+from ..history import History
+from ..models.core import Model
+from .encode import Encoded, EncodingUnsupported, encode
+
+
+def shape_stats(enc: Encoded) -> dict:
+    """Static interval structure of an encoded history: how deep does
+    concurrency run, and how wide must the search window be."""
+    n = int(enc.n_ok)
+    if n == 0:
+        return {"n_ok": 0, "n_info": int(enc.n_info),
+                "W_raw": enc.window_raw,
+                "mean_depth": 0.0, "p95_depth": 0}
+    inv = enc.inv[:n].astype(np.int64)
+    ret = enc.ret[:n].astype(np.int64)
+    order_i = np.sort(inv)
+    order_r = np.sort(ret)
+    # pending depth at each invocation t: ops with inv <= t < ret
+    depth = (np.searchsorted(order_i, inv, side="right")
+             - np.searchsorted(order_r, inv, side="right"))
+    return {"n_ok": n, "n_info": int(enc.n_info),
+            "W_raw": int(enc.window_raw),
+            "mean_depth": round(float(depth.mean()), 2),
+            "p95_depth": int(np.percentile(depth, 95))}
+
+
+def check_routed(model: Model, history: History,
+                 time_limit: Optional[float] = None,
+                 probe_s: float = 0.35,
+                 probe_configs: int = 30_000,
+                 enc: Optional[Encoded] = None) -> dict:
+    """Single-history check with shape-aware engine choice (see module
+    docstring). Returns the winning engine's result dict, annotated
+    with `engine`, `route_reason`, and `shape`."""
+    from . import jitlin, wgl, wgl_ref
+
+    t0 = _time.monotonic()
+    try:
+        enc = enc or encode(model, history)
+    except EncodingUnsupported as e:
+        r = wgl_ref.check(model, history, time_limit=time_limit)
+        r["engine"] = "oracle"
+        r["route_reason"] = f"encoding unsupported: {e}"
+        return r
+    shape = shape_stats(enc)
+
+    # 1. jitlin probe — decides near-serial / model-pruned shapes
+    #    outright; branchy shapes exhaust the config cap in ms.
+    budget = (min(probe_s, time_limit / 4) if time_limit is not None
+              else probe_s)
+    r = jitlin.check(model, history, time_limit=budget,
+                     max_configs=probe_configs)
+    if r.get("valid?") != "unknown":
+        r["engine"] = "jitlin"
+        r["route_reason"] = (
+            f"probe decided in {_time.monotonic() - t0:.3f}s "
+            f"(near-serial or model-pruned shape)")
+        r["shape"] = shape
+        return r
+
+    probe_cause = r.get("cause", "budget")
+
+    # 2. device kernel on the remaining budget
+    left = (time_limit - (_time.monotonic() - t0)
+            if time_limit is not None else None)
+    if left is not None and left <= 0.05:
+        r["engine"] = "jitlin"
+        r["route_reason"] = f"probe consumed the budget ({probe_cause})"
+        r["shape"] = shape
+        return r
+    r = wgl.check(model, history, time_limit=left, enc=enc)
+    if r.get("valid?") != "unknown":
+        r["engine"] = "device"
+        r["route_reason"] = (
+            f"probe hit {probe_cause}; branchy shape "
+            f"(mean_depth {shape['mean_depth']}, W {shape['W_raw']})")
+        r["shape"] = shape
+        return r
+
+    # 3. oracle sweep with whatever remains
+    left = (time_limit - (_time.monotonic() - t0)
+            if time_limit is not None else None)
+    if left is None or left > 0.5:
+        r2 = wgl_ref.check(model, history, time_limit=left)
+        if r2.get("valid?") != "unknown":
+            r2["engine"] = "oracle"
+            r2["route_reason"] = "device unknown; oracle fallback"
+            r2["shape"] = shape
+            return r2
+    r["engine"] = "device"
+    r["route_reason"] = "no engine decided within budget"
+    r["shape"] = shape
+    return r

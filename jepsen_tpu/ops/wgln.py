@@ -23,11 +23,10 @@ This kernel generalizes the packing to L lanes:
     (32-r))` with gathers clamped past L.
   * dedup, backlog spill/refill, flags and stats are wgl32's,
     unchanged — same CONSTS contract as `_build_search`, so the host
-    driver (`wgl.check`) dispatches by window width alone. The carry
-    differs (packed (K, L) uint32 windows vs (K, W) bool), so the
-    mesh-sharded vmap batch path (`parallel/batched.py`) still
-    builds the bool kernel for wide lanes; its auto strategy routes
-    wide-window keys to the streamed path, which lands here.
+    driver (`wgl.check`) dispatches by window width alone, and the
+    mesh-sharded vmap batch path (`parallel/batched.py`) vmaps this
+    kernel directly for wide lanes (carry indices 4/11/12 — fr_cnt,
+    flags, stats — are layout-compatible with wgl32's).
 
 Measured (cpu backend, adversarial_wave 6x14 span 5, W=71 -> L=3):
 the bool kernel decides 811k configs in ~103 s; this kernel in ~9 s

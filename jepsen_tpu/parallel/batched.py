@@ -27,7 +27,6 @@ from ..history import History
 from ..models.core import Model
 from ..ops import wgl_ref
 from ..ops.encode import INF, Encoded, EncodingUnsupported, _pad_to, encode
-from ..ops.wgl import _build_search
 
 
 def default_mesh(axis: str = "keys"):
@@ -101,7 +100,7 @@ def encode_batch(encs: Sequence[Encoded], batch_pad: int = 1) -> BatchEncoded:
                         table=table, n_ok=n_ok, n_info=n_info)
 
 
-def _batch_capacities(bk: int, W: int, n_pad: int):
+def _batch_capacities(bk: int, W: int, n_pad: int, L: int = 0):
     """Frontier K / memo H / backlog B *per key*, mirroring the single-
     history tuning in wgl._pick_capacities. Two measured facts drive
     this (see wgl.check's fast-path note): (1) narrow frontiers explore
@@ -109,31 +108,50 @@ def _batch_capacities(bk: int, W: int, n_pad: int):
     magnitude on valid histories; (2) the memo table must stay well
     under ~60% load or probe dedup degrades into re-exploration (the
     old per-lane H=2^16 thrashed at ~185k explored configs per lane and
-    blew the search up ~18x). Whole-batch caps: the (Bk, K, W, 2W)
-    successor intermediate stays under 128M bool elements, and the memo
+    blew the search up ~18x). Whole-batch caps: the narrow path's
+    (Bk, K, W, 2W) bool intermediate stays under 128M elements; the
+    packed path's (Bk, K, W, L) uint32 successor tensor (its memory
+    driver — see wgl.check's byte-budget policy) under 128 MB; memo
     tables (16 B/slot) under ~2 GB across the batch."""
-    budget = 128 * 1024 * 1024  # bool elements across the batch
-    cap = max(16, budget // max(1, bk * 2 * W * W))
-    # 64 for the fast path: narrow beams do ~K/depth of the work on
-    # valid lanes (see wgl.check), but vmap lanes can't escalate, so
-    # keep some breadth for the occasional exhaustive key.
-    K = min(64 if W <= 32 else 1024, cap)
+    import os
+
+    if L:  # packed multi-lane kernel (W > 32): byte budget over the
+        #    (Bk, K, W, L) u32 successor tensor, as in wgl.check
+        budget_bytes = 128 * 1024 * 1024
+        K = max(64, min(1024, budget_bytes // max(1, bk * W * L * 4 * 3)))
+        cap = int(os.environ.get("JEPSEN_TPU_MAX_FRONTIER", "0"))
+        if cap:
+            K = max(16, min(K, cap))
+    else:
+        budget = 128 * 1024 * 1024  # bool elements across the batch
+        cap = max(16, budget // max(1, bk * 2 * W * W))
+        # 64 for the fast path: narrow beams do ~K/depth of the work on
+        # valid lanes (see wgl.check), but vmap lanes can't escalate, so
+        # keep some breadth for the occasional exhaustive key.
+        K = min(64, cap)
     K = 1 << (K.bit_length() - 1)
     H = 1 << 21 if n_pad > 2048 else 1 << 19
     cap = max(1 << 16, 2**31 // (16 * max(1, bk)))
     # both kernels mask probe indices with `& (H - 1)` — H MUST stay a
     # power of two or most slots become unreachable
     H = min(H, 1 << (cap.bit_length() - 1))
-    B = 1 << 14
+    # packed rows are (L + Il + 2) u32s — a 2^16 backlog at L=3 is
+    # ~1.5 MB/key, and wide wavefronts (C(W/2, W) live configs) spill
+    # hard; the bool path keeps the smaller backlog
+    B = 1 << 16 if L else 1 << 14
     return K, H, B
 
 
 @functools.lru_cache(maxsize=16)
 def _compiled_batched(n_pad: int, ic_pad: int, W: int, S: int, O: int,
-                      K: int, H: int, B: int, chunk: int, probes: int):
+                      K: int, H: int, B: int, chunk: int, probes: int,
+                      L: int = 0):
     """vmap the shape-bucket kernel over the key axis and jit it.
     Windows that fit a uint32 lane use the bitmask fast path (W here is
-    already the trimmed W_eff, padded to a multiple of 8)."""
+    already the trimmed W_eff, padded to a multiple of 8); wider
+    windows use the packed multi-lane kernel (ops/wgln.py, W padded to
+    a multiple of 32, L = W//32 lanes) — the same ~11x-at-W=71 win the
+    single-history path gets, now on the mesh-sharded batch."""
     import jax
 
     if W <= 32:
@@ -142,8 +160,10 @@ def _compiled_batched(n_pad: int, ic_pad: int, W: int, S: int, O: int,
                                             K, H, B, chunk, probes,
                                             W=W)
     else:
-        init_fn, chunk_fn = _build_search(n_pad, ic_pad, W, S, O,
-                                          K, H, B, chunk, probes)
+        from ..ops.wgln import _build_searchN
+        init_fn, chunk_fn = _build_searchN(n_pad, ic_pad, S, O,
+                                           K, H, B, chunk, probes,
+                                           W=W, L=L)
     vinit = jax.vmap(init_fn)
     vchunk = jax.jit(jax.vmap(chunk_fn), donate_argnums=(1,))
     return vinit, vchunk
@@ -336,14 +356,11 @@ def check_batched(model: Model, histories: Sequence[History],
     if strategy == "auto":
         # An explicitly passed mesh pins the caller to the mesh-sharded
         # vmap path; otherwise large per-key histories stream (see
-        # check_streamed's rationale) — and so do WIDE-window keys:
-        # the vmap batch compiles the (K, W, 2W) bool kernel, while
-        # streamed singles go through wgl.check's packed multi-lane
-        # kernel (~11x faster at W=71 on cpu).
+        # check_streamed's rationale). Wide-window keys no longer force
+        # streaming: the vmap batch builds the packed multi-lane kernel
+        # (wgln.py) for W > 32, same as the single-history path.
         strategy = "stream" if (mesh is None
-                                and (max(e.n_ok for e in encs) > 512
-                                     or max(e.window_raw
-                                            for e in encs) > 32)) \
+                                and max(e.n_ok for e in encs) > 512) \
             else "vmap"
     if strategy == "stream":
         streamed = check_streamed(
@@ -382,22 +399,26 @@ def check_batched(model: Model, histories: Sequence[History],
     w_raw = max(e.window_raw for e in encs)
     inv_info, opcode_info = batch.inv_info, batch.opcode_info
     ic_pad = batch.ic_pad
+    ic_eff = max(8, _pad_to(int(batch.n_info.max()), 8))
+    if ic_eff < ic_pad:
+        inv_info = inv_info[:, :ic_eff]
+        opcode_info = opcode_info[:, :ic_eff]
+        ic_pad = ic_eff
     if w_raw <= 32:
         W = max(8, _pad_to(w_raw, 8))
-        ic_eff = max(8, _pad_to(int(batch.n_info.max()), 8))
-        if ic_eff < ic_pad:
-            inv_info = inv_info[:, :ic_eff]
-            opcode_info = opcode_info[:, :ic_eff]
-            ic_pad = ic_eff
-        probes = 4
+        L = 0
     else:
-        W = batch.window
-        probes = 16
-    K, H, B = _batch_capacities(bk, W, batch.n_pad)
+        # packed multi-lane kernel: window as L uint32 lanes; rounds
+        # are light (bit math, probe-only dedup), so poll often
+        W = _pad_to(w_raw, 32)
+        L = W // 32
+        chunk = min(chunk, 128)
+    probes = 4
+    K, H, B = _batch_capacities(bk, W, batch.n_pad, L)
     vinit, vchunk = _compiled_batched(
         n_pad=batch.n_pad, ic_pad=ic_pad, W=W,
         S=batch.table_s, O=batch.table_o, K=K, H=H, B=B,
-        chunk=chunk, probes=probes)
+        chunk=chunk, probes=probes, L=L)
 
     def shard(x):
         spec = PartitionSpec(axis) if x.ndim else PartitionSpec()

@@ -1,0 +1,53 @@
+"""Shape-aware engine routing tests (ops/route.py): near-serial and
+model-pruned shapes decide on the jitlin sweep, branchy shapes on the
+device kernel; every result explains its engine choice."""
+
+from jepsen_tpu import synth
+from jepsen_tpu.models import cas_register, mutex, register
+from jepsen_tpu.ops import route, wgl_ref
+
+
+def test_mutex_routes_to_jitlin():
+    # the BENCH r3 offender: device frontier_fill 0.136, memo 0.0 —
+    # the model prunes nearly every interleaving, jitlin sweeps it
+    h = synth.mutex_history(400, n_procs=4, seed=7)
+    r = route.check_routed(mutex(), h, time_limit=30)
+    assert r["valid?"] is True
+    assert r["engine"] == "jitlin", r["route_reason"]
+    assert r["shape"]["n_ok"] > 0
+
+
+def test_branchy_routes_to_device():
+    h = synth.adversarial_wave_history(4, width=12, span=3, seed=7)
+    r = route.check_routed(cas_register(), h, time_limit=120)
+    assert r["valid?"] is False  # invalid by construction
+    assert r["engine"] == "device", r["route_reason"]
+    assert "branchy" in r["route_reason"]
+
+
+def test_routed_verdicts_match_oracle():
+    for seed in range(6):
+        lie = 0.1 if seed % 2 else 0.0
+        h = synth.cas_register_history(60, n_procs=4, seed=seed,
+                                       lie_p=lie, crash_p=0.03)
+        r = route.check_routed(cas_register(), h, time_limit=30)
+        ref = wgl_ref.check(cas_register(), h)
+        assert r["valid?"] == ref["valid?"], (seed, r, ref)
+        assert "engine" in r and "route_reason" in r
+
+
+def test_empty_history_and_shape_stats():
+    from jepsen_tpu.history import History
+    from jepsen_tpu.ops.encode import encode
+    r = route.check_routed(register(), History(), time_limit=5)
+    assert r["valid?"] is True
+    # shape_stats n == 0 branch directly
+    h = History([])
+    enc = encode(register(), synth.cas_register_history(10, seed=1))
+    s = route.shape_stats(enc)
+    assert s["n_ok"] > 0 and s["mean_depth"] > 0
+    enc0 = type(enc)(**{**enc.__dict__, "n_ok": 0})
+    s0 = route.shape_stats(enc0)
+    assert s0 == {"n_ok": 0, "n_info": enc0.n_info,
+                  "W_raw": enc0.window_raw,
+                  "mean_depth": 0.0, "p95_depth": 0}
