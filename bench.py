@@ -331,6 +331,42 @@ def run_extras(budget: float, deadline: float) -> dict:
 
     run("elle_wr_3k", None, None, checker=elle_wr, need=45)
 
+    # The closure kernel AT CAPACITY (elle/tpu.py sizes itself for
+    # 4-8k txns): backend FORCED to the closure kernel even on cpu, so
+    # every bench records the MXU plane's wall + achieved TFLOP/s at a
+    # production shape next to the host-BFS row (VERDICT r3 #7). On
+    # cpu this is ~70 s of dense f32 matmuls (~0.08 TFLOP/s measured);
+    # on a v5e the same call models out to ~0.1 s in bf16.
+    def elle_append_8k():
+        from jepsen_tpu.elle import append as elle_append_mod
+        hist_a = synth.list_append_history(4000, n_procs=5, seed=7)
+        t0 = time.monotonic()
+        res = elle_append_mod.check(hist_a,
+                                    additional_graphs=("realtime",),
+                                    cycle_backend="tpu")
+        closure_wall = time.monotonic() - t0
+        t0 = time.monotonic()
+        res_h = elle_append_mod.check(hist_a,
+                                      additional_graphs=("realtime",),
+                                      cycle_backend="host")
+        host_wall = time.monotonic() - t0
+        out = {"valid?": res["valid?"],
+               "op_count": len(hist_a) // 2,
+               "engine": "closure" if res.get("cycle-engine") == "tpu"
+               else res.get("cycle-engine"),
+               "util": res.get("cycle-util"),
+               "cause": ",".join(res["anomaly-types"]) or None,
+               "closure_row": {"verdict": res["valid?"],
+                               "wall_s": round(closure_wall, 2)},
+               "host_row": {"verdict": res_h["valid?"],
+                            "wall_s": round(host_wall, 2)}}
+        if res["valid?"] != res_h["valid?"]:
+            out["cause"] = (f"ENGINE DISAGREEMENT: closure="
+                            f"{res['valid?']} host={res_h['valid?']}")
+        return out
+
+    run("elle_append_8k", None, None, checker=elle_append_8k, need=200)
+
     # independent 100 keys x 2k ops, batch-checked over the device mesh
     n_keys = int(os.environ.get("JEPSEN_TPU_BENCH_KEYS", "100"))
     per_key = int(os.environ.get("JEPSEN_TPU_BENCH_PER_KEY", "2000"))
@@ -397,7 +433,9 @@ def run_bench() -> tuple[dict, int]:
     # headline + the adversarial dual-engine config (~125 s) + extras;
     # configs that would overrun are skipped-and-recorded, and SIGTERM
     # still emits the partial line if the driver's own budget is less
-    total_s = float(os.environ.get("JEPSEN_TPU_BENCH_TOTAL_S", "780"))
+    # (raised from 780 in r4: + ~60 s tpu_aot evidence + ~80 s
+    # elle_append_8k capacity config)
+    total_s = float(os.environ.get("JEPSEN_TPU_BENCH_TOTAL_S", "930"))
     deadline = time.monotonic() + total_s
 
     probe_diags: list = []

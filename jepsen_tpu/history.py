@@ -272,6 +272,15 @@ class History:
                     h.append(json.loads(line))
         return h
 
+    @staticmethod
+    def from_edn(path: str) -> "History":
+        """Replay a reference-produced history.edn (one op map per prn
+        line, store.clj:338-346) or a checker_test.clj-style vector of
+        op maps. See jepsen_tpu.edn for the reader's scope."""
+        from . import edn
+        with open(path) as fh:
+            return edn.load_history(fh.read())
+
 
 def strip_nemesis(history: History) -> History:
     """Client ops only — checkers generally ignore nemesis ops."""

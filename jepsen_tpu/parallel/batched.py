@@ -116,9 +116,12 @@ def _batch_capacities(bk: int, W: int, n_pad: int, L: int = 0):
     import os
 
     if L:  # packed multi-lane kernel (W > 32): byte budget over the
-        #    (Bk, K, W, L) u32 successor tensor, as in wgl.check
+        #    (Bk, K, W, L) u32 successor tensor, as in wgl.check.
+        #    Floor at the kernel minimum (16), NOT wgl.check's 64 —
+        #    that floor is safe only at bk=1; here it could blow the
+        #    whole-batch byte budget several-fold on many wide keys.
         budget_bytes = 128 * 1024 * 1024
-        K = max(64, min(1024, budget_bytes // max(1, bk * W * L * 4 * 3)))
+        K = max(16, min(1024, budget_bytes // max(1, bk * W * L * 4 * 3)))
         cap = int(os.environ.get("JEPSEN_TPU_MAX_FRONTIER", "0"))
         if cap:
             K = max(16, min(K, cap))
