@@ -61,6 +61,33 @@ the shared framework. This package holds this framework's suites:
   (`zookeeper/src/jepsen/zookeeper.clj:1-145`): distro-package
   install, myid/zoo.cfg generation, and a znode CAS-register client
   over zkCli (CI-run against a scripted remote).
+- `rabbitmq` — the queue-workload exemplar
+  (`rabbitmq/src/jepsen/rabbitmq.clj`): a from-scratch AMQP 0-9-1
+  subset codec (method/header/body frames, publisher confirms,
+  basic.get/ack/reject), a LIVE mini broker whose confirms land only
+  after an fsync (--volatile demonstrates the confirmed-then-lost
+  anomaly), and the distributed-semaphore mutex workload checked
+  linearizable. CI-run against live subprocess brokers.
+- `chronos` — the scheduler-family exemplar
+  (`chronos/src/jepsen/chronos{,/checker}.clj`): periodic jobs whose
+  target execution windows must each be satisfied by a distinct
+  completed run (greedy-EDF matching replaces the reference's
+  constraint solver, exactly on the same disjoint-window structure),
+  plus set-full over job names; a LIVE mini scheduler actually fires
+  runs, and kill -9 leaves incomplete runs / missed windows for the
+  checker to report. CI-run.
+- `yuga` — the dual-API structure (`yugabyte/src/yugabyte/core.clj`):
+  one namespaced workload registry ("ycql/set", "ysql/bank", ...)
+  built from shared workload definitions with per-API transport
+  clients (RESP mini-redis for ycql, SQL mini-sqlite for ysql), and
+  a test-all api x workload sweep. CI-run live on both surfaces.
+- `cockroach` — the strict-serializability workloads
+  (`cockroachdb/src/jepsen/cockroach/{monotonic,comments}.clj`) over
+  the from-scratch pgwire client: monotonic (txn max+1 inserts with
+  DB timestamps; sts-order must match val-order) and comments (blind
+  multi-table inserts; a read seeing w but missing a
+  completed-before-w write is the T1<T2-only-T2-visible anomaly).
+  CI-run against the pgwire stub.
 
 Run one with `python -m jepsen_tpu.dbs.<suite> test --nodes ...`;
 sweep a suite's matrix with `... test-all`.

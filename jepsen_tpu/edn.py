@@ -197,6 +197,12 @@ class _Reader:
         while j < self.n and self.s[j] not in _DELIM:
             j += 1
         tok = self.s[self.i:j]
+        if not tok:
+            # a delimiter char no rule consumes (e.g. a stray "@"):
+            # raising beats an empty-symbol that never advances the
+            # cursor (observed: loads_all spun forever on "@")
+            raise EdnError(
+                f"unexpected character {self.s[self.i]!r} at {self.i}")
         self.i = j
         if tok == "nil":
             return None

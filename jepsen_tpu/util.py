@@ -213,7 +213,16 @@ def enable_compilation_cache(path: Optional[str] = None
     cache dir owns that risk knowingly. A provenance.json in the dir
     records who compiled the entries. Opt out with
     JEPSEN_TPU_NO_CACHE=1. Returns the cache dir, or None when
-    disabled or jax is unavailable."""
+    disabled or jax is unavailable.
+
+    Known cosmetic residue: XLA:CPU AOT entries record the compiler's
+    tuning pseudo-features (+prefer-no-gather/+prefer-no-scatter)
+    next to real ISA bits, and the loader's host probe never lists
+    them — so reloading an entry warns about exactly those two flags
+    EVEN ON THE MACHINE THAT WROTE IT (verified: fresh dir, write and
+    reload on one host, 32 warnings, only the prefer-no-* flags
+    differ). That warning is benign; the fingerprint scoping is what
+    prevents the real cross-ISA SIGILL case."""
     import json
     import os
     import platform

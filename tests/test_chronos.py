@@ -1,0 +1,167 @@
+"""Chronos suite tests: the target-window checker (greedy EDF matching
+vs the reference's solver semantics), the live mini scheduler firing
+real runs, crash behavior (missed windows stay missed, incomplete runs
+recorded), and the full suite end-to-end with chronos + set-full
+checkers."""
+
+import subprocess
+import sys
+import time
+
+import pytest
+
+from jepsen_tpu import core
+from jepsen_tpu.dbs import chronos as chr_mod
+from jepsen_tpu.history import History, invoke, ok
+
+
+# -- checker unit tests ----------------------------------------------------
+
+def _job(name=1, start=100.0, count=3, interval=2.0, epsilon=0.4,
+         duration=0.1):
+    return {"name": name, "start": start, "count": count,
+            "interval": interval, "epsilon": epsilon,
+            "duration": duration}
+
+
+def test_job_targets_cutoff():
+    j = _job()
+    # read just after the second target's window: only targets 0,1 due
+    ts = chr_mod.job_targets(100.0 + 2.0 + 0.6, j)
+    assert [t[0] for t in ts] == [100.0, 102.0]
+    # read far in the future: all `count` targets due, no more
+    ts = chr_mod.job_targets(1000.0, j)
+    assert len(ts) == 3
+
+
+def test_job_solution_valid_and_missing():
+    j = _job()
+    runs = [{"name": 1, "start": 100.1, "end": 100.2},
+            {"name": 1, "start": 102.3, "end": 102.4},
+            {"name": 1, "start": 104.0, "end": 104.1}]
+    s = chr_mod.job_solution(1000.0, j, runs)
+    assert s["valid?"] is True and not s["missing-targets"]
+    # drop the middle run: target 1 unsatisfied
+    s = chr_mod.job_solution(1000.0, j, [runs[0], runs[2]])
+    assert s["valid?"] is False
+    assert [m[0] for m in s["missing-targets"]] == [102.0]
+
+
+def test_job_solution_runs_are_distinct():
+    # one run cannot satisfy two targets, even if windows overlap
+    j = _job(interval=0.5, epsilon=1.0, count=2)
+    runs = [{"name": 1, "start": 100.5, "end": 100.6}]
+    s = chr_mod.job_solution(1000.0, j, runs)
+    assert s["valid?"] is False
+
+
+def test_incomplete_runs_dont_count():
+    j = _job(count=1)
+    s = chr_mod.job_solution(
+        1000.0, j, [{"name": 1, "start": 100.1, "end": None}])
+    assert s["valid?"] is False and s["incomplete"] == 1
+
+
+def test_checker_over_history():
+    j = _job(count=1)
+    h = History([
+        invoke(0, "add-job", j), ok(0, "add-job", j),
+        invoke(1, "read", None),
+        ok(1, "read", {"runs": [{"name": 1, "start": 100.2,
+                                 "end": 100.3}], "now": 1000.0}),
+    ]).index()
+    res = chr_mod.chronos_checker().check({}, h, {})
+    assert res["valid?"] is True and res["job-count"] == 1
+    # same history, no runs: invalid
+    h2 = History([
+        invoke(0, "add-job", j), ok(0, "add-job", j),
+        invoke(1, "read", None),
+        ok(1, "read", {"runs": [], "now": 1000.0}),
+    ]).index()
+    res2 = chr_mod.chronos_checker().check({}, h2, {})
+    assert res2["valid?"] is False
+
+
+# -- live mini scheduler ---------------------------------------------------
+
+@pytest.fixture()
+def mini(tmp_path):
+    import requests
+
+    srv_py = tmp_path / "minichronos.py"
+    srv_py.write_text(chr_mod.MINICHRONOS_SRC)
+    port = 24980
+    state = {"proc": None}
+
+    def start():
+        state["proc"] = subprocess.Popen(
+            [sys.executable, str(srv_py), "--port", str(port),
+             "--dir", str(tmp_path)], cwd=tmp_path)
+        deadline = time.monotonic() + 10
+        while True:
+            try:
+                requests.get(f"http://127.0.0.1:{port}/runs",
+                             timeout=1)
+                return f"http://127.0.0.1:{port}"
+            except requests.RequestException:
+                assert time.monotonic() < deadline, "never up"
+                time.sleep(0.1)
+
+    yield start, state
+    if state["proc"] is not None:
+        state["proc"].kill()
+        state["proc"].wait(timeout=10)
+
+
+def test_mini_fires_scheduled_runs(mini):
+    import requests
+
+    start, _ = mini
+    url = start()
+    job = {"name": 1, "start": time.time() + 0.3, "count": 2,
+           "interval": 0.8, "epsilon": 0.4, "duration": 0.05}
+    assert requests.post(f"{url}/jobs", json=job,
+                         timeout=2).status_code == 200
+    time.sleep(2.4)
+    data = requests.get(f"{url}/runs", timeout=2).json()
+    sol = chr_mod.job_solution(data["now"], job, [
+        r for r in data["runs"] if str(r["name"]) == "1"])
+    assert sol["valid?"] is True, (data, sol)
+
+
+def test_mini_missed_windows_stay_missed(mini):
+    """Jobs persist across kill -9 but windows missed while down are
+    NOT resurrected — the checker reports them as missing."""
+    import signal
+
+    import requests
+
+    start, state = mini
+    url = start()
+    job = {"name": 1, "start": time.time() + 0.3, "count": 3,
+           "interval": 0.8, "epsilon": 0.3, "duration": 0.05}
+    requests.post(f"{url}/jobs", json=job, timeout=2)
+    time.sleep(0.7)  # let the first target fire
+    state["proc"].send_signal(signal.SIGKILL)
+    state["proc"].wait(timeout=10)
+    time.sleep(1.2)  # at least one window passes while down
+    url = start()
+    time.sleep(1.6)  # let any remaining targets play out
+    data = requests.get(f"{url}/runs", timeout=2).json()
+    sol = chr_mod.job_solution(data["now"], job, data["runs"])
+    assert sol["valid?"] is False
+    assert sol["missing-targets"], sol
+
+
+# -- full suite -------------------------------------------------------------
+
+def test_full_suite_live(tmp_path):
+    opts = {"nodes": ["c1", "c2"], "concurrency": 4, "time_limit": 7,
+            "store_root": str(tmp_path / "store"),
+            "sandbox": str(tmp_path / "cluster")}
+    done = core.run(chr_mod.chronos_test(opts))
+    res = done["results"]
+    assert res["valid?"] is True, res
+    assert res["chronos"]["valid?"] is True
+    assert res["chronos"]["job-count"] > 0
+    assert res["set"]["valid?"] is True

@@ -1,0 +1,116 @@
+"""Cockroach suite tests: the monotonic and comments checkers on
+hand-built histories (including the anomalies each exists to catch),
+and both workloads live against the pgwire stub from the postgres
+suite tests (real SQL behind the from-scratch wire codec)."""
+
+import threading
+
+import pytest
+
+from jepsen_tpu import core
+from jepsen_tpu.dbs import cockroach as cr
+from jepsen_tpu.history import History, invoke, ok
+
+from test_postgres import PgStub, PgStubHandler
+
+
+# -- checker units ----------------------------------------------------------
+
+def _row(val, sts, node="n1", process=0):
+    return {"val": val, "sts": sts, "node": node, "process": process}
+
+
+def test_monotonic_checker_valid():
+    h = History([
+        invoke(0, "add", None), ok(0, "add", _row(0, "a")),
+        invoke(1, "add", None), ok(1, "add", _row(1, "b")),
+        invoke(0, "read", None),
+        ok(0, "read", [_row(0, "a"), _row(1, "b")]),
+    ]).index()
+    res = cr.MonotonicChecker().check({}, h, {})
+    assert res["valid?"] is True, res
+
+
+def test_monotonic_checker_catches_inversion_dup_loss():
+    # value order inverted relative to timestamp order
+    h = History([
+        invoke(0, "read", None),
+        ok(0, "read", [_row(1, "a"), _row(0, "b")]),
+    ]).index()
+    res = cr.MonotonicChecker().check({}, h, {})
+    assert res["valid?"] is False and res["off-order-val"]
+    # duplicate values
+    h = History([
+        invoke(0, "read", None),
+        ok(0, "read", [_row(0, "a"), _row(0, "b")]),
+    ]).index()
+    assert cr.MonotonicChecker().check({}, h, {})["duplicates"] == [0]
+    # acknowledged add lost
+    h = History([
+        invoke(0, "add", None), ok(0, "add", _row(5, "a")),
+        invoke(0, "read", None), ok(0, "read", []),
+    ]).index()
+    res = cr.MonotonicChecker().check({}, h, {})
+    assert res["valid?"] is False and res["lost"] == [5]
+
+
+def test_comments_checker_catches_missing_predecessor():
+    # w0 completes BEFORE w1 is invoked; a read sees w1 but not w0
+    h = History([
+        invoke(0, "write", 0), ok(0, "write", 0),
+        invoke(1, "write", 1), ok(1, "write", 1),
+        invoke(2, "read", None), ok(2, "read", [1]),
+    ]).index()
+    res = cr.CommentsChecker().check({}, h, {})
+    assert res["valid?"] is False
+    assert res["errors"][0]["missing"] == [0]
+    # seeing both (or neither) is fine; so is missing a CONCURRENT one
+    h2 = History([
+        invoke(0, "write", 0),
+        invoke(1, "write", 1), ok(1, "write", 1),
+        ok(0, "write", 0),  # w0 concurrent with w1: no precedence
+        invoke(2, "read", None), ok(2, "read", [1]),
+    ]).index()
+    assert cr.CommentsChecker().check({}, h2, {})["valid?"] is True
+
+
+# -- live against the pgwire stub -------------------------------------------
+
+@pytest.fixture()
+def stub(tmp_path):
+    srv = PgStub(("127.0.0.1", 0), PgStubHandler,
+                 str(tmp_path / "crdb.db"))
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    yield srv.server_address
+    srv.shutdown()
+
+
+def _run(stub, tmp_path, workload, **kw):
+    opts = {"nodes": ["n1"], "concurrency": kw.pop("concurrency", 3),
+            "time_limit": kw.pop("time_limit", 4),
+            "workload": workload,
+            "addr": f"{stub[0]}:{stub[1]}",
+            "store_root": str(tmp_path / "store"), **kw}
+    return core.run(cr.cockroach_test(opts))
+
+
+def test_monotonic_suite_live(stub, tmp_path):
+    done = _run(stub, tmp_path, "monotonic")
+    res = done["results"]
+    assert res["valid?"] is True, res
+    assert res["monotonic"]["add-count"] > 0
+    assert res["monotonic"]["read-count"] >= res["monotonic"]["add-count"]
+
+
+def test_comments_suite_live(stub, tmp_path):
+    done = _run(stub, tmp_path, "comments")
+    res = done["results"]
+    assert res["valid?"] is True, res
+    assert res["comments"]["write-count"] > 0
+
+
+def test_tests_fn_sweeps(tmp_path):
+    names = [t["name"] for t in cr.cockroach_tests(
+        {"nodes": ["n1"], "concurrency": 2,
+         "store_root": str(tmp_path)})]
+    assert names == ["cockroach-comments", "cockroach-monotonic"]

@@ -145,3 +145,37 @@ def test_full_suite_with_stub(stub, tmp_path):
     done = core.run(t)
     assert done["results"]["valid?"] is True
     assert done["results"]["register"]["valid?"] is True
+
+
+# -- LIVE mini mode (VERDICT r3 #6): real subprocesses over localexec
+
+def test_mini_suite_live_kill(tmp_path):
+    """install -> start -> kill -9 -> restart against live v1/kv
+    servers; the register stays linearizable (AOF keeps acknowledged
+    writes and the ModifyIndex stream across crashes)."""
+    opts = {"nodes": ["c1", "c2"], "concurrency": 4, "time_limit": 6,
+            "ops_per_key": 30, "rate": 50.0, "nemesis_interval": 2.0,
+            "server": "mini", "fault": "kill",
+            "store_root": str(tmp_path / "store"),
+            "sandbox": str(tmp_path / "cluster")}
+    done = core.run(consul.consul_test(opts))
+    res = done["results"]
+    assert res["valid?"] is True, res
+    assert res["register"]["valid?"] is True
+    # the nemesis actually fired against the live processes
+    nem = [o for o in done["history"] if o.process == "nemesis"
+           and o.f == "start" and o.value is not None]
+    assert nem, "nemesis never killed anything"
+
+
+def test_mini_suite_live_pause(tmp_path):
+    """SIGSTOP/SIGCONT faults against live servers: paused processes
+    stall clients (timeouts -> info), resume recovers, verdict holds."""
+    opts = {"nodes": ["c1"], "concurrency": 4, "time_limit": 6,
+            "ops_per_key": 30, "rate": 50.0, "nemesis_interval": 2.0,
+            "server": "mini", "fault": "pause",
+            "store_root": str(tmp_path / "store"),
+            "sandbox": str(tmp_path / "cluster")}
+    done = core.run(consul.consul_test(opts))
+    res = done["results"]
+    assert res["valid?"] is True, res

@@ -1,0 +1,75 @@
+"""Dual-API suite tests (dbs/yuga.py): the namespaced workload
+registry, shared-workload/swapped-client composition, and live runs of
+both API surfaces (RESP mini-redis for ycql, SQL mini-sqlite for
+ysql) under the kill/restart nemesis."""
+
+import pytest
+
+from jepsen_tpu import core
+from jepsen_tpu.dbs import yuga
+
+
+def _options(tmp_path, which, **kw):
+    return {"nodes": kw.pop("nodes", ["y1"]),
+            "concurrency": kw.pop("concurrency", 4),
+            "time_limit": kw.pop("time_limit", 6),
+            "nemesis_interval": kw.pop("nemesis_interval", 2.0),
+            "workload": which,
+            "store_root": str(tmp_path / "store"),
+            "sandbox": str(tmp_path / "cluster"), **kw}
+
+
+def test_registry_is_dual_api():
+    apis = {w.split("/", 1)[0] for w in yuga.WORKLOADS}
+    assert apis == {"ycql", "ysql"}
+    # the shared-workload promise: both APIs expose set + counter +
+    # single-key-acid built from the same workload fns
+    for shared in ("set", "counter", "single-key-acid"):
+        assert f"ycql/{shared}" in yuga.WORKLOADS
+        assert f"ysql/{shared}" in yuga.WORKLOADS
+
+
+def test_unknown_workload_rejected(tmp_path):
+    with pytest.raises(ValueError, match="unknown workload"):
+        yuga.yuga_test(_options(tmp_path, "ycql/nope"))
+
+
+def test_tests_fn_sweeps_expected(tmp_path):
+    names = [t["name"] for t in
+             yuga.yuga_tests(_options(tmp_path, None))]
+    assert len(names) == len(yuga.EXPECTED_TO_PASS)
+    assert any("ycql" in n for n in names)
+    assert any("ysql" in n for n in names)
+
+
+@pytest.mark.parametrize("which", ["ycql/set", "ycql/counter"])
+def test_ycql_live(tmp_path, which):
+    # generous time_limit: a loaded CI machine restarts the killed
+    # server slowly, and the final read must land after recovery
+    done = core.run(yuga.yuga_test(_options(tmp_path, which,
+                                            time_limit=8)))
+    res = done["results"]
+    assert res["valid?"] is True, res
+
+
+@pytest.mark.parametrize("which", ["ysql/set", "ysql/counter",
+                                   "ysql/append"])
+def test_ysql_live(tmp_path, which):
+    done = core.run(yuga.yuga_test(_options(tmp_path, which)))
+    res = done["results"]
+    assert res["valid?"] is True, res
+
+
+def test_ysql_single_key_acid_live(tmp_path):
+    done = core.run(yuga.yuga_test(_options(
+        tmp_path, "ysql/single-key-acid", nodes=["y1"],
+        concurrency=4, time_limit=5, per_key_limit=40)))
+    res = done["results"]
+    assert res["valid?"] is True, res
+
+
+def test_ysql_long_fork_live(tmp_path):
+    done = core.run(yuga.yuga_test(_options(
+        tmp_path, "ysql/long-fork", time_limit=5)))
+    res = done["results"]
+    assert res["valid?"] is True, res

@@ -131,3 +131,29 @@ def test_full_suite_with_stub(tmp_path):
     completions = [op for op in done["history"]
                    if getattr(op, "type", None) in ("ok", "fail")]
     assert completions
+
+
+# -- LIVE mini mode (VERDICT r3 #6): real znode servers + zkcli over
+#    localexec; the UNCHANGED client exercises the control-plane path
+
+def test_mini_suite_live_kill(tmp_path):
+    opts = {"nodes": ["z1", "z2"], "concurrency": 4, "time_limit": 6,
+            "rate": 20.0, "nemesis_interval": 2.0,
+            "server": "mini", "fault": "kill",
+            "store_root": str(tmp_path / "store"),
+            "sandbox": str(tmp_path / "cluster")}
+    done = core.run(zk.zk_test(opts))
+    res = done["results"]
+    assert res["valid?"] is True, res
+    assert res["linear"]["valid?"] is True
+
+
+def test_mini_suite_live_pause(tmp_path):
+    opts = {"nodes": ["z1"], "concurrency": 3, "time_limit": 6,
+            "rate": 20.0, "nemesis_interval": 2.0,
+            "server": "mini", "fault": "pause",
+            "store_root": str(tmp_path / "store"),
+            "sandbox": str(tmp_path / "cluster")}
+    done = core.run(zk.zk_test(opts))
+    res = done["results"]
+    assert res["valid?"] is True, res
