@@ -231,10 +231,30 @@ def with_remote(remote: Remote):
         yield
 
 
+def named_remote(name: str) -> Remote:
+    """A Remote by name: "cli" (OpenSSH binary, the default stack) or
+    "native" (the from-scratch SSH-2 implementation, sshnative.py) —
+    the reference's clj-ssh/sshj duality, selected via the ssh map's
+    "remote" key the way its :remote option picks a stack."""
+    if name == "native":
+        from . import sshnative
+        return retry_mod.remote(sshnative.remote())
+    if name in ("cli", "ssh"):
+        return default_remote()
+    raise ValueError(f"unknown remote {name!r} (want cli or native)")
+
+
 @contextmanager
 def with_ssh(ssh: Optional[dict]):
-    """Bind SSH configuration from a test's ssh map (control.clj:241-262)."""
+    """Bind SSH configuration from a test's ssh map (control.clj:241-262).
+    ssh["remote"] ("cli" | "native") selects the transport stack."""
     ssh = ssh or {}
+    if ssh.get("remote") and state.remote is None:
+        with _bind(remote=named_remote(ssh["remote"])):
+            with with_ssh({k: v for k, v in ssh.items()
+                           if k != "remote"}):
+                yield
+            return
     with _bind(dummy=ssh.get("dummy?", state.dummy),
                username=ssh.get("username", state.username),
                password=ssh.get("password", state.password),

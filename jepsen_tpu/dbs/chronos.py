@@ -86,6 +86,17 @@ def replay():
                     continue  # torn tail
                 JOBS[j["name"]] = j
                 FIRED[j["name"]] = set()
+    # resume run ids past every recorded one: a reused rid would
+    # OVERWRITE a pre-crash run in read_runs and fake a missed target
+    if os.path.exists(RUN_LOG):
+        with open(RUN_LOG) as fh:
+            for line in fh:
+                parts = line.split()
+                if len(parts) == 4 and parts[1].startswith("r"):
+                    try:
+                        RSEQ[0] = max(RSEQ[0], int(parts[1][1:]) + 1)
+                    except ValueError:
+                        pass
     # skip every target already due: missed-while-down stays missed
     now = time.time()
     for name, j in JOBS.items():
@@ -197,8 +208,12 @@ class MiniChronosDB(miniserver.MiniServerDB):
 
 def job_targets(read_time: float, job: dict) -> list:
     """[(start, deadline)] for every target that MUST have begun by
-    read_time (chronos/checker.clj job->targets)."""
-    finish = read_time - job["epsilon"] - job["duration"]
+    read_time (chronos/checker.clj job->targets). The cutoff includes
+    the forgiveness tail: a run may legally start as late as
+    t + epsilon + EPSILON_FORGIVENESS, so a target only becomes
+    demandable once read_time clears that PLUS the duration."""
+    finish = (read_time - job["epsilon"] - EPSILON_FORGIVENESS
+              - job["duration"])
     out = []
     for k in range(job["count"]):
         t = job["start"] + k * job["interval"]

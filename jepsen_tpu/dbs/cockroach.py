@@ -148,9 +148,10 @@ class MonotonicChecker(jchecker.Checker):
                 final = op.value
         if final is None:
             return {"valid?": "unknown", "error": "set was never read"}
+        from collections import Counter
         vals = [r["val"] for r in final]
         seen = set(vals)
-        dups = sorted({v for v in vals if vals.count(v) > 1})
+        dups = sorted(v for v, n in Counter(vals).items() if n > 1)
         lost = sorted(v for v in acked if v not in seen)
         off_sts = non_monotonic(lambda a, b: a <= b, "sts", final)
         off_val = non_monotonic(lambda a, b: a < b, "val", final)

@@ -308,6 +308,9 @@ p = argparse.ArgumentParser()
 p.add_argument("--port", type=int, required=True)
 p.add_argument("--dir", default=".")
 p.add_argument("--volatile", action="store_true")
+p.add_argument("--seed-semaphore", default=None,
+               help="queue to seed with ONE message on a fresh boot "
+                    "(atomic server-side: no client seeding race)")
 args = p.parse_args()
 
 AOF = os.path.join(args.dir, "rabbit.aof")
@@ -493,7 +496,14 @@ class Server(socketserver.ThreadingTCPServer):
     allow_reuse_address = True
     daemon_threads = True
 
+fresh = not (args.volatile or os.path.exists(AOF))
 replay()
+if args.seed_semaphore and fresh:
+    mid = MSEQ[0]
+    MSEQ[0] += 1
+    persist("P %d %s %s" % (mid, args.seed_semaphore,
+                            base64.b64encode(b"sem").decode()))
+    QUEUES.setdefault(args.seed_semaphore, []).append((mid, b"sem"))
 print("minirabbit serving on", args.port, flush=True)
 Server(("127.0.0.1", args.port), Conn).serve_forever()
 '''
@@ -514,15 +524,19 @@ class MiniRabbitDB(miniserver.MiniServerDB):
     logfile = MINI_LOGFILE
     data_files = ("rabbit.aof",)
 
-    def __init__(self, volatile: bool = False):
+    def __init__(self, volatile: bool = False,
+                 seed_semaphore: Optional[str] = None):
         self.volatile = volatile
+        self.seed_semaphore = seed_semaphore
 
     def port(self, test, node):
         return mini_node_port(test, node)
 
     def extra_args(self, test, node):
-        return ["--dir", ".", *((["--volatile"] if self.volatile
-                                 else []))]
+        return ["--dir", ".",
+                *(["--volatile"] if self.volatile else []),
+                *(["--seed-semaphore", self.seed_semaphore]
+                  if self.seed_semaphore else [])]
 
 
 class RabbitDB(jdb.DB, jdb.Process, jdb.LogFiles):
@@ -673,9 +687,10 @@ class RabbitSemaphoreClient(jclient.Client):
     message in jepsen.semaphore; acquire = basic.get WITHOUT ack
     (holding the unacked delivery IS holding the mutex), release =
     basic.reject with requeue. Checked linearizable against the mutex
-    model."""
-
-    _seeded: dict = {}  # per-test-id: the single semaphore message
+    model. The single token is seeded SERVER-side at broker boot
+    (--seed-semaphore): client-side seeding would race (two seeders
+    -> two tokens -> mutual exclusion silently broken), and in mini
+    mode every client pins the one broker that holds the token."""
 
     def __init__(self, port_fn=None, timeout: float = 5.0):
         self.port_fn = port_fn or (lambda test, node: (node, 5672))
@@ -694,13 +709,6 @@ class RabbitSemaphoreClient(jclient.Client):
             host, port = self.port_fn(test, self.node)
             self.conn = RabbitConn(host, port, self.timeout)
             self.conn.queue_declare(SEM_QUEUE)
-            key = id(test.get("nodes"))
-            if not RabbitSemaphoreClient._seeded.get(key):
-                RabbitSemaphoreClient._seeded[key] = True
-                self.conn.confirm_select()
-                self.conn.queue_purge(SEM_QUEUE)
-                if not self.conn.publish(SEM_QUEUE, b"sem"):
-                    raise AmqpError("couldn't seed semaphore message")
         return self.conn
 
     def invoke(self, test, op):
@@ -777,8 +785,18 @@ def rabbitmq_test(options: dict) -> dict:
         return ("127.0.0.1", mini_node_port(test, node)) \
             if mode == "mini" else (node, 5672)
 
+    def sem_port_fn(test, node):
+        # ONE logical semaphore: every worker drives the broker that
+        # holds the single seeded token (nodes[0] in mini mode; a real
+        # cluster mirrors the queue, so any node works there)
+        return port_fn(test, test["nodes"][0]) if mode == "mini" \
+            else (node, 5672)
+
     if mode == "mini":
-        db: jdb.DB = MiniRabbitDB(volatile=volatile)
+        db: jdb.DB = MiniRabbitDB(
+            volatile=volatile,
+            seed_semaphore=(SEM_QUEUE if workload == "semaphore"
+                            else None))
         extra = {
             "remote": localexec.remote(options.get("sandbox")
                                        or "rabbitmq-cluster"),
@@ -815,7 +833,7 @@ def rabbitmq_test(options: dict) -> dict:
             gen.clients(gen.each_thread(gen.once(
                 lambda test, ctx: {"f": "drain", "value": None}))))
     elif workload == "semaphore":
-        client = RabbitSemaphoreClient(port_fn=port_fn)
+        client = RabbitSemaphoreClient(port_fn=sem_port_fn)
         checker = jchecker.compose({
             "mutex": jchecker.linearizable(models.mutex(),
                                            time_limit=60),

@@ -26,9 +26,14 @@ def _job(name=1, start=100.0, count=3, interval=2.0, epsilon=0.4,
 
 def test_job_targets_cutoff():
     j = _job()
-    # read just after the second target's window: only targets 0,1 due
-    ts = chr_mod.job_targets(100.0 + 2.0 + 0.6, j)
+    # a target only becomes demandable once the read clears its FULL
+    # allowed window (epsilon + forgiveness) plus the run duration:
+    # at 103.1 targets 100,102 are due, 104 is not
+    ts = chr_mod.job_targets(103.1, j)
     assert [t[0] for t in ts] == [100.0, 102.0]
+    # just inside target 1's window+duration: only target 0 is due
+    ts = chr_mod.job_targets(102.0 + 0.4 + 0.5 + 0.1 - 0.01, j)
+    assert [t[0] for t in ts] == [100.0]
     # read far in the future: all `count` targets due, no more
     ts = chr_mod.job_targets(1000.0, j)
     assert len(ts) == 3
