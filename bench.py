@@ -611,6 +611,44 @@ def run_bench() -> tuple[dict, int]:
 # fills it in as milestones land.
 _PARTIAL: dict = {}
 
+DETAILS_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "BENCH_DETAILS.json")
+
+
+def emit(out: dict) -> None:
+    """The stdout contract is ONE parseable JSON line — and the
+    driver records only a bounded TAIL of output, so a huge line gets
+    its HEAD cut off and parses as nothing (observed: BENCH_r03
+    `parsed: null` despite rc=0). So: the FULL result goes to
+    BENCH_DETAILS.json in the repo (the round snapshot carries it to
+    the judge), and stdout gets a compact summary line that always
+    fits the window."""
+    try:
+        with open(DETAILS_PATH, "w") as f:
+            json.dump(out, f, indent=1)
+    except OSError:
+        pass  # a read-only checkout still gets the compact line
+
+    compact = {k: out.get(k) for k in
+               ("metric", "value", "unit", "vs_baseline", "verdict",
+                "platform", "cold_s", "terminated", "error", "cause")
+               if out.get(k) is not None}
+    aot = out.get("tpu_aot")
+    if isinstance(aot, dict):
+        compact["tpu_aot"] = {
+            "all_ok": aot.get("all_ok", aot.get("ok")),
+            "kernels": {k: v.get("ok")
+                        for k, v in (aot.get("kernels") or {}).items()},
+            "evidence_wall_s": aot.get("evidence_wall_s")}
+    cfgs = out.get("configs")
+    if isinstance(cfgs, dict):
+        compact["configs"] = {
+            name: {k: v.get(k) for k in ("verdict", "wall_s", "engine")
+                   if isinstance(v, dict) and v.get(k) is not None}
+            for name, v in cfgs.items()}
+    compact["details"] = "BENCH_DETAILS.json"
+    print(json.dumps(compact), flush=True)
+
 
 def _sigterm(_signo, _frame):
     try:
@@ -622,7 +660,7 @@ def _sigterm(_signo, _frame):
         "value": None, "unit": "s", "vs_baseline": None}
     out.setdefault("verdict", "terminated")
     out["terminated"] = True
-    print(json.dumps(out), flush=True)
+    emit(out)
     os._exit(1)
 
 
@@ -641,11 +679,11 @@ def main() -> int:
                "value": None, "unit": "s", "vs_baseline": None,
                "verdict": "error",
                "error": f"{type(e).__name__}: {e}"[:500]}
-        print(json.dumps(out))
+        emit(out)
         if isinstance(e, KeyboardInterrupt):
             raise
         return 1
-    print(json.dumps(out))
+    emit(out)
     return rc
 
 

@@ -217,7 +217,23 @@ def elle_case(n_pad: int = 4096, e_pad: int = 16384, q_pad: int = 256,
 def evidence(out_dir: Optional[str] = None,
              include_wgln: bool = True) -> dict:
     """AOT-compile the flagship kernels for TPU v5e and return the
-    BENCH `tpu_aot` block.  ~1-2 min of pure host compile work."""
+    BENCH `tpu_aot` block.  ~1-2 min of pure host compile work.
+    The persistent jax cache is bypassed for these compiles: TPU
+    executables serialized by a compile-only client can't deserialize
+    ("DeserializeLoadedExecutable not implemented" warnings observed),
+    so caching them is pure pollution."""
+    import jax
+    old_cache = jax.config.jax_compilation_cache_dir
+    if old_cache:
+        jax.config.update("jax_compilation_cache_dir", None)
+    try:
+        return _evidence(out_dir, include_wgln)
+    finally:
+        if old_cache:
+            jax.config.update("jax_compilation_cache_dir", old_cache)
+
+
+def _evidence(out_dir: Optional[str], include_wgln: bool) -> dict:
     topo = tpu_topology()
     if topo is None:
         return {"ok": False,
