@@ -497,7 +497,14 @@ def check(model: Model, history: History, time_limit: Optional[float] = None,
         iinv, iopc = iinv[:ic_eff], iopc[:ic_eff]
         accel = safe_backend() not in (None, "cpu")
         budget_bytes = (1024 if accel else 128) * 1024 * 1024
-        K = max(64, min(4096, budget_bytes // (W_eff * L * 4 * 3)))
+        # cpu caps the beam at 1024: XLA:CPU compile scales with K and
+        # the post-compile search rate is flat across K=1024..4096 on
+        # the adversarial shape (measured: 50.4 s total at K=1024 w/
+        # 5.1 s compile vs 53.6 s at K=4096 w/ 13.9 s), so the bigger
+        # beam only buys compile latency there; accelerators keep the
+        # full width (compile is fast, rounds scale with K)
+        K = max(64, min(4096 if accel else 1024,
+                        budget_bytes // (W_eff * L * 4 * 3)))
         # XLA:CPU compile time scales with K (~3 s at 512, ~14 s at
         # 4096); JEPSEN_TPU_MAX_FRONTIER lets CI cap the beam so its
         # many small shape buckets don't pay production-size compiles
