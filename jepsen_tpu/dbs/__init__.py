@@ -81,6 +81,16 @@ the shared framework. This package holds this framework's suites:
   built from shared workload definitions with per-API transport
   clients (RESP mini-redis for ycql, SQL mini-sqlite for ysql), and
   a test-all api x workload sweep. CI-run live on both surfaces.
+- `tidb` — the reference's deep-dive exemplar
+  (`tidb/src/tidb/core.clj:32-151`): 11 workloads (bank +
+  multitable, long-fork, monotonic, txn-cycle, append, register,
+  set, set-cas, sequential, table DDL races) over the shared
+  MySQL-wire codec, with the reference's four option axes
+  (auto-retry session vars, FOR UPDATE read locks, use-index,
+  update-in-place) expanded combinatorially by test-all
+  (all-combos / expected-to-pass / quick), and pd -> tikv -> tidb
+  three-daemon automation in tarball mode. CI-run live on the
+  MySQL-wire mini servers.
 - `cockroach` — the strict-serializability workloads
   (`cockroachdb/src/jepsen/cockroach/{monotonic,comments}.clj`) over
   the from-scratch pgwire client: monotonic (txn max+1 inserts with

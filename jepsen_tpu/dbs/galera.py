@@ -221,7 +221,7 @@ class MySqlConn:
 # -- the LIVE mini server ---------------------------------------------------
 
 MINIMYSQL_SRC = r'''
-import argparse, hashlib, os, socketserver, sqlite3, struct
+import argparse, hashlib, os, re, socketserver, sqlite3, struct
 
 p = argparse.ArgumentParser()
 p.add_argument("--port", type=int, required=True)
@@ -242,6 +242,25 @@ def put_lenenc(n):
     if n < 1 << 24:
         return b"\xfd" + n.to_bytes(3, "little")
     return b"\xfe" + struct.pack("<Q", n)
+
+def translate(sql):
+    # the dialect bridge: suite clients speak real MySQL SQL; the
+    # sqlite engine behind the wire needs these three MySQL-isms
+    # rewritten (everything else is common SQL)
+    sql = sql.replace("auto_increment", "AUTOINCREMENT") \
+             .replace("AUTO_INCREMENT", "AUTOINCREMENT")
+    # row-lock hints: BEGIN IMMEDIATE already serializes writers
+    sql = re.sub(r"\s+for\s+update\s*$", "", sql, flags=re.I)
+    # upsert: ON DUPLICATE KEY UPDATE -> ON CONFLICT(pk) DO UPDATE
+    # SET, conflict target = first column of the insert column list
+    m = re.search(r"\son\s+duplicate\s+key\s+update\s+", sql, re.I)
+    if m:
+        head, tail = sql[:m.start()], sql[m.end():]
+        cm = re.search(r"insert\s+into\s+\S+\s*\(\s*"
+                       r"([A-Za-z_][A-Za-z_0-9]*)", head, re.I)
+        pk = cm.group(1) if cm else "id"
+        sql = head + " ON CONFLICT(" + pk + ") DO UPDATE SET " + tail
+    return sql
 
 class Conn(socketserver.StreamRequestHandler):
     def send_pkt(self, payload):
@@ -345,9 +364,7 @@ class Conn(socketserver.StreamRequestHandler):
                 return self.ok()
             if up.startswith("SET "):
                 return self.ok()  # session knobs: accepted, ignored
-            # translate the one MySQL-ism the suite uses
-            sql = sql.replace("auto_increment", "AUTOINCREMENT") \
-                     .replace("AUTO_INCREMENT", "AUTOINCREMENT")
+            sql = translate(sql)
             before = db.total_changes
             cur = db.execute(sql)
             if cur.description is None:

@@ -1,0 +1,210 @@
+"""TiDB suite tests: the combinatorial option-axis machinery
+(all-combos / expected-to-pass / quick, tidb/core.clj:46-151), the
+MySQL->sqlite dialect bridge the mini server adds for TiDB SQL
+(FOR UPDATE, ON DUPLICATE KEY UPDATE), the pd/tikv/tidb daemon-stack
+automation as command assertions, and full workloads end-to-end
+against LIVE mini servers under the kill/restart nemesis."""
+
+import subprocess
+import sys
+import time
+
+import pytest
+
+from jepsen_tpu import core
+from jepsen_tpu.dbs import galera as ga
+from jepsen_tpu.dbs import tidb as ti
+from jepsen_tpu.history import History, fail, invoke, ok
+
+
+# -- option-axis combinatorics (core.clj:111-151) ---------------------------
+
+def test_all_combos():
+    assert ti.all_combos({}) == [{}]
+    combos = ti.all_combos({"a": [1, 2], "b": [True, False]})
+    assert len(combos) == 4
+    assert {"a": 1, "b": True} in combos
+    assert len({tuple(sorted(c.items())) for c in combos}) == 4
+    # the reference's append axes: 2*2*2 = 8
+    assert len(ti.all_combos(ti.WORKLOAD_OPTIONS["append"])) == 8
+    # register: 2*2*2*2 = 16
+    assert len(ti.all_combos(ti.WORKLOAD_OPTIONS["register"])) == 16
+    assert ti.all_combos(ti.WORKLOAD_OPTIONS["table"]) == [{}]
+
+
+def test_expected_to_pass_pins_retry_off():
+    table = ti.expected_to_pass(ti.WORKLOAD_OPTIONS)
+    for w, opts in table.items():
+        assert opts["auto_retry"] == [False]
+        assert opts["auto_retry_limit"] == [0]
+    # other axes survive
+    assert table["register"]["read_lock"] == [None, "FOR UPDATE"]
+
+
+def test_quick_options_shape():
+    q = ti.quick_workload_options(ti.WORKLOAD_OPTIONS)
+    # redundant workloads dropped (core.clj:145-151)
+    for dropped in ("bank", "long-fork", "monotonic", "sequential",
+                    "table"):
+        assert dropped not in q
+    assert "append" in q and "bank-multitable" in q
+    # retry axes -> defaults, read locks off
+    assert q["append"]["auto_retry"] == ["default"]
+    assert q["append"]["read_lock"] == [None]
+    # use-index kept only where true
+    assert q.get("register", {}).get("use_index") == [True]
+
+
+def test_tidb_tests_matrix(tmp_path):
+    opts = {"nodes": ["n1"], "concurrency": 2, "combos": "quick",
+            "store_root": str(tmp_path / "s"),
+            "sandbox": str(tmp_path / "c")}
+    tests = list(ti.tidb_tests(opts))
+    names = [t["name"] for t in tests]
+    assert len(names) == len(set(names)), "duplicate test names"
+    # quick keeps 6 workloads; register expands use_index=True only
+    assert any("register" in n for n in names)
+    assert not any("long-fork" in n for n in names)
+    # explicit workload + combos=all expands every axis product
+    all_reg = list(ti.tidb_tests({**opts, "workload": "register",
+                                  "combos": "all"}))
+    assert len(all_reg) == 16
+
+
+# -- the dialect bridge (mini server translate) -----------------------------
+
+@pytest.fixture()
+def mini(tmp_path):
+    srv_py = tmp_path / "minimysql.py"
+    srv_py.write_text(ga.MINIMYSQL_SRC)
+    port = 26980
+    proc = subprocess.Popen(
+        [sys.executable, str(srv_py), "--port", str(port),
+         "--dir", str(tmp_path), "--password", ga.MINI_PASSWORD],
+        cwd=tmp_path)
+    deadline = time.monotonic() + 10
+    conn = None
+    while conn is None:
+        try:
+            conn = ga.MySqlConn("127.0.0.1", port, timeout=2)
+        except OSError:
+            assert time.monotonic() < deadline, "never up"
+            time.sleep(0.1)
+    yield conn, port
+    conn.close()
+    proc.kill()
+    proc.wait(timeout=10)
+
+
+def test_on_duplicate_key_update_bridge(mini):
+    conn, _ = mini
+    conn.query("CREATE TABLE test (id INT NOT NULL PRIMARY KEY, "
+               "sk INT, val INT)")
+    conn.query("INSERT INTO test (id, sk, val) VALUES (1, 1, 10) "
+               "ON DUPLICATE KEY UPDATE val = 10")
+    conn.query("INSERT INTO test (id, sk, val) VALUES (1, 1, 20) "
+               "ON DUPLICATE KEY UPDATE val = 20")
+    rows, _ = conn.query("SELECT val FROM test WHERE id = 1")
+    assert rows == [["20"]]
+
+
+def test_for_update_bridge(mini):
+    conn, _ = mini
+    conn.query("CREATE TABLE t2 (id INT PRIMARY KEY, v INT)")
+    conn.query("INSERT INTO t2 VALUES (1, 7)")
+    rows, _ = conn.query("SELECT v FROM t2 WHERE id = 1 FOR UPDATE")
+    assert rows == [["7"]]
+
+
+def test_session_axes_accepted(mini):
+    conn, _ = mini
+    conn.query("SET @@tidb_disable_txn_auto_retry = 1")
+    conn.query("SET @@tidb_retry_limit = 0")
+    rows, _ = conn.query("SELECT 1")
+    assert rows == [["1"]]
+
+
+# -- table-workload checker -------------------------------------------------
+
+def test_table_checker():
+    h = History([
+        invoke(0, "insert", [1, 0]),
+        fail(0, "insert", [1, 0], error="doesn't-exist"),
+    ]).index()
+    res = ti.TableChecker().check({}, h, {})
+    assert res["valid?"] is False and res["errors"]
+    h2 = History([
+        invoke(0, "insert", [1, 0]),
+        fail(0, "insert", [1, 0], error="duplicate-key"),
+        invoke(1, "create-table", 2), ok(1, "create-table", 2),
+    ]).index()
+    assert ti.TableChecker().check({}, h2, {})["valid?"] is True
+
+
+# -- full suites against LIVE mini servers ----------------------------------
+
+def _options(tmp_path, which, **kw):
+    return {"nodes": kw.pop("nodes", ["t1"]),
+            "concurrency": kw.pop("concurrency", 4),
+            "time_limit": kw.pop("time_limit", 8),
+            "nemesis_interval": kw.pop("nemesis_interval", 2.5),
+            "workload": which,
+            "store_root": str(tmp_path / "store"),
+            "sandbox": str(tmp_path / "cluster"), **kw}
+
+
+@pytest.mark.parametrize("which,axes", [
+    ("register", {"use_index": True, "read_lock": "FOR UPDATE"}),
+    ("append", {}),
+    ("set-cas", {"read_lock": "FOR UPDATE"}),
+    ("table", {}),
+    ("bank-multitable", {"update_in_place": False}),
+])
+def test_full_suite_live(tmp_path, which, axes):
+    done = core.run(ti.tidb_test(_options(tmp_path, which, **axes)))
+    res = done["results"]
+    assert res["valid?"] is True, res
+
+
+# -- real-cluster automation (tarball mode) ---------------------------------
+
+def test_tarball_commands():
+    from jepsen_tpu import control as c
+    from jepsen_tpu.control.dummy import DummyRemote
+
+    log: list = []
+    db = ti.TidbDB()
+    test = {"nodes": ["n1", "n2", "n3"], "force_reinstall": True}
+    with c.with_remote(DummyRemote(log)):
+        with c.on("n2"):
+            db.setup(test, "n2")
+    cmds = [x[1] for x in log if isinstance(x[1], str)]
+    joined = "\n".join(cmds)
+    # install via (cached) archive fetch into /opt/tidb
+    assert "/opt/tidb" in joined
+    assert "download.pingcap.org" in ti.tarball_url(ti.VERSION)
+    # dependency order: pd before tikv before tidb
+    i_pd = joined.index("pd-server")
+    i_kv = joined.index("tikv-server")
+    i_db = joined.index("tidb-server")
+    assert i_pd < i_kv < i_db
+    assert "--initial-cluster" in joined
+    assert "pd1=http://n1:2380" in joined
+    assert "pd2=http://n2:2380" in joined
+    assert "--store tikv" in joined or "--store" in joined
+    # kill runs in reverse dependency order
+    log.clear()
+    with c.with_remote(DummyRemote(log)):
+        with c.on("n2"):
+            db.kill(test, "n2")
+    kcmds = "\n".join(x[1] for x in log if isinstance(x[1], str))
+    assert kcmds.index("tidb-server") < kcmds.index("tikv-server") \
+        < kcmds.index("pd-server")
+
+
+def test_pd_cluster_strings():
+    test = {"nodes": ["a", "b"]}
+    assert ti.pd_name(test, "a") == "pd1"
+    assert ti.pd_initial_cluster(test) == \
+        "pd1=http://a:2380,pd2=http://b:2380"
+    assert ti.pd_endpoints(test) == "a:2379,b:2379"
