@@ -91,6 +91,25 @@ the shared framework. This package holds this framework's suites:
   (all-combos / expected-to-pass / quick), and pd -> tikv -> tidb
   three-daemon automation in tarball mode. CI-run live on the
   MySQL-wire mini servers.
+- `stolon` — the PostgreSQL-HA family
+  (`stolon/src/jepsen/stolon/{ledger,append,db}.clj`): the ledger
+  double-spend workload (transactions as rows, charitable-reading
+  checker; fund-then-double-spend attack generator) and elle
+  list-append over the shared pgwire codec; LIVE mini pgwire
+  servers in CI, real sentinel/keeper/proxy-over-etcdv3 automation
+  in `ha` mode.
+- `raftis` — redis-over-raft (`raftis/src/jepsen/raftis.clj`, the
+  reference's smallest suite): one linearizable register over the
+  live mini-redis servers, with the reference's definite-fail error
+  taxonomy; floyd tarball automation in `tarball` mode.
+- `aerospike` — the record-store family
+  (`aerospike/src/aerospike/*.clj`): a from-scratch Aerospike
+  binary-protocol subset (AS_MSG framing, generation counters),
+  generation-CAS registers / INCR counters / CAS-appended sets
+  against LIVE mini servers, .deb + mesh-config automation, and the
+  `dbs/spec/aerospike_gen.tla` TLA+ spec explored exhaustively in
+  CI (the reference suite's own spec/aerospike.tla is the role
+  model).
 - `cockroach` — the strict-serializability workloads
   (`cockroachdb/src/jepsen/cockroach/{monotonic,comments}.clj`) over
   the from-scratch pgwire client: monotonic (txn max+1 inserts with

@@ -1,0 +1,83 @@
+"""Shared connect-with-retry client plumbing for the mini-server
+suites (one copy, the miniserver.py discipline): a client that lazily
+opens one connection to its node — or to the primary, for mini modes
+whose single logical store lives on nodes[0] — retrying briefly
+across a server's kill/restart window, with a post-connect hook for
+session setup (e.g. tidb's auto-retry vars)."""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+from .. import client as jclient
+
+
+class RetryClient(jclient.Client):
+    """Subclasses implement `_connect(host, port)` returning an
+    object with `.close()`, and may override `retry_excs` (what to
+    swallow while the server restarts), `_post_connect`, and
+    `default_port`."""
+
+    retry_excs: tuple = (OSError,)
+    default_port: int = 0
+    connect_deadline_s: float = 5.0
+
+    def __init__(self, port_fn=None, timeout: float = 5.0,
+                 pin_primary: bool = False):
+        self.port_fn = port_fn or (lambda test, node:
+                                   (node, self.default_port))
+        self.timeout = timeout
+        self.pin_primary = pin_primary
+        self.node: Optional[str] = None
+        self.conn = None
+
+    def open(self, test, node):
+        c = type(self)(self.port_fn, self.timeout, self.pin_primary)
+        c.node = node
+        return c
+
+    def _connect(self, host: str, port: int):
+        raise NotImplementedError
+
+    def _post_connect(self, conn, test) -> None:
+        """Session setup on a fresh connection (default: none)."""
+
+    def _conn(self, test):
+        if self.conn is None:
+            target = (test["nodes"][0] if self.pin_primary
+                      else self.node)
+            host, port = self.port_fn(test, target)
+            deadline = time.monotonic() + self.connect_deadline_s
+            while True:
+                try:
+                    conn = self._connect(host, port)
+                    break
+                except self.retry_excs:
+                    # a server dying mid-handshake surfaces as a
+                    # protocol error too, and the retry window must
+                    # cover the restart either way
+                    if time.monotonic() >= deadline:
+                        raise
+                    time.sleep(0.1)
+            self._post_connect(conn, test)
+            self.conn = conn
+        return self.conn
+
+    def _drop(self):
+        if self.conn is not None:
+            self.conn.close()
+            self.conn = None
+
+    def close(self, test):
+        self._drop()
+
+
+def kill_targets(mode: str):
+    """Node-targeter for kill/pause nemeses: mini modes pin the
+    primary (it holds the one logical store), real clusters fault a
+    random member."""
+    from .. import generator as gen
+    if mode == "mini":
+        return lambda nodes: [nodes[0]]
+    return lambda nodes: [gen.RNG.choice(nodes)]

@@ -1,0 +1,90 @@
+---- MODULE aerospike_gen ----
+(***************************************************************************)
+(* A TLA+ model of the aerospike suite's generation-CAS contract           *)
+(* (jepsen_tpu/dbs/aerospike.py): every record carries a generation        *)
+(* counter; a write flagged EXPECT_GEN_EQUAL commits only if the record's  *)
+(* generation still equals the one the writer fetched. Role model: the     *)
+(* reference's aerospike/spec/aerospike.tla (which models cluster          *)
+(* formation; this models the data-plane contract its workloads check).    *)
+(*                                                                         *)
+(*   Checked  mode (GenChecked = TRUE): between a client's fetch and its   *)
+(*            write, any interleaved commit bumps the generation and the   *)
+(*            late writer gets GENERATION_ERROR — no lost updates: every   *)
+(*            committed write observed the immediately-preceding commit.   *)
+(*   Relaxed  mode (GenChecked = FALSE): blind writes; TLC finds the       *)
+(*            NoLostUpdates violation (two clients fetch gen g, both       *)
+(*            write, the second silently clobbers the first) — exactly    *)
+(*            the anomaly the cas-register workload's linearizability     *)
+(*            checker observes when CAS skips the generation policy.      *)
+(*                                                                         *)
+(* Model-check with TLC:                                                   *)
+(*   CONSTANTS Clients = {c1, c2}  Values = {1, 2}  GenChecked = TRUE     *)
+(*   INVARIANT TypeOK  NoLostUpdates                                      *)
+(* tests/test_aerospike.py explores this state machine exhaustively       *)
+(* (TLC is not in the CI image), proving NoLostUpdates in checked mode    *)
+(* and refuting it with a concrete interleaving in relaxed mode.          *)
+(***************************************************************************)
+
+EXTENDS Naturals, FiniteSets
+
+CONSTANTS Clients,    \* concurrent writer processes
+          Values,     \* writable values
+          GenChecked  \* TRUE = EXPECT_GEN_EQUAL enforced
+
+MaxGen == 3           \* exploration bound on the generation counter
+
+VARIABLES
+  gen,       \* the record's generation counter
+  value,     \* the record's current value
+  fetched,   \* client -> the generation it last fetched (or -1)
+  applied    \* set of <<observed_gen, new_gen>> committed transitions
+
+vars == <<gen, value, fetched, applied>>
+
+Init ==
+  /\ gen = 0
+  /\ value = 0
+  /\ fetched = [c \in Clients |-> -1]
+  /\ applied = {}
+
+(* A client reads the record, remembering its generation. *)
+Fetch(c) ==
+  /\ gen < MaxGen
+  /\ fetched' = [fetched EXCEPT ![c] = gen]
+  /\ UNCHANGED <<gen, value, applied>>
+
+(* A client that fetched attempts the CAS write. In checked mode it
+   commits only when the generation is unchanged; in relaxed mode it
+   always commits (a blind write). *)
+Write(c, v) ==
+  /\ fetched[c] # -1
+  /\ gen < MaxGen
+  /\ IF GenChecked /\ fetched[c] # gen
+     THEN \* GENERATION_ERROR: the client must refetch
+          /\ fetched' = [fetched EXCEPT ![c] = -1]
+          /\ UNCHANGED <<gen, value, applied>>
+     ELSE /\ gen' = gen + 1
+          /\ value' = v
+          /\ applied' = applied \union {<<fetched[c], gen'>>}
+          /\ fetched' = [fetched EXCEPT ![c] = -1]
+
+Next ==
+  \/ \E c \in Clients : Fetch(c)
+  \/ \E c \in Clients, v \in Values : Write(c, v)
+
+Spec == Init /\ [][Next]_vars
+
+----
+TypeOK ==
+  /\ gen \in 0..MaxGen
+  /\ \A c \in Clients : fetched[c] \in -1..MaxGen
+
+(* Every committed write observed the generation immediately before
+   the one it created: transitions are <<g, g+1>>. A lost update is a
+   commit whose observed generation is stale — <<g, g'>> with
+   g' # g + 1 means some other commit landed in between and was
+   clobbered without being observed. *)
+NoLostUpdates ==
+  \A t \in applied : t[2] = t[1] + 1
+
+====

@@ -37,13 +37,14 @@ import itertools
 from typing import Optional
 
 from .. import checker as jchecker
-from .. import cli, client as jclient, control, db as jdb
+from .. import cli, control, db as jdb
 from .. import generator as gen
 from .. import nemesis as jnemesis
 from ..control import localexec, nodeutil
 from ..independent import KV, tuple_
 from ..os_setup import Debian
 from ..txn import APPEND, R, W, is_mop
+from . import retryclient
 from .galera import MiniGaleraDB, MySqlConn, MySqlError
 
 VERSION = "v3.0.3"  # pingcap release era of the reference suite
@@ -207,59 +208,28 @@ class MiniTidbDB(MiniGaleraDB):
 
 # -- client base --------------------------------------------------------------
 
-class _TidbBase(jclient.Client):
+class _TidbBase(retryclient.RetryClient):
     """Shared TiDB SQL client plumbing: connect-with-retry to the
     node (or the primary in mini mode), session init for the
     auto-retry axes (sql.clj init-conn!:28-47), txn helpers with
     abort capture (sql.clj:178-230)."""
 
-    def __init__(self, port_fn=None, timeout: float = 5.0,
-                 pin_primary: bool = False):
-        self.port_fn = port_fn or (lambda test, node: (node, SQL_PORT))
-        self.timeout = timeout
-        self.pin_primary = pin_primary
-        self.node: Optional[str] = None
-        self.conn: Optional[MySqlConn] = None
+    retry_excs = (OSError, MySqlError)
+    default_port = SQL_PORT
 
-    def open(self, test, node):
-        c = type(self)(self.port_fn, self.timeout, self.pin_primary)
-        c.node = node
-        return c
+    def _connect(self, host, port) -> MySqlConn:
+        return MySqlConn(host, port, timeout=self.timeout)
 
-    def _conn(self, test) -> MySqlConn:
-        if self.conn is None:
-            import time as _t
-            target = (test["nodes"][0] if self.pin_primary
-                      else self.node)
-            host, port = self.port_fn(test, target)
-            deadline = _t.monotonic() + 5.0
-            while True:
-                try:
-                    conn = MySqlConn(host, port, timeout=self.timeout)
-                    break
-                except (OSError, MySqlError):
-                    if _t.monotonic() >= deadline:
-                        raise
-                    _t.sleep(0.1)
-            # session axes (sql.clj init-conn!): :default leaves the
-            # server's own behavior in place
-            ar = test.get("auto_retry", "default")
-            if ar != "default":
-                conn.query("SET @@tidb_disable_txn_auto_retry = "
-                           f"{0 if ar else 1}")
-            lim = test.get("auto_retry_limit", "default")
-            if lim != "default":
-                conn.query(f"SET @@tidb_retry_limit = {int(lim)}")
-            self.conn = conn
-        return self.conn
-
-    def _drop(self):
-        if self.conn is not None:
-            self.conn.close()
-            self.conn = None
-
-    def close(self, test):
-        self._drop()
+    def _post_connect(self, conn, test):
+        # session axes (sql.clj init-conn!): :default leaves the
+        # server's own behavior in place
+        ar = test.get("auto_retry", "default")
+        if ar != "default":
+            conn.query("SET @@tidb_disable_txn_auto_retry = "
+                       f"{0 if ar else 1}")
+        lim = test.get("auto_retry_limit", "default")
+        if lim != "default":
+            conn.query(f"SET @@tidb_retry_limit = {int(lim)}")
 
     # -- SQL helpers honoring the option axes --
     @staticmethod
@@ -969,22 +939,16 @@ def quick_workload_options(workload_options: dict) -> dict:
     return out
 
 
-def _kill_targets(mode):
-    """mini pins the primary (it holds the one logical store, the
-    galera-mini topology); real clusters fault a random member."""
-    if mode == "mini":
-        return lambda nodes: [nodes[0]]
-    return lambda nodes: [gen.RNG.choice(nodes)]
-
-
 NEMESES = {
     "partition": lambda db, mode: jnemesis.partition_random_halves(),
     "kill": lambda db, mode: jnemesis.node_start_stopper(
-        _kill_targets(mode),
+        retryclient.kill_targets(mode),
         lambda test, node: db.kill(test, node),
         lambda test, node: db.start(test, node)),
+    # pause follows the same targeting: in mini mode every client is
+    # pinned to the primary, so pausing anyone else faults nobody
     "pause": lambda db, mode: jnemesis.node_start_stopper(
-        lambda nodes: [gen.RNG.choice(nodes)],
+        retryclient.kill_targets(mode),
         lambda test, node: db.pause(test, node),
         lambda test, node: db.resume(test, node)),
     "none": lambda db, mode: jnemesis.Nemesis(),

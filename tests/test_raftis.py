@@ -1,0 +1,70 @@
+"""Raftis suite tests: definite/indefinite error taxonomy, the full
+register suite live against mini-redis servers under kill faults, and
+the floyd tarball automation as command assertions."""
+
+import pytest
+
+from jepsen_tpu import core
+from jepsen_tpu.dbs import raftis as ra
+
+
+def test_error_taxonomy():
+    client = ra.RaftisClient()
+    # a client whose connection always raises the given message
+    class Boom:
+        def __init__(self, msg):
+            self.msg = msg
+
+        def cmd(self, *a):
+            raise ra.RedisError(self.msg)
+
+        def close(self):
+            pass
+
+    for msg, expect in [
+        ("ERR write InComplete: no leader node!", "fail"),
+        ("socket closed", "fail"),
+        ("ERR some transient storm", "info"),
+    ]:
+        client.conn = Boom(msg)
+        out = client.invoke({}, {"f": "write", "value": 1})
+        assert out["type"] == expect, (msg, out)
+    # reads always definite
+    client.conn = Boom("ERR some transient storm")
+    out = client.invoke({}, {"f": "read", "value": None})
+    assert out["type"] == "fail"
+
+
+def test_initial_cluster():
+    assert ra.initial_cluster({"nodes": ["a", "b"]}) == \
+        "a:8901,b:8901"
+
+
+def test_full_suite_live(tmp_path):
+    done = core.run(ra.raftis_test({
+        "nodes": ["r1"], "concurrency": 4, "time_limit": 8,
+        "nemesis_interval": 2.5,
+        "store_root": str(tmp_path / "store"),
+        "sandbox": str(tmp_path / "cluster")}))
+    res = done["results"]
+    assert res["valid?"] is True, res
+    assert res["linear"]["valid?"] is True
+    # the register actually moved
+    assert any(op.f == "write" and op.is_ok for op in done["history"])
+
+
+def test_tarball_commands():
+    from jepsen_tpu import control as c
+    from jepsen_tpu.control.dummy import DummyRemote
+
+    log: list = []
+    db = ra.RaftisDB()
+    test = {"nodes": ["n1", "n2"], "force_reinstall": True}
+    with c.with_remote(DummyRemote(log)):
+        with c.on("n1"):
+            db.setup(test, "n1")
+    joined = "\n".join(x[1] for x in log if isinstance(x[1], str))
+    assert "/opt/raftis" in joined
+    assert "PikaLabs/floyd" in ra.tarball_url(ra.VERSION)
+    # positional daemon args: cluster, node, raft port, data, client
+    assert "n1:8901,n2:8901 n1 8901 data 6379" in joined
