@@ -110,6 +110,19 @@ the shared framework. This package holds this framework's suites:
   `dbs/spec/aerospike_gen.tla` TLA+ spec explored exhaustively in
   CI (the reference suite's own spec/aerospike.tla is the role
   model).
+- `rethinkdb` — the document-store-with-topology family
+  (`rethinkdb/src/jepsen/rethinkdb{,/document_cas}.clj`): a
+  from-scratch ReQL subset (V0_4 handshake, term ASTs), document
+  CAS via branch-update, the write_acks/read_mode durability matrix,
+  and the reconfigure nemesis issuing topology churn through the
+  client protocol; live mini servers in CI, apt automation in deb
+  mode.
+- `hazelcast` — the data-grid family
+  (`hazelcast/src/jepsen/hazelcast.clj`, standing also for ignite):
+  atomic-long unique IDs, CAS longs, queues, CAS'd map sets, and
+  fenced locks (mutex-linearizable + fence-monotonic) over a
+  from-scratch binary frame protocol; the volatile-lock violation
+  is demonstrated deterministically in CI.
 - `cockroach` — the strict-serializability workloads
   (`cockroachdb/src/jepsen/cockroach/{monotonic,comments}.clj`) over
   the from-scratch pgwire client: monotonic (txn max+1 inserts with

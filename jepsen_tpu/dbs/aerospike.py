@@ -45,7 +45,6 @@ from .. import generator as gen
 from .. import nemesis as jnemesis
 from ..control import localexec, nodeutil
 from ..independent import KV, tuple_
-from ..models import cas_register
 from ..os_setup import Debian
 from . import miniserver, retryclient
 
@@ -617,20 +616,13 @@ def aerospike_test(options: dict) -> dict:
     else:
         raise ValueError(f"unknown server mode {mode!r}")
 
-    interval = options.get("nemesis_interval") or 3.0
-    time_limit = options.get("time_limit") or 10
-    workload_gen = w["generator"]
-    nem_gen = gen.cycle([gen.sleep(interval),
-                         {"type": "info", "f": "start"},
-                         gen.sleep(interval),
-                         {"type": "info", "f": "stop"}])
-    if not w.get("wrap_time", True):
-        nem_gen = gen.phases(
-            gen.time_limit(max(1.0, time_limit - 4.0), nem_gen),
-            gen.once(lambda test, ctx: {"type": "info", "f": "stop"}))
-    workload_gen = gen.nemesis(nem_gen, workload_gen)
-    if w.get("wrap_time", True):
-        workload_gen = gen.time_limit(time_limit, workload_gen)
+    nemesis = jnemesis.node_start_stopper(
+        retryclient.kill_targets(mode),
+        lambda test, node: db.kill(test, node),
+        lambda test, node: db.start(test, node))
+    workload_gen = retryclient.standard_generator(
+        w, nemesis, options.get("nemesis_interval") or 3.0,
+        options.get("time_limit") or 10)
     pass_extra = {k: v for k, v in w.items()
                   if k not in ("checker", "generator", "client",
                                "wrap_time")}
@@ -641,10 +633,7 @@ def aerospike_test(options: dict) -> dict:
         "concurrency": options["concurrency"],
         "db": db,
         "client": client,
-        "nemesis": jnemesis.node_start_stopper(
-            retryclient.kill_targets(mode),
-            lambda test, node: db.kill(test, node),
-            lambda test, node: db.start(test, node)),
+        "nemesis": nemesis,
         "checker": jchecker.compose({
             which: w["checker"],
             "exceptions": jchecker.unhandled_exceptions(),

@@ -134,7 +134,7 @@ def raftis_test(options: dict) -> dict:
         client.port_fn = lambda test, node: (
             "127.0.0.1", mini_node_port(test, test["nodes"][0]))
         nemesis = jnemesis.node_start_stopper(
-            lambda ns: [ns[0]],
+            retryclient.kill_targets(mode),
             lambda test, node: db.kill(test, node),
             lambda test, node: db.start(test, node))
         extra = {

@@ -81,3 +81,34 @@ def kill_targets(mode: str):
     if mode == "mini":
         return lambda nodes: [nodes[0]]
     return lambda nodes: [gen.RNG.choice(nodes)]
+
+
+def standard_generator(w: dict, nemesis, interval: float,
+                       time_limit: float):
+    """The suites' shared generator shape: the workload interleaved
+    with a start/stop fault cycle under one time limit. A workload
+    with ``wrap_time: False`` manages its own phases (e.g. sets'
+    add-then-final-read), so the TIME LIMIT moves to the nemesis
+    stream, which stops faults 4 s early — the drain window — and
+    issues one final stop so the last phase runs against a healthy
+    system. A Noop nemesis gets a sleep-only stream (nothing to
+    drive)."""
+    from .. import generator as gen
+    from .. import nemesis as jnemesis
+    workload_gen = w["generator"]
+    if isinstance(nemesis, jnemesis.Noop):
+        nem_gen = gen.repeat(gen.sleep(interval))
+    else:
+        nem_gen = gen.cycle([gen.sleep(interval),
+                             {"type": "info", "f": "start"},
+                             gen.sleep(interval),
+                             {"type": "info", "f": "stop"}])
+    if not w.get("wrap_time", True):
+        nem_gen = gen.phases(
+            gen.time_limit(max(1.0, time_limit - 4.0), nem_gen),
+            gen.once(lambda test, ctx: {"type": "info",
+                                        "f": "stop"}))
+    workload_gen = gen.nemesis(nem_gen, workload_gen)
+    if w.get("wrap_time", True):
+        workload_gen = gen.time_limit(time_limit, workload_gen)
+    return workload_gen

@@ -1,0 +1,192 @@
+"""RethinkDB suite tests: the from-scratch ReQL subset (V0_4
+handshake, term ASTs, cas-by-branch semantics) against the live mini
+server, kill -9 durability, the reconfigure nemesis issuing topology
+churn through the client protocol, full suites end-to-end, and the
+deb automation as command assertions."""
+
+import subprocess
+import sys
+import time
+
+import pytest
+
+from jepsen_tpu import core
+from jepsen_tpu.dbs import rethinkdb as rt
+
+
+# -- term builders -----------------------------------------------------------
+
+def test_term_shapes():
+    t = rt.t_read("jepsen", "cas", "5", "majority")
+    assert t[0] == rt.DEFAULT
+    get_field = t[1][0]
+    assert get_field[0] == rt.GET_FIELD
+    row = get_field[1][0]
+    assert row[0] == rt.GET
+    table = row[1][0]
+    assert table[0] == rt.TABLE and table[2] == {"read_mode":
+                                                 "majority"}
+    w = rt.t_write("jepsen", "cas", "5", 3)
+    assert w[0] == rt.INSERT and w[2] == {"conflict": "update"}
+    c = rt.t_cas("jepsen", "cas", "5", 1, 2)
+    assert c[0] == rt.UPDATE
+    fn = c[1][1]
+    assert fn[0] == rt.FUNC and fn[1][1][0] == rt.BRANCH
+
+
+# -- live mini server --------------------------------------------------------
+
+@pytest.fixture()
+def mini(tmp_path):
+    srv_py = tmp_path / "minirethink.py"
+    srv_py.write_text(rt.MINIRETHINK_SRC)
+    port = 28480
+    proc = subprocess.Popen(
+        [sys.executable, str(srv_py), "--port", str(port),
+         "--dir", str(tmp_path)], cwd=tmp_path)
+    deadline = time.monotonic() + 10
+    conn = None
+    while conn is None:
+        try:
+            conn = rt.ReqlConn("127.0.0.1", port, timeout=2)
+        except OSError:
+            assert time.monotonic() < deadline, "never up"
+            time.sleep(0.1)
+    yield conn, port, tmp_path
+    conn.close()
+    proc.kill()
+    proc.wait(timeout=10)
+
+
+def test_handshake_and_crud(mini):
+    conn, _, _ = mini
+    # read of a missing doc returns the DEFAULT fallback
+    assert conn.run(rt.t_read("jepsen", "cas", "k1")) is None
+    res = conn.run(rt.t_write("jepsen", "cas", "k1", 7))
+    assert res["inserted"] == 1 and res["errors"] == 0
+    assert conn.run(rt.t_read("jepsen", "cas", "k1")) == 7
+    # conflict=update overwrites
+    res = conn.run(rt.t_write("jepsen", "cas", "k1", 9))
+    assert res["replaced"] == 1
+    assert conn.run(rt.t_read("jepsen", "cas", "k1")) == 9
+
+
+def test_cas_branch_semantics(mini):
+    conn, _, _ = mini
+    conn.run(rt.t_write("jepsen", "cas", "c", 1))
+    # matching old value: replaced
+    res = conn.run(rt.t_cas("jepsen", "cas", "c", 1, 2))
+    assert res["errors"] == 0 and res["replaced"] == 1
+    assert conn.run(rt.t_read("jepsen", "cas", "c")) == 2
+    # stale old value: the branch ERRORs, nothing replaced
+    res = conn.run(rt.t_cas("jepsen", "cas", "c", 1, 3))
+    assert res["errors"] == 1 and res["replaced"] == 0
+    assert res["first_error"] == "abort"
+    assert conn.run(rt.t_read("jepsen", "cas", "c")) == 2
+
+
+def test_admin_and_reconfigure(mini):
+    conn, _, _ = mini
+    res = conn.run(rt.t_write_acks("single", ["n1", "n2"]))
+    assert res["replaced"] == 1
+    res = conn.run(rt.t_reconfigure("jepsen", "cas", "n2",
+                                    ["n1", "n2"]))
+    assert res["reconfigured"] == 1
+
+
+def test_survives_kill(mini, tmp_path):
+    conn, port, path = mini
+    conn.run(rt.t_write("jepsen", "cas", "durable", 42))
+    assert subprocess.run(
+        ["pkill", "-9", "-f", f"minirethink.py --port {port}"],
+        capture_output=True).returncode == 0
+    deadline = time.monotonic() + 10
+    while subprocess.run(
+            ["pgrep", "-f", f"minirethink.py --port {port}"],
+            capture_output=True).returncode == 0:
+        assert time.monotonic() < deadline, "old server immortal"
+        time.sleep(0.05)
+    proc = subprocess.Popen(
+        [sys.executable, str(path / "minirethink.py"), "--port",
+         str(port), "--dir", str(path)], cwd=path)
+    try:
+        deadline = time.monotonic() + 10
+        while True:
+            try:
+                c2 = rt.ReqlConn("127.0.0.1", port, timeout=2)
+                out = c2.run(rt.t_read("jepsen", "cas", "durable"))
+                c2.close()
+                break
+            except (OSError, ConnectionError):
+                assert time.monotonic() < deadline, "never back"
+                time.sleep(0.1)
+        assert out == 42
+    finally:
+        proc.kill()
+        proc.wait(timeout=10)
+
+
+# -- full suites against LIVE mini servers -----------------------------------
+
+def _options(tmp_path, **kw):
+    return {"nodes": kw.pop("nodes", ["r1"]),
+            "concurrency": kw.pop("concurrency", 4),
+            "time_limit": kw.pop("time_limit", 8),
+            "nemesis_interval": kw.pop("nemesis_interval", 2.5),
+            "per_key_limit": 30,
+            "store_root": str(tmp_path / "store"),
+            "sandbox": str(tmp_path / "cluster"), **kw}
+
+
+def test_full_suite_live(tmp_path):
+    done = core.run(rt.rethinkdb_test(
+        _options(tmp_path, write_acks="majority",
+                 read_mode="majority")))
+    res = done["results"]
+    assert res["valid?"] is True, res
+
+
+def test_full_suite_reconfigure(tmp_path):
+    done = core.run(rt.rethinkdb_test(
+        _options(tmp_path, reconfigure=True)))
+    res = done["results"]
+    assert res["valid?"] is True, res
+    # the reconfigure nemesis actually drove topology churn
+    reconfs = [op for op in done["history"]
+               if op.f == "reconfigure" and op.is_info
+               and isinstance(op.value, dict)
+               and op.value.get("reconfigured") == 1]
+    assert reconfs, "no successful reconfigure landed"
+
+
+def test_tests_matrix(tmp_path):
+    tests = list(rt.rethinkdb_tests(_options(tmp_path)))
+    names = [t["name"] for t in tests]
+    assert len(tests) == 4  # 3 durability combos + reconfigure
+    assert len(set(names)) == 4
+    assert any("reconfigure" in n for n in names)
+    assert any("wsingle-rsingle" in n for n in names)
+
+
+# -- deb automation ----------------------------------------------------------
+
+def test_deb_commands():
+    from jepsen_tpu import control as c
+    from jepsen_tpu.control.dummy import DummyRemote
+
+    log: list = []
+    db = rt.RethinkDB()
+    test = {"nodes": ["n1", "n2", "n3"]}
+    with c.with_remote(DummyRemote(log)):
+        with c.on("n2"):
+            db.setup(test, "n2")
+            db.kill(test, "n2")
+    cmds = [x[1] for x in log if isinstance(x[1], str)]
+    joined = "\n".join(cmds)
+    assert "rethinkdb=" in joined
+    assert "service rethinkdb start" in joined
+    conf = rt.RethinkDB.config(test, "n2")
+    # joins point at the OTHER nodes only
+    assert "join=n1:29015" in conf and "join=n3:29015" in conf
+    assert "join=n2:29015" not in conf
+    assert "bind=all" in conf

@@ -115,6 +115,14 @@ def test_minipg_survives_kill(mini, tmp_path):
         ["pkill", "-9", "-f", f"minipg.py --port {port}"],
         capture_output=True)
     assert out.returncode == 0
+    # wait for the old process to actually die (pkill is async):
+    # binding over a still-live listener would EADDRINUSE
+    deadline = time.monotonic() + 10
+    while subprocess.run(
+            ["pgrep", "-f", f"minipg.py --port {port}"],
+            capture_output=True).returncode == 0:
+        assert time.monotonic() < deadline, "old server immortal"
+        time.sleep(0.05)
     proc = subprocess.Popen(
         [sys.executable, str(path / "minipg.py"), "--port", str(port),
          "--dir", str(path)], cwd=path)
