@@ -123,6 +123,17 @@ the shared framework. This package holds this framework's suites:
   fenced locks (mutex-linearizable + fence-monotonic) over a
   from-scratch binary frame protocol; the volatile-lock violation
   is demonstrated deterministically in CI.
+- `robustirc` — the exactly-once-messaging family
+  (`robustirc/src/jepsen/robustirc.clj`): the RobustSession HTTP
+  protocol (session auth, ClientMessageId dedup) with a from-scratch
+  RFC-1459 parser; topic-set workload live in CI, including
+  retransmit-across-restart exactly-once proofs; go-get automation
+  in `go` mode.
+- `logcabin` — the raft-reference-implementation family
+  (`logcabin/src/jepsen/logcabin.clj`): CAS register driven by a
+  TreeOps-shaped CLI shelled over the control plane per op (the
+  reference's transport), live tree servers in CI, scons
+  source-build + bootstrap/Reconfigure automation in `source` mode.
 - `cockroach` — the strict-serializability workloads
   (`cockroachdb/src/jepsen/cockroach/{monotonic,comments}.clj`) over
   the from-scratch pgwire client: monotonic (txn max+1 inserts with

@@ -581,11 +581,19 @@ def rethinkdb_test(options: dict) -> dict:
 
 def rethinkdb_tests(options: dict):
     """test-all: the durability matrix plus the reconfigure
-    variant."""
+    variant. An explicit --name becomes the prefix (sibling suites'
+    pattern), keeping per-test store directories distinct."""
+    base = options.get("name")
     for write_acks, read_mode in AXES:
-        yield rethinkdb_test(dict(options, write_acks=write_acks,
-                                  read_mode=read_mode))
-    yield rethinkdb_test(dict(options, reconfigure=True))
+        opts = dict(options, write_acks=write_acks,
+                    read_mode=read_mode)
+        if base:
+            opts["name"] = f"{base}-w{write_acks}-r{read_mode}"
+        yield rethinkdb_test(opts)
+    opts = dict(options, reconfigure=True)
+    if base:
+        opts["name"] = f"{base}-reconfigure"
+    yield rethinkdb_test(opts)
 
 
 RETHINKDB_OPTS = [

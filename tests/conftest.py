@@ -26,3 +26,20 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 assert jax.device_count() == 8, jax.devices()
+
+
+def kill_and_wait(script: str, port: int, timeout_s: float = 10):
+    """pkill -9 a mini server by its `<script> --port <port>` command
+    line and wait until the process is actually gone — pkill is
+    async, and restarting before the old listener dies would
+    EADDRINUSE. Shared by every suite's kill-recovery test."""
+    import subprocess
+    import time
+    pattern = f"{script} --port {port}"
+    assert subprocess.run(["pkill", "-9", "-f", pattern],
+                          capture_output=True).returncode == 0
+    deadline = time.monotonic() + timeout_s
+    while subprocess.run(["pgrep", "-f", pattern],
+                         capture_output=True).returncode == 0:
+        assert time.monotonic() < deadline, "old server immortal"
+        time.sleep(0.05)

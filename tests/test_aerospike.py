@@ -12,6 +12,8 @@ from collections import deque
 
 import pytest
 
+from conftest import kill_and_wait
+
 from jepsen_tpu import core
 from jepsen_tpu.dbs import aerospike as ae
 
@@ -101,17 +103,7 @@ def test_incr(mini):
 def test_survives_kill(mini, tmp_path):
     conn, port, path = mini
     conn.put("cats", "durable", {"value": 77})
-    out = subprocess.run(
-        ["pkill", "-9", "-f", f"miniaero.py --port {port}"],
-        capture_output=True)
-    assert out.returncode == 0
-    # wait for the old process to actually die (pkill is async)
-    deadline = time.monotonic() + 10
-    while subprocess.run(
-            ["pgrep", "-f", f"miniaero.py --port {port}"],
-            capture_output=True).returncode == 0:
-        assert time.monotonic() < deadline, "old server immortal"
-        time.sleep(0.05)
+    kill_and_wait("miniaero.py", port)
     proc = subprocess.Popen(
         [sys.executable, str(path / "miniaero.py"), "--port",
          str(port), "--dir", str(path)], cwd=path)

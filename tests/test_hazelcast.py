@@ -11,6 +11,8 @@ import time
 
 import pytest
 
+from conftest import kill_and_wait
+
 from jepsen_tpu import core
 from jepsen_tpu import checker as jchecker
 from jepsen_tpu.dbs import hazelcast as hz
@@ -115,15 +117,7 @@ def test_data_survives_kill_but_locks_do_not(mini, tmp_path):
     fence = conn.try_lock("broken")
     assert fence > hz.INVALID_FENCE   # we hold the lock
     # kill -9 and restart
-    assert subprocess.run(
-        ["pkill", "-9", "-f", f"minihz.py --port {port}"],
-        capture_output=True).returncode == 0
-    deadline = time.monotonic() + 10
-    while subprocess.run(
-            ["pgrep", "-f", f"minihz.py --port {port}"],
-            capture_output=True).returncode == 0:
-        assert time.monotonic() < deadline, "old server immortal"
-        time.sleep(0.05)
+    kill_and_wait("minihz.py", port)
     proc = _start(path, port)
     try:
         c2 = _connect(port)

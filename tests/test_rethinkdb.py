@@ -10,6 +10,8 @@ import time
 
 import pytest
 
+from conftest import kill_and_wait
+
 from jepsen_tpu import core
 from jepsen_tpu.dbs import rethinkdb as rt
 
@@ -97,15 +99,7 @@ def test_admin_and_reconfigure(mini):
 def test_survives_kill(mini, tmp_path):
     conn, port, path = mini
     conn.run(rt.t_write("jepsen", "cas", "durable", 42))
-    assert subprocess.run(
-        ["pkill", "-9", "-f", f"minirethink.py --port {port}"],
-        capture_output=True).returncode == 0
-    deadline = time.monotonic() + 10
-    while subprocess.run(
-            ["pgrep", "-f", f"minirethink.py --port {port}"],
-            capture_output=True).returncode == 0:
-        assert time.monotonic() < deadline, "old server immortal"
-        time.sleep(0.05)
+    kill_and_wait("minirethink.py", port)
     proc = subprocess.Popen(
         [sys.executable, str(path / "minirethink.py"), "--port",
          str(port), "--dir", str(path)], cwd=path)

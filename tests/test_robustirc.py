@@ -1,0 +1,166 @@
+"""RobustIRC suite tests: the RFC-1459 parser, the robustsession
+protocol against the live mini server (session auth, ClientMessageId
+dedup, kill -9 durability, retransmit-across-restart exactly-once),
+the full topic-set suite live, and the go-mode automation as command
+assertions."""
+
+import subprocess
+import sys
+import time
+
+import pytest
+import requests
+
+from conftest import kill_and_wait
+
+from jepsen_tpu import core
+from jepsen_tpu.dbs import robustirc as ri
+
+
+# -- IRC grammar -------------------------------------------------------------
+
+def test_parse_irc():
+    assert ri.parse_irc("TOPIC #jepsen :42") == \
+        (None, "TOPIC", ["#jepsen"], "42")
+    assert ri.parse_irc(":nick!u@h TOPIC #jepsen :7\r\n") == \
+        ("nick!u@h", "TOPIC", ["#jepsen"], "7")
+    assert ri.parse_irc("JOIN #jepsen") == \
+        (None, "JOIN", ["#jepsen"], None)
+    assert ri.parse_irc("USER j j j j") == \
+        (None, "USER", ["j", "j", "j", "j"], None)
+
+
+def test_topic_value():
+    assert ri.topic_value("TOPIC #jepsen :42") == 42
+    assert ri.topic_value(":n!u@h TOPIC #jepsen :9") == 9
+    assert ri.topic_value("TOPIC #other :5") is None
+    assert ri.topic_value("PRIVMSG #jepsen :42") is None
+    assert ri.topic_value("TOPIC #jepsen :not-an-int") is None
+
+
+# -- live mini server --------------------------------------------------------
+
+def _start(path, port):
+    srv_py = path / "miniirc.py"
+    if not srv_py.exists():
+        srv_py.write_text(ri.MINIIRC_SRC)
+    return subprocess.Popen(
+        [sys.executable, str(srv_py), "--port", str(port),
+         "--dir", str(path)], cwd=path)
+
+
+def _session(port, deadline_s=10) -> ri.RobustSession:
+    deadline = time.monotonic() + deadline_s
+    while True:
+        try:
+            return ri.RobustSession(f"http://127.0.0.1:{port}",
+                                    timeout=2)
+        except requests.RequestException:
+            assert time.monotonic() < deadline, "never up"
+            time.sleep(0.1)
+
+
+@pytest.fixture()
+def mini(tmp_path):
+    port = 29980
+    proc = _start(tmp_path, port)
+    session = _session(port)
+    yield session, port, tmp_path
+    session.close()
+    proc.kill()
+    proc.wait(timeout=10)
+
+
+def test_session_and_stream(mini):
+    s, _, _ = mini
+    s.post("NICK a")
+    s.post("JOIN #jepsen")
+    s.post("TOPIC #jepsen :1")
+    msgs = s.read_all()
+    assert [m["Data"] for m in msgs] == \
+        ["NICK a", "JOIN #jepsen", "TOPIC #jepsen :1"]
+
+
+def test_bad_auth_rejected(mini):
+    s, port, _ = mini
+    bad = ri.RobustSession(f"http://127.0.0.1:{port}", timeout=2)
+    bad.auth = "wrong"
+    with pytest.raises(requests.HTTPError):
+        bad.post("NICK x", retries=0)
+    bad.close()
+
+
+def test_client_message_id_dedup(mini):
+    """The exactly-once heart: the same ClientMessageId posted twice
+    lands ONCE."""
+    s, _, _ = mini
+    mid = s.new_message_id()
+    s.post("TOPIC #jepsen :5", msg_id=mid)
+    s.post("TOPIC #jepsen :5", msg_id=mid)   # retransmit
+    topics = [m for m in s.read_all()
+              if ri.topic_value(m["Data"]) == 5]
+    assert len(topics) == 1
+
+
+def test_retransmit_across_restart_exactly_once(mini, tmp_path):
+    """A retransmit whose ORIGINAL landed before a kill -9 must not
+    double-apply after the restart: SEEN_IDS is rebuilt from the
+    fsync'd log."""
+    s, port, path = mini
+    mid = s.new_message_id()
+    s.post("TOPIC #jepsen :77", msg_id=mid)
+    kill_and_wait("miniirc.py", port)
+    proc = _start(path, port)
+    try:
+        deadline = time.monotonic() + 10
+        while True:
+            try:
+                # same session (persisted), same message id
+                s.post("TOPIC #jepsen :77", msg_id=mid, retries=0)
+                break
+            except requests.RequestException:
+                assert time.monotonic() < deadline, "never back"
+                time.sleep(0.1)
+        topics = [m for m in s.read_all()
+                  if ri.topic_value(m["Data"]) == 77]
+        assert len(topics) == 1  # survived AND deduplicated
+    finally:
+        proc.kill()
+        proc.wait(timeout=10)
+
+
+# -- full suite against LIVE mini servers ------------------------------------
+
+def test_full_suite_live(tmp_path):
+    done = core.run(ri.robustirc_test({
+        "nodes": ["i1"], "concurrency": 4, "time_limit": 8,
+        "nemesis_interval": 2.5,
+        "store_root": str(tmp_path / "store"),
+        "sandbox": str(tmp_path / "cluster")}))
+    res = done["results"]
+    assert res["valid?"] is True, res
+
+
+# -- go automation -----------------------------------------------------------
+
+def test_go_commands():
+    from jepsen_tpu import control as c
+    from jepsen_tpu.control.dummy import DummyRemote
+
+    log: list = []
+    db = ri.RobustIrcDB()
+    test = {"nodes": ["n1", "n2"]}
+    with c.with_remote(DummyRemote(log)):
+        with c.on("n1"):
+            db.setup(test, "n1")
+    primary = "\n".join(x[1] for x in log if isinstance(x[1], str))
+    assert "golang-go" in primary
+    assert "github.com/robustirc/robustirc" in primary
+    assert "-singlenode" in primary       # the primary bootstraps
+    log.clear()
+    with c.with_remote(DummyRemote(log)):
+        with c.on("n2"):
+            db.setup(test, "n2")
+    joiner = "\n".join(x[1] for x in log if isinstance(x[1], str))
+    assert "-join=n1:13001" in joiner     # others join the primary
+    assert "-singlenode" not in joiner

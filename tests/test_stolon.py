@@ -10,6 +10,8 @@ import time
 
 import pytest
 
+from conftest import kill_and_wait
+
 from jepsen_tpu import core
 from jepsen_tpu.dbs import stolon as st
 from jepsen_tpu.dbs.postgres import PgConn
@@ -111,18 +113,7 @@ def test_minipg_survives_kill(mini, tmp_path):
     conn.query("CREATE TABLE k (id INTEGER PRIMARY KEY)")
     conn.query("INSERT INTO k VALUES (42)")
     # find and kill the server process hard
-    out = subprocess.run(
-        ["pkill", "-9", "-f", f"minipg.py --port {port}"],
-        capture_output=True)
-    assert out.returncode == 0
-    # wait for the old process to actually die (pkill is async):
-    # binding over a still-live listener would EADDRINUSE
-    deadline = time.monotonic() + 10
-    while subprocess.run(
-            ["pgrep", "-f", f"minipg.py --port {port}"],
-            capture_output=True).returncode == 0:
-        assert time.monotonic() < deadline, "old server immortal"
-        time.sleep(0.05)
+    kill_and_wait("minipg.py", port)
     proc = subprocess.Popen(
         [sys.executable, str(path / "minipg.py"), "--port", str(port),
          "--dir", str(path)], cwd=path)
