@@ -251,6 +251,10 @@ def translate(sql):
              .replace("AUTO_INCREMENT", "AUTOINCREMENT")
     # row-lock hints: BEGIN IMMEDIATE already serializes writers
     sql = re.sub(r"\s+for\s+update\s*$", "", sql, flags=re.I)
+    sql = re.sub(r"\s+lock\s+in\s+share\s+mode\s*$", "", sql,
+                 flags=re.I)
+    # storage-engine clauses (NDBCLUSTER, InnoDB...): one engine here
+    sql = re.sub(r"\s+engine\s*=\s*\w+", "", sql, flags=re.I)
     # upsert: ON DUPLICATE KEY UPDATE -> ON CONFLICT(pk) DO UPDATE
     # SET, conflict target = first column of the insert column list
     m = re.search(r"\son\s+duplicate\s+key\s+update\s+", sql, re.I)
@@ -510,23 +514,15 @@ class _GaleraBase(jclient.Client):
 
     def _conn(self, test) -> MySqlConn:
         if self.conn is None:
-            import time as _t
+            from .retryclient import connect_with_retry
             target = (test["nodes"][0] if self.pin_primary
                       else self.node)
             host, port = self.port_fn(test, target)
-            deadline = _t.monotonic() + 5.0
-            while True:
-                try:
-                    self.conn = MySqlConn(host, port,
-                                          timeout=self.timeout)
-                    break
-                except (OSError, MySqlError):
-                    # MySqlError too: a server dying mid-handshake
-                    # surfaces as (2013) lost connection, and the
-                    # retry window must cover the restart either way
-                    if _t.monotonic() >= deadline:
-                        raise
-                    _t.sleep(0.1)
+            # MySqlError counts too: a server dying mid-handshake
+            # surfaces as (2013) lost connection
+            self.conn = connect_with_retry(
+                lambda: MySqlConn(host, port, timeout=self.timeout),
+                (OSError, MySqlError))
         return self.conn
 
     def _drop(self):

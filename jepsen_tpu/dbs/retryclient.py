@@ -13,6 +13,22 @@ from typing import Optional
 from .. import client as jclient
 
 
+def connect_with_retry(connect, retry_excs: tuple,
+                       deadline_s: float = 5.0):
+    """THE one copy of the connect-retry discipline: call `connect`
+    until it returns, swallowing `retry_excs` (a server dying
+    mid-handshake surfaces as a protocol error too, and the retry
+    window must cover the restart either way) until the deadline."""
+    deadline = time.monotonic() + deadline_s
+    while True:
+        try:
+            return connect()
+        except retry_excs:
+            if time.monotonic() >= deadline:
+                raise
+            time.sleep(0.1)
+
+
 class RetryClient(jclient.Client):
     """Subclasses implement `_connect(host, port)` returning an
     object with `.close()`, and may override `retry_excs` (what to
@@ -48,18 +64,9 @@ class RetryClient(jclient.Client):
             target = (test["nodes"][0] if self.pin_primary
                       else self.node)
             host, port = self.port_fn(test, target)
-            deadline = time.monotonic() + self.connect_deadline_s
-            while True:
-                try:
-                    conn = self._connect(host, port)
-                    break
-                except self.retry_excs:
-                    # a server dying mid-handshake surfaces as a
-                    # protocol error too, and the retry window must
-                    # cover the restart either way
-                    if time.monotonic() >= deadline:
-                        raise
-                    time.sleep(0.1)
+            conn = connect_with_retry(
+                lambda: self._connect(host, port),
+                self.retry_excs, self.connect_deadline_s)
             self._post_connect(conn, test)
             self.conn = conn
         return self.conn

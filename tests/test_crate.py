@@ -1,0 +1,169 @@
+"""Crate suite tests: the _version MVCC semantics on the live mini
+server (default 1, bump-on-update, guarded CAS), the dialect bridge
+(string/INDEX OFF/upsert/refresh), all three checkers' anomaly
+detection, and the workloads end-to-end against LIVE servers
+(crate/src/jepsen/crate/*.clj)."""
+
+import subprocess
+import sys
+import time
+
+import pytest
+
+from jepsen_tpu import core
+from jepsen_tpu.dbs import crate as cr
+from jepsen_tpu.dbs.postgres import PgConn, PgError, tag_count
+from jepsen_tpu.history import History, invoke, ok, fail
+
+
+@pytest.fixture()
+def mini(tmp_path):
+    srv_py = tmp_path / "minicrate.py"
+    srv_py.write_text(cr.MINICRATE_SRC)
+    port = 27390
+    proc = subprocess.Popen(
+        [sys.executable, str(srv_py), "--port", str(port),
+         "--dir", str(tmp_path)], cwd=tmp_path)
+    deadline = time.monotonic() + 10
+    while True:
+        try:
+            conn = PgConn("127.0.0.1", port, timeout=3)
+            break
+        except OSError:
+            assert time.monotonic() < deadline, "never up"
+            time.sleep(0.1)
+    yield conn, port
+    conn.close()
+    proc.kill()
+    proc.wait(timeout=10)
+
+
+def test_version_column_semantics(mini):
+    conn, _ = mini
+    conn.query("create table registers (id integer primary key, "
+               "value integer)")
+    conn.query("insert into registers (id, value) values (1, 10)")
+    rows, _ = conn.query("select value, _version from registers "
+                         "where id = 1")
+    assert rows == [["10", "1"]]            # fresh row: version 1
+    conn.query("update registers set value = 11 where id = 1")
+    rows, _ = conn.query("select value, _version from registers "
+                         "where id = 1")
+    assert rows == [["11", "2"]]            # update bumped it
+    # guarded CAS: stale version matches nothing
+    _, tag = conn.query("update registers set value = 99 "
+                        "where id = 1 and _version = 1")
+    assert tag_count(tag) == 0
+    _, tag = conn.query("update registers set value = 12 "
+                        "where id = 1 and _version = 2")
+    assert tag_count(tag) == 1
+    rows, _ = conn.query("select value, _version from registers")
+    assert rows == [["12", "3"]]
+
+
+def test_dialect_bridge(mini):
+    conn, _ = mini
+    conn.query("create table sets (id integer primary key, "
+               "elements string INDEX OFF STORAGE WITH "
+               "(columnstore = false))")
+    conn.query('alter table sets set (number_of_replicas = "0-all")')
+    conn.query("refresh table sets")        # absorbed, not an error
+    # mysql-spelled upsert bumps _version on conflict
+    conn.query("insert into sets (id, elements) values (5, 'a') "
+               "on duplicate key update elements = VALUES(elements)")
+    conn.query("insert into sets (id, elements) values (5, 'b') "
+               "on duplicate key update elements = VALUES(elements)")
+    rows, _ = conn.query("select elements, _version from sets")
+    assert rows == [["b", "2"]]
+
+
+def test_multiversion_checker():
+    # values arrive unwrapped: these checkers run per-key under
+    # independent.checker
+    good = History([
+        invoke(0, "read", None), ok(0, "read", [7, 2]),
+        invoke(1, "read", None), ok(1, "read", [7, 2]),
+    ]).index()
+    assert cr.MultiVersionChecker().check({}, good, {})["valid?"]
+    bad = History([
+        invoke(0, "read", None), ok(0, "read", [7, 2]),
+        invoke(1, "read", None), ok(1, "read", [8, 2]),
+    ]).index()
+    res = cr.MultiVersionChecker().check({}, bad, {})
+    assert res["valid?"] is False and "v2" in res["multis"]
+
+
+def test_lost_updates_checker():
+    h = History([
+        invoke(0, "add", 1), ok(0, "add", 1),
+        invoke(1, "add", 2), ok(1, "add", 2),
+        invoke(2, "add", 9), fail(2, "add", 9),
+        invoke(0, "read", None), ok(0, "read", [1]),
+    ]).index()
+    res = cr.LostUpdatesChecker().check({}, h, {})
+    assert res["valid?"] is False
+    assert res["lost"] == [2]               # acked but missing
+    # the failed add (9) must NOT count as lost
+    assert 9 not in res["lost"]
+
+
+def test_dirty_read_checker():
+    h = History([
+        invoke(0, "write", 0), ok(0, "write", 0),
+        invoke(1, "read", 0), ok(1, "read", 0),
+        invoke(2, "read", 5), ok(2, "read", 5),   # never visible!
+        invoke(0, "strong-read", None), ok(0, "strong-read", [0, 1]),
+        invoke(1, "strong-read", None), ok(1, "strong-read", [0, 1]),
+    ]).index()
+    res = cr.DirtyReadChecker().check({}, h, {})
+    assert res["valid?"] is False
+    assert res["dirty"] == [5]
+    assert res["nodes-agree?"] is True
+    h2 = History([
+        invoke(0, "write", 0), ok(0, "write", 0),
+        invoke(0, "strong-read", None), ok(0, "strong-read", [0]),
+        invoke(1, "strong-read", None), ok(1, "strong-read", []),
+    ]).index()
+    res2 = cr.DirtyReadChecker().check({}, h2, {})
+    assert res2["valid?"] is False            # replicas disagree
+    assert res2["nodes-agree?"] is False
+
+
+def _options(tmp_path, which, **kw):
+    return {"nodes": kw.pop("nodes", ["c1"]),
+            "concurrency": kw.pop("concurrency", 4),
+            "time_limit": kw.pop("time_limit", 8),
+            "nemesis_interval": kw.pop("nemesis_interval", 2.5),
+            "workload": which,
+            "store_root": str(tmp_path / "store"),
+            "sandbox": str(tmp_path / "cluster"), **kw}
+
+
+@pytest.mark.parametrize("which", ["version-divergence",
+                                   "lost-updates", "dirty-read"])
+def test_full_suite_live(tmp_path, which):
+    done = core.run(cr.crate_test(_options(tmp_path, which)))
+    res = done["results"]
+    assert res["valid?"] is True, res
+
+
+def test_zip_commands():
+    from jepsen_tpu import control as c
+    from jepsen_tpu.control.dummy import DummyRemote
+
+    log: list = []
+    db = cr.CrateDB()
+    test = {"nodes": ["n1", "n2", "n3"]}
+    with c.with_remote(DummyRemote(log)):
+        with c.on("n1"):
+            db.setup(test, "n1")
+            db.teardown(test, "n1")
+    cmds = [x[1] for x in log if isinstance(x[1], str)]
+    joined = "\n".join(cmds)
+    assert "openjdk-8" in joined
+    assert "bin/crate" in joined
+    assert "io.crate.bootstrap.CrateDB" in joined
+    yml = cr.CrateDB.crate_yml(test, "n2")
+    assert '"n1:44300", "n2:44300", "n3:44300"' in yml
+    assert "minimum_master_nodes: 2" in yml
+    assert "psql.port: 5432" in yml

@@ -1,0 +1,190 @@
+"""Ignite suite tests: the thin-protocol handshake/framing, BOTH
+transaction concurrency models on the live mini grid (pessimistic
+lock-wait abort, optimistic-serializable validation failure), the pds
+persistence axis, the runner's config matrix, and register/bank
+end-to-end against LIVE servers (ignite.clj + runner.clj)."""
+
+import subprocess
+import sys
+import time
+
+import pytest
+
+from jepsen_tpu import core
+from jepsen_tpu.dbs import ignite as ig
+
+
+@pytest.fixture()
+def mini(tmp_path):
+    state = {"procs": []}
+
+    def start(pds=True, port=28990, subdir="d"):
+        d = tmp_path / subdir
+        d.mkdir(exist_ok=True)
+        srv_py = d / "miniignite.py"
+        srv_py.write_text(ig.MINIIGNITE_SRC)
+        proc = subprocess.Popen(
+            [sys.executable, str(srv_py), "--port", str(port),
+             "--dir", str(d),
+             "--pds", "true" if pds else "false"],
+            cwd=d)
+        state["procs"].append(proc)
+        deadline = time.monotonic() + 30  # generous: loaded CI
+        while True:
+            try:
+                return ig.IgniteConn("127.0.0.1", port, timeout=3)
+            except OSError:
+                assert time.monotonic() < deadline, "never up"
+                time.sleep(0.1)
+
+    yield start, state
+    for proc in state["procs"]:
+        proc.kill()
+        proc.wait(timeout=10)
+
+
+def test_cache_ops_and_replace(mini):
+    start, _ = mini
+    conn = start()
+    assert conn.get("C", "k") is None
+    conn.put("C", "k", 3)
+    assert conn.get("C", "k") == 3
+    assert conn.replace("C", "k", 3, 4) is True
+    assert conn.replace("C", "k", 3, 5) is False
+    assert conn.get("C", "k") == 4
+    conn.close()
+
+
+def test_pessimistic_lock_wait_aborts(mini):
+    """Two pessimistic txns contending on one entry: the second
+    write must time out (TransactionTimeoutException analog)."""
+    start, _ = mini
+    c1, c2 = start(), ig.IgniteConn("127.0.0.1", 28990, timeout=10)
+    c1.put("C", "x", 0)
+    t1 = c1.tx_start("PESSIMISTIC", "REPEATABLE_READ")
+    c1.put("C", "x", 1, tx=t1)          # t1 holds the entry lock
+    t2 = c2.tx_start("PESSIMISTIC", "REPEATABLE_READ")
+    with pytest.raises(ig.TxConflict):
+        c2.put("C", "x", 2, tx=t2)       # waits, then aborts
+    c1.tx_commit(t1)
+    assert c1.get("C", "x") == 1
+    c1.close()
+    c2.close()
+
+
+def test_optimistic_serializable_validation(mini):
+    """Optimistic-serializable read/write sets validate at commit:
+    the loser of a racing update must get TxConflict."""
+    start, _ = mini
+    c1, c2 = start(), ig.IgniteConn("127.0.0.1", 28990, timeout=5)
+    c1.put("C", "y", 10)
+    t1 = c1.tx_start("OPTIMISTIC", "SERIALIZABLE")
+    t2 = c2.tx_start("OPTIMISTIC", "SERIALIZABLE")
+    assert c1.get("C", "y", tx=t1) == 10
+    assert c2.get("C", "y", tx=t2) == 10
+    c1.put("C", "y", 11, tx=t1)
+    c2.put("C", "y", 12, tx=t2)
+    c1.tx_commit(t1)                     # wins
+    with pytest.raises(ig.TxConflict):
+        c2.tx_commit(t2)                 # version moved: must abort
+    assert c1.get("C", "y") == 11
+    c1.close()
+    c2.close()
+
+
+def test_pds_axis_controls_durability(mini):
+    """pds=true survives a kill -9 + restart; pds=false loses the
+    grid's data — the reference's ##pds## toggle, made observable."""
+    start, state = mini
+    conn = start(pds=True, port=28991, subdir="pds-on")
+    tx = conn.tx_start("PESSIMISTIC", "REPEATABLE_READ")
+    conn.put("C", "durable", 7, tx=tx)
+    conn.tx_commit(tx)
+    conn.close()
+    state["procs"][-1].kill()
+    state["procs"][-1].wait(timeout=10)
+    conn = start(pds=True, port=28992, subdir="pds-on")
+    assert conn.get("C", "durable") == 7    # replayed from the log
+    conn.close()
+
+    conn = start(pds=False, port=28993, subdir="pds-off")
+    conn.put("C", "volatile", 9)
+    conn.close()
+    state["procs"][-1].kill()
+    state["procs"][-1].wait(timeout=10)
+    conn = start(pds=False, port=28994, subdir="pds-off")
+    assert conn.get("C", "volatile") is None  # grid data lost
+    conn.close()
+
+
+def test_config_validation():
+    with pytest.raises(ValueError, match="atomicity"):
+        ig.cache_config({"cache_atomicity": "EVENTUAL"}, "C")
+    with pytest.raises(ValueError, match="concurrency"):
+        ig.transaction_config({"tx_concurrency": "CHAOTIC"})
+    cfg = ig.cache_config({}, "REGISTER")
+    assert cfg["atomicity"] == "TRANSACTIONAL"
+    assert cfg["backups"] == 1
+
+
+def test_matrix_shape(tmp_path):
+    tests = list(ig.ignite_tests(_options(tmp_path, None)))
+    names = [t["name"] for t in tests]
+    # bank sweeps 2 concurrency x 3 isolation; register pins one
+    assert len(tests) == 7
+    assert sum("bank" in n for n in names) == 6
+    assert any("optimistic-serializable" in n for n in names)
+    for t in tests:
+        assert t["tx_config"]["concurrency"] in ig.TX_CONCURRENCY
+
+
+def _options(tmp_path, which, **kw):
+    return {"nodes": kw.pop("nodes", ["i1"]),
+            "concurrency": kw.pop("concurrency", 4),
+            "time_limit": kw.pop("time_limit", 8),
+            "nemesis_interval": kw.pop("nemesis_interval", 2.5),
+            "workload": which,
+            "store_root": str(tmp_path / "store"),
+            "sandbox": str(tmp_path / "grid"), **kw}
+
+
+def test_register_live(tmp_path):
+    done = core.run(ig.ignite_test(_options(tmp_path, "register")))
+    res = done["results"]
+    assert res["valid?"] is True, res
+
+
+@pytest.mark.parametrize("conc,iso", [
+    ("PESSIMISTIC", "REPEATABLE_READ"),
+    ("OPTIMISTIC", "SERIALIZABLE")])
+def test_bank_live(tmp_path, conc, iso):
+    done = core.run(ig.ignite_test(_options(
+        tmp_path, "bank", tx_concurrency=conc, tx_isolation=iso)))
+    res = done["results"]
+    assert res["valid?"] is True, res
+
+
+def test_zip_commands():
+    from jepsen_tpu import control as c
+    from jepsen_tpu.control.dummy import DummyRemote
+
+    log: list = []
+    db = ig.IgniteDB()
+    test = {"nodes": ["n1", "n2", "n3"]}
+    with c.with_remote(DummyRemote(log)):
+        with c.on("n1"):
+            db.setup(test, "n1")
+            db.teardown(test, "n1")
+    cmds = [x[1] for x in log if isinstance(x[1], str)]
+    joined = "\n".join(cmds)
+    assert "/opt/ignite" in joined
+    assert "openjdk-8" in joined
+    assert "bin/ignite.sh" in joined
+    assert "servers=3," in joined          # topology await
+    assert "--activate" in joined
+    assert "CommandLineStartup" in joined  # targeted kill
+    ups = [x[1] for x in log if isinstance(x[1], tuple)
+           and x[1][0] == "upload"]
+    assert any("server-ignite-n1.xml" in str(u[2]) for u in ups)
+    xml = ig.server_xml(test, False, True)
+    assert "n2:47500..47509" in xml and "persistenceEnabled=\"true\"" in xml

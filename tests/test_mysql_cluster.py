@@ -1,0 +1,80 @@
+"""MySQL Cluster suite tests: the three-role node-id/config algebra
+(mysql_cluster.clj:56-117), the deb recipe command assertions, and
+the register workload end-to-end against LIVE mini servers
+(mysql_cluster.clj:187-220)."""
+
+import pytest
+
+from jepsen_tpu import core
+from jepsen_tpu.dbs import mysql_cluster as mc
+
+
+NODES5 = ["n1", "n2", "n3", "n4", "n5"]
+
+
+def test_node_id_blocks():
+    test = {"nodes": NODES5}
+    assert mc.mgmd_node_id(test, "n1") == 1
+    assert mc.mgmd_node_id(test, "n5") == 5
+    assert mc.ndbd_node_id(test, "n1") == 11
+    assert mc.mysqld_node_id(test, "n1") == 21
+    assert mc.mysqld_node_id(test, "n5") == 25
+
+
+def test_ndbd_group_is_first_four():
+    assert mc.ndbd_nodes({"nodes": NODES5}) == ["n1", "n2", "n3", "n4"]
+    assert mc.ndbd_nodes({"nodes": ["a", "b"]}) == ["a", "b"]
+
+
+def test_nodes_conf_sections():
+    test = {"nodes": NODES5}
+    conf = mc.nodes_conf(test)
+    # mgmd + mysqld everywhere, ndbd on the storage group only
+    assert conf.count("[ndb_mgmd]") == 5
+    assert conf.count("[ndbd]") == 4
+    assert conf.count("[mysqld]") == 5
+    assert "NodeId=11" in conf and "NodeId=15" not in conf
+    assert mc.ndb_connect_string(test) == "n1,n2,n3,n4,n5"
+
+
+def test_deb_commands():
+    from jepsen_tpu import control as c
+    from jepsen_tpu.control.dummy import DummyRemote
+
+    log: list = []
+    db = mc.MySQLClusterDB()
+    test = {"nodes": ["n1", "n2", "n3", "n4", "n5"]}
+    with c.with_remote(DummyRemote(log)):
+        with c.on("n5"):
+            db.setup(test, "n5")   # NOT in the storage group
+        with c.on("n1"):
+            db.setup(test, "n1")   # storage + sql + mgmd
+            db.setup_primary(test, "n1")
+            db.teardown(test, "n1")
+    cmds = [x[1] for x in log if isinstance(x[1], str)]
+    joined = "\n".join(cmds)
+    assert "mysql-cluster-gpl" in joined
+    assert "--force-confask --force-confnew" in joined
+    assert "ndb_mgmd" in joined
+    assert joined.count("/ndbd") == 1      # only the storage node
+    assert "mysqld_safe" in joined
+    assert "--ndb-nodeid=11" in joined     # n1's storage id
+    assert "--ndb-nodeid=1" in joined      # n1's mgmd id
+    assert "ndb_mgm -e show" in joined     # primary readiness poll
+    ups = [x[1] for x in log if isinstance(x[1], tuple)
+           and x[1][0] == "upload"]
+    dests = " ".join(str(u[2]) for u in ups)
+    assert "/etc/my.cnf" in dests and "/etc/my.config.ini" in dests
+
+
+def test_register_live(tmp_path):
+    done = core.run(mc.ndb_test({
+        "nodes": ["m1"],
+        "concurrency": 4,
+        "time_limit": 8,
+        "nemesis_interval": 2.5,
+        "store_root": str(tmp_path / "store"),
+        "sandbox": str(tmp_path / "cluster"),
+    }))
+    res = done["results"]
+    assert res["valid?"] is True, res

@@ -1,0 +1,132 @@
+"""Percona suite tests: lock-clause translation on the shared mini
+MySQL server, the bank client's lock_type/in_place axes end-to-end
+against LIVE servers, deadlock-retry behavior, and the deb recipe's
+preseed/bootstrap/stock-dir command assertions
+(percona.clj:34-147,231-293)."""
+
+import subprocess
+import sys
+import time
+
+import pytest
+
+from jepsen_tpu import core
+from jepsen_tpu.dbs import galera as ga
+from jepsen_tpu.dbs import percona as pc
+
+
+# -- mini-server dialect bridge ---------------------------------------------
+
+@pytest.fixture()
+def mini(tmp_path):
+    srv_py = tmp_path / "minimysql.py"
+    srv_py.write_text(ga.MINIMYSQL_SRC)
+    port = 25985
+    proc = subprocess.Popen(
+        [sys.executable, str(srv_py), "--port", str(port),
+         "--dir", str(tmp_path), "--password", ga.MINI_PASSWORD],
+        cwd=tmp_path)
+    deadline = time.monotonic() + 10
+    while True:
+        try:
+            conn = ga.MySqlConn("127.0.0.1", port, timeout=2)
+            break
+        except OSError:
+            assert time.monotonic() < deadline, "never up"
+            time.sleep(0.1)
+    yield conn
+    conn.close()
+    proc.kill()
+    proc.wait(timeout=10)
+
+
+def test_lock_clauses_accepted(mini):
+    """Both MySQL row-lock clauses must survive the dialect bridge."""
+    mini.query("CREATE TABLE accounts "
+               "(id INTEGER PRIMARY KEY, balance BIGINT)")
+    mini.query("INSERT INTO accounts VALUES (0, 50)")
+    rows, _ = mini.query("SELECT balance FROM accounts "
+                         "WHERE id=0 FOR UPDATE")
+    assert rows == [["50"]]
+    rows, _ = mini.query("SELECT balance FROM accounts "
+                         "WHERE id=0 LOCK IN SHARE MODE")
+    assert rows == [["50"]]
+
+
+def test_bad_lock_type_rejected():
+    with pytest.raises(ValueError, match="lock_type"):
+        pc.PerconaBankClient(lock_type="table")
+
+
+# -- full suites against live servers ---------------------------------------
+
+def _options(tmp_path, which, **kw):
+    return {"nodes": kw.pop("nodes", ["p1"]),
+            "concurrency": kw.pop("concurrency", 4),
+            "time_limit": kw.pop("time_limit", 8),
+            "nemesis_interval": kw.pop("nemesis_interval", 2.5),
+            "workload": which,
+            "store_root": str(tmp_path / "store"),
+            "sandbox": str(tmp_path / "cluster"), **kw}
+
+
+@pytest.mark.parametrize("lock,in_place", [
+    ("none", False), ("update", True), ("share", False)])
+def test_bank_live(tmp_path, lock, in_place):
+    done = core.run(pc.percona_test(_options(
+        tmp_path, "bank", lock_type=lock, in_place=in_place)))
+    res = done["results"]
+    assert res["valid?"] is True, res
+
+
+def test_dirty_reads_live(tmp_path):
+    done = core.run(pc.percona_test(_options(tmp_path, "dirty-reads")))
+    assert done["results"]["valid?"] is True, done["results"]
+
+
+def test_test_all_matrix_shape(tmp_path):
+    tests = list(pc.percona_tests(_options(tmp_path, None)))
+    names = [t["name"] for t in tests]
+    # lock/in-place sweep + dirty-reads (percona.clj permutations)
+    assert len(tests) == 5
+    assert any("bank-none" in n for n in names)
+    assert any("bank-update-inplace" in n for n in names)
+    assert any("bank-share" in n for n in names)
+    assert any("dirty-reads" in n for n in names)
+    # deb mode flips the nemesis to a partitioner (percona.clj:212)
+    from jepsen_tpu import nemesis as jn
+    deb = pc.percona_test(_options(tmp_path, "bank", server="deb",
+                                   nodes=["p1", "p2", "p3"]))
+    assert isinstance(deb["nemesis"], jn.Partitioner)
+
+
+# -- deb recipe command assertions ------------------------------------------
+
+def test_deb_commands():
+    from jepsen_tpu import control as c
+    from jepsen_tpu.control.dummy import DummyRemote
+
+    log: list = []
+    db = pc.PerconaDB()
+    test = {"nodes": ["n1", "n2"]}
+    with c.with_remote(DummyRemote(log)):
+        with c.on("n1"):
+            db.setup(test, "n1")
+        with c.on("n2"):
+            db.setup(test, "n2")
+        with c.on("n1"):
+            db.teardown(test, "n1")
+    cmds = [x[1] for x in log if isinstance(x[1], str)]
+    joined = "\n".join(cmds)
+    assert "debconf-set-selections" in joined
+    assert "percona-xtradb-cluster-56" in joined
+    assert joined.count("bootstrap-pxc") == 1   # ONLY the primary
+    assert "cp -rp /var/lib/mysql /var/lib/mysql-stock" in joined
+    # teardown restores the pristine datadir (percona.clj:139-144)
+    assert "cp -rp /var/lib/mysql-stock /var/lib/mysql" in joined
+    ups = [x[1] for x in log if isinstance(x[1], tuple)
+           and x[1][0] == "upload"]
+    assert any("jepsen.cnf" in str(u[2]) for u in ups)
+    # primary's gcomm is EMPTY, joiners carry the full list
+    assert pc.PerconaDB.cluster_address(test, "n1") == "gcomm://"
+    assert pc.PerconaDB.cluster_address(test, "n2") == "gcomm://n1,n2"
