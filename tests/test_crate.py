@@ -24,18 +24,22 @@ def mini(tmp_path):
     proc = subprocess.Popen(
         [sys.executable, str(srv_py), "--port", str(port),
          "--dir", str(tmp_path)], cwd=tmp_path)
-    deadline = time.monotonic() + 10
-    while True:
-        try:
-            conn = PgConn("127.0.0.1", port, timeout=3)
-            break
-        except OSError:
-            assert time.monotonic() < deadline, "never up"
-            time.sleep(0.1)
-    yield conn, port
-    conn.close()
-    proc.kill()
-    proc.wait(timeout=10)
+    conn = None
+    try:
+        deadline = time.monotonic() + 30  # generous: loaded CI
+        while True:
+            try:
+                conn = PgConn("127.0.0.1", port, timeout=3)
+                break
+            except OSError:
+                assert time.monotonic() < deadline, "never up"
+                time.sleep(0.1)
+        yield conn, port
+    finally:
+        if conn is not None:
+            conn.close()
+        proc.kill()
+        proc.wait(timeout=10)
 
 
 def test_version_column_semantics(mini):

@@ -1,0 +1,217 @@
+"""Dgraph suite tests: the mini alpha's MVCC transaction model
+(snapshot reads, write-write conflicts, @upsert index-read conflicts
+— including the REPRODUCED duplicate-uid anomaly when the schema
+lacks @upsert), crash durability, the checkers, and the eight
+workloads end-to-end against LIVE servers (dgraph/src/jepsen/dgraph)."""
+
+import subprocess
+import sys
+import time
+
+import pytest
+
+from jepsen_tpu import core
+from jepsen_tpu.dbs import dgraph as dg
+from jepsen_tpu.history import History, invoke, ok
+from jepsen_tpu.independent import tuple_
+
+
+@pytest.fixture()
+def mini(tmp_path):
+    state = {"procs": []}
+
+    def start(port=27590, subdir="d"):
+        d = tmp_path / subdir
+        d.mkdir(exist_ok=True)
+        srv_py = d / "minidgraph.py"
+        srv_py.write_text(dg.MINIDGRAPH_SRC)
+        proc = subprocess.Popen(
+            [sys.executable, str(srv_py), "--port", str(port),
+             "--dir", str(d)], cwd=d)
+        state["procs"].append(proc)
+        deadline = time.monotonic() + 30
+        while True:
+            try:
+                return dg.DgraphConn("127.0.0.1", port, timeout=3)
+            except (OSError, dg.DgraphError):
+                assert time.monotonic() < deadline, "never up"
+                time.sleep(0.1)
+
+    yield start, state
+    for proc in state["procs"]:
+        proc.kill()
+        proc.wait(timeout=10)
+
+
+def test_snapshot_reads_and_ryw(mini):
+    start, _ = mini
+    conn = start()
+    conn.alter("name: string @index(exact) .")
+    conn.mutate(None, set_objs=[{"name": "a"}], commit_now=True)
+    # a txn's snapshot is fixed at start; its own writes overlay it
+    ts = conn.begin()
+    before = conn.query("{ q(func: eq(name, $n)) { uid } }",
+                        {"n": "a"}, ts=ts)["q"]
+    assert len(before) == 1
+    conn.mutate(ts, set_objs=[{"name": "a"}])
+    ryw = conn.query("{ q(func: eq(name, $n)) { uid } }",
+                     {"n": "a"}, ts=ts)["q"]
+    assert len(ryw) == 2          # read-your-writes
+    # a commit AFTER our start_ts is invisible to us
+    conn.mutate(None, set_objs=[{"name": "a"}], commit_now=True)
+    snap = conn.query("{ q(func: eq(name, $n)) { uid } }",
+                      {"n": "a"}, ts=ts)["q"]
+    assert len(snap) == 2         # still 1 committed + 1 ours
+    conn.abort(ts)
+    conn.close()
+
+
+def test_write_write_conflict(mini):
+    start, _ = mini
+    conn = start()
+    conn.alter("value: int .")
+    uids = conn.mutate(None, set_objs=[{"value": 1}],
+                       commit_now=True)
+    uid = next(iter(uids.values()))
+    t1, t2 = conn.begin(), conn.begin()
+    conn.mutate(t1, set_objs=[{"uid": uid, "value": 2}])
+    conn.mutate(t2, set_objs=[{"uid": uid, "value": 3}])
+    conn.commit(t1)
+    with pytest.raises(dg.TxnConflict):
+        conn.commit(t2)
+    conn.close()
+
+
+def _upsert_race(conn, key):
+    """Two racing insert-unless-exists txns; returns #committed."""
+    t1, t2 = conn.begin(), conn.begin()
+    committed = 0
+    for t in (t1, t2):
+        found = conn.query("{ q(func: eq(email, $e)) { uid } }",
+                           {"e": key}, ts=t)["q"]
+        assert found == []
+        conn.mutate(t, set_objs=[{"email": key}])
+    for t in (t1, t2):
+        try:
+            conn.commit(t)
+            committed += 1
+        except dg.TxnConflict:
+            pass
+    return committed
+
+
+def test_upsert_schema_axis_decides_the_anomaly(mini):
+    """THE dgraph lesson (upsert.clj): without @upsert the index
+    read doesn't conflict and both inserts commit (duplicate uids);
+    with @upsert exactly one wins."""
+    start, _ = mini
+    conn = start()
+    conn.alter("email: string @index(exact) .")     # no @upsert
+    assert _upsert_race(conn, "dup@x") == 2          # anomaly!
+    recs = conn.query("{ q(func: eq(email, $e)) { uid } }",
+                      {"e": "dup@x"})["q"]
+    assert len(recs) == 2
+    conn.alter("email: string @index(exact) @upsert .")
+    assert _upsert_race(conn, "uniq@x") == 1         # cured
+    conn.close()
+
+
+def test_list_pred_and_delete(mini):
+    start, _ = mini
+    conn = start()
+    conn.alter("tags: [int] .")
+    uids = conn.mutate(None, set_objs=[{"tags": 1}],
+                       commit_now=True)
+    uid = next(iter(uids.values()))
+    conn.mutate(None, set_objs=[{"uid": uid, "tags": 2}],
+                commit_now=True)
+    recs = conn.query("{ q(func: uid($u)) { uid tags } }",
+                      {"u": uid})["q"]
+    assert sorted(recs[0]["tags"]) == [1, 2]
+    # whole-node delete clears every pred
+    conn.mutate(None, del_objs=[{"uid": uid}], commit_now=True)
+    recs = conn.query("{ q(func: uid($u)) { uid tags } }",
+                      {"u": uid})["q"]
+    assert recs == []
+    conn.close()
+
+
+def test_crash_durability(mini):
+    start, state = mini
+    conn = start(port=27591, subdir="dur")
+    conn.alter("key: int @index(int) .")
+    conn.mutate(None, set_objs=[{"key": 42, "value": 7}],
+                commit_now=True)
+    conn.close()
+    state["procs"][-1].kill()
+    state["procs"][-1].wait(timeout=10)
+    conn = start(port=27592, subdir="dur")
+    recs = conn.query("{ q(func: eq(key, $k)) { uid value } }",
+                      {"k": 42})["q"]
+    assert len(recs) == 1 and recs[0]["value"] == 7
+    conn.close()
+
+
+def test_upsert_checker():
+    good = History([
+        invoke(0, "upsert", tuple_(1, None)),
+        ok(0, "upsert", tuple_(1, "0x1")),
+        invoke(1, "read", None), ok(1, "read", ["0x1"]),
+    ]).index()
+    assert dg.UpsertChecker().check({}, good, {})["valid?"]
+    bad = History([
+        invoke(0, "read", None), ok(0, "read", ["0x1", "0x2"]),
+    ]).index()
+    res = dg.UpsertChecker().check({}, bad, {})
+    assert res["valid?"] is False and res["bad-reads"]
+
+
+def test_delete_checker():
+    good = History([
+        invoke(0, "read", None), ok(0, "read", []),
+        invoke(1, "read", None),
+        ok(1, "read", [{"uid": "0x1", "key": 3}]),
+    ]).index()
+    assert dg.DeleteChecker().check({}, good,
+                                    {"history_key": 3})["valid?"]
+    bad = History([
+        invoke(0, "read", None),
+        ok(0, "read", [{"uid": "0x1"}]),          # key index stale
+    ]).index()
+    assert dg.DeleteChecker().check({}, bad, {})["valid?"] is False
+
+
+def _options(tmp_path, which, **kw):
+    return {"nodes": kw.pop("nodes", ["d1"]),
+            "concurrency": kw.pop("concurrency", 4),
+            "time_limit": kw.pop("time_limit", 8),
+            "nemesis_interval": kw.pop("nemesis_interval", 2.5),
+            "workload": which,
+            "store_root": str(tmp_path / "store"),
+            "sandbox": str(tmp_path / "cluster"), **kw}
+
+
+@pytest.mark.parametrize("which", sorted(dg.WORKLOADS))
+def test_full_suite_live(tmp_path, which):
+    done = core.run(dg.dgraph_test(_options(tmp_path, which)))
+    res = done["results"]
+    assert res["valid?"] is True, res
+
+
+def test_zip_commands():
+    from jepsen_tpu import control as c
+    from jepsen_tpu.control.dummy import DummyRemote
+
+    log: list = []
+    db = dg.DgraphDB()
+    test = {"nodes": ["n1", "n2", "n3"]}
+    with c.with_remote(DummyRemote(log)):
+        with c.on("n2"):
+            db.setup(test, "n2")
+            db.teardown(test, "n2")
+    cmds = [x[1] for x in log if isinstance(x[1], str)]
+    joined = "\n".join(cmds)
+    assert "zero" in joined and "alpha" in joined
+    assert "--replicas 2" in joined
+    assert "--peer n1:5080" in joined      # joiners point at primary
+    assert "--zero n2:5080" in joined      # alpha at the local zero

@@ -26,18 +26,22 @@ def mini(tmp_path):
         [sys.executable, str(srv_py), "--port", str(port),
          "--dir", str(tmp_path), "--password", ga.MINI_PASSWORD],
         cwd=tmp_path)
-    deadline = time.monotonic() + 10
-    while True:
-        try:
-            conn = ga.MySqlConn("127.0.0.1", port, timeout=2)
-            break
-        except OSError:
-            assert time.monotonic() < deadline, "never up"
-            time.sleep(0.1)
-    yield conn
-    conn.close()
-    proc.kill()
-    proc.wait(timeout=10)
+    conn = None
+    try:
+        deadline = time.monotonic() + 30  # generous: loaded CI
+        while True:
+            try:
+                conn = ga.MySqlConn("127.0.0.1", port, timeout=2)
+                break
+            except OSError:
+                assert time.monotonic() < deadline, "never up"
+                time.sleep(0.1)
+        yield conn
+    finally:
+        if conn is not None:
+            conn.close()
+        proc.kill()
+        proc.wait(timeout=10)
 
 
 def test_lock_clauses_accepted(mini):
