@@ -108,12 +108,19 @@ SCHEMA = {}     # pred -> {"upsert": bool, "list": bool}
 # op: "set" | "del" (del with value=None wipes the pred)
 VERSIONS = {}
 NEXT_TS = [1]
+RESERVED_TS = [0]   # durable high-water mark (reserved in blocks)
 NEXT_UID = [1]
 TXNS = {}       # start_ts -> {"writes": [...], "index_reads": set}
 
 def next_ts():
+    """Timestamps must NEVER be reissued across a kill -9 — a
+    reissued start_ts would let a stale client's /commit ack writes
+    that died with the old process. Reserve blocks durably."""
     ts = NEXT_TS[0]
     NEXT_TS[0] += 1
+    if NEXT_TS[0] > RESERVED_TS[0]:
+        RESERVED_TS[0] = NEXT_TS[0] + 1000
+        log_append(["ts", RESERVED_TS[0]])
     return ts
 
 def log_append(rec):
@@ -151,10 +158,11 @@ def replay():
                 apply_schema(rec[1])
             elif rec[0] == "commit":
                 apply_writes(rec[1], [tuple(w) for w in rec[2]])
-                for _, _, _, _ in rec[2]:
-                    pass
             elif rec[0] == "uid":
                 NEXT_UID[0] = max(NEXT_UID[0], rec[1])
+            elif rec[0] == "ts":
+                NEXT_TS[0] = max(NEXT_TS[0], rec[1])
+    RESERVED_TS[0] = max(RESERVED_TS[0], NEXT_TS[0])
 
 def visible(pred, uid, ts, overlay=None):
     """Value(s) of (uid, pred) at snapshot ts (+ txn overlay):
@@ -334,6 +342,12 @@ class H(BaseHTTPRequestHandler):
                                     ts or NEXT_TS[0], txn)
                     return self._reply(200, {"data": res})
                 if path == "/mutate":
+                    if ts and txn is None:
+                        # unknown nonzero startTs: the txn died with
+                        # a previous process — never resurrect it
+                        return self._reply(
+                            409, {"err": "ABORTED: Transaction has "
+                                         "been aborted. Please retry."})
                     if txn is None:
                         txn = {"writes": [], "index_reads": set()}
                     uids = mutate(txn, body)

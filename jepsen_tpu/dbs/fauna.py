@@ -93,11 +93,19 @@ GIANT = threading.Lock()
 CLASSES = {}    # name -> {"history": {id: [(ts, data_or_None)]}}
 INDEXES = {}    # name -> {source, terms, values, serialized}
 NEXT_TS = [1]
+RESERVED_TS = [0]   # durable high-water mark (reserved in blocks)
 NEXT_ID = [1]
 
 def next_ts():
+    """Read-only queries consume timestamps too, and a ts handed to
+    a client must never be reissued after a kill -9 (a later commit
+    landing below an already-returned read ts would fake a
+    monotonicity violation). Reserve blocks durably."""
     ts = NEXT_TS[0]
     NEXT_TS[0] += 1
+    if NEXT_TS[0] > RESERVED_TS[0]:
+        RESERVED_TS[0] = NEXT_TS[0] + 1000
+        log_append(["ts", RESERVED_TS[0]])
     return ts
 
 def log_append(rec):
@@ -130,6 +138,9 @@ def replay():
                 CLASSES.setdefault(rec[1], {})
             elif rec[0] == "id":
                 NEXT_ID[0] = max(NEXT_ID[0], rec[1])
+            elif rec[0] == "ts":
+                NEXT_TS[0] = max(NEXT_TS[0], rec[1])
+    RESERVED_TS[0] = max(RESERVED_TS[0], NEXT_TS[0])
 
 def visible(cls, iid, ts, overlay):
     chain = list(CLASSES.get(cls, {}).get(str(iid), ()))

@@ -81,7 +81,10 @@ class MiniServerDB(jdb.DB, jdb.Process, jdb.Pause, jdb.LogFiles):
             "/usr/bin/python3", self.script,
             "--port", str(self.port(test, node)),
             *self.extra_args(test, node))
-        nodeutil.await_tcp_port(self.port(test, node), timeout_s=30)
+        # generous: on a loaded CI machine a python server's
+        # interpreter start alone can take tens of seconds, and a
+        # too-short wait crashes the nemesis heal path mid-test
+        nodeutil.await_tcp_port(self.port(test, node), timeout_s=90)
 
     def _grepkill(self, test, node):
         nodeutil.grepkill(f"{self.script} --port "

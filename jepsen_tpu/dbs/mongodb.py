@@ -40,7 +40,7 @@ from .. import net as jnet
 from .. import nemesis as jnemesis
 from ..control import nodeutil
 from ..independent import KV, tuple_
-from ..os_setup import Debian
+from ..os_setup import Debian, SmartOS
 from ..workloads import linearizable_register
 
 VERSION = "3.2.0"
@@ -196,19 +196,36 @@ class MongoConn:
 
 # -- DB automation ----------------------------------------------------------
 
+#: the rocks-era build bucket (mongodb_rocks.clj:33-35); the rocksdb
+#: storage engine ships in these debs, not the stock ones
+ROCKS_DEB_URL = ("https://s3.amazonaws.com/parse-mongodb-builds/debs/"
+                 "mongodb-org-server_{v}_amd64.deb")
+
+STORAGE_ENGINES = ("wiredTiger", "rocksdb", "mmapv1")
+
+
 class MongoDB(jdb.DB, jdb.Process, jdb.LogFiles):
     """deb install + mongod --replSet daemon + replica-set initiation
     from the primary, issued over this module's own wire client
-    (mongodb_rocks.clj:29-38 install; core.clj rs-initiate)."""
+    (mongodb_rocks.clj:29-38 install; core.clj rs-initiate). The
+    ``storage_engine`` axis is the whole point of the mongodb-rocks
+    suite (its mongod.conf %ENGINE% template, :41-46): rocksdb
+    engines install from the parse-mongodb-builds bucket."""
 
-    def __init__(self, version: str = VERSION):
+    def __init__(self, version: str = VERSION,
+                 storage_engine: str = "wiredTiger"):
+        if storage_engine not in STORAGE_ENGINES:
+            raise ValueError(f"storage_engine {storage_engine!r} "
+                             f"not in {STORAGE_ENGINES}")
         self.version = version
+        self.storage_engine = storage_engine
 
     def _start(self, test, node):
         nodeutil.start_daemon(
             {"logfile": LOGFILE, "pidfile": PIDFILE, "chdir": "/"},
             "mongod",
             "--replSet", REPL_SET,
+            "--storageEngine", self.storage_engine,
             "--dbpath", DATA_DIR,
             "--port", str(PORT),
             "--bind_ip", "0.0.0.0",
@@ -217,10 +234,12 @@ class MongoDB(jdb.DB, jdb.Process, jdb.LogFiles):
         nodeutil.await_tcp_port(PORT, timeout_s=120)
 
     def setup(self, test, node):
+        url = (ROCKS_DEB_URL if self.storage_engine == "rocksdb"
+               else DEB_URL)
         with control.su():
             # atomic node-local download cache: a partial wget must
             # not poison later setups
-            deb = nodeutil.cached_wget(DEB_URL.format(v=self.version))
+            deb = nodeutil.cached_wget(url.format(v=self.version))
             control.exec_("dpkg", "-i", "--force-confnew", deb)
             control.exec_("mkdir", "-p", DATA_DIR,
                           "/var/log/mongodb")
@@ -352,31 +371,109 @@ class MongoClient(jclient.Client):
             self.conn.close()
 
 
+class LoggerClient(MongoClient):
+    """The mongodb-rocks logger queue (mongodb_rocks.clj:87-146):
+    writes insert timestamped payload documents; deletes
+    find-and-modify the OLDEST by time out (sort {time: 1}, remove).
+    The payload is trimmed from the reference's 100 KiB to keep CI
+    wire traffic sane; the shape is identical."""
+
+    COLL = "logger"
+    PAYLOAD = "x" * 4096
+
+    def invoke(self, test, op):
+        f = op["f"]
+        try:
+            if f == "write":
+                self._conn(test).cmd({
+                    "insert": self.COLL, "$db": self.DB_NAME,
+                    "documents": [{"_id": str(op["value"]),
+                                   "time": int(op["time_ms"]),
+                                   "payload": self.PAYLOAD}],
+                    "writeConcern": {"w": self.write_concern}})
+                return {**op, "type": "ok"}
+            if f == "delete":
+                reply = self._conn(test).cmd({
+                    "findAndModify": self.COLL,
+                    "$db": self.DB_NAME,
+                    "query": {}, "sort": {"time": 1},
+                    "remove": True})
+                doc = reply.get("value")
+                if doc is None:
+                    return {**op, "type": "fail"}
+                return {**op, "type": "ok", "value": doc["_id"]}
+            raise ValueError(f"unknown op {f!r}")
+        except (OSError, ConnectionError, MongoError, KeyError) as e:
+            if self.conn is not None:
+                self.conn.close()
+                self.conn = None
+            return {**op, "type": "info", "error": str(e)[:200]}
+
+
+def _logger_workload(options):
+    """mongodb_rocks.clj:131-146: 2:1 write/delete mix, latency
+    checker."""
+    counter = iter(range(10 ** 9))
+    clock = iter(range(10 ** 12))
+
+    def write(test, ctx):
+        return {"f": "write", "value": f"t-{next(counter)}",
+                "time_ms": next(clock)}
+
+    def delete(test, ctx):
+        return {"f": "delete", "value": None}
+
+    return {
+        "client": LoggerClient(
+            write_concern=options.get("write_concern")
+                          or "majority"),
+        "checker": jchecker.perf(),
+        "generator": gen.clients(gen.mix([write, write, delete])),
+    }
+
+
 def mongodb_test(options: dict) -> dict:
     """Register workload under partition-random-halves (the
-    document_cas suite shape)."""
+    document_cas suite shape); ``workload=logger`` swaps in the
+    mongodb-rocks queue; ``os=smartos`` runs the mongodb-smartos
+    path (SmartOS setup + ipfilter partitions)."""
     nodes = options["nodes"]
-    db = MongoDB(options.get("version") or VERSION)
-    w = linearizable_register.workload(
-        {"nodes": nodes,
-         "concurrency": options["concurrency"],
-         "per_key_limit": options.get("per_key_limit") or 100,
-         "algorithm": "competition"})
+    db = MongoDB(options.get("version") or VERSION,
+                 options.get("storage_engine") or "wiredTiger")
+    which = options.get("workload") or "register"
+    if which == "logger":
+        w = _logger_workload(options)
+        client = w["client"]
+    elif which == "register":
+        w = linearizable_register.workload(
+            {"nodes": nodes,
+             "concurrency": options["concurrency"],
+             "per_key_limit": options.get("per_key_limit") or 100,
+             "algorithm": "competition"})
+        client = MongoClient(
+            write_concern=options.get("write_concern") or "majority")
+    else:
+        raise ValueError(f"unknown workload {which!r}")
+    if (options.get("os") or "debian") == "smartos":
+        # the mongodb-smartos path: pkgin setup + ipfilter partitions
+        os_setup, net = SmartOS(), jnet.ipfilter()
+    else:
+        os_setup, net = Debian(), jnet.iptables()
     interval = options.get("nemesis_interval") or 10.0
     return {
-        "name": options.get("name") or f"mongodb-{VERSION}",
+        "name": options.get("name")
+                or f"mongodb-{which}-{db.storage_engine}-{db.version}",
         "store_root": options.get("store_root") or "store",
         "nodes": nodes,
         "concurrency": options["concurrency"],
         "ssh": options.get("ssh") or {},
-        "os": Debian(),
+        "os": os_setup,
         "db": db,
-        "net": jnet.iptables(),
-        "client": MongoClient(
-            write_concern=options.get("write_concern") or "majority"),
+        "net": net,
+        "client": client,
         "nemesis": jnemesis.partition_random_halves(),
         "checker": jchecker.compose({
-            "register": w["checker"],
+            which: w["checker"],
             "exceptions": jchecker.unhandled_exceptions(),
         }),
         "generator": gen.time_limit(
@@ -396,6 +493,15 @@ MONGODB_OPTS = [
             help="Where to write results"),
     cli.Opt("version", metavar="VERSION", default=VERSION,
             help="mongodb-org-server deb version"),
+    cli.Opt("workload", metavar="NAME", default="register",
+            help="register (document-cas) or logger (the "
+                 "mongodb-rocks queue)"),
+    cli.Opt("storage_engine", metavar="ENGINE", default="wiredTiger",
+            help=f"one of {', '.join(STORAGE_ENGINES)} "
+                 "(rocksdb = the mongodb-rocks variant)"),
+    cli.Opt("os", metavar="OS", default="debian",
+            help="debian or smartos (the mongodb-smartos "
+                 "ipfilter path)"),
     cli.Opt("write_concern", metavar="W", default="majority",
             help="write concern for updates (majority, 1, ...)"),
     cli.Opt("per_key_limit", metavar="N", default=100, parse=int,

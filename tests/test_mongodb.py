@@ -96,6 +96,30 @@ class MongoStubHandler(socketserver.StreamRequestHandler):
                         coll[new["_id"]] = dict(new)
                         n += 1
                 return {"ok": 1, "n": n, "nModified": modified}
+            if "insert" in doc:
+                coll = srv.colls.setdefault(doc["insert"], {})
+                for d in doc["documents"]:
+                    if d["_id"] in coll:
+                        return {"ok": 1, "n": 0, "writeErrors": [
+                            {"index": 0, "code": 11000,
+                             "errmsg": "duplicate key"}]}
+                    coll[d["_id"]] = dict(d)
+                return {"ok": 1, "n": len(doc["documents"])}
+            if "findAndModify" in doc:
+                coll = srv.colls.setdefault(doc["findAndModify"], {})
+                docs = list(coll.values())
+                # stable per-field sorts compose primary-first only
+                # when applied in REVERSE field order
+                for field, direction in reversed(list(
+                        (doc.get("sort") or {}).items())):
+                    docs.sort(key=lambda d: d.get(field),
+                              reverse=direction < 0)
+                if not docs:
+                    return {"ok": 1, "value": None}
+                hit = docs[0]
+                if doc.get("remove"):
+                    del coll[hit["_id"]]
+                return {"ok": 1, "value": hit}
             if "replSetInitiate" in doc:
                 return {"ok": 1}
             return {"ok": 0, "errmsg": f"no such command: {doc}"}
@@ -171,3 +195,66 @@ def test_full_suite_with_stub(stub, tmp_path):
     done = core.run(t)
     assert done["results"]["valid?"] is True
     assert done["results"]["register"]["valid?"] is True
+
+
+def test_logger_queue_semantics(stub):
+    """mongodb-rocks' logger queue: inserts + oldest-first
+    find-and-modify removal."""
+    port = stub.server_address[1]
+    cl = mdb.LoggerClient(
+        addr_fn=lambda test, node: ("127.0.0.1", port)).open({}, "n1")
+    for i, t in [("a", 30), ("b", 10), ("c", 20)]:
+        r = cl.invoke({}, {"f": "write", "value": i, "time_ms": t,
+                           "process": 0})
+        assert r["type"] == "ok"
+    # deletes drain in time order: b (10), c (20), a (30)
+    out = [cl.invoke({}, {"f": "delete", "value": None,
+                          "process": 0})["value"] for _ in range(3)]
+    assert out == ["b", "c", "a"]
+    assert cl.invoke({}, {"f": "delete", "value": None,
+                          "process": 0})["type"] == "fail"
+
+
+def test_storage_engine_axis():
+    """mongodb-rocks: the engine rides --storageEngine and rocksdb
+    installs from the parse builds bucket (mongodb_rocks.clj:29-46)."""
+    log: list = []
+    db = mdb.MongoDB(storage_engine="rocksdb")
+    test = {"nodes": ["n1"]}
+    with c.with_remote(DummyRemote(log)):
+        with c.on("n1"):
+            db.setup(test, "n1")
+    cmds = [x[1] for x in log if isinstance(x[1], str)]
+    joined = "\n".join(cmds)
+    assert "--storageEngine rocksdb" in joined
+    # the deb cache keys by URL, so the bucket only shows on a cache
+    # miss; assert the URL selection directly instead
+    assert "parse-mongodb-builds" in mdb.ROCKS_DEB_URL
+    assert "parse-mongodb-builds" not in mdb.DEB_URL
+    with pytest.raises(ValueError, match="storage_engine"):
+        mdb.MongoDB(storage_engine="leveldb")
+
+
+def test_smartos_path(tmp_path):
+    """mongodb-smartos: os=smartos swaps in SmartOS setup and
+    ipfilter partitions."""
+    from jepsen_tpu import net as jnet
+    from jepsen_tpu.os_setup import SmartOS
+    t = mdb.mongodb_test({"nodes": ["n1"], "concurrency": 2,
+                          "os": "smartos",
+                          "store_root": str(tmp_path / "store")})
+    assert isinstance(t["os"], SmartOS)
+    assert isinstance(t["net"], jnet.IPFilter)
+
+
+def test_logger_full_suite_with_stub(stub, tmp_path):
+    port = stub.server_address[1]
+    opts = {"nodes": ["n1", "n2"], "concurrency": 4, "time_limit": 4,
+            "workload": "logger",
+            "store_root": str(tmp_path / "store"),
+            "ssh": {"dummy?": True}}
+    t = mdb.mongodb_test(opts)
+    t["client"].addr_fn = lambda test, node: ("127.0.0.1", port)
+    t["name"] = "mongodb-logger-stub"
+    done = core.run(t)
+    assert done["results"]["valid?"] is True
