@@ -17,8 +17,12 @@ write is visible.
 
 DB automation (core.clj shape): deb-package install, the service
 started with a cluster config listing every node as a unicast host,
-readiness = HTTP port + cluster-health wait. CI runs the client
-against a wire-compatible REST stub (tests/test_elasticsearch.py).
+readiness = HTTP port + cluster-health wait. ``server=mini``
+(default) runs LIVE in-repo REST servers — an fsync'd translog with
+torn-tail replay, the refresh visibility gate for real (restart
+reloads documents but nothing is searchable until the next
+``_refresh``), and a ``--lossy-every`` axis that reproduces the
+acknowledged-insert-loss counterexample against live processes.
 """
 
 from __future__ import annotations
@@ -35,8 +39,9 @@ from .. import cli, client as jclient, control, db as jdb
 from .. import generator as gen
 from .. import net as jnet
 from .. import nemesis as jnemesis
-from ..control import nodeutil
+from ..control import localexec, nodeutil
 from ..os_setup import Debian
+from . import miniserver
 
 VERSION = "1.5.0"  # the era the reference tested (core.clj)
 HTTP_PORT = 9200
@@ -202,6 +207,138 @@ class EsSetClient(jclient.Client):
             self.http.close()
 
 
+# -- the LIVE mini server ----------------------------------------------------
+
+MINI_BASE_PORT = 28300
+
+MINIES_SRC = r'''
+import argparse, json, os, threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+p = argparse.ArgumentParser()
+p.add_argument("--port", type=int, required=True)
+p.add_argument("--dir", default=".")
+p.add_argument("--lossy-every", type=int, default=0,
+               help="drop every Nth acknowledged doc (the famous "
+                    "acked-then-lost partition bug, compressed)")
+args = p.parse_args()
+
+LOG_PATH = os.path.join(args.dir, "minies.jsonl")
+LOCK = threading.Lock()
+DOCS, INDICES, SEARCHABLE = {}, set(), set()
+ACKED = [0]
+
+def log_append(rec):
+    with open(LOG_PATH, "a") as fh:
+        fh.write(json.dumps(rec) + "\n")
+        fh.flush()
+        os.fsync(fh.fileno())
+
+def replay():
+    if not os.path.exists(LOG_PATH):
+        return
+    with open(LOG_PATH) as fh:
+        for line in fh:
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                break  # torn tail
+            if rec[0] == "doc":
+                DOCS[rec[1]] = rec[2]
+            elif rec[0] == "index":
+                INDICES.add(rec[1])
+    # a restart reloads the translog but the segment view starts
+    # cold: nothing is searchable until the next _refresh
+
+class H(BaseHTTPRequestHandler):
+    def log_message(self, *a):
+        pass
+
+    def _reply(self, code, obj):
+        body = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_PUT(self):
+        parts = self.path.strip("/").split("/")
+        n = int(self.headers.get("Content-Length") or 0)
+        doc = json.loads(self.rfile.read(n) or b"{}")
+        if len(parts) == 1:  # index creation with mapping
+            with LOCK:
+                if parts[0] in INDICES:
+                    self._reply(400, {"error": "IndexAlreadyExists"})
+                else:
+                    INDICES.add(parts[0])
+                    log_append(["index", parts[0]])
+                    self._reply(200, {"acknowledged": True})
+            return
+        with LOCK:
+            ACKED[0] += 1
+            drop = (args.lossy_every
+                    and ACKED[0] % args.lossy_every == 0)
+            if not drop:
+                log_append(["doc", parts[-1], doc])
+                DOCS[parts[-1]] = doc
+            self._reply(201, {"result": "created"})
+
+    def do_POST(self):
+        if self.path.endswith("/_refresh"):
+            with LOCK:
+                SEARCHABLE.clear()
+                SEARCHABLE.update(DOCS)
+            self._reply(200, {"_shards": {"failed": 0}})
+            return
+        self._reply(400, {"error": "unsupported"})
+
+    def do_GET(self):
+        if "/_search" in self.path:
+            with LOCK:
+                hits = [{"_id": k, "_source": DOCS[k]}
+                        for k in sorted(SEARCHABLE) if k in DOCS]
+            self._reply(200, {"hits": {"total": len(hits),
+                                       "hits": hits}})
+            return
+        self._reply(404, {"found": False})
+
+replay()
+print("minies serving on", args.port, flush=True)
+ThreadingHTTPServer(("127.0.0.1", args.port), H).serve_forever()
+'''
+
+
+def mini_node_port(test: dict, node: str) -> int:
+    from . import node_port as _shared
+    return _shared(test, node, MINI_BASE_PORT, "es_ports")
+
+
+class MiniEsDB(miniserver.MiniServerDB):
+    """LIVE in-repo REST servers: fsync'd translog with torn-tail
+    replay, the refresh visibility gate FOR REAL (a restart reloads
+    documents but nothing is searchable until the next _refresh), and
+    the --lossy-every counterexample axis."""
+
+    script = "minies.py"
+    src = MINIES_SRC
+    pidfile = "minies.pid"
+    logfile = "minies.log"
+    data_files = ("minies.jsonl",)
+
+    def __init__(self, lossy_every: int = 0):
+        self.lossy_every = lossy_every
+
+    def port(self, test, node):
+        return mini_node_port(test, node)
+
+    def extra_args(self, test, node):
+        args = ["--dir", "."]
+        if self.lossy_every:
+            args += ["--lossy-every", str(self.lossy_every)]
+        return args
+
+
 def elasticsearch_test(options: dict) -> dict:
     """Set workload under partition-random-halves (sets.clj shape:
     adds for the time limit, HEAL the cluster, settle, then every
@@ -210,10 +347,36 @@ def elasticsearch_test(options: dict) -> dict:
     from ..workloads import sets
 
     nodes = options["nodes"]
-    db = ElasticsearchDB(options.get("version") or VERSION)
+    mode = options.get("server") or "mini"
+    client = EsSetClient()
+    if mode == "mini":
+        db: jdb.DB = MiniEsDB(int(options.get("lossy_every") or 0))
+        # the primary holds the one logical store; honor es_ports
+        # overrides the server side (node_port) also honors
+        client.base_url_fn = lambda node, _test={"nodes": nodes,
+                                                 **options}: (
+            "http://127.0.0.1:%d"
+            % mini_node_port(_test, nodes[0]))
+        extra = {
+            "remote": localexec.remote(options.get("sandbox")
+                                       or "es-cluster"),
+            "ssh": {"dummy?": False},
+        }
+        nemesis = jnemesis.node_start_stopper(
+            lambda ns: [ns[0]],
+            lambda test, node: db.kill(test, node),
+            lambda test, node: db.start(test, node))
+    elif mode == "deb":
+        db = ElasticsearchDB(options.get("version") or VERSION)
+        extra = {"ssh": options.get("ssh") or {}, "os": Debian(),
+                 "net": jnet.iptables()}
+        nemesis = jnemesis.partition_random_halves()
+    else:
+        raise ValueError(f"unknown server mode {mode!r}")
     time_limit = options.get("time_limit") or 30
     w = sets.workload()  # checker only; phases built explicitly below
-    interval = options.get("nemesis_interval") or 10.0
+    interval = options.get("nemesis_interval") or (
+        3.0 if mode == "mini" else 10.0)
     add_phase = gen.nemesis(
         gen.time_limit(time_limit,
                        gen.cycle([gen.sleep(interval),
@@ -223,16 +386,15 @@ def elasticsearch_test(options: dict) -> dict:
         gen.time_limit(max(1, time_limit - 2),
                        gen.clients(sets.adds())))
     return {
-        "name": options.get("name") or f"elasticsearch-{VERSION}",
+        "name": options.get("name")
+                or f"elasticsearch-{mode}-{VERSION}",
         "store_root": options.get("store_root") or "store",
         "nodes": nodes,
         "concurrency": options["concurrency"],
-        "ssh": options.get("ssh") or {},
-        "os": Debian(),
         "db": db,
-        "net": jnet.iptables(),
-        "client": EsSetClient(),
-        "nemesis": jnemesis.partition_random_halves(),
+        "client": client,
+        "nemesis": nemesis,
+        **extra,
         "checker": jchecker.compose({
             "sets": w["checker"],
             "exceptions": jchecker.unhandled_exceptions(),
@@ -254,9 +416,17 @@ ELASTICSEARCH_OPTS = [
             help="Where to write results"),
     cli.Opt("version", metavar="VERSION", default=VERSION,
             help="elasticsearch deb version"),
-    cli.Opt("nemesis_interval", metavar="SECONDS", default=10.0,
+    cli.Opt("server", metavar="MODE", default="mini",
+            help="mini (live in-repo REST servers) or deb (real "
+                 "elasticsearch on --ssh nodes)"),
+    cli.Opt("sandbox", metavar="DIR", default="es-cluster"),
+    cli.Opt("lossy_every", metavar="N", default=0, parse=int,
+            help="mini servers drop every Nth acked doc (the "
+                 "acked-then-lost counterexample)"),
+    cli.Opt("nemesis_interval", metavar="SECONDS", default=None,
             parse=float,
-            help="Seconds between partition start/stop"),
+            help="Seconds between fault start/stop (default: 3 in "
+                 "mini mode, 10 in deb mode)"),
 ]
 
 COMMANDS = {

@@ -10,6 +10,12 @@ version-free value-compare CAS transactions, with the standard
 definite/indefinite error discipline (HTTP error = fail for reads,
 info for writes that may have applied).
 
+``server=mini`` runs LIVE in-repo v3-gateway servers (per-key mod
+revisions, txn compare/branch semantics, fsync'd revision log with
+torn-tail replay) under kill/pause faults, so the tutorial exemplar's
+CI exercises real processes; ``server=deb`` (default) is the real
+etcd automation.
+
 Reference surfaces: zookeeper/src/jepsen/zookeeper.clj:1-145 (suite
 shape), doc/tutorial/02-db.md..05-nemesis.md (etcd automation),
 jepsen/src/jepsen/db.clj:11-41 (protocols).
@@ -31,7 +37,8 @@ from .. import cli, client as jclient, control, db as jdb
 from .. import generator as gen
 from .. import net as jnet
 from .. import nemesis as jnemesis
-from ..control import nodeutil
+from ..control import localexec, nodeutil
+from . import miniserver
 from ..independent import KV, tuple_
 from ..os_setup import Debian
 from ..workloads import linearizable_register
@@ -151,6 +158,145 @@ class EtcdDB(jdb.DB, jdb.Process, jdb.Pause, jdb.Primary, jdb.LogFiles):
 
     def log_files(self, test, node):
         return [LOGFILE]
+
+
+# -- the LIVE mini server ----------------------------------------------------
+
+MINI_BASE_PORT = 28500
+
+MINIETCD_SRC = r'''
+import argparse, base64, json, os, threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+p = argparse.ArgumentParser()
+p.add_argument("--port", type=int, required=True)
+p.add_argument("--dir", default=".")
+args = p.parse_args()
+
+LOG_PATH = os.path.join(args.dir, "minietcd.jsonl")
+LOCK = threading.Lock()
+DATA = {}       # key -> (value, mod_revision)
+REV = [0]
+
+def log_append(rec):
+    with open(LOG_PATH, "a") as fh:
+        fh.write(json.dumps(rec) + "\n")
+        fh.flush()
+        os.fsync(fh.fileno())
+
+def put(k, v):
+    REV[0] += 1
+    DATA[k] = (v, REV[0])
+
+def replay():
+    if not os.path.exists(LOG_PATH):
+        return
+    with open(LOG_PATH) as fh:
+        for line in fh:
+            try:
+                k, v, rev = json.loads(line)
+            except ValueError:
+                break  # torn tail
+            DATA[k] = (v, rev)
+            REV[0] = max(REV[0], rev)
+
+def b64(s):
+    return base64.b64encode(s.encode()).decode()
+
+def unb64(s):
+    return base64.b64decode(s).decode()
+
+def kvs_for(k):
+    if k not in DATA:
+        return []
+    v, rev = DATA[k]
+    return [{"key": b64(k), "value": b64(v),
+             "mod_revision": str(rev)}]
+
+def compare_holds(cmp):
+    k = unb64(cmp["key"])
+    if cmp.get("target") == "MOD":
+        have = DATA[k][1] if k in DATA else 0
+        want = cmp.get("mod_revision", cmp.get("modRevision", 0))
+        return have == int(want)
+    want = unb64(cmp["value"])
+    return k in DATA and DATA[k][0] == want
+
+class H(BaseHTTPRequestHandler):
+    def log_message(self, *a):
+        pass
+
+    def _reply(self, obj):
+        body = json.dumps(obj).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_POST(self):
+        n = int(self.headers.get("Content-Length") or 0)
+        req = json.loads(self.rfile.read(n) or b"{}")
+        with LOCK:
+            if self.path == "/v3/kv/put":
+                k, v = unb64(req["key"]), unb64(req["value"])
+                put(k, v)
+                log_append([k, v, REV[0]])
+                self._reply({"header": {}})
+            elif self.path == "/v3/kv/range":
+                kvs = kvs_for(unb64(req["key"]))
+                self._reply({"header": {}, "kvs": kvs,
+                             "count": str(len(kvs))})
+            elif self.path == "/v3/kv/txn":
+                ok = all(compare_holds(c)
+                         for c in req.get("compare") or [])
+                branch = req.get("success" if ok else "failure") or []
+                responses = []
+                for o in branch:
+                    if "requestPut" in o:
+                        pk = unb64(o["requestPut"]["key"])
+                        pv = unb64(o["requestPut"]["value"])
+                        put(pk, pv)
+                        log_append([pk, pv, REV[0]])
+                        responses.append({"responsePut": {}})
+                    elif "requestRange" in o:
+                        kvs = kvs_for(unb64(
+                            o["requestRange"]["key"]))
+                        responses.append(
+                            {"response_range": {"kvs": kvs}})
+                self._reply({"header": {}, "succeeded": ok,
+                             "responses": responses})
+            else:
+                self.send_error(404)
+
+replay()
+print("minietcd serving on", args.port, flush=True)
+ThreadingHTTPServer(("127.0.0.1", args.port), H).serve_forever()
+'''
+
+
+def mini_node_port(test: dict, node: str) -> int:
+    from . import node_port as _shared
+    return _shared(test, node, MINI_BASE_PORT, "etcd_ports")
+
+
+class MiniEtcdDB(miniserver.MiniServerDB):
+    """LIVE in-repo v3-gateway servers: per-key mod revisions, txn
+    compare/branch semantics, fsync'd revision log with torn-tail
+    replay — the tutorial exemplar's CI runs against killable
+    processes like the rest of the family."""
+
+    script = "minietcd.py"
+    src = MINIETCD_SRC
+    pidfile = "minietcd.pid"
+    logfile = "minietcd.log"
+    data_files = ("minietcd.jsonl",)
+
+    def port(self, test, node):
+        return mini_node_port(test, node)
+
+    def extra_args(self, test, node):
+        return ["--dir", "."]
 
 
 class EtcdClient(jclient.Client):
@@ -616,19 +762,43 @@ def etcd_test(options: dict) -> dict:
     `nemesis`: one of NEMESES (partition, kill, pause, none) — the
     tidb-style matrix both axes of `test-all` sweep."""
     nodes = options["nodes"]
-    db = EtcdDB(options.get("version") or VERSION)
+    mode = options.get("server") or "deb"
+    db: jdb.DB = (MiniEtcdDB() if mode == "mini"
+                  else EtcdDB(options.get("version") or VERSION))
     which = options.get("workload") or "register"
     try:
         w = WORKLOADS[which](options)
     except KeyError:
         raise ValueError(f"unknown workload {which!r}; have "
                          f"{sorted(WORKLOADS)}") from None
-    nem_name = options.get("nemesis") or "partition"
-    try:
-        nemesis = NEMESES[nem_name](db)
-    except KeyError:
-        raise ValueError(f"unknown nemesis {nem_name!r}; have "
-                         f"{sorted(NEMESES)}") from None
+    nem_name = options.get("nemesis") or (
+        "kill" if mode == "mini" else "partition")
+    if mode == "mini" and nem_name == "partition":
+        raise ValueError("mini mode has no network to partition; "
+                         "use kill/pause/none")
+    if mode == "mini" and nem_name in ("kill", "pause"):
+        # mini clients pin the primary's store (the galera-family
+        # one-logical-store convention): faults must hit THAT node,
+        # not a random idle placeholder
+        if nem_name == "kill":
+            nemesis = jnemesis.node_start_stopper(
+                lambda ns: [ns[0]],
+                lambda test, node: db.kill(test, node),
+                lambda test, node: db.start(test, node))
+        else:
+            nemesis = jnemesis.node_start_stopper(
+                lambda ns: [ns[0]],
+                lambda test, node: db.pause(test, node),
+                lambda test, node: db.resume(test, node))
+        nem_name_resolved = True
+    else:
+        nem_name_resolved = False
+    if not nem_name_resolved:
+        try:
+            nemesis = NEMESES[nem_name](db)
+        except KeyError:
+            raise ValueError(f"unknown nemesis {nem_name!r}; have "
+                             f"{sorted(NEMESES)}") from None
     interval = options.get("nemesis_interval") or 5.0
     workload_gen = w["generator"]
     time_limit = options.get("time_limit") or 30
@@ -649,15 +819,29 @@ def etcd_test(options: dict) -> dict:
     extra = {k: v for k, v in w.items()
              if k not in ("checker", "generator", "client",
                           "wrap_time")}
+    if mode == "mini":
+        client = w["client"]
+        # the primary holds the one logical store; honor etcd_ports
+        # overrides the server side (node_port) also honors
+        client.base_url_fn = lambda node, _test={"nodes": nodes,
+                                                 **options}: (
+            "http://127.0.0.1:%d"
+            % mini_node_port(_test, nodes[0]))
+        extra.update({
+            "remote": localexec.remote(options.get("sandbox")
+                                       or "etcd-cluster"),
+            "ssh": {"dummy?": False},
+        })
+    else:
+        extra.update({"ssh": options.get("ssh") or {},
+                      "os": Debian(), "net": jnet.iptables()})
     return {
-        "name": options.get("name") or f"etcd-{which}-{nem_name}",
+        "name": options.get("name")
+                or f"etcd-{which}-{nem_name}-{mode}",
         "store_root": options.get("store_root") or "store",
         "nodes": nodes,
         "concurrency": options["concurrency"],
-        "ssh": options.get("ssh") or {},
-        "os": Debian(),
         "db": db,
-        "net": jnet.iptables(),
         "client": w["client"],
         "nemesis": nemesis,
         # No gating stats checker: a short run where some op type
@@ -679,6 +863,9 @@ def etcd_tests(options: dict):
                  else sorted(WORKLOADS))
     nemeses = ([options["nemesis"]] if options.get("nemesis")
                else sorted(NEMESES))
+    if (options.get("server") or "deb") == "mini":
+        # no network to partition over localexec: sweep the rest
+        nemeses = [n for n in nemeses if n != "partition"] or ["kill"]
     for which in workloads:
         for nem in nemeses:
             opts = dict(options, workload=which, nemesis=nem)
@@ -693,6 +880,10 @@ ETCD_OPTS = [
             help="Where to write results"),
     cli.Opt("version", metavar="VERSION", default=VERSION,
             help="etcd release to install"),
+    cli.Opt("server", metavar="MODE", default="deb",
+            help="deb (real etcd on --ssh nodes) or mini (live "
+                 "in-repo v3-gateway servers, kill/pause faults)"),
+    cli.Opt("sandbox", metavar="DIR", default="etcd-cluster"),
     cli.Opt("workload", metavar="NAME", default=None,
             help=f"one of {', '.join(sorted(WORKLOADS))} "
                  "(test: default register; test-all: sweeps all)"),

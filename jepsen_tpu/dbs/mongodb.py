@@ -21,9 +21,12 @@ Write/read concerns ride the command documents (`writeConcern:
 DB automation: deb-package install (mongodb_rocks.clj:29-38 pattern),
 mongod --replSet daemon per node, and replica-set initiation issued
 over this module's own wire client as `replSetInitiate` against the
-primary (the reference drives the same command through monger). CI
-runs the client against a wire-compatible OP_MSG stub
-(tests/test_mongodb.py); no mongod ships in this environment.
+primary (the reference drives the same command through monger).
+``server=mini`` (default) runs LIVE in-repo OP_MSG servers (fsync'd
+mutation log, crash-safe replay) under a kill nemesis — CI exercises
+the real wire + automation + recovery; ``server=deb`` is the real
+replica set under partition-random-halves, with the mongodb-rocks
+``storage_engine`` axis and the mongodb-smartos ``os=smartos`` path.
 """
 
 from __future__ import annotations
@@ -38,10 +41,11 @@ from .. import cli, client as jclient, control, db as jdb
 from .. import generator as gen
 from .. import net as jnet
 from .. import nemesis as jnemesis
-from ..control import nodeutil
+from ..control import localexec, nodeutil
 from ..independent import KV, tuple_
 from ..os_setup import Debian, SmartOS
 from ..workloads import linearizable_register
+from . import miniserver
 
 VERSION = "3.2.0"
 PORT = 27017
@@ -196,6 +200,199 @@ class MongoConn:
 
 # -- DB automation ----------------------------------------------------------
 
+# -- the LIVE mini server ----------------------------------------------------
+
+MINI_BASE_PORT = 28100
+
+MINIMONGO_SRC = r'''
+import argparse, json, os, socketserver, struct, threading
+
+p = argparse.ArgumentParser()
+p.add_argument("--port", type=int, required=True)
+p.add_argument("--dir", default=".")
+args = p.parse_args()
+
+LOG_PATH = os.path.join(args.dir, "minimongo.jsonl")
+LOCK = threading.Lock()
+COLLS = {}
+
+def enc_elem(name, v):
+    nb = name.encode() + b"\x00"
+    if isinstance(v, bool):
+        return b"\x08" + nb + (b"\x01" if v else b"\x00")
+    if isinstance(v, int):
+        if -(2**31) <= v < 2**31:
+            return b"\x10" + nb + struct.pack("<i", v)
+        return b"\x12" + nb + struct.pack("<q", v)
+    if isinstance(v, float):
+        return b"\x01" + nb + struct.pack("<d", v)
+    if isinstance(v, str):
+        sb = v.encode() + b"\x00"
+        return b"\x02" + nb + struct.pack("<i", len(sb)) + sb
+    if v is None:
+        return b"\x0a" + nb
+    if isinstance(v, dict):
+        return b"\x03" + nb + bson_encode(v)
+    if isinstance(v, (list, tuple)):
+        return b"\x04" + nb + bson_encode(
+            {str(i): x for i, x in enumerate(v)})
+    raise TypeError("bson: %r" % type(v))
+
+def bson_encode(doc):
+    body = b"".join(enc_elem(str(k), v) for k, v in doc.items())
+    return struct.pack("<i", len(body) + 5) + body + b"\x00"
+
+def dec_elem(buf, off):
+    tag = buf[off]
+    off += 1
+    end = buf.index(b"\x00", off)
+    name = buf[off:end].decode()
+    off = end + 1
+    if tag == 0x10:
+        return name, struct.unpack_from("<i", buf, off)[0], off + 4
+    if tag == 0x12:
+        return name, struct.unpack_from("<q", buf, off)[0], off + 8
+    if tag == 0x01:
+        return name, struct.unpack_from("<d", buf, off)[0], off + 8
+    if tag == 0x02:
+        n = struct.unpack_from("<i", buf, off)[0]
+        return name, buf[off + 4:off + 4 + n - 1].decode(), off + 4 + n
+    if tag == 0x08:
+        return name, buf[off] == 1, off + 1
+    if tag == 0x0A:
+        return name, None, off
+    if tag in (0x03, 0x04):
+        n = struct.unpack_from("<i", buf, off)[0]
+        sub = bson_decode(buf[off:off + n])
+        if tag == 0x04:
+            sub = [sub[k] for k in sorted(sub, key=int)]
+        return name, sub, off + n
+    raise ValueError("bson tag 0x%02x" % tag)
+
+def bson_decode(buf):
+    out = {}
+    off = 4
+    while buf[off] != 0:
+        name, v, off = dec_elem(buf, off)
+        out[name] = v
+    return out
+
+def log_append(rec):
+    with open(LOG_PATH, "a") as fh:
+        fh.write(json.dumps(rec) + "\n")
+        fh.flush()
+        os.fsync(fh.fileno())
+
+def apply_mut(rec):
+    kind, coll, doc = rec
+    c = COLLS.setdefault(coll, {})
+    if kind == "put":
+        c[doc["_id"]] = doc
+    elif kind == "del":
+        c.pop(doc, None)
+
+def replay():
+    if not os.path.exists(LOG_PATH):
+        return
+    with open(LOG_PATH) as fh:
+        for line in fh:
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                break  # torn tail
+            apply_mut(rec)
+
+def matches(d, flt):
+    return all(d.get(k) == v for k, v in (flt or {}).items())
+
+def dispatch(doc):
+    if "find" in doc:
+        coll = COLLS.get(doc["find"], {})
+        batch = [d for d in coll.values()
+                 if matches(d, doc.get("filter"))]
+        limit = doc.get("limit") or 0
+        if limit:
+            batch = batch[:limit]
+        return {"ok": 1, "cursor": {"id": 0, "firstBatch": batch}}
+    if "update" in doc:
+        coll = COLLS.setdefault(doc["update"], {})
+        n = modified = 0
+        for u in doc["updates"]:
+            q, new = u["q"], u["u"]
+            hits = [d for d in coll.values() if matches(d, q)]
+            if hits:
+                for d in hits:
+                    log_append(["put", doc["update"], new])
+                    apply_mut(["put", doc["update"], new])
+                    n += 1
+                    modified += 1
+            elif u.get("upsert"):
+                log_append(["put", doc["update"], new])
+                apply_mut(["put", doc["update"], new])
+                n += 1
+        return {"ok": 1, "n": n, "nModified": modified}
+    if "insert" in doc:
+        coll = COLLS.setdefault(doc["insert"], {})
+        for d in doc["documents"]:
+            if d["_id"] in coll:
+                return {"ok": 1, "n": 0, "writeErrors": [
+                    {"index": 0, "code": 11000,
+                     "errmsg": "duplicate key"}]}
+            log_append(["put", doc["insert"], d])
+            apply_mut(["put", doc["insert"], d])
+        return {"ok": 1, "n": len(doc["documents"])}
+    if "findAndModify" in doc:
+        coll = COLLS.setdefault(doc["findAndModify"], {})
+        docs = [d for d in coll.values()
+                if matches(d, doc.get("query"))]
+        for field, direction in reversed(list(
+                (doc.get("sort") or {}).items())):
+            docs.sort(key=lambda d: d.get(field),
+                      reverse=direction < 0)
+        if not docs:
+            return {"ok": 1, "value": None}
+        hit = docs[0]
+        if doc.get("remove"):
+            log_append(["del", doc["findAndModify"], hit["_id"]])
+            apply_mut(["del", doc["findAndModify"], hit["_id"]])
+        return {"ok": 1, "value": hit}
+    if "replSetInitiate" in doc or "ping" in doc:
+        return {"ok": 1}
+    return {"ok": 0, "errmsg": "no such command"}
+
+class Conn(socketserver.StreamRequestHandler):
+    def handle(self):
+        while True:
+            hdr = self.rfile.read(16)
+            if len(hdr) < 16:
+                return
+            length, rid, _, opcode = struct.unpack("<iiii", hdr)
+            body = self.rfile.read(length - 16)
+            if len(body) < length - 16 or opcode != 2013:
+                return
+            doc = bson_decode(body[5:])
+            with LOCK:
+                reply = dispatch(doc)
+            out = struct.pack("<I", 0) + b"\x00" + bson_encode(reply)
+            self.wfile.write(struct.pack(
+                "<iiii", 16 + len(out), 0, rid, 2013) + out)
+            self.wfile.flush()
+
+class Server(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+replay()
+print("minimongo serving on", args.port, flush=True)
+Server(("127.0.0.1", args.port), Conn).serve_forever()
+'''
+
+
+def mini_node_port(test: dict, node: str) -> int:
+    from . import node_port as _shared
+    return _shared(test, node, MINI_BASE_PORT, "mongo_ports")
+
+
 #: the rocks-era build bucket (mongodb_rocks.clj:33-35); the rocksdb
 #: storage engine ships in these debs, not the stock ones
 ROCKS_DEB_URL = ("https://s3.amazonaws.com/parse-mongodb-builds/debs/"
@@ -284,6 +481,24 @@ class MongoDB(jdb.DB, jdb.Process, jdb.LogFiles):
 
     def log_files(self, test, node):
         return [LOGFILE]
+
+
+class MiniMongoDB(miniserver.MiniServerDB):
+    """LIVE in-repo OP_MSG servers (fsync'd mutation log, crash-safe
+    replay) — the same promotion consul/zookeeper got: the real wire
+    client and DB automation run against killable processes in CI."""
+
+    script = "minimongo.py"
+    src = MINIMONGO_SRC
+    pidfile = "minimongo.pid"
+    logfile = "minimongo.log"
+    data_files = ("minimongo.jsonl",)
+
+    def port(self, test, node):
+        return mini_node_port(test, node)
+
+    def extra_args(self, test, node):
+        return ["--dir", "."]
 
 
 # -- client -----------------------------------------------------------------
@@ -433,13 +648,14 @@ def _logger_workload(options):
 
 
 def mongodb_test(options: dict) -> dict:
-    """Register workload under partition-random-halves (the
-    document_cas suite shape); ``workload=logger`` swaps in the
-    mongodb-rocks queue; ``os=smartos`` runs the mongodb-smartos
-    path (SmartOS setup + ipfilter partitions)."""
+    """Register workload (the document_cas suite shape);
+    ``workload=logger`` swaps in the mongodb-rocks queue;
+    ``os=smartos`` runs the mongodb-smartos path (SmartOS setup +
+    ipfilter partitions). ``server=mini`` (default) runs LIVE in-repo
+    OP_MSG servers under a kill nemesis; ``server=deb`` is the real
+    replica-set automation under partition-random-halves."""
     nodes = options["nodes"]
-    db = MongoDB(options.get("version") or VERSION,
-                 options.get("storage_engine") or "wiredTiger")
+    mode = options.get("server") or "mini"
     which = options.get("workload") or "register"
     if which == "logger":
         w = _logger_workload(options)
@@ -454,24 +670,46 @@ def mongodb_test(options: dict) -> dict:
             write_concern=options.get("write_concern") or "majority")
     else:
         raise ValueError(f"unknown workload {which!r}")
-    if (options.get("os") or "debian") == "smartos":
-        # the mongodb-smartos path: pkgin setup + ipfilter partitions
-        os_setup, net = SmartOS(), jnet.ipfilter()
+    if mode == "mini":
+        db: jdb.DB = MiniMongoDB()
+        client.addr_fn = lambda test, node: (
+            "127.0.0.1", mini_node_port(test, test["nodes"][0]))
+        extra = {
+            "remote": localexec.remote(options.get("sandbox")
+                                       or "mongo-cluster"),
+            "ssh": {"dummy?": False},
+        }
+        nemesis = jnemesis.node_start_stopper(
+            lambda ns: [ns[0]],
+            lambda test, node: db.kill(test, node),
+            lambda test, node: db.start(test, node))
+    elif mode == "deb":
+        db = MongoDB(options.get("version") or VERSION,
+                     options.get("storage_engine") or "wiredTiger")
+        if (options.get("os") or "debian") == "smartos":
+            # mongodb-smartos path: pkgin setup + ipfilter partitions
+            os_setup, net = SmartOS(), jnet.ipfilter()
+        else:
+            os_setup, net = Debian(), jnet.iptables()
+        extra = {"ssh": options.get("ssh") or {}, "os": os_setup,
+                 "net": net}
+        nemesis = jnemesis.partition_random_halves()
     else:
-        os_setup, net = Debian(), jnet.iptables()
-    interval = options.get("nemesis_interval") or 10.0
+        raise ValueError(f"unknown server mode {mode!r}")
+    engine = (db.storage_engine if isinstance(db, MongoDB)
+              else "mini")
+    version = db.version if isinstance(db, MongoDB) else VERSION
+    interval = options.get("nemesis_interval") or (
+        3.0 if mode == "mini" else 10.0)
     return {
         "name": options.get("name")
-                or f"mongodb-{which}-{db.storage_engine}-{db.version}",
+                or f"mongodb-{which}-{engine}-{version}",
         "store_root": options.get("store_root") or "store",
         "nodes": nodes,
         "concurrency": options["concurrency"],
-        "ssh": options.get("ssh") or {},
-        "os": os_setup,
         "db": db,
-        "net": net,
         "client": client,
-        "nemesis": jnemesis.partition_random_halves(),
+        "nemesis": nemesis,
         "checker": jchecker.compose({
             which: w["checker"],
             "exceptions": jchecker.unhandled_exceptions(),
@@ -484,6 +722,7 @@ def mongodb_test(options: dict) -> dict:
                            gen.sleep(interval),
                            {"type": "info", "f": "stop"}]),
                 w["generator"])),
+        **extra,
     }
 
 
@@ -493,6 +732,10 @@ MONGODB_OPTS = [
             help="Where to write results"),
     cli.Opt("version", metavar="VERSION", default=VERSION,
             help="mongodb-org-server deb version"),
+    cli.Opt("server", metavar="MODE", default="mini",
+            help="mini (live in-repo OP_MSG servers) or deb (real "
+                 "replica set on --ssh nodes)"),
+    cli.Opt("sandbox", metavar="DIR", default="mongo-cluster"),
     cli.Opt("workload", metavar="NAME", default="register",
             help="register (document-cas) or logger (the "
                  "mongodb-rocks queue)"),
@@ -506,9 +749,10 @@ MONGODB_OPTS = [
             help="write concern for updates (majority, 1, ...)"),
     cli.Opt("per_key_limit", metavar="N", default=100, parse=int,
             help="Ops per key"),
-    cli.Opt("nemesis_interval", metavar="SECONDS", default=10.0,
+    cli.Opt("nemesis_interval", metavar="SECONDS", default=None,
             parse=float,
-            help="Seconds between partition start/stop"),
+            help="Seconds between fault start/stop (default: 3 in "
+                 "mini mode, 10 in deb mode)"),
 ]
 
 COMMANDS = {

@@ -135,6 +135,7 @@ def test_db_commands():
 
 def test_full_suite_with_stub(stub, tmp_path):
     opts = {"nodes": ["n1", "n2"], "concurrency": 4, "time_limit": 4,
+            "server": "deb",
             "store_root": str(tmp_path / "store"),
             "ssh": {"dummy?": True}}
     t = es.elasticsearch_test(opts)
@@ -150,12 +151,40 @@ def test_lossy_stub_caught(stub, tmp_path):
     exists to catch — surface as lost elements in the set checker."""
     EsStub.lossy_every = 5
     opts = {"nodes": ["n1"], "concurrency": 2, "time_limit": 3,
+            "server": "deb",
             "store_root": str(tmp_path / "store"),
             "ssh": {"dummy?": True}}
     t = es.elasticsearch_test(opts)
     t["client"] = es.EsSetClient(base_url_fn=lambda node: stub)
     t["name"] = "es-lossy"
     done = core.run(t)
+    sets_res = done["results"]["sets"]
+    assert sets_res["valid?"] is False
+    assert sets_res["set"]["lost-count"] > 0
+
+
+def _mini_options(tmp_path, **kw):
+    return {"nodes": kw.pop("nodes", ["e1"]),
+            "concurrency": kw.pop("concurrency", 4),
+            "time_limit": kw.pop("time_limit", 8),
+            "nemesis_interval": kw.pop("nemesis_interval", 2.5),
+            "store_root": str(tmp_path / "store"),
+            "sandbox": str(tmp_path / "cluster"), **kw}
+
+
+def test_full_suite_live(tmp_path):
+    """LIVE mini-ES processes under the kill/restart nemesis: the
+    fsync'd translog must carry acknowledged docs across kill -9,
+    and the refresh gate must rebuild searchability after restart."""
+    done = core.run(es.elasticsearch_test(_mini_options(tmp_path)))
+    res = done["results"]
+    assert res["valid?"] is True, res
+
+
+def test_lossy_live_caught(tmp_path):
+    """The acked-then-lost counterexample against LIVE servers."""
+    done = core.run(es.elasticsearch_test(_mini_options(
+        tmp_path, lossy_every=5, nemesis_interval=60.0)))
     sets_res = done["results"]["sets"]
     assert sets_res["valid?"] is False
     assert sets_res["set"]["lost-count"] > 0

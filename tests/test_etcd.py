@@ -323,3 +323,17 @@ def test_sequential_suite_with_stub(stub, tmp_path):
     done = _run_suite(stub, tmp_path, "sequential", etcd.EtcdSeqClient)
     assert done["results"]["valid?"] is True, \
         done["results"]["sequential"]
+
+
+def test_full_suite_live_mini(tmp_path):
+    """LIVE mini-etcd processes under the kill/restart nemesis: the
+    fsync'd revision log must carry acknowledged writes across
+    kill -9 (register + CAS over real mod revisions)."""
+    done = core.run(etcd.etcd_test({
+        "nodes": ["t1"], "concurrency": 4, "time_limit": 8,
+        "nemesis_interval": 2.5, "server": "mini",
+        "per_key_limit": 40,
+        "store_root": str(tmp_path / "store"),
+        "sandbox": str(tmp_path / "cluster")}))
+    res = done["results"]
+    assert res["valid?"] is True, res

@@ -185,7 +185,7 @@ def test_db_commands():
 def test_full_suite_with_stub(stub, tmp_path):
     port = stub.server_address[1]
     opts = {"nodes": ["n1", "n2"], "concurrency": 4, "time_limit": 4,
-            "per_key_limit": 15,
+            "per_key_limit": 15, "server": "deb",
             "store_root": str(tmp_path / "store"),
             "ssh": {"dummy?": True}}
     t = mdb.mongodb_test(opts)
@@ -241,7 +241,7 @@ def test_smartos_path(tmp_path):
     from jepsen_tpu import net as jnet
     from jepsen_tpu.os_setup import SmartOS
     t = mdb.mongodb_test({"nodes": ["n1"], "concurrency": 2,
-                          "os": "smartos",
+                          "os": "smartos", "server": "deb",
                           "store_root": str(tmp_path / "store")})
     assert isinstance(t["os"], SmartOS)
     assert isinstance(t["net"], jnet.IPFilter)
@@ -250,7 +250,7 @@ def test_smartos_path(tmp_path):
 def test_logger_full_suite_with_stub(stub, tmp_path):
     port = stub.server_address[1]
     opts = {"nodes": ["n1", "n2"], "concurrency": 4, "time_limit": 4,
-            "workload": "logger",
+            "workload": "logger", "server": "deb",
             "store_root": str(tmp_path / "store"),
             "ssh": {"dummy?": True}}
     t = mdb.mongodb_test(opts)
@@ -258,3 +258,22 @@ def test_logger_full_suite_with_stub(stub, tmp_path):
     t["name"] = "mongodb-logger-stub"
     done = core.run(t)
     assert done["results"]["valid?"] is True
+
+
+def _mini_options(tmp_path, which, **kw):
+    return {"nodes": kw.pop("nodes", ["m1"]),
+            "concurrency": kw.pop("concurrency", 4),
+            "time_limit": kw.pop("time_limit", 8),
+            "nemesis_interval": kw.pop("nemesis_interval", 2.5),
+            "workload": which,
+            "store_root": str(tmp_path / "store"),
+            "sandbox": str(tmp_path / "cluster"), **kw}
+
+
+@pytest.mark.parametrize("which", ["register", "logger"])
+def test_full_suite_live(tmp_path, which):
+    """LIVE mini-mongod processes under the kill/restart nemesis:
+    the wire client, DB automation, and crash recovery all real."""
+    done = core.run(mdb.mongodb_test(_mini_options(tmp_path, which)))
+    res = done["results"]
+    assert res["valid?"] is True, res
