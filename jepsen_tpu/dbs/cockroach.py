@@ -19,10 +19,14 @@ Two workloads, two custom checkers:
   T1 < T2-but-only-T2-visible anomaly — the strict serializability
   violation cockroach's comments workload was built to catch.
 
-The DB-side timestamp expression is configurable: the default
-`strftime('%Y-%m-%d %H:%M:%f','now')` suits the CI pgwire stub (real
-SQL on sqlite, tests/test_postgres.py); a real postgres/cockroach
-endpoint passes e.g. ``now()::text`` / ``cluster_logical_timestamp()``.
+``server=mini`` (default) runs LIVE in-repo pgwire servers (the
+stolon family's WAL + full-fsync sqlite engines) under a kill
+nemesis, so both strict-serializability checkers hold across crash
+recovery in CI; ``--addr`` targets any external pgwire endpoint. The
+DB-side timestamp expression is configurable: the default
+`strftime('%Y-%m-%d %H:%M:%f','now')` suits the sqlite engines; a
+real postgres/cockroach endpoint passes e.g. ``now()::text`` /
+``cluster_logical_timestamp()``.
 """
 
 from __future__ import annotations
@@ -31,9 +35,18 @@ from typing import Optional
 
 from .. import checker as jchecker
 from .. import cli, db as jdb, generator as gen
+from .. import nemesis as jnemesis
+from ..control import localexec
 from ..history import History
-from .postgres import (BEGIN_SQL, PgClientBase, PgError,
+from . import retryclient
+from .postgres import (BEGIN_SQL, PgError, PgRetryClientBase,
                        tag_count)
+
+MINI_BASE_PORT = 28600
+
+#: Pg plumbing + the shared connect-retry window (one copy of the
+#: retrying base lives in postgres.py)
+_CrdbBase = PgRetryClientBase
 
 
 class _ExternalEndpoint(jdb.DB):
@@ -46,6 +59,24 @@ class _ExternalEndpoint(jdb.DB):
     def teardown(self, test, node):
         pass
 
+
+def mini_node_port(test: dict, node: str) -> int:
+    from . import node_port as _shared
+    return _shared(test, node, MINI_BASE_PORT, "crdb_ports")
+
+
+def _mini_db():
+    """LIVE pgwire mini servers — the stolon family's WAL-backed
+    sqlite engine behind the shared pgwire codec, cockroach's own
+    port block."""
+    from .stolon import MiniStolonDB
+
+    class MiniCrdbDB(MiniStolonDB):
+        def port(self, test, node):
+            return mini_node_port(test, node)
+
+    return MiniCrdbDB()
+
 TABLE = "mono"
 COMMENT_TABLES = 3
 SQLITE_TS = "strftime('%Y-%m-%d %H:%M:%f','now')"
@@ -53,7 +84,7 @@ SQLITE_TS = "strftime('%Y-%m-%d %H:%M:%f','now')"
 
 # -- monotonic --------------------------------------------------------------
 
-class MonotonicClient(PgClientBase):
+class MonotonicClient(_CrdbBase):
     """add = one serializable txn: SELECT max(val) -> INSERT max+1
     with a DB timestamp (monotonic.clj:100-125); read = full scan
     ordered by (sts, val) — sts ties (ms clock) are broken by val so
@@ -171,7 +202,7 @@ def id_table(i: int) -> str:
     return f"comment_{i % COMMENT_TABLES}"
 
 
-class CommentsClient(PgClientBase):
+class CommentsClient(_CrdbBase):
     """Blind single-row inserts across N tables + transactional
     multi-table reads (comments.clj:44-82)."""
 
@@ -290,9 +321,10 @@ WORKLOADS = {"monotonic": _w_monotonic, "comments": _w_comments}
 
 
 def cockroach_test(options: dict) -> dict:
-    """Workload over an external pgwire endpoint (the postgres-suite
-    deployment model: the DB lifecycle is NOT managed here — point
-    `addr` at a cockroach/postgres/stub endpoint)."""
+    """``server=mini`` (default): LIVE in-repo pgwire servers under a
+    kill/restart nemesis. ``--addr host:port`` switches to the
+    external-endpoint deployment model (the DB lifecycle is NOT
+    managed — point it at a real cockroach / postgres / stub)."""
     which = options.get("workload") or "monotonic"
     try:
         w = WORKLOADS[which](options)
@@ -300,22 +332,52 @@ def cockroach_test(options: dict) -> dict:
         raise ValueError(f"unknown workload {which!r}; have "
                          f"{sorted(WORKLOADS)}") from None
     client = w["client"]
+    mode = options.get("server") or "mini"
+    workload_gen = w["generator"]
     if options.get("addr"):
+        # explicit endpoint wins: the external deployment model
         host, port = options["addr"].rsplit(":", 1)
         client.addr_fn = lambda test, node: (host, int(port))
+        mode = "external"
+    if mode == "mini":
+        db: jdb.DB = _mini_db()
+        client.addr_fn = lambda test, node: (
+            "127.0.0.1", mini_node_port(test, test["nodes"][0]))
+        nemesis = jnemesis.node_start_stopper(
+            retryclient.kill_targets("mini"),
+            lambda test, node: db.kill(test, node),
+            lambda test, node: db.start(test, node))
+        extra = {
+            "remote": localexec.remote(options.get("sandbox")
+                                       or "crdb-cluster"),
+            "ssh": {"dummy?": False},
+            "nemesis": nemesis,
+        }
+        # both workloads manage their own phases/limits, so the
+        # shared shape runs them unwrapped with a self-bounding fault
+        # stream that stops before monotonic's final reads
+        workload_gen = retryclient.standard_generator(
+            {**w, "wrap_time": False}, nemesis,
+            options.get("nemesis_interval") or 3.0,
+            options.get("time_limit") or 10)
+    elif mode == "external":
+        db = _ExternalEndpoint()
+        extra = {"ssh": {"dummy?": True}}
+    else:
+        raise ValueError(f"unknown server mode {mode!r}")
     return {
-        "name": options.get("name") or f"cockroach-{which}",
+        "name": options.get("name") or f"cockroach-{which}-{mode}",
         "store_root": options.get("store_root") or "store",
         "nodes": options["nodes"],
         "concurrency": options["concurrency"],
-        "ssh": {"dummy?": True},
-        "db": _ExternalEndpoint(),
+        "db": db,
         "client": client,
         "checker": jchecker.compose({
             which: w["checker"],
             "exceptions": jchecker.unhandled_exceptions(),
         }),
-        "generator": w["generator"],
+        "generator": workload_gen,
+        **extra,
     }
 
 
@@ -332,8 +394,15 @@ COCKROACH_OPTS = [
     cli.Opt("store_root", metavar="DIR", default="store"),
     cli.Opt("workload", metavar="NAME", default=None,
             help=f"one of {', '.join(sorted(WORKLOADS))}"),
+    cli.Opt("server", metavar="MODE", default="mini",
+            help="mini (live in-repo pgwire servers, kill faults) "
+                 "or external (point --addr at an endpoint)"),
+    cli.Opt("sandbox", metavar="DIR", default="crdb-cluster"),
+    cli.Opt("nemesis_interval", metavar="SECONDS", default=3.0,
+            parse=float),
     cli.Opt("addr", metavar="HOST:PORT", default=None,
-            help="pgwire endpoint (cockroach / postgres / stub)"),
+            help="pgwire endpoint (cockroach / postgres / stub); "
+                 "implies server=external"),
     cli.Opt("ts_sql", metavar="SQL", default=None,
             help="DB-side timestamp expression (default suits the "
                  "sqlite-backed CI stub; real cockroach: "

@@ -44,7 +44,7 @@ from ..history import History
 from ..independent import KV, tuple_
 from ..os_setup import Debian
 from . import miniserver, retryclient
-from .postgres import PgClientBase, PgError, tag_count
+from .postgres import PgError, PgRetryClientBase, tag_count
 
 VERSION = "2.3.4"  # reference era (crate/project.clj)
 PSQL_PORT = 5432
@@ -296,13 +296,8 @@ class CrateDB(jdb.DB, jdb.Process, jdb.LogFiles):
 
 # -- clients ----------------------------------------------------------------
 
-class _CrateBase(PgClientBase):
+class _CrateBase(PgRetryClientBase):
     """Pg plumbing + the shared connect-retry window."""
-
-    def _conn(self, test):
-        return retryclient.connect_with_retry(
-            lambda: PgClientBase._conn(self, test),
-            (OSError, PgError))
 
 
 class VersionDivergenceClient(_CrateBase):

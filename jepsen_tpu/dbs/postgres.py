@@ -241,6 +241,19 @@ class PgClientBase(jclient.Client):
         self._drop()
 
 
+class PgRetryClientBase(PgClientBase):
+    """Pg plumbing + the family's shared connect-retry window
+    (retryclient.connect_with_retry), for suites whose mini servers
+    get kill -9'd mid-run: ops spanning the restart reconnect instead
+    of spraying connection-refused infos."""
+
+    def _conn(self, test):
+        from .retryclient import connect_with_retry
+        return connect_with_retry(
+            lambda: PgClientBase._conn(self, test),
+            (OSError, PgError))
+
+
 # Serializable isolation: the suite's checkers (bank conservation,
 # elle G2/G-single) assert serializable behavior — postgres's default
 # READ COMMITTED would legitimately fail them on a HEALTHY endpoint.

@@ -114,3 +114,17 @@ def test_tests_fn_sweeps(tmp_path):
         {"nodes": ["n1"], "concurrency": 2,
          "store_root": str(tmp_path)})]
     assert names == ["cockroach-comments", "cockroach-monotonic"]
+
+
+@pytest.mark.parametrize("which", ["monotonic", "comments"])
+def test_full_suite_live(tmp_path, which):
+    """LIVE pgwire mini servers under the kill/restart nemesis: the
+    strict-serializability checkers must hold across crash recovery
+    (WAL + full-fsync engines behind the wire)."""
+    done = core.run(cr.cockroach_test({
+        "nodes": ["c1"], "concurrency": 4, "time_limit": 8,
+        "nemesis_interval": 2.5, "workload": which,
+        "store_root": str(tmp_path / "store"),
+        "sandbox": str(tmp_path / "cluster")}))
+    res = done["results"]
+    assert res["valid?"] is True, res
