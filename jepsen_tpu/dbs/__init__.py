@@ -13,8 +13,9 @@ the shared framework. This package holds this framework's suites:
   surface, a v3 JSON-gateway client, and the tidb-style test-all
   matrix: 8 workloads (register, append, wr, bank, sets,
   long-fork, monotonic, sequential — tidb's workload list)
-  x 4 nemeses (partition, kill, pause, none) — CI-run against a
-  wire-compatible stub.
+  x 4 nemeses (partition, kill, pause, none). `mini` mode runs LIVE
+  in-repo v3-gateway servers (fsync'd revision log) under kill/pause
+  faults in CI; `deb` is the real automation.
 - `redis` — the redis-protocol family (the reference's disque): a
   from-scratch RESP2 codec and CAS as an atomic server-side Lua
   script, with two server modes — `source` builds real redis from the
@@ -29,8 +30,7 @@ the shared framework. This package holds this framework's suites:
   fsync'd AOF, kill -9 recovery — over localexec; `source` mode
   clone-and-makes real disque. CI drives the live path, including a
   deterministic volatile-loss counterexample.
-- `sqlite` — the SQL/ACID family exemplar (standing in for galera /
-  percona / stolon / postgres-rds): a LIVE server wrapping stdlib
+- `sqlite` — the SQL/ACID family exemplar: a LIVE server wrapping stdlib
   sqlite3 behind the shared RESP wire — micro-op txns in one
   serializable BEGIN IMMEDIATE, WAL + synchronous=FULL crash safety —
   driven by elle append/wr and bank workloads under a primary-kill
@@ -45,22 +45,30 @@ the shared framework. This package holds this framework's suites:
   mongodb-smartos): a from-scratch BSON subset codec + OP_MSG wire
   framing, document-CAS via conditional updates (nModified decides),
   write-concern knobs, deb install + replica-set initiation issued
-  over the suite's own wire client (CI-run against a wire-compatible
-  OP_MSG stub).
+  over the suite's own wire client. `mini` mode (default) runs LIVE
+  in-repo OP_MSG servers (fsync'd mutation log) under a kill nemesis
+  in CI; the mongodb-rocks `storage_engine` axis + logger queue and
+  the mongodb-smartos `os=smartos` (SmartOS + ipfilter) path ride the
+  deb mode.
 - `elasticsearch` — the search-engine family
   (elasticsearch/src/jepsen/elasticsearch/sets.clj): set workload
   over the document REST API with the refresh-before-read visibility
-  gate, deb install + unicast-discovery automation; CI proves both
-  the valid path and the famous acknowledged-insert-loss
-  counterexample against a wire-compatible stub.
+  gate, deb install + unicast-discovery automation. `mini` mode
+  (default) runs LIVE servers with an fsync'd translog and a REAL
+  refresh gate (restart reloads docs, nothing searchable until
+  _refresh); the famous acknowledged-insert-loss counterexample runs
+  live via `--lossy-every`.
 - `consul` — the HTTP-KV exemplar (consul/src/jepsen/consul.clj):
   v1/kv client with the reference's two-step INDEX-based CAS recipe,
-  agent automation with primary bootstrap + retry-join (CI-run
-  against a wire-compatible stub).
+  agent automation with primary bootstrap + retry-join; `mini` mode
+  runs LIVE v1/kv servers with fsync'd AOFs under kill and
+  SIGSTOP/SIGCONT faults in CI.
 - `zookeeper` — the reference's minimal single-file exemplar
   (`zookeeper/src/jepsen/zookeeper.clj:1-145`): distro-package
   install, myid/zoo.cfg generation, and a znode CAS-register client
-  over zkCli (CI-run against a scripted remote).
+  over zkCli; `mini` mode runs LIVE znode servers plus an uploaded
+  zkCli-shaped CLI in CI, so the unchanged control-plane client
+  drives real processes.
 - `rabbitmq` — the queue-workload exemplar
   (`rabbitmq/src/jepsen/rabbitmq.clj`): a from-scratch AMQP 0-9-1
   subset codec (method/header/body frames, publisher confirms,
@@ -117,8 +125,8 @@ the shared framework. This package holds this framework's suites:
   and the reconfigure nemesis issuing topology churn through the
   client protocol; live mini servers in CI, apt automation in deb
   mode.
-- `hazelcast` — the data-grid family
-  (`hazelcast/src/jepsen/hazelcast.clj`, standing also for ignite):
+- `hazelcast` — the data-grid primitives family
+  (`hazelcast/src/jepsen/hazelcast.clj`):
   atomic-long unique IDs, CAS longs, queues, CAS'd map sets, and
   fenced locks (mutex-linearizable + fence-monotonic) over a
   from-scratch binary frame protocol; the volatile-lock violation
@@ -140,7 +148,48 @@ the shared framework. This package holds this framework's suites:
   DB timestamps; sts-order must match val-order) and comments (blind
   multi-table inserts; a read seeing w but missing a
   completed-before-w write is the T1<T2-only-T2-visible anomaly).
-  CI-run against the pgwire stub.
+  `mini` mode (default) runs LIVE WAL-backed pgwire servers under a
+  kill nemesis in CI; `--addr` targets any external endpoint.
+- `galera` — the MySQL-replication family
+  (`galera/src/jepsen/galera.clj`): a from-scratch MySQL wire codec
+  (packet framing, mysql_native_password scrambling, COM_QUERY
+  resultsets) over LIVE mini servers; set inserts, explicit-txn bank
+  transfers, and the famous dirty-reads workload.
+- `percona` — the MySQL-transaction exemplar
+  (`percona/src/jepsen/percona.clj`): the bank's lock_type (none /
+  FOR UPDATE / LOCK IN SHARE MODE) and in-place axes swept by
+  test-all, deadlock-abort retries, debconf-preseed + stock-datadir
+  cluster automation. CI-run live on the shared MySQL wire.
+- `mysql_cluster` — NDB's three-role automation
+  (`mysql-cluster/src/jepsen/mysql_cluster.clj`): ndb_mgmd / ndbd /
+  mysqld with node-id blocks 1/11/21 and one shared config.ini, plus
+  a linearizable register over ENGINE=NDBCLUSTER row CAS. CI-run
+  live on the shared MySQL wire.
+- `ignite` — the data-grid cache/transaction exemplar
+  (`ignite/src/jepsen/ignite*.clj`): the runner's configuration
+  lattice (cache atomicity/mode/backups/write-sync x transaction
+  concurrency x isolation) swept by test-all; the LIVE mini grid
+  implements BOTH concurrency models (pessimistic entry locks with
+  wait-timeout aborts, optimistic-serializable commit validation)
+  and a real pds durability axis. CI-run live.
+- `crate` — the _version MVCC family
+  (`crate/src/jepsen/crate/*.clj`): pgwire clients over LIVE mini
+  servers whose dialect bridge maintains a real per-row `_version`;
+  version-divergence, lost-updates, and the refresh/strong-read
+  dirty-read workload with its dirty/lost/not-on-all algebra.
+- `dgraph` — the graph-database exemplar
+  (`dgraph/src/jepsen/dgraph/*.clj`): a LIVE mini alpha implementing
+  dgraph's MVCC transaction model (snapshot reads, write-write
+  commit conflicts, @upsert-gated index-read conflicts — the
+  duplicate-uid upsert anomaly reproduces on demand) under an
+  HTTP/JSON txn protocol; all eight reference workloads. CI-run.
+- `fauna` — the largest reference suite
+  (`faunadb/src/jepsen/faunadb/*.clj`): a from-scratch FQL-subset
+  JSON expression evaluator where every query is one
+  strictly-serializable txn; register CAS via If/Equals,
+  single-query bank, set, pages (the non-serialized paginated-read
+  anomaly demonstrated live), At-temporal monotonic, adya g2.
+  CI-run.
 
 Run one with `python -m jepsen_tpu.dbs.<suite> test --nodes ...`;
 sweep a suite's matrix with `... test-all`.
