@@ -96,6 +96,10 @@ def replay():
                 continue
             if cmd[0].upper() == "SET":
                 DATA[cmd[1]] = cmd[2]
+            elif cmd[0].upper() == "MSET":
+                pairs = cmd[1:]
+                for i in range(0, len(pairs) - 1, 2):
+                    DATA[pairs[i]] = pairs[i + 1]
             elif cmd[0].upper() == "DEL":
                 for k in cmd[1:]:
                     DATA.pop(k, None)
@@ -142,6 +146,26 @@ class Handler(socketserver.StreamRequestHandler):
                 if n:  # acknowledged deletes must survive kill -9 too
                     persist("DEL", *cmd[1:])
                 return b":%d\r\n" % n
+            if op == "MGET":
+                # atomic under LOCK like real single-threaded redis:
+                # the snapshot the long-fork/multi-key reads rely on
+                out = [b"*%d\r\n" % (len(cmd) - 1)]
+                for k in cmd[1:]:
+                    v = DATA.get(k)
+                    if v is None:
+                        out.append(b"$-1\r\n")
+                    else:
+                        b = v.encode()
+                        out.append(b"$%d\r\n%s\r\n" % (len(b), b))
+                return b"".join(out)
+            if op == "MSET":
+                pairs = cmd[1:]
+                if len(pairs) % 2:
+                    return b"-ERR wrong number of arguments\r\n"
+                for i in range(0, len(pairs), 2):
+                    DATA[pairs[i]] = pairs[i + 1]
+                persist("MSET", *pairs)
+                return b"+OK\r\n"
             if op == "EVAL":
                 if cmd[1] != CAS_LUA:
                     return b"-ERR unsupported script\r\n"

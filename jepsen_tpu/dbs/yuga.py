@@ -14,13 +14,17 @@ namespaces. Here the two surfaces ride this package's existing live
 transports:
 
 - **ycql** — the key-value/CQL-flavored surface over the mini-redis
-  RESP transport (dbs/redis.py): GET/SET, atomic server-side CAS.
-  Workloads: set (CAS-loop list under one key), counter (CAS-loop
-  increments), single-key-acid (the linearizable register).
+  RESP transport (dbs/redis.py): GET/SET, atomic server-side CAS,
+  atomic MGET/MSET batches. Workloads: set (CAS-loop list under one
+  key), counter (CAS-loop increments), single-key-acid (the
+  linearizable register), multi-key-acid (txn batches over 3-subkey
+  groups, linearizable against the multi-register model), bank
+  (whole-map CAS transfers), long-fork (MGET snapshots).
 - **ysql** — the SQL surface over the mini-sqlite transport
   (dbs/sqlite.py): serializable TXN micro-ops, conditional-UPDATE
   CAS (CASKV), transactional INCRKV. Workloads: set, counter,
-  single-key-acid, bank, append (elle list-append), long-fork.
+  single-key-acid, multi-key-acid, bank, append (elle list-append),
+  long-fork.
 
 Both run as LIVE per-node subprocesses over localexec, like every
 mini suite, under a kill/restart nemesis.
@@ -91,6 +95,26 @@ class _YcqlBase(jclient.Client):
         return self._conn(test).cmd("EVAL", CAS_LUA, 1, key,
                                     old, new) == 1
 
+    #: CAS-loop retry bound shared by every ycql mutate path
+    CAS_ATTEMPTS = 48
+
+    def _cas_loop(self, test, op, key: str, update):
+        """THE one copy of the GET -> update(cur) -> CAS retry loop.
+        `update(cur)` returns the new serialized value, or a
+        completed op dict to short-circuit (insufficient funds,
+        unseeded key); None means re-seed was issued, retry."""
+        conn = self._conn(test)
+        for _ in range(self.CAS_ATTEMPTS):
+            cur = conn.cmd("GET", key)
+            new = update(cur)
+            if new is None:
+                continue
+            if isinstance(new, dict):
+                return new
+            if self._cas(test, key, cur, new):
+                return {**op, "type": "ok"}
+        return {**op, "type": "info", "error": "cas-contention"}
+
     def close(self, test):
         self._drop()
 
@@ -115,18 +139,17 @@ class YcqlSetClient(_YcqlBase):
             conn = self._conn(test)
             if op["f"] == "add":
                 v = int(op["value"])
-                for _ in range(48):
-                    cur = conn.cmd("GET", SET_KEY)
+
+                def update(cur):
                     if cur is None:
                         # pre-seed window (shouldn't happen: setup
                         # runs first; AOF replay keeps it): never
                         # blind-SET over a racing seeder
                         conn.cmd("SET", SET_KEY, "[]")
-                        continue
-                    new = json.dumps(json.loads(cur) + [v])
-                    if self._cas(test, SET_KEY, cur, new):
-                        return {**op, "type": "ok"}
-                return {**op, "type": "info", "error": "cas-contention"}
+                        return None
+                    return json.dumps(json.loads(cur) + [v])
+
+                return self._cas_loop(test, op, SET_KEY, update)
             if op["f"] == "read":
                 cur = conn.cmd("GET", SET_KEY)
                 return {**op, "type": "ok",
@@ -153,15 +176,14 @@ class YcqlCounterClient(_YcqlBase):
             conn = self._conn(test)
             if op["f"] == "add":
                 d = int(op["value"])
-                for _ in range(48):
-                    cur = conn.cmd("GET", COUNTER_KEY)
+
+                def update(cur):
                     if cur is None:
                         conn.cmd("SET", COUNTER_KEY, "0")
-                        continue
-                    if self._cas(test, COUNTER_KEY, cur,
-                                 str(int(cur) + d)):
-                        return {**op, "type": "ok"}
-                return {**op, "type": "info", "error": "cas-contention"}
+                        return None
+                    return str(int(cur) + d)
+
+                return self._cas_loop(test, op, COUNTER_KEY, update)
             if op["f"] == "read":
                 cur = conn.cmd("GET", COUNTER_KEY)
                 return {**op, "type": "ok",
@@ -171,6 +193,124 @@ class YcqlCounterClient(_YcqlBase):
             self._drop()
             t = "fail" if op["f"] == "read" else "info"
             return {**op, "type": t, "error": str(e)[:200]}
+
+
+class YcqlBankClient(_YcqlBase):
+    """Bank over the KV surface (ycql/bank.clj shape): the account
+    map lives as ONE JSON document under a key; transfers are a CAS
+    loop on the whole map — the single-key atomicity the CQL surface
+    gives cheaply."""
+
+    KEY = "yuga:bank"
+
+    def setup(self, test):
+        conn = self._conn(test)
+        if conn.cmd("GET", self.KEY) is None:
+            accounts = test["accounts"]
+            total = test["total-amount"]
+            per, rem = divmod(total, len(accounts))
+            m = {str(a): per + (1 if i < rem else 0)
+                 for i, a in enumerate(accounts)}
+            conn.cmd("SET", self.KEY, json.dumps(m, sort_keys=True))
+
+    def invoke(self, test, op):
+        f = op["f"]
+        try:
+            conn = self._conn(test)
+            if f == "read":
+                cur = conn.cmd("GET", self.KEY)
+                m = json.loads(cur) if cur else {}
+                return {**op, "type": "ok",
+                        "value": {int(k): v for k, v in m.items()}}
+            if f == "transfer":
+                t = op["value"]
+                src, dst, amt = (str(t["from"]), str(t["to"]),
+                                 t["amount"])
+
+                def update(cur):
+                    if cur is None:
+                        return {**op, "type": "fail",
+                                "error": "unseeded"}
+                    m = json.loads(cur)
+                    if m.get(src, 0) < amt:
+                        return {**op, "type": "fail"}
+                    m[src] = m.get(src, 0) - amt
+                    m[dst] = m.get(dst, 0) + amt
+                    return json.dumps(m, sort_keys=True)
+
+                return self._cas_loop(test, op, self.KEY, update)
+            raise ValueError(f"unknown op {f!r}")
+        except (OSError, ConnectionError, RedisError) as e:
+            self._drop()
+            t = "fail" if f == "read" else "info"
+            return {**op, "type": t, "error": str(e)[:200]}
+
+
+class YcqlTxnClient(_YcqlBase):
+    """Micro-op txns over the KV surface for long-fork /
+    multi-key-acid: all-read txns are ONE atomic MGET snapshot,
+    write mops land in ONE atomic MSET (single-threaded server =
+    real txn atomicity, like CQL batches)."""
+
+    PREFIX = "yuga:mk"
+
+    def _key(self, k) -> str:
+        return f"{self.PREFIX}:{k}"
+
+    def invoke(self, test, op):
+        mops = op["value"]
+        try:
+            conn = self._conn(test)
+            reads = [m for m in mops if m[0] == "r"]
+            writes = [m for m in mops if m[0] == "w"]
+            done = []
+            if writes and reads:
+                # not produced by these workloads; writes-first
+                # would break read-your-txn semantics
+                raise ValueError("mixed r/w txns unsupported on "
+                                 "the ycql KV surface")
+            if writes:
+                flat = []
+                for _, k, v in writes:
+                    flat += [self._key(k), json.dumps(v)]
+                conn.cmd("MSET", *flat)
+                done = [list(m) for m in mops]
+            elif reads:
+                vals = conn.cmd("MGET",
+                                *[self._key(m[1]) for m in reads])
+                done = [["r", m[1],
+                         json.loads(v) if v is not None else None]
+                        for m, v in zip(reads, vals)]
+            return {**op, "type": "ok", "value": done}
+        except (OSError, ConnectionError, RedisError) as e:
+            self._drop()
+            t = "fail" if not any(m[0] == "w" for m in mops) \
+                else "info"
+            return {**op, "type": t, "error": str(e)[:200]}
+
+
+class YcqlMultiKeyClient(YcqlTxnClient):
+    """multi-key-acid over the KV surface: [K [mops]] independent
+    tuples, each group's sub-registers namespaced under K (one
+    worker runs one op at a time, so the group marker is safe
+    instance state)."""
+
+    _group = None
+
+    def _key(self, k) -> str:
+        return f"{self.PREFIX}:{self._group}:{k}"
+
+    def invoke(self, test, op):
+        from ..independent import KV, tuple_
+        kv = op["value"]
+        if not isinstance(kv, KV):
+            raise ValueError(f"want [k mops] tuples, got {kv!r}")
+        K, mops = kv
+        self._group = K
+        done = super().invoke(test, {**op, "value": mops})
+        # re-wrap EVERY completion: the independent layer pairs and
+        # unwraps by tuple, and error paths echoed the raw mops
+        return {**done, "value": tuple_(K, done["value"])}
 
 
 # -- ysql clients (SQL transport) -------------------------------------------
@@ -272,6 +412,29 @@ class YsqlTxnClient(SqliteClient):
                     "error": str(e)[:200]}
 
 
+class YsqlMultiKeyClient(SqliteClient):
+    """multi-key-acid over the SQL surface: the group's mops run in
+    ONE serializable transaction, sub-registers namespaced under K."""
+
+    def invoke(self, test, op):
+        from ..independent import KV, tuple_
+        kv = op["value"]
+        if not isinstance(kv, KV):
+            raise ValueError(f"want [k mops] tuples, got {kv!r}")
+        K, mops = kv
+        keyed = [[m[0], f"yuga:mk:{K}:{m[1]}", m[2]] for m in mops]
+        try:
+            conn = self._conn(test)
+            out = json.loads(conn.cmd("TXN", json.dumps(keyed)))
+            done = [[o[0], m[1], o[2]] for o, m in zip(out, mops)]
+            return {**op, "type": "ok", "value": tuple_(K, done)}
+        except (OSError, ConnectionError, RedisError) as e:
+            self._drop_conn()
+            writes = any(m[0] != "r" for m in mops)
+            return {**op, "type": "info" if writes else "fail",
+                    "error": str(e)[:200]}
+
+
 # -- shared workload fragments ----------------------------------------------
 
 def _counter_workload(options):
@@ -321,6 +484,44 @@ def _long_fork_workload(options):
     return long_fork.workload(n=2)
 
 
+def _multi_key_workload(options):
+    """multi_key_acid.clj:40-70: txns of [r/w k v] mops over a
+    3-subkey group, linearizable against the multi-register model,
+    independent groups."""
+    import itertools
+
+    from .. import independent
+    from ..models import multi_register
+
+    subkeys = [0, 1, 2]
+
+    def _subset():
+        ks = [k for k in subkeys if gen.RNG.random() < 0.5]
+        return ks or [gen.RNG.choice(subkeys)]
+
+    def fgen(K):
+        def r(test, ctx):
+            return {"f": "txn",
+                    "value": [["r", k, None] for k in _subset()]}
+
+        def w(test, ctx):
+            return {"f": "txn",
+                    "value": [["w", k, gen.RNG.randrange(5)]
+                              for k in _subset()]}
+
+        return gen.limit(options.get("per_key_limit") or 40,
+                         gen.mix([r, w]))
+
+    n = max(1, min(int(options["concurrency"]),
+                   2 * len(options["nodes"])))
+    return {
+        "checker": independent.checker(jchecker.linearizable(
+            model=multi_register(), algorithm="competition")),
+        "generator": independent.concurrent_generator(
+            n, itertools.count(), fgen),
+    }
+
+
 def _with_client(workload_fn, client_ctor):
     """core.clj's with-client macro: same workload, swapped client."""
     def build(options):
@@ -336,11 +537,19 @@ WORKLOADS = {
                                          YcqlCounterClient),
     "ycql/single-key-acid": _with_client(_register_workload,
                                          RedisClient),
+    "ycql/multi-key-acid":  _with_client(_multi_key_workload,
+                                         YcqlMultiKeyClient),
+    "ycql/bank":            _with_client(_bank_workload,
+                                         YcqlBankClient),
+    "ycql/long-fork":       _with_client(_long_fork_workload,
+                                         YcqlTxnClient),
     "ysql/set":             _with_client(_set_workload, YsqlSetClient),
     "ysql/counter":         _with_client(_counter_workload,
                                          YsqlCounterClient),
     "ysql/single-key-acid": _with_client(_register_workload,
                                          YsqlRegisterClient),
+    "ysql/multi-key-acid":  _with_client(_multi_key_workload,
+                                         YsqlMultiKeyClient),
     "ysql/bank":            _with_client(_bank_workload,
                                          SqliteBankClient),
     "ysql/append":          _with_client(_append_workload,

@@ -20,12 +20,14 @@ from .core import (
     is_inconsistent,
     Register,
     CASRegister,
+    MultiRegister,
     Mutex,
     FIFOQueue,
     UnorderedQueue,
     NoOp,
     register,
     cas_register,
+    multi_register,
     mutex,
     fifo_queue,
     unordered_queue,
@@ -39,12 +41,14 @@ __all__ = [
     "is_inconsistent",
     "Register",
     "CASRegister",
+    "MultiRegister",
     "Mutex",
     "FIFOQueue",
     "UnorderedQueue",
     "NoOp",
     "register",
     "cas_register",
+    "multi_register",
     "mutex",
     "fifo_queue",
     "unordered_queue",

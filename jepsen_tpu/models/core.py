@@ -169,6 +169,49 @@ class UnorderedQueue(Model):
         return len(self.items) > op_counts.get("enqueue", 0)
 
 
+@dataclass(frozen=True)
+class MultiRegister(Model):
+    """A transactional multi-register (yugabyte's multi-key-acid
+    model, multi_key_acid.clj:16-38): ops carry f="txn" with value =
+    a list of [f k v] micro-ops over independent sub-registers; every
+    mop applies atomically in order. Nil reads are always legal.
+
+    State is a sorted (key, value) tuple so configurations stay
+    hashable for the generic table encoder."""
+
+    state: tuple = ()
+
+    def _get(self, k):
+        for kk, vv in self.state:
+            if kk == k:
+                return vv
+        return None
+
+    def _set(self, k, v) -> "MultiRegister":
+        rest = tuple((kk, vv) for kk, vv in self.state if kk != k)
+        return MultiRegister(tuple(sorted(rest + ((k, v),))))
+
+    def step(self, op):
+        mops = op.value
+        if not isinstance(mops, (list, tuple)):
+            return inconsistent(
+                f"multi-register wants mop lists, got {mops!r}")
+        cur = self
+        for mop in mops:
+            f, k, v = mop
+            if f == "w":
+                cur = cur._set(k, v)
+            elif f == "r":
+                if v is not None and v != cur._get(k):
+                    return inconsistent(
+                        f"can't read {v!r} from key {k!r} "
+                        f"(= {cur._get(k)!r})")
+            else:
+                return inconsistent(
+                    f"unknown mop f {f!r} for multi-register")
+        return cur
+
+
 # -- constructor conveniences (knossos model/register style) --
 def register(value=None) -> Register:
     return Register(value)
@@ -188,6 +231,10 @@ def fifo_queue() -> FIFOQueue:
 
 def unordered_queue() -> UnorderedQueue:
     return UnorderedQueue(frozenset())
+
+
+def multi_register(values: dict = None) -> MultiRegister:
+    return MultiRegister(tuple(sorted((values or {}).items())))
 
 
 def noop() -> NoOp:

@@ -73,3 +73,30 @@ def test_ysql_long_fork_live(tmp_path):
         tmp_path, "ysql/long-fork", time_limit=5)))
     res = done["results"]
     assert res["valid?"] is True, res
+
+
+@pytest.mark.parametrize("which", ["ycql/multi-key-acid",
+                                   "ysql/multi-key-acid"])
+def test_multi_key_acid_live(tmp_path, which):
+    """multi_key_acid.clj: txn batches over 3-subkey groups checked
+    linearizable against the multi-register model, on BOTH API
+    surfaces (atomic MSET/MGET on ycql, serializable TXN on ysql)."""
+    done = core.run(yuga.yuga_test(_options(tmp_path, which)))
+    res = done["results"]
+    assert res["valid?"] is True, res
+
+
+def test_ycql_bank_live(tmp_path):
+    """ycql/bank: conserved totals via whole-map CAS transfers."""
+    done = core.run(yuga.yuga_test(_options(tmp_path, "ycql/bank")))
+    res = done["results"]
+    assert res["valid?"] is True, res
+
+
+def test_ycql_long_fork_live(tmp_path):
+    """ycql/long-fork: MGET snapshots must never expose the G2
+    divergence."""
+    done = core.run(yuga.yuga_test(_options(tmp_path,
+                                            "ycql/long-fork")))
+    res = done["results"]
+    assert res["valid?"] is True, res
