@@ -62,6 +62,7 @@ from .. import cli, control, db as jdb
 from .. import generator as gen
 from .. import independent
 from .. import nemesis as jnemesis
+from .. import net as jnet
 from ..checker import Checker
 from ..control import localexec, nodeutil
 from ..history import History
@@ -481,6 +482,12 @@ class DgraphConn:
         self.base = f"http://{host}:{port}"
         self.http = requests.Session()
         self.timeout = timeout
+        # start_ts of txns this session deliberately finished (commit,
+        # abort, or commitNow mutate): _DgraphBase.txn only swallows a
+        # commit-time ABORTED for these — an ABORTED on an unfinished
+        # txn (e.g. a restarted alpha that lost the startTs) must
+        # surface as fail, never as a false ok.
+        self.finished: set = set()
         # touch the endpoint so the retry window covers startup
         self._post("/query", {"query": "{ q(func: eq(_probe_, 0)) "
                                        "{ uid } }"})
@@ -512,16 +519,21 @@ class DgraphConn:
 
     def mutate(self, ts: Optional[int], set_objs=None, del_objs=None,
                commit_now: bool = False) -> dict:
-        return self._post(
+        uids = self._post(
             "/mutate",
             {"set": set_objs or [], "delete": del_objs or []},
             startTs=ts,
             commitNow="true" if commit_now else "")["uids"]
+        if commit_now and ts is not None:
+            self.finished.add(ts)
+        return uids
 
     def commit(self, ts: int):
         self._post("/commit", {}, startTs=ts)
+        self.finished.add(ts)
 
     def abort(self, ts: int):
+        self.finished.add(ts)
         try:
             self._post("/abort", {}, startTs=ts)
         except (OSError, DgraphError):
@@ -565,7 +577,13 @@ class _DgraphBase(retryclient.RetryClient):
         try:
             conn.commit(ts)
         except DgraphAborted:
-            pass  # body finished it: with-txn's TxnFinishedException
+            # Only a txn the body itself finished (commit/abort/
+            # commitNow) gets the with-txn TxnFinishedException pass;
+            # any other ABORTED (conflict, or a restarted alpha that
+            # no longer knows this startTs) means nothing committed —
+            # guard() turns the re-raise into a fail op.
+            if ts not in conn.finished:
+                raise
         return out
 
     def guard(self, op, body):
@@ -1191,6 +1209,12 @@ def dgraph_test(options: dict) -> dict:
         raise ValueError(f"unknown server mode {mode!r}")
 
     if options.get("nemesis") == "partition":
+        if mode == "mini":
+            raise ValueError("mini mode has no network to partition; "
+                             "use the default kill nemesis")
+        # Partitioner.setup heals test["net"] (nemesis/__init__.py),
+        # so a partition run must carry a Net implementation.
+        extra["net"] = jnet.iptables()
         nemesis = jnemesis.partition_random_halves()
     else:
         nemesis = jnemesis.node_start_stopper(

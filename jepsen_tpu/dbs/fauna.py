@@ -55,6 +55,7 @@ from .. import cli, control, db as jdb
 from .. import generator as gen
 from .. import independent
 from .. import nemesis as jnemesis
+from .. import net as jnet
 from ..checker import Checker
 from ..control import localexec, nodeutil
 from ..history import History
@@ -974,6 +975,12 @@ def fauna_test(options: dict) -> dict:
         raise ValueError(f"unknown server mode {mode!r}")
 
     if options.get("nemesis") == "partition":
+        if mode == "mini":
+            raise ValueError("mini mode has no network to partition; "
+                             "use the default kill nemesis")
+        # Partitioner.setup heals test["net"] (nemesis/__init__.py),
+        # so a partition run must carry a Net implementation.
+        extra["net"] = jnet.iptables()
         nemesis = jnemesis.partition_random_halves()
     else:
         nemesis = jnemesis.node_start_stopper(

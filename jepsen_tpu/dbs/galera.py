@@ -97,6 +97,11 @@ def put_lenenc(n: int) -> bytes:
 
 
 CAPS = (0x00000001   # LONG_PASSWORD
+        | 0x00000002  # FOUND_ROWS: affected-rows = matched, not
+        #               changed — the CAS clients decide success by
+        #               UPDATE ... WHERE value=old row counts, and a
+        #               cas [x, x] against real MySQL would otherwise
+        #               report 0 changed rows = a spurious failure
         | 0x00000008  # CONNECT_WITH_DB
         | 0x00000200  # PROTOCOL_41
         | 0x00002000  # TRANSACTIONS

@@ -47,6 +47,7 @@ from .. import checker as jchecker
 from .. import cli, control, db as jdb
 from .. import generator as gen
 from .. import nemesis as jnemesis
+from .. import net as jnet
 from ..control import localexec, nodeutil
 from ..independent import KV, tuple_
 from ..os_setup import Debian
@@ -708,6 +709,12 @@ def ignite_test(options: dict) -> dict:
 
     # ignite/nemesis.clj: kill-node or partition-random-halves
     if options.get("nemesis") == "partition":
+        if mode == "mini":
+            raise ValueError("mini mode has no network to partition; "
+                             "use the default kill nemesis")
+        # Partitioner.setup heals test["net"] (nemesis/__init__.py),
+        # so a partition run must carry a Net implementation.
+        extra["net"] = jnet.iptables()
         nemesis = jnemesis.partition_random_halves()
     else:
         nemesis = jnemesis.node_start_stopper(

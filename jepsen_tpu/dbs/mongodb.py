@@ -300,7 +300,12 @@ def replay():
                 rec = json.loads(line)
             except ValueError:
                 break  # torn tail
-            apply_mut(rec)
+            try:
+                apply_mut(rec)
+            except Exception:
+                # a malformed record must never brick the boot: skip
+                # it (the write it describes was rejected client-side)
+                continue
 
 def matches(d, flt):
     return all(d.get(k) == v for k, v in (flt or {}).items())
@@ -317,15 +322,22 @@ def dispatch(doc):
     if "update" in doc:
         coll = COLLS.setdefault(doc["update"], {})
         n = modified = 0
-        for u in doc["updates"]:
+        for i, u in enumerate(doc["updates"]):
             q, new = u["q"], u["u"]
+            if "_id" not in new:
+                # validate BEFORE log_append: a durable record that
+                # apply_mut cannot replay would brick every restart
+                return {"ok": 1, "n": n, "writeErrors": [
+                    {"index": i, "code": 9,
+                     "errmsg": "replacement document needs _id"}]}
             hits = [d for d in coll.values() if matches(d, q)]
             if hits:
-                for d in hits:
-                    log_append(["put", doc["update"], new])
-                    apply_mut(["put", doc["update"], new])
-                    n += 1
-                    modified += 1
+                # replacement semantics: one doc replaced (first
+                # match), not one put per hit
+                log_append(["put", doc["update"], new])
+                apply_mut(["put", doc["update"], new])
+                n += 1
+                modified += 1
             elif u.get("upsert"):
                 log_append(["put", doc["update"], new])
                 apply_mut(["put", doc["update"], new])

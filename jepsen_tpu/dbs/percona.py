@@ -39,6 +39,7 @@ import time
 from .. import checker as jchecker
 from .. import cli, control, db as jdb
 from .. import nemesis as jnemesis
+from .. import net as jnet
 from ..control import localexec, nodeutil
 from ..os_setup import Debian
 from . import retryclient
@@ -303,7 +304,10 @@ def percona_test(options: dict) -> dict:
             lambda test, node: db.start(test, node))
     elif mode == "deb":
         db = PerconaDB(options.get("version") or VERSION)
-        extra = {"ssh": options.get("ssh") or {}, "os": Debian()}
+        # Partitioner.setup heals test["net"], so the deb run carries
+        # the iptables Net implementation (nemesis/__init__.py).
+        extra = {"ssh": options.get("ssh") or {}, "os": Debian(),
+                 "net": jnet.iptables()}
         # percona.clj:212 — the suite nemesis is partition-random-
         # halves, not a killer: the anomalies are replication-level
         nemesis = jnemesis.partition_random_halves()

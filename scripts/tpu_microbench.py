@@ -1,0 +1,147 @@
+#!/usr/bin/env python
+"""Microbenchmark the WGL fast-path round's primitives on the current
+platform (run once with JAX_PLATFORMS=tpu, once with cpu) to find where
+the measured ~0.6 ms/round on TPU goes: tiny gathers, the memo-table
+probe chain, scatter, or plain per-op launch overhead inside
+lax.while_loop.
+
+Usage: JAX_PLATFORMS=tpu python scripts/tpu_microbench.py
+"""
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+OUT = {}
+
+
+def bench(name, fn, *args, iters=50, inner=1):
+    """Median wall of fn(*args) after a warmup call; inner = how many
+    device iterations one call covers (report per-iteration)."""
+    r = fn(*args)
+    jax.block_until_ready(r)
+    walls = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        r = fn(*args)
+        jax.block_until_ready(r)
+        walls.append(time.perf_counter() - t0)
+    us = float(np.median(walls)) * 1e6 / inner
+    OUT[name] = round(us, 1)
+    print(f"{name:42s} {us:10.1f} us", flush=True)
+    return r
+
+
+def main():
+    print("platform:", jax.default_backend(), jax.devices(), flush=True)
+    key = jax.random.PRNGKey(0)
+
+    K, W, IC = 16, 32, 8
+    n_pad = 20224
+    H = 1 << 23
+    R = K * (W + IC)
+
+    ret = jnp.asarray(np.random.randint(0, 20000, n_pad, dtype=np.int32))
+    base = jnp.asarray(np.random.randint(0, 9000, K, dtype=np.int32))
+    posc = base[:, None] + jnp.arange(W, dtype=jnp.int32)
+    table = jnp.zeros((H, 4), dtype=jnp.uint32)
+    idx = jnp.asarray(np.random.randint(0, H, R, dtype=np.int32))
+    sig = jnp.asarray(np.random.randint(1, 2**31, (R, 3)).astype(np.uint32))
+
+    # 1. window gather (K, W) from (n_pad,)
+    bench("gather_window_(16,32)_from_20k",
+          jax.jit(lambda p: ret[p]), posc)
+
+    # 2. table row gather (R, 4) from (H, 4)
+    bench("gather_table_(640,4)_from_8M",
+          jax.jit(lambda i: table[i]), idx)
+
+    # 3. table row scatter
+    bench("scatter_table_(640,4)_into_8M",
+          jax.jit(lambda t, i, s: t.at[i].set(
+              jnp.concatenate([s, s[:, :1]], axis=1))), table, idx, sig)
+
+    # 4. 3-key sort of (R,)
+    s0 = sig[:, 0]
+    bench("sort3_(640,)",
+          jax.jit(lambda a, b, c: lax.sort((a, b, c), num_keys=3)),
+          s0, sig[:, 1], sig[:, 2])
+
+    # 5. elementwise u32 block (roughly the bit-math volume of a round)
+    x = jnp.asarray(np.random.randint(0, 2**31, (K, W)).astype(np.uint32))
+
+    def bitmath(v):
+        for _ in range(12):
+            v = (v ^ (v >> 3)) * jnp.uint32(16777619)
+        return v
+    bench("bitmath12_(16,32)", jax.jit(bitmath), x)
+
+    # 6. cumsum + compaction scatter (R,) -> (K,)
+    newm = jnp.asarray(np.random.rand(R) < 0.05)
+
+    def compact(new, vals):
+        posn = jnp.cumsum(new.astype(jnp.int32)) - 1
+        fidx = jnp.where(new & (posn < K), posn, K)
+        return jnp.zeros(K, jnp.int32).at[fidx].set(vals, mode="drop")
+    bench("compact_cumsum_scatter_(640->16)",
+          jax.jit(compact), newm, idx)
+
+    # 7. the real round_body: once per call vs 100 rounds in while_loop
+    from jepsen_tpu.ops.wgl32 import _build_search32
+    init_fn, _ = _build_search32(n_pad=n_pad, ic_pad=IC, S=8, O=16,
+                                 K=K, H=H, B=1 << 18, chunk=1,
+                                 probes=4, W=W)
+    init_fn100, chunk100 = _build_search32(n_pad=n_pad, ic_pad=IC, S=8,
+                                           O=16, K=K, H=H, B=1 << 18,
+                                           chunk=100, probes=4, W=W)
+    inv = jnp.sort(jnp.asarray(
+        np.random.randint(0, 20000, n_pad, dtype=np.int32)))
+    suf = jnp.full(n_pad + 1, 2**31 - 1, dtype=jnp.int32)
+    T = jnp.asarray(np.zeros((8, 16), dtype=np.int32))
+    iinv = jnp.full(IC, 2**31 - 1, dtype=jnp.int32)
+    iopc = jnp.zeros(IC, dtype=jnp.int32)
+    consts = (inv, ret, jnp.zeros(n_pad, jnp.int32), suf, iinv, iopc, T,
+              jnp.int32(10000), jnp.int32(0), jnp.int32(2**30))
+    carry0 = init_fn100(0)
+    chunk_jit = jax.jit(chunk100)
+    # while_loop with chunk=100: per-round cost with NO dispatch from host
+    bench("round_in_whileloop_x100", lambda: chunk_jit(consts, carry0),
+          iters=20, inner=100)
+
+    # 8. same at K=256 (does width amortize per-round overhead?)
+    initb, chunkb = _build_search32(n_pad=n_pad, ic_pad=IC, S=8, O=16,
+                                    K=256, H=H, B=1 << 18, chunk=100,
+                                    probes=4, W=W)
+    carryb = initb(0)
+    chunkb_jit = jax.jit(chunkb)
+    bench("round_in_whileloop_x100_K256",
+          lambda: chunkb_jit(consts, carryb), iters=10, inner=100)
+
+    # 9. K=1024
+    initc, chunkc = _build_search32(n_pad=n_pad, ic_pad=IC, S=8, O=16,
+                                    K=1024, H=H, B=1 << 18, chunk=100,
+                                    probes=4, W=W)
+    carryc = initc(0)
+    chunkc_jit = jax.jit(chunkc)
+    bench("round_in_whileloop_x100_K1024",
+          lambda: chunkc_jit(consts, carryc), iters=10, inner=100)
+
+    # 10. smaller table: H=2^19 (VMEM-scale) — does table size matter?
+    initd, chunkd = _build_search32(n_pad=n_pad, ic_pad=IC, S=8, O=16,
+                                    K=K, H=1 << 19, B=1 << 18, chunk=100,
+                                    probes=4, W=W)
+    carryd = initd(0)
+    chunkd_jit = jax.jit(chunkd)
+    bench("round_in_whileloop_x100_H19",
+          lambda: chunkd_jit(consts, carryd), iters=20, inner=100)
+
+    print("JSON:", json.dumps({"platform": jax.default_backend(),
+                               "us": OUT}), flush=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
