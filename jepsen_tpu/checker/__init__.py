@@ -376,6 +376,32 @@ def _race_competition(model, h, time_limit, device=None,
         return wgl_ref.check(model, h, time_limit=time_limit,
                              stop=winner.is_set)
 
+    def device_cpu():
+        # Platform-aware lane (round-4 VERDICT #3): with an accelerator
+        # adopted, the SAME kernel on a host core wins small and
+        # near-serial shapes (latency-bound rounds, ~9x measured on
+        # the 10k headline) — so the cpu build races too, and the
+        # winning engine names its platform.
+        #
+        # Init caveat (measured live): jax cannot bring up the cpu
+        # backend alone — backends() initializes every plugin, so a
+        # wedged accelerator runtime hangs `local_devices(backend=
+        # "cpu")` too. When the default backend isn't up yet this lane
+        # waits only BRIEFLY (the pure-Python oracle lane covers the
+        # wedged-runtime case) and bows out.
+        from ..util import backend_ready
+        wait = min(10.0, time_limit / 4) if time_limit else 10.0
+        if not backend_ready(wait):
+            return {"valid?": UNKNOWN,
+                    "cause": "backend-init-timeout (cpu lane; "
+                             "pure-host lanes cover this case)"}
+        kw = {}
+        if max_configs is not None:
+            kw["max_configs"] = max_configs
+        return wgl_tpu.check(model, h, time_limit=time_limit,
+                             stop=winner.is_set, enc=enc,
+                             platform="cpu", **kw)
+
     def device_engine():
         # The engine's FIRST device call would trigger backend init,
         # which on a wedged accelerator runtime hangs forever rather
@@ -402,11 +428,17 @@ def _race_competition(model, h, time_limit, device=None,
 
     t_race0 = time.monotonic()
     threads = [arm("device", device_engine), arm("oracle", oracle)]
+    if safe_backend() not in (None, "cpu"):
+        # only when an accelerator is KNOWN to hold the default
+        # backend: on an uninitialized or cpu default the "device"
+        # lane already IS the cpu build, and a second identical
+        # kernel would just contend for the same cores
+        threads.append(arm("device@cpu", device_cpu))
     for t in threads:
         t.start()
     res: dict = {}
     unknowns: dict = {}
-    for _ in range(2):  # take the FIRST definitive verdict
+    for _ in range(len(threads)):  # take the FIRST definitive verdict
         name, r = outcomes.get()
         if r.get("valid?") != UNKNOWN:
             r["engine"] = name
@@ -414,9 +446,9 @@ def _race_competition(model, h, time_limit, device=None,
             break
         unknowns[name] = r
     else:
-        # both unknown: prefer the oracle's cause (it has diagnostics)
+        # all unknown: prefer the oracle's cause (it has diagnostics)
         res = unknowns.get("oracle") or unknowns.get("device") \
-            or {"valid?": UNKNOWN}
+            or unknowns.get("device@cpu") or {"valid?": UNKNOWN}
     # Reap the loser without gating the fast win (it self-cancels at
     # its next stop poll; an uninterruptible first compile can outlive
     # any wait) — flag a still-draining loser so downstream timings
@@ -425,7 +457,7 @@ def _race_competition(model, h, time_limit, device=None,
         t.join(timeout=0.1)
         if t.is_alive():
             res["loser_draining"] = t.name
-    if res.get("engine") == "device":
+    if str(res.get("engine", "")).startswith("device"):
         res = enrich_spare(res, t_race0)
     return res
 

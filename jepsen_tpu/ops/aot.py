@@ -137,7 +137,10 @@ def _wgl_consts_spec(n_pad: int, ic_pad: int, S: int, O: int):
     import jax
     import jax.numpy as jnp
     v = lambda shape: jax.ShapeDtypeStruct(shape, jnp.int32)  # noqa: E731
-    return (v((n_pad,)), v((n_pad,)), v((n_pad,)), v((n_pad,)),
+    # sufminret carries one extra slot (encode.py pads a suffix-min
+    # sentinel past the last op), and the kernels now stack it into
+    # the fused meta table, so the spec must match exactly
+    return (v((n_pad,)), v((n_pad,)), v((n_pad,)), v((n_pad + 1,)),
             v((ic_pad,)), v((ic_pad,)), v((S, O)), v(()), v(()), v(()))
 
 
@@ -152,18 +155,29 @@ def _wgl_analytic(K: int, W: int, ic: int, probes: int = 4) -> dict:
     t_round = bytes_per_round / V5E_PEAK_HBM_BYTES
     return {"analytic_bytes_per_round": bytes_per_round,
             "analytic_round_time_s": t_round,
-            "modeled_configs_per_s_ceiling": int(K / t_round)}
+            "modeled_configs_per_s_ceiling": int(K / t_round),
+            # round-4 calibration: the measured v5e point sits ~10^3-4
+            # below this ceiling — the real rounds are LATENCY-bound
+            # (serialized gather/scatter dependency chains), not
+            # bandwidth-bound. The ceiling stays as compile-level
+            # evidence; bench.py's tpu_measured block prints the
+            # measured configs/s and the model-error factor beside it.
+            "model_status": "uncalibrated bandwidth ceiling; see "
+                            "BENCH tpu_measured.model_error_x"}
 
 
 def wgl32_case(n_pad: int = 16384, ic_pad: int = 8, S: int = 1024,
                O: int = 16, K: int = 16, H: int = 1 << 23,
-               B: int = 1 << 18, chunk: int = 1024, W: int = 8) -> tuple:
+               B: int = 1 << 18, chunk: int = 4096, W: int = 8) -> tuple:
     """The headline shape: a 10k-op cas-register history (n_pad 2^14,
-    register state space, narrow window) through the bitmask kernel."""
+    register state space, narrow window) through the bitmask kernel —
+    compiled with the ACCEL layout and chunk size the chip actually
+    runs (accel=True; the host layout differs, see wgl32 docstring)."""
     import jax
     from .wgl32 import _build_search32
     init_fn, chunk_fn = _build_search32(n_pad, ic_pad, S, O, K, H, B,
-                                        chunk, probes=4, W=W)
+                                        chunk, probes=4, W=W,
+                                        accel=True)
     carry_spec = jax.eval_shape(init_fn, 0)
     return chunk_fn, (_wgl_consts_spec(n_pad, ic_pad, S, O), carry_spec), \
         {"K": K, "W": W, "chunk": chunk,
@@ -172,14 +186,16 @@ def wgl32_case(n_pad: int = 16384, ic_pad: int = 8, S: int = 1024,
 
 def wgln_case(n_pad: int = 4096, ic_pad: int = 8, S: int = 256,
               O: int = 16, K: int = 1024, H: int = 1 << 23,
-              B: int = 1 << 20, chunk: int = 128, W: int = 96,
+              B: int = 1 << 20, chunk: int = 512, W: int = 96,
               L: int = 3) -> tuple:
     """The adversarial-wave shape: W raw 71 -> 96 padded, 3 uint32
-    lanes, production beam — the 2.2M-config bench config's kernel."""
+    lanes, production beam — the 2.2M-config bench config's kernel,
+    compiled with the ACCEL layout and chunk size the chip runs."""
     import jax
     from .wgln import _build_searchN
     init_fn, chunk_fn = _build_searchN(n_pad, ic_pad, S, O, K, H, B,
-                                       chunk, probes=4, W=W, L=L)
+                                       chunk, probes=4, W=W, L=L,
+                                       accel=True)
     carry_spec = jax.eval_shape(init_fn, 0)
     return chunk_fn, (_wgl_consts_spec(n_pad, ic_pad, S, O), carry_spec), \
         {"K": K, "W": W, "L": L, "chunk": chunk,
@@ -210,7 +226,10 @@ def elle_case(n_pad: int = 4096, e_pad: int = 16384, q_pad: int = 256,
         "n_pad": n_pad, "n_sub": n_sub, "iters": iters,
         "analytic_matmul_flops": total_flops,
         "modeled_full_call_time_s": round(t_full, 5),
-        "modeled_mfu_if_mxu_bound": 1.0,
+        # an UPPER BOUND, not a claim: the bench's tpu_measured block
+        # prints the achieved TFLOP/s / MFU next to this model (round-4
+        # VERDICT #4 — measured v5e point: ~50 TFLOP/s, ~25% MFU)
+        "modeled_mfu_upper_bound": 1.0,
         "modeled_tflops_at_peak": round(V5E_PEAK_BF16_FLOPS / 1e12, 1)}
 
 

@@ -113,7 +113,9 @@ def check_routed(model: Model, history: History,
         r["engine"] = "device"
         r["route_reason"] = (
             f"probe hit {probe_cause}; branchy shape "
-            f"(mean_depth {shape['mean_depth']}, W {shape['W_raw']})")
+            f"(mean_depth {shape['mean_depth']}, W {shape['W_raw']}) "
+            f"-> device kernel on platform "
+            f"{r.get('platform', 'unknown')}")
         r["shape"] = shape
         return r
 

@@ -336,7 +336,8 @@ def _pad_to_mult(n: int, m: int) -> int:
     return max(m, ((n + m - 1) // m) * m)
 
 
-def _pick_capacities(W: int, ic_pad: int, n: int):
+def _pick_capacities(W: int, ic_pad: int, n: int,
+                     accel: Optional[bool] = None):
     """Frontier capacity K, memo-table size H, backlog B scaled to the
     problem AND the platform. The (K, W, 2W) successor intermediate is
     the memory driver for the general kernel; the memo table must stay
@@ -349,7 +350,8 @@ def _pick_capacities(W: int, ic_pad: int, n: int):
     # and beam width is the general kernel's throughput knob (configs
     # decided per round scale ~linearly with K at fixed round cost on
     # the TPU, where the (K, W, 2W) gathers are bandwidth-cheap).
-    accel = safe_backend() not in (None, "cpu")
+    if accel is None:
+        accel = safe_backend() not in (None, "cpu")
     budget = (256 if accel else 32) * 1024 * 1024  # bool elements
     K = max(16, min(4096, budget // max(1, 2 * W * W)))
     K = 1 << (K.bit_length() - 1)
@@ -385,22 +387,20 @@ _K_BIG = 512
 
 
 def _widen_frontier(carry, k_new: int):
-    """Pad the frontier arrays of a wgl32 carry from K to k_new rows
+    """Pad the packed frontier (K, C) of a wgl32 carry to k_new rows
     (zeros beyond fr_cnt are inert); backlog/memo/flags ride along."""
     import jax.numpy as jnp
 
-    def pad(a):
-        width = [(0, k_new - a.shape[0])] + [(0, 0)] * (a.ndim - 1)
-        return jnp.pad(a, width)
-
-    return (pad(carry[0]), pad(carry[1]), pad(carry[2]), pad(carry[3]),
-            *carry[4:])
+    fr = carry[0]
+    return (jnp.pad(fr, [(0, k_new - fr.shape[0]), (0, 0)]),
+            *carry[1:])
 
 
 def check(model: Model, history: History, time_limit: Optional[float] = None,
           max_configs: int = 200_000_000, frontier: Optional[int] = None,
           enc: Optional[Encoded] = None,
-          stop: Optional[Callable[[], bool]] = None) -> dict:
+          stop: Optional[Callable[[], bool]] = None,
+          platform: Optional[str] = None) -> dict:
     """Decide linearizability on the accelerator.
 
     Returns {"valid?": True/False/"unknown", ...}. "unknown" (deadline,
@@ -409,6 +409,14 @@ def check(model: Model, history: History, time_limit: Optional[float] = None,
     the caller already holds this history's Encoded (the streamed
     per-key fan-out does). `stop` is polled between device chunks;
     True cancels with cause "cancelled" (competition racing).
+
+    `platform` overrides the engine's platform choice: "cpu" compiles
+    the HOST layout and pins the kernel onto the CPU backend even when
+    an accelerator is the jax default — platform-aware competition
+    (`checker._race_competition`) races device@accel against
+    device@cpu because small/near-serial shapes are latency-bound and
+    the host core wins them (round-4 VERDICT #3). The result carries
+    `platform` so route_reason/engine rows can name it.
     """
     from ..util import backend_ready
 
@@ -442,9 +450,12 @@ def check(model: Model, history: History, time_limit: Optional[float] = None,
         # valid linearization
         return {"valid?": True, "op_count": enc.n_info}
 
+    from ..util import safe_backend
+    accel = (platform or safe_backend()) not in (None, "cpu")
+
     W = enc.window
     ic_pad = len(enc.inv_info)
-    K, H, B = _pick_capacities(W, ic_pad, n)
+    K, H, B = _pick_capacities(W, ic_pad, n, accel=accel)
     if enc.window_raw <= 32:
         # Fast-path sweet spot (measured on the BASELINE model matrix):
         # configs_explored scales ~linearly with K — the search
@@ -460,10 +471,11 @@ def check(model: Model, history: History, time_limit: Optional[float] = None,
         K = frontier  # override breadth only; the memo table must still
         #               fit the config space (see _pick_capacities)
     # Rounds per device call: the deadline/budget/stop signals are only
-    # checked between calls. 1024 keeps fast-path poll granularity a
-    # few seconds while per-call dispatch stays negligible; the packed
-    # wide-window branch below sets its own (128).
-    chunk = 1024
+    # checked between calls — and each poll costs a full device->host
+    # round-trip (~75 ms through the tunneled v5e), so the accelerator
+    # build runs big chunks. 1024 keeps CPU fast-path poll granularity
+    # a few seconds; the packed wide-window branch below sets its own.
+    chunk = 4096 if accel else 1024
     iinv, iopc = enc.inv_info, enc.opcode_info
     if enc.window_raw <= 32:
         # Bitmask fast path: window in one uint32 lane, sort-free dedup.
@@ -480,7 +492,7 @@ def check(model: Model, history: History, time_limit: Optional[float] = None,
         init_fn, chunk_jit = compiled_search32(
             n_pad=len(enc.inv), ic_pad=ic_eff,
             S=enc.table.shape[0], O=enc.table.shape[1],
-            K=K, H=H, B=B, chunk=chunk, probes=4, W=W_eff)
+            K=K, H=H, B=B, chunk=chunk, probes=4, W=W_eff, accel=accel)
     else:
         # Packed multi-lane kernel (wgln.py): window as L uint32
         # lanes. Successors are bit math + funnel shifts instead of
@@ -488,14 +500,12 @@ def check(model: Model, history: History, time_limit: Optional[float] = None,
         # 3-key sort — measured ~11x over the bool kernel at W=71 on
         # cpu. The (K, W, L) u32 successor tensor is the memory
         # driver, so the beam scales with a byte budget over it.
-        from ..util import safe_backend
         from .wgln import compiled_searchN
         W_eff = _pad_to_mult(enc.window_raw, 32)
         L = W_eff // 32
         ic_eff = max(8, _pad_to_mult(enc.n_info, 8))
         ic_eff = min(ic_eff, ic_pad)
         iinv, iopc = iinv[:ic_eff], iopc[:ic_eff]
-        accel = safe_backend() not in (None, "cpu")
         budget_bytes = (1024 if accel else 128) * 1024 * 1024
         # cpu caps the beam at 1024: XLA:CPU compile scales with K and
         # the post-compile search rate is flat across K=1024..4096 on
@@ -525,11 +535,40 @@ def check(model: Model, history: History, time_limit: Optional[float] = None,
         # ~30% load at the encode cap, and fewer probe rounds measured
         # ~1.5x on search time (failed inserts re-explore soundly)
         probes_used, row_cols = 4, W_eff + ic_eff
-        chunk = 128  # rounds are light; poll a few times a second
+        # cpu polls a few times a second; the accelerator amortizes
+        # its ~75 ms poll round-trip over bigger chunks
+        chunk = 512 if accel else 128
         init_fn, chunk_jit = compiled_searchN(
             n_pad=len(enc.inv), ic_pad=ic_eff,
             S=enc.table.shape[0], O=enc.table.shape[1],
-            K=K, H=H, B=B, chunk=chunk, probes=4, W=W_eff, L=L)
+            K=K, H=H, B=B, chunk=chunk, probes=4, W=W_eff, L=L,
+            accel=accel)
+
+    import contextlib
+
+    import jax
+    dev_ctx = contextlib.nullcontext()
+    if platform == "cpu" and safe_backend() not in (None, "cpu"):
+        # pin the host layout onto the CPU backend that coexists with
+        # the accelerator (platform-aware competition lane)
+        try:
+            dev_ctx = jax.default_device(
+                jax.local_devices(backend="cpu")[0])
+        except Exception:  # noqa: BLE001 — no cpu backend: stay put
+            pass
+    with dev_ctx:
+        res = _run_search(enc, init_fn, chunk_jit, iinv, iopc, n,
+                          max_configs, frontier, K, H, B, W, W_eff,
+                          ic_eff, chunk, probes_used, row_cols, accel,
+                          t_enter, time_limit, stop)
+    res.setdefault("platform", platform or safe_backend() or "cpu")
+    return res
+
+
+def _run_search(enc, init_fn, chunk_jit, iinv, iopc, n, max_configs,
+                frontier, K, H, B, W, W_eff, ic_eff, chunk, probes_used,
+                row_cols, accel, t_enter, time_limit, stop):
+    import jax.numpy as jnp
 
     consts = (jnp.asarray(enc.inv), jnp.asarray(enc.ret),
               jnp.asarray(enc.opcode), jnp.asarray(enc.sufminret),
@@ -541,16 +580,17 @@ def check(model: Model, history: History, time_limit: Optional[float] = None,
     t0 = _time.monotonic()
     first_call_s = None
     while True:
-        carry = chunk_jit(consts, carry)
-        flags = np.asarray(carry[11])
-        stats = np.asarray(carry[12])
+        carry, summary = chunk_jit(consts, carry)
+        # ONE device->host transfer per poll: the packed summary is
+        # [fr_cnt, found, overflow, exhausted, stats...]
+        s = np.asarray(summary)
+        fr_cnt, flags, stats = int(s[0]), s[1:4], s[4:]
         if first_call_s is None:
             # compile + first chunk: the cold/warm split every result
             # reports (a persistent compilation cache turns this into
             # a deserialization — see util.enable_compilation_cache)
             first_call_s = _time.monotonic() - t0
         found, overflow = bool(flags[0]), bool(flags[1])
-        fr_cnt = int(carry[4])
         total_explored = int(stats[0])
         if (not found and fr_cnt > 0 and not frontier
                 and enc.window_raw <= 32 and K < _K_BIG
@@ -562,7 +602,8 @@ def check(model: Model, history: History, time_limit: Optional[float] = None,
             _, chunk_jit = compiled_search32(
                 n_pad=len(enc.inv), ic_pad=ic_eff,
                 S=enc.table.shape[0], O=enc.table.shape[1],
-                K=_K_BIG, H=H, B=B, chunk=chunk, probes=4, W=W_eff)
+                K=_K_BIG, H=H, B=B, chunk=chunk, probes=4, W=W_eff,
+                accel=accel)
             carry = _widen_frontier(carry, _K_BIG)
             K = _K_BIG
         wall = _time.monotonic() - t0

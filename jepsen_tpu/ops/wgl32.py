@@ -1,27 +1,54 @@
-"""Bitmask fast-path WGL kernel (windows ≤ 32 ok-ops wide).
+"""Bitmask fast-path WGL kernel (windows ≤ 32 ok-ops wide), scatter-lean.
 
 The general kernel (`wgl.py`) keeps the linearized-window as a (K, W)
-bool tensor and renormalizes configs with (K, W, 2W) gather machinery;
-profiling showed those gathers plus the 3-key successor sort dominate
-per-round time. Real Jepsen histories have small concurrency, so the
-exact window bound W (encode.py) is almost always ≤ 32 — and a window
-that fits one uint32 lane turns the whole successor construction into
-elementwise bit arithmetic:
+bool tensor and renormalizes configs with (K, W, 2W) gather machinery.
+Real Jepsen histories have small concurrency, so the exact window bound
+W (encode.py) is almost always ≤ 32 — and a window that fits one uint32
+lane turns the whole successor construction into elementwise bit
+arithmetic:
 
   * set bit j:        win' = win | (1 << j)
   * renormalize:      t = count-trailing-ones(win'), base += t,
                       win' >>= t        (ctz via popcount((x & -x) - 1))
   * crashed-op masks: one uint32 word per 32 info ops
 
-Dedup drops the sort entirely: every successor probes the memo hash
-table directly, and racing twins (two parents producing the same config
-in one round) are detected at insert time — the loser re-reads the slot
-it just contended for and sees its own signature with a different row
-id, i.e. "seen". Per-round work is a few (K, 32) gathers, elementwise
-u32 math, and `probes` gather/scatter rounds on the table.
+Layout is driven by a measured accelerator cost model (round 5, v5e
+behind the axon runtime): inside a device `lax.while_loop`, elementwise
+math / sorts / reductions are effectively free, row-gathers are cheap
+and pipeline, but every SCATTER costs ~30 µs of serialized latency —
+the round-3 layout (four frontier arrays + four backlog arrays + a
+4-iteration probe loop with insert-per-probe) paid ~16 scatters ≈
+600 µs/round on the chip vs ~70 µs on a CPU core. So this kernel:
 
-Same consts/carry contract as `wgl._build_search`, so the host driver
-and the batched mesh path dispatch between kernels by window width.
+  * packs each config into ONE int32 row [base, win, mst, info words]:
+    frontier (K, C) and backlog (B, C) update in one scatter each;
+  * folds the op metadata into one row table `meta` (n_pad+1, 4) =
+    [inv, ret, opcode, sufminret] — one row-gather per round instead
+    of four element-gathers;
+  * folds the model transition table into `TK[opc * S + mst]` rows so
+    ok-candidates and info-candidates share one row-gather;
+  * probes the memo table with ONE batched gather of all `probes`
+    candidate slots, inserts with ONE scatter at each row's first
+    empty slot, and verifies with one gather — racing twins (two
+    parents producing the same config in one round) are detected at
+    verify time: the loser sees its own signature under a different
+    row id, i.e. "seen". Rows whose insert lost to a *different*
+    signature (slot collision) stay "unseen" and may re-explore
+    later — sound, same as the old kernel's leftover-pending rows.
+
+Same consts contract as `wgl._build_search` (inv, ret, opcode,
+sufminret, inv_info, opcode_info, T, n_ok, n_info, max_cfg); the carry
+is the packed 7-tuple
+
+    (fr, fr_cnt, bk, bk_cnt, table, flags, stats)
+
+shared with the packed wide-window kernel (`wgln.py`) so the host
+driver (`wgl.check`) and the batched mesh path (`parallel/batched.py`)
+read counters at fixed indices: fr_cnt = carry[1], flags = carry[5],
+stats = carry[6].
+
+Reference parity: this is the knossos wgl/analysis engine the
+reference reaches through `jepsen/src/jepsen/checker.clj:199-202`.
 """
 
 from __future__ import annotations
@@ -31,6 +58,9 @@ import functools
 import numpy as np
 
 INF = np.int32(2**31 - 1)
+
+# carry indices shared by wgl.py / parallel/batched.py
+FR, FR_CNT, BK, BK_CNT, TABLE, FLAGS, STATS = range(7)
 
 
 def _popcount32(x):
@@ -59,19 +89,84 @@ def _fnv_words(words, seed):
     return h
 
 
+def _i32(x):
+    import jax
+    import jax.numpy as jnp
+    return jax.lax.bitcast_convert_type(x, jnp.int32)
+
+
+def _u32(x):
+    import jax
+    import jax.numpy as jnp
+    return jax.lax.bitcast_convert_type(x, jnp.uint32)
+
+
+def probe_insert(table, s0, s1, s2, explore, probes: int, H: int):
+    """Memo-table dedup with one batched probe gather, one insert
+    scatter, one verify gather (see module docstring). Returns
+    (table, seen) — `seen` marks rows whose exact signature was
+    already in the table (or lost an insert race to a twin this
+    round). Shared with wgln.py."""
+    import jax.numpy as jnp
+
+    R = s0.shape[0]
+    step = s1 | jnp.uint32(1)
+    mysig = jnp.stack([s0, s1, s2], axis=1)                   # (R, 3)
+    myrow = jnp.arange(R, dtype=jnp.uint32)
+
+    pr = jnp.arange(probes, dtype=jnp.uint32)
+    idx_p = ((s0[:, None] + pr[None, :] * step[:, None])
+             & jnp.uint32(H - 1)).astype(jnp.int32)           # (R, P)
+    slots = table[idx_p.reshape(-1)].reshape(R, probes, 4)    # 1 gather
+    occ = slots[:, :, 0] != 0
+    eq = occ & jnp.all(slots[:, :, :3] == mysig[:, None, :], axis=2)
+    seen = jnp.any(eq, axis=1)
+
+    empt = ~occ
+    has_empty = jnp.any(empt, axis=1)
+    firstp = jnp.argmax(empt, axis=1).astype(jnp.int32)       # first empty
+    onehot = firstp[:, None] == jnp.arange(probes,
+                                           dtype=jnp.int32)[None, :]
+    ins_idx = jnp.sum(jnp.where(onehot, idx_p, 0), axis=1)    # (R,)
+
+    inserting = explore & ~seen & has_empty
+    widx = jnp.where(inserting, ins_idx, H)
+    entry = jnp.concatenate([mysig, myrow[:, None].astype(jnp.uint32)],
+                            axis=1)
+    table = table.at[widx].set(entry, mode="drop")            # 1 scatter
+    verify = table[ins_idx]                                   # 1 gather
+    v_eq = jnp.all(verify[:, :3] == mysig, axis=1)
+    twin_lost = inserting & v_eq & (verify[:, 3] != myrow)
+    seen = seen | twin_lost
+    return table, seen
+
+
 def _build_search32(n_pad: int, ic_pad: int, S: int, O: int,
                     K: int, H: int, B: int, chunk: int, probes: int,
-                    W: int = 32):
+                    W: int = 32, accel: bool = False):
     """Build (init_fn, chunk_fn) for the W<=32 bitmask kernel. `W` is the
     window width actually materialized (pad the exact requirement to a
-    small multiple — successor row count R = K*(W + ic_pad) drives probe
-    traffic, the kernel's dominant cost). Crashed-op masks use
-    ceil(ic_pad/32) uint32 words."""
+    small multiple — successor row count R = K*(W + ic_pad) drives the
+    dedup traffic). Crashed-op masks use ceil(ic_pad/32) uint32 words.
+
+    `accel` selects the accelerator layout (measured on the v5e, round
+    5): the grand-table fused gather, top_k frontier compaction, and
+    cond-guarded backlog — each trades vector work (free on the VPU)
+    for serialized ~30 µs scatter/gather latency. On a CPU core the
+    same trades LOSE (caches make scatters cheap, top_k dear), so the
+    host build keeps the scatter-compaction layout."""
     import jax.numpy as jnp
     from jax import lax
 
     assert 1 <= W <= 32
     Il = max(1, (ic_pad + 31) // 32)
+    C = 3 + Il  # packed config row: [base, win, mst, info words...]
+    # Grand-table fusion: when the (pos, mst) product is small enough,
+    # ONE row-gather per round serves op metadata, suffix-min tail,
+    # AND both transition lookups (see chunk_fn). Small model state
+    # spaces (register/cas/mutex: S <= ~64) always qualify; large ones
+    # (queue models) fall back to the two-gather scheme.
+    fused = accel and (n_pad + 1) * S + ic_pad * S <= (1 << 22)
 
     # Host-precomputed per-info-op word/bit masks: setting info op m.
     info_word = np.arange(ic_pad) // 32                     # (ic,)
@@ -80,74 +175,101 @@ def _build_search32(n_pad: int, ic_pad: int, S: int, O: int,
     info_set_mask[np.arange(ic_pad), info_word] = info_bit
 
     def init_fn(mstate0):
-        fr_base = jnp.zeros(K, dtype=jnp.int32)
-        fr_win = jnp.zeros(K, dtype=jnp.uint32)
-        fr_info = jnp.zeros((K, Il), dtype=jnp.uint32)
-        fr_mst = jnp.zeros(K, dtype=jnp.int32).at[0].set(mstate0)
+        fr = jnp.zeros((K, C), dtype=jnp.int32).at[0, 2].set(mstate0)
         fr_cnt = jnp.int32(1)
-        bk_base = jnp.zeros(B, dtype=jnp.int32)
-        bk_win = jnp.zeros(B, dtype=jnp.uint32)
-        bk_info = jnp.zeros((B, Il), dtype=jnp.uint32)
-        bk_mst = jnp.zeros(B, dtype=jnp.int32)
+        bk = jnp.zeros((B, C), dtype=jnp.int32)
         bk_cnt = jnp.int32(0)
         table = jnp.zeros((H, 4), dtype=jnp.uint32)
         flags = jnp.zeros(3, dtype=bool)   # found, overflow, exhausted
         # explored, rounds-in-chunk, max_base, memo_hits, inserted,
         # rounds_total — the last three feed the result's util block
         stats = jnp.zeros(6, dtype=jnp.int32)
-        return (fr_base, fr_win, fr_info, fr_mst, fr_cnt,
-                bk_base, bk_win, bk_info, bk_mst, bk_cnt,
-                table, flags, stats)
+        return (fr, fr_cnt, bk, bk_cnt, table, flags, stats)
 
     jinfo_word = jnp.asarray(info_word.astype(np.int32))
     jinfo_bit = jnp.asarray(info_bit)
     jinfo_set = jnp.asarray(info_set_mask)
 
     def round_body(consts, carry):
-        (inv, ret, opc, suf, iinv, iopc, T, n_ok, n_info, max_cfg) = consts
-        (fr_base, fr_win, fr_info, fr_mst, fr_cnt,
-         bk_base, bk_win, bk_info, bk_mst, bk_cnt,
-         table, flags, stats) = carry
+        (GT, iinv, iopc_c, n_ok, n_info, max_cfg) = consts
+        (fr, fr_cnt, bk, bk_cnt, table, flags, stats) = carry
+
+        fr_base = fr[:, 0]
+        fr_win = _u32(fr[:, 1])
+        fr_mst = fr[:, 2]
+        fr_info = _u32(fr[:, 3:])                             # (K, Il)
 
         alive = jnp.arange(K, dtype=jnp.int32) < fr_cnt
         j = jnp.arange(W, dtype=jnp.int32)
         winbit = (fr_win[:, None] >> j[None, :].astype(jnp.uint32)) \
-            & jnp.uint32(1)                                   # (K, 32)
+            & jnp.uint32(1)                                   # (K, W)
         linearized = winbit == 1
 
         # --- candidate discovery -------------------------------------
-        pos = fr_base[:, None] + j                            # (K, 32)
+        pos = fr_base[:, None] + j                            # (K, W)
         posc = jnp.minimum(pos, n_pad - 1)
-        retw = jnp.where(linearized | (pos >= n_ok), INF, ret[posc])
+        tailp = jnp.minimum(fr_base + W, n_pad)               # (K,)
+        m = jnp.arange(ic_pad, dtype=jnp.int32)
+        if fused:
+            # ONE row-gather serves window metadata + transitions,
+            # the suffix-min tail, and the info-op transitions: GT is
+            # indexed pos*S + mst for ok ops (rows [inv, ret, nst,
+            # suf]) and (n_pad+1)*S + m*S + mst for info ops (rows
+            # [iinv, 0, nst, 0]) — see chunk_fn.
+            gidx = jnp.concatenate(
+                [(posc * S + fr_mst[:, None]).reshape(-1),
+                 tailp * S + fr_mst,
+                 ((n_pad + 1) * S + m[None, :] * S
+                  + fr_mst[:, None]).reshape(-1)])
+            grows = GT[gidx]                                  # gather
+            okrows = grows[:K * W].reshape(K, W, 4)
+            invw, retw0, nst_ok = (okrows[..., 0], okrows[..., 1],
+                                   okrows[..., 2])
+            tail = grows[K * W:K * W + K, 3]                  # (K,)
+            irows = grows[K * W + K:].reshape(K, ic_pad, 4)
+            iinvw, nst_info = irows[..., 0], irows[..., 2]
+        else:
+            (meta, TK) = GT
+            mrows = meta[posc.reshape(-1)].reshape(K, W, 4)   # gather
+            invw, retw0, opw = (mrows[..., 0], mrows[..., 1],
+                                mrows[..., 2])
+            tail = meta[tailp][:, 3]                          # gather
+            tidx = jnp.concatenate(
+                [(opw * S + fr_mst[:, None]).reshape(-1),
+                 (iopc_c[None, :] * S + fr_mst[:, None]).reshape(-1)])
+            nst_all = TK[tidx][:, 0]                          # gather
+            nst_ok = nst_all[:K * W].reshape(K, W)
+            nst_info = nst_all[K * W:].reshape(K, ic_pad)
+            iinvw = jnp.broadcast_to(iinv[None, :], (K, ic_pad))
+
+        retw = jnp.where(linearized | (pos >= n_ok), INF, retw0)
         minret = jnp.min(retw, axis=1)
-        tail = suf[jnp.minimum(fr_base + W, n_pad)]
         minret = jnp.minimum(minret, tail)                    # (K,)
 
-        invw = inv[posc]
         cand_ok = (~linearized) & (pos < n_ok) \
             & (invw < minret[:, None]) & alive[:, None]
-        opw = opc[posc]
-        nst_ok = T[fr_mst[:, None], opw]                      # (K, 32)
-        legal_ok = cand_ok & (nst_ok >= 0)
 
-        m = jnp.arange(ic_pad, dtype=jnp.int32)
         # info bit m of lane k: (fr_info[k, word(m)] & bit(m)) != 0
-        info_words = fr_info[:, jinfo_word]                   # (K, ic)
+        if Il == 1:
+            info_words = jnp.broadcast_to(fr_info[:, :1], (K, ic_pad))
+        else:
+            info_words = fr_info[:, jinfo_word]               # (K, ic)
         info_set = (info_words & jinfo_bit[None, :]) != 0
         cand_info = (~info_set) & (m[None, :] < n_info) \
-            & (iinv[None, :] < minret[:, None]) & alive[:, None]
-        nst_info = T[fr_mst[:, None], iopc[None, :]]          # (K, ic)
+            & (iinvw < minret[:, None]) & alive[:, None]
+
+        legal_ok = cand_ok & (nst_ok >= 0)
         legal_info = cand_info & (nst_info >= 0)
 
         # --- successor construction (pure bit math) ------------------
-        bit = (jnp.uint32(1) << j.astype(jnp.uint32))         # (32,)
-        win_ok = fr_win[:, None] | bit[None, :]               # (K, 32)
+        bit = (jnp.uint32(1) << j.astype(jnp.uint32))         # (W,)
+        win_ok = fr_win[:, None] | bit[None, :]               # (K, W)
         t = _ctz32(~win_ok)                                   # trailing ones
         ti = t.astype(jnp.int32)
         shifted = jnp.where(t >= 32, jnp.uint32(0),
                             win_ok >> jnp.minimum(t, jnp.uint32(31)))
         # t in [1, 32]; t == 32 -> window fully drained
-        base_ok = fr_base[:, None] + ti                       # (K, 32)
+        base_ok = fr_base[:, None] + ti                       # (K, W)
 
         base_s = jnp.concatenate(
             [base_ok.reshape(-1),
@@ -163,7 +285,6 @@ def _build_search32(n_pad: int, ic_pad: int, S: int, O: int,
             [nst_ok.reshape(-1), nst_info.reshape(-1)])
         legal = jnp.concatenate(
             [legal_ok.reshape(-1), legal_info.reshape(-1)])   # (R,)
-        R = legal.shape[0]
 
         success = legal & (base_s >= n_ok) & (win_s == 0)
         found = jnp.any(success)
@@ -175,80 +296,67 @@ def _build_search32(n_pad: int, ic_pad: int, S: int, O: int,
         s0 = _fnv_words(words, 0x811C9DC5) | jnp.uint32(1)  # never 0
         s1 = _fnv_words(words, 0x01000193)
         s2 = _fnv_words(words, 0xDEADBEEF)
-        myrow = jnp.arange(R, dtype=jnp.uint32)
-        step = s1 | jnp.uint32(1)
-        mysig = jnp.stack([s0, s1, s2], axis=1)               # (R, 3)
 
-        # --- probe-based dedup (no sort) -----------------------------
-        # Twins (same signature, same round) collide on the same probe
-        # sequence: the claim loser re-reads the slot, sees its own
-        # signature under a different row id, and counts as seen.
-        def probe(_, st):
-            table, pending, seen, pr = st
-            idx = ((s0 + pr * step) & jnp.uint32(H - 1)).astype(jnp.int32)
-            slot = table[idx]                                 # (R, 4)
-            occupied = slot[:, 0] != 0
-            sig_eq = jnp.all(slot[:, :3] == mysig, axis=1)
-            equal = occupied & sig_eq
-            seen = seen | (pending & equal)
-            claim = pending & ~occupied
-            widx = jnp.where(claim, idx, H)
-            entry = jnp.concatenate([mysig, myrow[:, None]], axis=1)
-            table = table.at[widx].set(entry, mode="drop")
-            slot2 = table[idx]
-            sig_eq2 = jnp.all(slot2[:, :3] == mysig, axis=1)
-            won = claim & sig_eq2 & (slot2[:, 3] == myrow)
-            twin = claim & sig_eq2 & ~won
-            seen = seen | twin
-            pending = pending & ~(equal | won | twin)
-            pr = pr + pending.astype(jnp.uint32)
-            return table, pending, seen, pr
-
-        table, pending, seen, _ = lax.fori_loop(
-            0, probes, probe,
-            (table, explore, jnp.zeros(R, dtype=bool),
-             jnp.zeros(R, dtype=jnp.uint32)))
-        # leftover pending (table too contended): treat as unseen — may
-        # re-explore later; sound.
+        # --- memo dedup: 1 gather + 1 scatter + 1 verify gather ------
+        table, seen = probe_insert(table, s0, s1, s2, explore, probes, H)
         new = explore & ~seen
 
         # --- compact survivors into frontier + backlog ---------------
+        succ = jnp.concatenate(
+            [base_s[:, None],
+             _i32(win_s)[:, None],
+             mst_s[:, None],
+             _i32(info_s)], axis=1)                           # (R, C)
+
+        R = succ.shape[0]
         posn = jnp.cumsum(new.astype(jnp.int32)) - 1          # (R,)
         total = jnp.sum(new.astype(jnp.int32))
 
-        to_front = new & (posn < K)
-        fidx = jnp.where(to_front, posn, K)
-        nfr_base = jnp.zeros(K, dtype=jnp.int32).at[fidx].set(
-            base_s, mode="drop")
-        nfr_win = jnp.zeros(K, dtype=jnp.uint32).at[fidx].set(
-            win_s, mode="drop")
-        nfr_info = jnp.zeros((K, Il), dtype=jnp.uint32).at[fidx].set(
-            info_s, mode="drop")
-        nfr_mst = jnp.zeros(K, dtype=jnp.int32).at[fidx].set(
-            mst_s, mode="drop")
+        if accel:
+            # frontier = first K new rows, selected by top_k + row
+            # gather (no scatter on the critical path)
+            score = jnp.where(new, R - posn, 0)
+            _, fsel = lax.top_k(score, K)                     # (K,)
+            nfr = succ[fsel]                                  # gather
+        else:
+            to_front = new & (posn < K)
+            fidx = jnp.where(to_front, posn, K)
+            nfr = jnp.zeros((K, C), dtype=jnp.int32).at[fidx].set(
+                succ, mode="drop")
         nfr_cnt = jnp.minimum(total, K)
 
+        # backlog spill + refill are RARE on the fast path (the beam
+        # usually swallows the whole wavefront): on the accel build
+        # both ride lax.cond so the common-case round pays no scatter
+        # for them. Under vmap (the batched mesh path) cond lowers to
+        # select and both sides run — same cost as the unconditional
+        # layout, no worse.
         spill = new & (posn >= K)
         sidx = jnp.where(spill, bk_cnt + posn - K, B)
         overflow = jnp.any(spill & (sidx >= B))
         sidx = jnp.minimum(sidx, B)
-        bk_base = bk_base.at[sidx].set(base_s, mode="drop")
-        bk_win = bk_win.at[sidx].set(win_s, mode="drop")
-        bk_info = bk_info.at[sidx].set(info_s, mode="drop")
-        bk_mst = bk_mst.at[sidx].set(mst_s, mode="drop")
+
+        def do_spill(b):
+            return b.at[sidx].set(succ, mode="drop")
+
+        bk = lax.cond(total > K, do_spill, lambda b: b, bk) if accel \
+            else do_spill(bk)
         nbk_cnt = jnp.minimum(bk_cnt + jnp.maximum(total - K, 0), B)
 
         # refill frontier from the backlog top
         room = K - nfr_cnt
         take = jnp.minimum(room, nbk_cnt)
-        kidx = jnp.arange(K, dtype=jnp.int32)
-        taking = kidx < take
-        src = jnp.where(taking, jnp.maximum(nbk_cnt - 1 - kidx, 0), 0)
-        dst = jnp.where(taking, nfr_cnt + kidx, K)
-        nfr_base = nfr_base.at[dst].set(bk_base[src], mode="drop")
-        nfr_win = nfr_win.at[dst].set(bk_win[src], mode="drop")
-        nfr_info = nfr_info.at[dst].set(bk_info[src], mode="drop")
-        nfr_mst = nfr_mst.at[dst].set(bk_mst[src], mode="drop")
+
+        def do_refill(args):
+            nfr, bk = args
+            kidx = jnp.arange(K, dtype=jnp.int32)
+            taking = kidx < take
+            src = jnp.where(taking, jnp.maximum(nbk_cnt - 1 - kidx, 0), 0)
+            dst = jnp.where(taking, nfr_cnt + kidx, K)
+            return nfr.at[dst].set(bk[src], mode="drop")
+
+        nfr = lax.cond(take > 0, do_refill, lambda a: a[0],
+                       (nfr, bk)) if accel else do_refill((nfr, bk))
         nfr_cnt = nfr_cnt + take
         nbk_cnt = nbk_cnt - take
 
@@ -262,24 +370,62 @@ def _build_search32(n_pad: int, ic_pad: int, S: int, O: int,
             stats[3] + jnp.sum(seen.astype(jnp.int32)),
             stats[4] + total,
             stats[5] + 1])
-        return (nfr_base, nfr_win, nfr_info, nfr_mst, nfr_cnt,
-                bk_base, bk_win, bk_info, bk_mst, nbk_cnt,
-                table, nflags, nstats)
+        return (nfr, nfr_cnt, bk, nbk_cnt, table, nflags, nstats)
 
     def chunk_fn(consts, carry):
-        max_cfg = consts[-1]
+        (inv, ret, opc, suf, iinv, iopc, T, n_ok, n_info, max_cfg) = consts
+        # Fused lookup tables, built once per chunk call (hoisted out
+        # of the round loop).
+        inv_p = jnp.concatenate([inv, jnp.full((1,), INF, jnp.int32)])
+        ret_p = jnp.concatenate([ret, jnp.full((1,), INF, jnp.int32)])
+        opc_p = jnp.concatenate([opc, jnp.zeros((1,), jnp.int32)])
+        if fused:
+            # Grand table GT: rows (pos, mst) -> [inv, ret, nst, suf]
+            # for ok ops, then (m, mst) -> [iinv, 0, nst, 0] for info
+            # ops — the round's whole lookup plane in one gather.
+            np1 = n_pad + 1
+            nst_ok = T[:, opc_p].T                            # (np1, S)
+            ok_rows = jnp.stack(
+                [jnp.broadcast_to(inv_p[:, None], (np1, S)),
+                 jnp.broadcast_to(ret_p[:, None], (np1, S)),
+                 nst_ok,
+                 jnp.broadcast_to(suf[:, None], (np1, S))],
+                axis=2).reshape(np1 * S, 4)
+            nst_i = T[:, iopc].T                              # (ic, S)
+            info_rows = jnp.stack(
+                [jnp.broadcast_to(iinv[:, None], (ic_pad, S)),
+                 jnp.zeros((ic_pad, S), jnp.int32),
+                 nst_i,
+                 jnp.zeros((ic_pad, S), jnp.int32)],
+                axis=2).reshape(ic_pad * S, 4)
+            GT = jnp.concatenate([ok_rows, info_rows])
+        else:
+            # meta rows [inv, ret, opcode, sufminret] with a sentinel
+            # row at n_pad; TK[o * S + s] = T[s, o] rows.
+            meta = jnp.stack([inv_p, ret_p, opc_p, suf], axis=1)
+            TK = jnp.broadcast_to(T.T.reshape(-1, 1), (S * O, 2))
+            GT = (meta, TK)
+        rconsts = (GT, iinv, iopc, n_ok, n_info, max_cfg)
 
         def cond(c):
-            flags, stats = c[11], c[12]
-            return (~flags[0]) & (c[4] > 0) \
+            flags, stats = c[FLAGS], c[STATS]
+            return (~flags[0]) & (c[FR_CNT] > 0) \
                 & (stats[1] < chunk) & (stats[0] < max_cfg)
 
         def body(c):
-            return round_body(consts, c)
+            return round_body(rconsts, c)
 
-        stats = carry[12]
-        carry = carry[:12] + (stats.at[1].set(0),)
-        return lax.while_loop(cond, body, carry)
+        stats = carry[STATS]
+        carry = carry[:STATS] + (stats.at[1].set(0),)
+        out = lax.while_loop(cond, body, carry)
+        # one packed (10,) summary so the host polls with a SINGLE
+        # device->host transfer per chunk (each transfer costs a full
+        # runtime round-trip — ~75 ms through the tunneled v5e, which
+        # dominated the headline wall before this)
+        summary = jnp.concatenate(
+            [out[FR_CNT][None], out[FLAGS].astype(jnp.int32),
+             out[STATS]])
+        return out, summary
 
     return init_fn, chunk_fn
 
@@ -287,9 +433,10 @@ def _build_search32(n_pad: int, ic_pad: int, S: int, O: int,
 @functools.lru_cache(maxsize=32)
 def compiled_search32(n_pad: int, ic_pad: int, S: int, O: int,
                       K: int, H: int, B: int, chunk: int, probes: int,
-                      W: int = 32):
+                      W: int = 32, accel: bool = False):
     import jax
 
     init_fn, chunk_fn = _build_search32(n_pad, ic_pad, S, O,
-                                        K, H, B, chunk, probes, W=W)
+                                        K, H, B, chunk, probes, W=W,
+                                        accel=accel)
     return init_fn, jax.jit(chunk_fn, donate_argnums=(1,))

@@ -21,12 +21,16 @@ This kernel generalizes the packing to L lanes:
     the first lane with a zero bit and r its trailing-ones count;
     the shifted window is `(lane[l+q] >> r) | (lane[l+q+1] <<
     (32-r))` with gathers clamped past L.
-  * dedup, backlog spill/refill, flags and stats are wgl32's,
-    unchanged — same CONSTS contract as `_build_search`, so the host
-    driver (`wgl.check`) dispatches by window width alone, and the
-    mesh-sharded vmap batch path (`parallel/batched.py`) vmaps this
-    kernel directly for wide lanes (carry indices 4/11/12 — fr_cnt,
-    flags, stats — are layout-compatible with wgl32's).
+  * memory layout and dedup are wgl32's scatter-lean scheme (see its
+    module docstring for the measured cost model): each config is ONE
+    int32 row [base, win lanes..., mst, info words...] so frontier
+    (K, C) and backlog (B, C) update in one scatter each, op metadata
+    and the transition table ride fused row-gathers, and the memo
+    probe is `wgl32.probe_insert` (one gather + one scatter + one
+    verify gather). Same consts contract as `wgl._build_search`; same
+    packed carry (fr, fr_cnt, bk, bk_cnt, table, flags, stats) as
+    wgl32, so the host driver (`wgl.check`) dispatches by window
+    width alone and `parallel/batched.py` vmaps either kernel.
 
 Measured (cpu backend, adversarial_wave 6x14 span 5, W=71 -> L=3):
 the bool kernel decides 811k configs in ~103 s; this kernel in ~9 s
@@ -40,21 +44,26 @@ import functools
 
 import numpy as np
 
-from .wgl32 import _ctz32, _fnv_words
+from .wgl32 import FLAGS, FR_CNT, STATS, _ctz32, _fnv_words, _i32, _u32, \
+    probe_insert
 
 INF = np.int32(2**31 - 1)
 
 
 def _build_searchN(n_pad: int, ic_pad: int, S: int, O: int,
                    K: int, H: int, B: int, chunk: int, probes: int,
-                   W: int, L: int):
+                   W: int, L: int, accel: bool = False):
     """Build (init_fn, chunk_fn) for the packed L-lane kernel.
-    W == 32*L is the materialized window width."""
+    W == 32*L is the materialized window width. `accel` picks the
+    accelerator layout (see wgl32._build_search32)."""
     import jax.numpy as jnp
     from jax import lax
 
     assert W == 32 * L and L >= 2
     Il = max(1, (ic_pad + 31) // 32)
+    C = 2 + L + Il  # [base, win lanes..., mst, info words...]
+    MST = 1 + L     # column index of the model state
+    fused = accel and (n_pad + 1) * S + ic_pad * S <= (1 << 22)
 
     # host-precomputed tables
     j_arr = np.arange(W)
@@ -68,24 +77,16 @@ def _build_searchN(n_pad: int, ic_pad: int, S: int, O: int,
     info_set_mask[np.arange(ic_pad), info_word] = info_bit
 
     def init_fn(mstate0):
-        fr_base = jnp.zeros(K, dtype=jnp.int32)
-        fr_win = jnp.zeros((K, L), dtype=jnp.uint32)
-        fr_info = jnp.zeros((K, Il), dtype=jnp.uint32)
-        fr_mst = jnp.zeros(K, dtype=jnp.int32).at[0].set(mstate0)
+        fr = jnp.zeros((K, C), dtype=jnp.int32).at[0, MST].set(mstate0)
         fr_cnt = jnp.int32(1)
-        bk_base = jnp.zeros(B, dtype=jnp.int32)
-        bk_win = jnp.zeros((B, L), dtype=jnp.uint32)
-        bk_info = jnp.zeros((B, Il), dtype=jnp.uint32)
-        bk_mst = jnp.zeros(B, dtype=jnp.int32)
+        bk = jnp.zeros((B, C), dtype=jnp.int32)
         bk_cnt = jnp.int32(0)
         table = jnp.zeros((H, 4), dtype=jnp.uint32)
         flags = jnp.zeros(3, dtype=bool)   # found, overflow, exhausted
         # explored, rounds-in-chunk, max_base, memo_hits, inserted,
         # rounds_total (util contract, wgl.py)
         stats = jnp.zeros(6, dtype=jnp.int32)
-        return (fr_base, fr_win, fr_info, fr_mst, fr_cnt,
-                bk_base, bk_win, bk_info, bk_mst, bk_cnt,
-                table, flags, stats)
+        return (fr, fr_cnt, bk, bk_cnt, table, flags, stats)
 
     jlane = jnp.asarray(lane_of_j)
     jshift = jnp.asarray(shift_of_j)
@@ -95,10 +96,13 @@ def _build_searchN(n_pad: int, ic_pad: int, S: int, O: int,
     jinfo_set = jnp.asarray(info_set_mask)
 
     def round_body(consts, carry):
-        (inv, ret, opc, suf, iinv, iopc, T, n_ok, n_info, max_cfg) = consts
-        (fr_base, fr_win, fr_info, fr_mst, fr_cnt,
-         bk_base, bk_win, bk_info, bk_mst, bk_cnt,
-         table, flags, stats) = carry
+        (GT, iinv, iopc_c, n_ok, n_info, max_cfg) = consts
+        (fr, fr_cnt, bk, bk_cnt, table, flags, stats) = carry
+
+        fr_base = fr[:, 0]
+        fr_win = _u32(fr[:, 1:1 + L])                     # (K, L)
+        fr_mst = fr[:, MST]
+        fr_info = _u32(fr[:, MST + 1:])                   # (K, Il)
 
         alive = jnp.arange(K, dtype=jnp.int32) < fr_cnt
         j = jnp.arange(W, dtype=jnp.int32)
@@ -107,27 +111,54 @@ def _build_searchN(n_pad: int, ic_pad: int, S: int, O: int,
         linearized = ((winw >> jshift[None, :])
                       & jnp.uint32(1)) == 1
 
-        # --- candidate discovery (identical shape to wgl32) ----------
+        # --- candidate discovery (wgl32's fused-gather shape) --------
         pos = fr_base[:, None] + j                        # (K, W)
         posc = jnp.minimum(pos, n_pad - 1)
-        retw = jnp.where(linearized | (pos >= n_ok), INF, ret[posc])
+        tailp = jnp.minimum(fr_base + W, n_pad)           # (K,)
+        m = jnp.arange(ic_pad, dtype=jnp.int32)
+        if fused:
+            gidx = jnp.concatenate(
+                [(posc * S + fr_mst[:, None]).reshape(-1),
+                 tailp * S + fr_mst,
+                 ((n_pad + 1) * S + m[None, :] * S
+                  + fr_mst[:, None]).reshape(-1)])
+            grows = GT[gidx]                              # gather
+            okrows = grows[:K * W].reshape(K, W, 4)
+            invw, retw0, nst_ok = (okrows[..., 0], okrows[..., 1],
+                                   okrows[..., 2])
+            tail = grows[K * W:K * W + K, 3]              # (K,)
+            irows = grows[K * W + K:].reshape(K, ic_pad, 4)
+            iinvw, nst_info = irows[..., 0], irows[..., 2]
+        else:
+            (meta, TK) = GT
+            mrows = meta[posc.reshape(-1)].reshape(K, W, 4)   # gather
+            invw, retw0, opw = (mrows[..., 0], mrows[..., 1],
+                                mrows[..., 2])
+            tail = meta[tailp][:, 3]                      # gather
+            tidx = jnp.concatenate(
+                [(opw * S + fr_mst[:, None]).reshape(-1),
+                 (iopc_c[None, :] * S + fr_mst[:, None]).reshape(-1)])
+            nst_all = TK[tidx][:, 0]                      # gather
+            nst_ok = nst_all[:K * W].reshape(K, W)
+            nst_info = nst_all[K * W:].reshape(K, ic_pad)
+            iinvw = jnp.broadcast_to(iinv[None, :], (K, ic_pad))
+
+        retw = jnp.where(linearized | (pos >= n_ok), INF, retw0)
         minret = jnp.min(retw, axis=1)
-        tail = suf[jnp.minimum(fr_base + W, n_pad)]
         minret = jnp.minimum(minret, tail)                # (K,)
 
-        invw = inv[posc]
         cand_ok = (~linearized) & (pos < n_ok) \
             & (invw < minret[:, None]) & alive[:, None]
-        opw = opc[posc]
-        nst_ok = T[fr_mst[:, None], opw]                  # (K, W)
-        legal_ok = cand_ok & (nst_ok >= 0)
 
-        m = jnp.arange(ic_pad, dtype=jnp.int32)
-        info_words = fr_info[:, jinfo_word]               # (K, ic)
+        if Il == 1:
+            info_words = jnp.broadcast_to(fr_info[:, :1], (K, ic_pad))
+        else:
+            info_words = fr_info[:, jinfo_word]           # (K, ic)
         info_set = (info_words & jinfo_bit[None, :]) != 0
         cand_info = (~info_set) & (m[None, :] < n_info) \
-            & (iinv[None, :] < minret[:, None]) & alive[:, None]
-        nst_info = T[fr_mst[:, None], iopc[None, :]]      # (K, ic)
+            & (iinvw < minret[:, None]) & alive[:, None]
+
+        legal_ok = cand_ok & (nst_ok >= 0)
         legal_info = cand_info & (nst_info >= 0)
 
         # --- ok successors: set bit j, then funnel-shift right -------
@@ -180,14 +211,13 @@ def _build_searchN(n_pad: int, ic_pad: int, S: int, O: int,
             [nst_ok.reshape(-1), nst_info.reshape(-1)])
         legal = jnp.concatenate(
             [legal_ok.reshape(-1), legal_info.reshape(-1)])  # (R,)
-        R = legal.shape[0]
 
         success = legal & (base_s >= n_ok) \
             & jnp.all(win_s == 0, axis=1)
         found = jnp.any(success)
         explore = legal & ~success
 
-        # --- hash + probe dedup (wgl32's, L window words) ------------
+        # --- hash + probe dedup (shared with wgl32) ------------------
         words = ([base_s.astype(jnp.uint32)]
                  + [win_s[:, i] for i in range(L)]
                  + [mst_s.astype(jnp.uint32)]
@@ -195,73 +225,56 @@ def _build_searchN(n_pad: int, ic_pad: int, S: int, O: int,
         s0 = _fnv_words(words, 0x811C9DC5) | jnp.uint32(1)
         s1 = _fnv_words(words, 0x01000193)
         s2 = _fnv_words(words, 0xDEADBEEF)
-        myrow = jnp.arange(R, dtype=jnp.uint32)
-        step = s1 | jnp.uint32(1)
-        mysig = jnp.stack([s0, s1, s2], axis=1)           # (R, 3)
-
-        def probe(_, st):
-            table, pending, seen, pr = st
-            idx = ((s0 + pr * step) & jnp.uint32(H - 1)).astype(jnp.int32)
-            slot = table[idx]
-            occupied = slot[:, 0] != 0
-            sig_eq = jnp.all(slot[:, :3] == mysig, axis=1)
-            equal = occupied & sig_eq
-            seen = seen | (pending & equal)
-            claim = pending & ~occupied
-            widx = jnp.where(claim, idx, H)
-            entry = jnp.concatenate([mysig, myrow[:, None]], axis=1)
-            table = table.at[widx].set(entry, mode="drop")
-            slot2 = table[idx]
-            sig_eq2 = jnp.all(slot2[:, :3] == mysig, axis=1)
-            won = claim & sig_eq2 & (slot2[:, 3] == myrow)
-            twin = claim & sig_eq2 & ~won
-            seen = seen | twin
-            pending = pending & ~(equal | won | twin)
-            pr = pr + pending.astype(jnp.uint32)
-            return table, pending, seen, pr
-
-        table, pending, seen, _ = lax.fori_loop(
-            0, probes, probe,
-            (table, explore, jnp.zeros(R, dtype=bool),
-             jnp.zeros(R, dtype=jnp.uint32)))
+        table, seen = probe_insert(table, s0, s1, s2, explore, probes, H)
         new = explore & ~seen
 
         # --- compact survivors into frontier + backlog ---------------
+        succ = jnp.concatenate(
+            [base_s[:, None],
+             _i32(win_s),
+             mst_s[:, None],
+             _i32(info_s)], axis=1)                       # (R, C)
+
+        R = succ.shape[0]
         posn = jnp.cumsum(new.astype(jnp.int32)) - 1
         total = jnp.sum(new.astype(jnp.int32))
 
-        to_front = new & (posn < K)
-        fidx = jnp.where(to_front, posn, K)
-        nfr_base = jnp.zeros(K, dtype=jnp.int32).at[fidx].set(
-            base_s, mode="drop")
-        nfr_win = jnp.zeros((K, L), dtype=jnp.uint32).at[fidx].set(
-            win_s, mode="drop")
-        nfr_info = jnp.zeros((K, Il), dtype=jnp.uint32).at[fidx].set(
-            info_s, mode="drop")
-        nfr_mst = jnp.zeros(K, dtype=jnp.int32).at[fidx].set(
-            mst_s, mode="drop")
+        if accel:
+            score = jnp.where(new, R - posn, 0)
+            _, fsel = lax.top_k(score, K)                 # (K,)
+            nfr = succ[fsel]                              # gather
+        else:
+            to_front = new & (posn < K)
+            fidx = jnp.where(to_front, posn, K)
+            nfr = jnp.zeros((K, C), dtype=jnp.int32).at[fidx].set(
+                succ, mode="drop")
         nfr_cnt = jnp.minimum(total, K)
 
         spill = new & (posn >= K)
         sidx = jnp.where(spill, bk_cnt + posn - K, B)
         overflow = jnp.any(spill & (sidx >= B))
         sidx = jnp.minimum(sidx, B)
-        bk_base = bk_base.at[sidx].set(base_s, mode="drop")
-        bk_win = bk_win.at[sidx].set(win_s, mode="drop")
-        bk_info = bk_info.at[sidx].set(info_s, mode="drop")
-        bk_mst = bk_mst.at[sidx].set(mst_s, mode="drop")
+
+        def do_spill(b):
+            return b.at[sidx].set(succ, mode="drop")
+
+        bk = lax.cond(total > K, do_spill, lambda b: b, bk) if accel \
+            else do_spill(bk)
         nbk_cnt = jnp.minimum(bk_cnt + jnp.maximum(total - K, 0), B)
 
         room = K - nfr_cnt
         take = jnp.minimum(room, nbk_cnt)
-        kidx = jnp.arange(K, dtype=jnp.int32)
-        taking = kidx < take
-        src = jnp.where(taking, jnp.maximum(nbk_cnt - 1 - kidx, 0), 0)
-        dst = jnp.where(taking, nfr_cnt + kidx, K)
-        nfr_base = nfr_base.at[dst].set(bk_base[src], mode="drop")
-        nfr_win = nfr_win.at[dst].set(bk_win[src], mode="drop")
-        nfr_info = nfr_info.at[dst].set(bk_info[src], mode="drop")
-        nfr_mst = nfr_mst.at[dst].set(bk_mst[src], mode="drop")
+
+        def do_refill(args):
+            nfr, bk = args
+            kidx = jnp.arange(K, dtype=jnp.int32)
+            taking = kidx < take
+            src = jnp.where(taking, jnp.maximum(nbk_cnt - 1 - kidx, 0), 0)
+            dst = jnp.where(taking, nfr_cnt + kidx, K)
+            return nfr.at[dst].set(bk[src], mode="drop")
+
+        nfr = lax.cond(take > 0, do_refill, lambda a: a[0],
+                       (nfr, bk)) if accel else do_refill((nfr, bk))
         nfr_cnt = nfr_cnt + take
         nbk_cnt = nbk_cnt - take
 
@@ -275,24 +288,53 @@ def _build_searchN(n_pad: int, ic_pad: int, S: int, O: int,
             stats[3] + jnp.sum(seen.astype(jnp.int32)),
             stats[4] + total,
             stats[5] + 1])
-        return (nfr_base, nfr_win, nfr_info, nfr_mst, nfr_cnt,
-                bk_base, bk_win, bk_info, bk_mst, nbk_cnt,
-                table, nflags, nstats)
+        return (nfr, nfr_cnt, bk, nbk_cnt, table, nflags, nstats)
 
     def chunk_fn(consts, carry):
-        max_cfg = consts[-1]
+        (inv, ret, opc, suf, iinv, iopc, T, n_ok, n_info, max_cfg) = consts
+        # fused lookup tables (see wgl32.chunk_fn)
+        inv_p = jnp.concatenate([inv, jnp.full((1,), INF, jnp.int32)])
+        ret_p = jnp.concatenate([ret, jnp.full((1,), INF, jnp.int32)])
+        opc_p = jnp.concatenate([opc, jnp.zeros((1,), jnp.int32)])
+        if fused:
+            np1 = n_pad + 1
+            nst_ok = T[:, opc_p].T                        # (np1, S)
+            ok_rows = jnp.stack(
+                [jnp.broadcast_to(inv_p[:, None], (np1, S)),
+                 jnp.broadcast_to(ret_p[:, None], (np1, S)),
+                 nst_ok,
+                 jnp.broadcast_to(suf[:, None], (np1, S))],
+                axis=2).reshape(np1 * S, 4)
+            nst_i = T[:, iopc].T                          # (ic, S)
+            info_rows = jnp.stack(
+                [jnp.broadcast_to(iinv[:, None], (ic_pad, S)),
+                 jnp.zeros((ic_pad, S), jnp.int32),
+                 nst_i,
+                 jnp.zeros((ic_pad, S), jnp.int32)],
+                axis=2).reshape(ic_pad * S, 4)
+            GT = jnp.concatenate([ok_rows, info_rows])
+        else:
+            meta = jnp.stack([inv_p, ret_p, opc_p, suf], axis=1)
+            TK = jnp.broadcast_to(T.T.reshape(-1, 1), (S * O, 2))
+            GT = (meta, TK)
+        rconsts = (GT, iinv, iopc, n_ok, n_info, max_cfg)
 
         def cond(c):
-            flags, stats = c[11], c[12]
-            return (~flags[0]) & (c[4] > 0) \
+            flags, stats = c[FLAGS], c[STATS]
+            return (~flags[0]) & (c[FR_CNT] > 0) \
                 & (stats[1] < chunk) & (stats[0] < max_cfg)
 
         def body(c):
-            return round_body(consts, c)
+            return round_body(rconsts, c)
 
-        stats = carry[12]
-        carry = carry[:12] + (stats.at[1].set(0),)
-        return lax.while_loop(cond, body, carry)
+        stats = carry[STATS]
+        carry = carry[:STATS] + (stats.at[1].set(0),)
+        out = lax.while_loop(cond, body, carry)
+        # single packed host-poll summary (see wgl32.chunk_fn)
+        summary = jnp.concatenate(
+            [out[FR_CNT][None], out[FLAGS].astype(jnp.int32),
+             out[STATS]])
+        return out, summary
 
     return init_fn, chunk_fn
 
@@ -300,9 +342,10 @@ def _build_searchN(n_pad: int, ic_pad: int, S: int, O: int,
 @functools.lru_cache(maxsize=32)
 def compiled_searchN(n_pad: int, ic_pad: int, S: int, O: int,
                      K: int, H: int, B: int, chunk: int, probes: int,
-                     W: int, L: int):
+                     W: int, L: int, accel: bool = False):
     import jax
 
     init_fn, chunk_fn = _build_searchN(n_pad, ic_pad, S, O,
-                                       K, H, B, chunk, probes, W, L)
+                                       K, H, B, chunk, probes, W, L,
+                                       accel=accel)
     return init_fn, jax.jit(chunk_fn, donate_argnums=(1,))

@@ -148,7 +148,7 @@ def _batch_capacities(bk: int, W: int, n_pad: int, L: int = 0):
 @functools.lru_cache(maxsize=16)
 def _compiled_batched(n_pad: int, ic_pad: int, W: int, S: int, O: int,
                       K: int, H: int, B: int, chunk: int, probes: int,
-                      L: int = 0):
+                      L: int = 0, accel: bool = False):
     """vmap the shape-bucket kernel over the key axis and jit it.
     Windows that fit a uint32 lane use the bitmask fast path (W here is
     already the trimmed W_eff, padded to a multiple of 8); wider
@@ -161,12 +161,12 @@ def _compiled_batched(n_pad: int, ic_pad: int, W: int, S: int, O: int,
         from ..ops.wgl32 import _build_search32
         init_fn, chunk_fn = _build_search32(n_pad, ic_pad, S, O,
                                             K, H, B, chunk, probes,
-                                            W=W)
+                                            W=W, accel=accel)
     else:
         from ..ops.wgln import _build_searchN
         init_fn, chunk_fn = _build_searchN(n_pad, ic_pad, S, O,
                                            K, H, B, chunk, probes,
-                                           W=W, L=L)
+                                           W=W, L=L, accel=accel)
     vinit = jax.vmap(init_fn)
     vchunk = jax.jit(jax.vmap(chunk_fn), donate_argnums=(1,))
     return vinit, vchunk
@@ -358,12 +358,23 @@ def check_batched(model: Model, histories: Sequence[History],
 
     if strategy == "auto":
         # An explicitly passed mesh pins the caller to the mesh-sharded
-        # vmap path; otherwise large per-key histories stream (see
-        # check_streamed's rationale). Wide-window keys no longer force
-        # streaming: the vmap batch builds the packed multi-lane kernel
-        # (wgln.py) for W > 32, same as the single-history path.
-        strategy = "stream" if (mesh is None
-                                and max(e.n_ok for e in encs) > 512) \
+        # vmap path. On a CPU backend, large per-key histories stream
+        # (see check_streamed's rationale: lockstep lanes pay every
+        # key's rows until the slowest finishes, and host cores run
+        # the single-key kernel fast). On an ACCELERATOR the trade
+        # flips — the per-round cost is serialized-latency-bound, so
+        # lockstep vmap amortizes the same ~hundreds-of-us round over
+        # EVERY key at once, while streaming pays it per key,
+        # sequentially, on however few devices exist (round-4 measured
+        # 197.7 s streamed vs 12.2 s on a lone CPU for 100 x 2k keys).
+        # Wide-window keys no longer force streaming: the vmap batch
+        # builds the packed multi-lane kernel (wgln.py) for W > 32.
+        from ..util import safe_backend
+        on_accel = safe_backend() not in (None, "cpu")
+        stream_wins = (not on_accel
+                       and max(e.n_ok for e in encs) > 512) \
+            or (on_accel and len(encs) < 4)
+        strategy = "stream" if (mesh is None and stream_wins) \
             else "vmap"
     if strategy == "stream":
         streamed = check_streamed(
@@ -418,10 +429,12 @@ def check_batched(model: Model, histories: Sequence[History],
         chunk = min(chunk, 128)
     probes = 4
     K, H, B = _batch_capacities(bk, W, batch.n_pad, L)
+    from ..util import safe_backend
+    accel = safe_backend() not in (None, "cpu")
     vinit, vchunk = _compiled_batched(
         n_pad=batch.n_pad, ic_pad=ic_pad, W=W,
         S=batch.table_s, O=batch.table_o, K=K, H=H, B=B,
-        chunk=chunk, probes=probes, L=L)
+        chunk=chunk, probes=probes, L=L, accel=accel)
 
     def shard(x):
         spec = PartitionSpec(axis) if x.ndim else PartitionSpec()
@@ -439,11 +452,11 @@ def check_batched(model: Model, histories: Sequence[History],
     t0 = _time.monotonic()
     timed_out = False
     while True:
-        carry = vchunk(consts, carry)
-        flags = np.asarray(carry[11])       # (Bk, 3)
-        stats = np.asarray(carry[12])       # (Bk, 6)
-        fr_cnt = np.asarray(carry[4])       # (Bk,)
-        found = flags[:, 0]
+        carry, summary = vchunk(consts, carry)
+        # one packed (Bk, 10) poll transfer: [fr_cnt, flags, stats]
+        s = np.asarray(summary)
+        fr_cnt, flags, stats = s[:, 0], s[:, 1:4], s[:, 4:]
+        found = flags[:, 0] != 0
         empty = fr_cnt == 0
         budget = stats[:, 0] >= max_configs
         live = ~(found | empty | budget)

@@ -56,6 +56,13 @@ def _expand(anomalies) -> tuple:
 
 
 def _dump_anomalies(test, opts, res):
+    """Write the browsable per-anomaly file tree the reference's elle
+    integration produces (`jepsen/src/jepsen/tests/cycle.clj:9-16`
+    passes `:directory`; elle writes one file per anomaly type): for
+    each anomaly, `elle/<name>.json` (machine-readable cases) and
+    `elle/<name>.txt` (one human-readable block per case — cycle,
+    step-by-step explanation). Browsable next to linear.svg in the
+    web UI's store browser."""
     if res.get("valid?") is True or not test or not test.get("store_root"):
         return
     try:
@@ -66,6 +73,31 @@ def _dump_anomalies(test, opts, res):
         for name, cases in (res.get("anomalies") or {}).items():
             with open(os.path.join(d, f"{name}.json"), "w") as fh:
                 json.dump(cases, fh, indent=2, default=repr)
+            with open(os.path.join(d, f"{name}.txt"), "w") as fh:
+                fh.write(f"{name} — {len(cases)} case(s)\n")
+                fh.write("=" * 60 + "\n\n")
+                for i, case in enumerate(cases):
+                    fh.write(f"case {i}\n")
+                    if isinstance(case, dict):
+                        if case.get("cycle") is not None:
+                            fh.write("  cycle: "
+                                     + " -> ".join(
+                                         f"T{t}" for t in case["cycle"])
+                                     + "\n")
+                        for s in case.get("steps") or []:
+                            fh.write(f"  step: T{s.get('from')} "
+                                     f"-{s.get('type')}-> "
+                                     f"T{s.get('to')}\n")
+                        if case.get("explanation"):
+                            fh.write("  why:  "
+                                     + str(case["explanation"]) + "\n")
+                        for k, v in case.items():
+                            if k not in ("cycle", "steps",
+                                         "explanation"):
+                                fh.write(f"  {k}: {v!r}\n")
+                    else:
+                        fh.write(f"  {case!r}\n")
+                    fh.write("\n")
     except Exception:  # noqa: BLE001 — diagnostics must not mask results
         pass
 

@@ -1,0 +1,77 @@
+"""Per-HLO-op overhead inside a device while_loop on axon TPU:
+chain N unfusable ops per iteration, see how round cost scales with N."""
+import time, jax, jax.numpy as jnp, numpy as np
+from jax import lax
+print('backend:', jax.default_backend())
+rng = np.random.RandomState(0)
+
+
+def sync(r):
+    return np.asarray(jax.tree.leaves(r)[-1]).reshape(-1)[0]
+
+
+def timeloop(name, body, state, n=1000, reps=3):
+    def f(st):
+        def cond(c): return c[0] < n
+        def b(c): return (c[0] + 1, body(c[1]))
+        return lax.while_loop(cond, b, (jnp.int32(0), st))
+    fj = jax.jit(f)
+    r = fj(state); sync(r)
+    best = 1e9
+    for _ in range(reps):
+        t0 = time.time(); r = fj(state); sync(r); t = time.time() - t0
+        best = min(best, t)
+    print(f'{name}: {best/n*1e6:8.2f} us/rd  (call {best*1e3:.1f} ms)')
+
+
+x0 = jnp.asarray(rng.rand(640).astype(np.float32))
+
+# N barrier-separated elementwise ops on (640,)
+for N in (1, 4, 16, 64):
+    def body(st, N=N):
+        x = st
+        for _ in range(N):
+            x = jax.lax.optimization_barrier(x * jnp.float32(1.0000001) + jnp.float32(1e-7))
+        return x
+    timeloop(f'{N:3d} barriered elementwise (640,)  ', body, x0, n=1000)
+
+# N barrier-separated elementwise on (64, 128) aligned tile
+y0 = jnp.asarray(rng.rand(64, 128).astype(np.float32))
+for N in (16, 64):
+    def body(st, N=N):
+        x = st
+        for _ in range(N):
+            x = jax.lax.optimization_barrier(x * jnp.float32(1.0000001) + jnp.float32(1e-7))
+        return x
+    timeloop(f'{N:3d} barriered elementwise (64,128)', body, y0, n=1000)
+
+# N gathers per iteration (independent indices, barriered)
+H = 1 << 19
+tb0 = jnp.asarray(rng.randint(1, 1 << 31, (H, 4)).astype(np.uint32))
+idx0 = jnp.asarray(rng.randint(0, H, 640).astype(np.int32))
+for N in (1, 4, 8):
+    def body(st, N=N):
+        idx = st
+        acc = jnp.uint32(0)
+        for i in range(N):
+            slot = tb0[jax.lax.optimization_barrier((idx + i * 97) % H)]
+            acc = acc + slot[:, 0].max()
+        return ((idx + acc.astype(jnp.int32) % 3 + 1) % H)
+    timeloop(f'{N:3d} gathers/iter                  ', body, idx0, n=500)
+
+# N scatters per iteration
+for N in (1, 4, 8):
+    def body(st, N=N):
+        tb, idx = st
+        for i in range(N):
+            vals = jnp.stack([(idx + i).astype(jnp.uint32)] * 4, 1)
+            tb = tb.at[jax.lax.optimization_barrier((idx + i * 131) % H)].set(vals)
+        return (tb, (idx + tb[0, 1].astype(jnp.int32) % 3 + 1) % H)
+    timeloop(f'{N:3d} scatters/iter                 ', body, (tb0, idx0), n=500)
+
+# reduction per iter
+def body_red(st):
+    x, s = st
+    m = x.max()
+    return (x * jnp.float32(1.0000001), s + m)
+timeloop('  1 reduction/iter                ', body_red, (x0, jnp.float32(0)), n=1000)

@@ -28,8 +28,12 @@ def test_batched_matches_oracle():
 
 
 def test_batched_explicit_mesh():
+    import os
     mesh = default_mesh()
-    assert mesh.devices.size == 8  # conftest forces 8 CPU devices
+    if not os.environ.get("JEPSEN_TPU_TESTS_TPU"):
+        assert mesh.devices.size == 8  # conftest forces 8 CPU devices
+    # real-chip tier: whatever device count the hardware has is fine —
+    # the point here is verdict parity over an explicit mesh
     hists = [synth.cas_register_history(40, n_procs=4, seed=s)
              for s in range(5)]  # 5 keys over 8 devices: padded lanes
     res = check_batched(models.cas_register(), hists, mesh=mesh)

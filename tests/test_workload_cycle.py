@@ -64,6 +64,13 @@ def test_append_checker_detects_and_dumps(tmp_path):
     with open(os.path.join(d, "G1a.json")) as fh:
         cases = json.load(fh)
     assert cases[0]["key"] == "x"
+    # the browsable text tree (cycle.clj:9-16's :directory analog):
+    # one .txt per anomaly with a case block + explanation
+    assert "G1a.txt" in files
+    with open(os.path.join(d, "G1a.txt")) as fh:
+        txt = fh.read()
+    assert "G1a — 1 case(s)" in txt
+    assert "case 0" in txt
 
 
 def test_anomaly_expansion():
