@@ -476,6 +476,7 @@ def check(model: Model, history: History, time_limit: Optional[float] = None,
     # build runs big chunks. 1024 keeps CPU fast-path poll granularity
     # a few seconds; the packed wide-window branch below sets its own.
     chunk = 4096 if accel else 1024
+    depth = 1  # the fast path raises this on accel (depth-fused rounds)
     iinv, iopc = enc.inv_info, enc.opcode_info
     if enc.window_raw <= 32:
         # Bitmask fast path: window in one uint32 lane, sort-free dedup.
@@ -489,10 +490,18 @@ def check(model: Model, history: History, time_limit: Optional[float] = None,
         B = 1 << 18  # packed rows are cheap; escalation spills hard
         W = W_eff  # the width the kernel actually runs at
         probes_used, row_cols = 4, W_eff + ic_eff
+        # Depth-fused accel rounds: the search is DEPTH-bound (valid
+        # histories need ~n_ok sequential linearization levels) and
+        # accel rounds are latency-bound, so fusing several levels per
+        # memo/backlog commit divides the serialized round count
+        # (wgl32.round_body_deep). chunk counts super-rounds.
+        depth = 4 if accel else 1
+        chunk = max(1, chunk // depth)
         init_fn, chunk_jit = compiled_search32(
             n_pad=len(enc.inv), ic_pad=ic_eff,
             S=enc.table.shape[0], O=enc.table.shape[1],
-            K=K, H=H, B=B, chunk=chunk, probes=4, W=W_eff, accel=accel)
+            K=K, H=H, B=B, chunk=chunk, probes=4, W=W_eff, accel=accel,
+            depth=depth)
     else:
         # Packed multi-lane kernel (wgln.py): window as L uint32
         # lanes. Successors are bit math + funnel shifts instead of
@@ -560,14 +569,14 @@ def check(model: Model, history: History, time_limit: Optional[float] = None,
         res = _run_search(enc, init_fn, chunk_jit, iinv, iopc, n,
                           max_configs, frontier, K, H, B, W, W_eff,
                           ic_eff, chunk, probes_used, row_cols, accel,
-                          t_enter, time_limit, stop)
+                          t_enter, time_limit, stop, depth=depth)
     res.setdefault("platform", platform or safe_backend() or "cpu")
     return res
 
 
 def _run_search(enc, init_fn, chunk_jit, iinv, iopc, n, max_configs,
                 frontier, K, H, B, W, W_eff, ic_eff, chunk, probes_used,
-                row_cols, accel, t_enter, time_limit, stop):
+                row_cols, accel, t_enter, time_limit, stop, depth=1):
     import jax.numpy as jnp
 
     consts = (jnp.asarray(enc.inv), jnp.asarray(enc.ret),
@@ -603,7 +612,7 @@ def _run_search(enc, init_fn, chunk_jit, iinv, iopc, n, max_configs,
                 n_pad=len(enc.inv), ic_pad=ic_eff,
                 S=enc.table.shape[0], O=enc.table.shape[1],
                 K=_K_BIG, H=H, B=B, chunk=chunk, probes=4, W=W_eff,
-                accel=accel)
+                accel=accel, depth=depth)
             carry = _widen_frontier(carry, _K_BIG)
             K = _K_BIG
         wall = _time.monotonic() - t0

@@ -101,18 +101,17 @@ def _u32(x):
     return jax.lax.bitcast_convert_type(x, jnp.uint32)
 
 
-def probe_insert(table, s0, s1, s2, explore, probes: int, H: int):
-    """Memo-table dedup with one batched probe gather, one insert
-    scatter, one verify gather (see module docstring). Returns
-    (table, seen) — `seen` marks rows whose exact signature was
-    already in the table (or lost an insert race to a twin this
-    round). Shared with wgln.py."""
+def probe_check(table, s0, s1, s2, probes: int, H: int):
+    """Check-only memo probe: ONE batched gather of all `probes`
+    candidate slots. Returns (seen, ins_idx, has_empty) — `ins_idx`
+    is each row's first-empty slot as of this read (the insert site),
+    `has_empty` whether one exists. No table mutation: multi-level
+    rounds batch their inserts into one end-of-round scatter."""
     import jax.numpy as jnp
 
     R = s0.shape[0]
     step = s1 | jnp.uint32(1)
     mysig = jnp.stack([s0, s1, s2], axis=1)                   # (R, 3)
-    myrow = jnp.arange(R, dtype=jnp.uint32)
 
     pr = jnp.arange(probes, dtype=jnp.uint32)
     idx_p = ((s0[:, None] + pr[None, :] * step[:, None])
@@ -128,6 +127,21 @@ def probe_insert(table, s0, s1, s2, explore, probes: int, H: int):
     onehot = firstp[:, None] == jnp.arange(probes,
                                            dtype=jnp.int32)[None, :]
     ins_idx = jnp.sum(jnp.where(onehot, idx_p, 0), axis=1)    # (R,)
+    return seen, ins_idx, has_empty
+
+
+def probe_insert(table, s0, s1, s2, explore, probes: int, H: int):
+    """Memo-table dedup with one batched probe gather, one insert
+    scatter, one verify gather (see module docstring). Returns
+    (table, seen) — `seen` marks rows whose exact signature was
+    already in the table (or lost an insert race to a twin this
+    round). Shared with wgln.py."""
+    import jax.numpy as jnp
+
+    R = s0.shape[0]
+    mysig = jnp.stack([s0, s1, s2], axis=1)                   # (R, 3)
+    myrow = jnp.arange(R, dtype=jnp.uint32)
+    seen, ins_idx, has_empty = probe_check(table, s0, s1, s2, probes, H)
 
     inserting = explore & ~seen & has_empty
     widx = jnp.where(inserting, ins_idx, H)
@@ -143,7 +157,7 @@ def probe_insert(table, s0, s1, s2, explore, probes: int, H: int):
 
 def _build_search32(n_pad: int, ic_pad: int, S: int, O: int,
                     K: int, H: int, B: int, chunk: int, probes: int,
-                    W: int = 32, accel: bool = False):
+                    W: int = 32, accel: bool = False, depth: int = 1):
     """Build (init_fn, chunk_fn) for the W<=32 bitmask kernel. `W` is the
     window width actually materialized (pad the exact requirement to a
     small multiple — successor row count R = K*(W + ic_pad) drives the
@@ -190,9 +204,12 @@ def _build_search32(n_pad: int, ic_pad: int, S: int, O: int,
     jinfo_bit = jnp.asarray(info_bit)
     jinfo_set = jnp.asarray(info_set_mask)
 
-    def round_body(consts, carry):
+    def _expand(consts, fr, fr_cnt):
+        """One expansion level: frontier rows (K, C) -> packed
+        successors (R, C) with legality/success masks and hash
+        signatures. Shared by the single-level round and the
+        depth-fused accel round."""
         (GT, iinv, iopc_c, n_ok, n_info, max_cfg) = consts
-        (fr, fr_cnt, bk, bk_cnt, table, flags, stats) = carry
 
         fr_base = fr[:, 0]
         fr_win = _u32(fr[:, 1])
@@ -297,17 +314,24 @@ def _build_search32(n_pad: int, ic_pad: int, S: int, O: int,
         s1 = _fnv_words(words, 0x01000193)
         s2 = _fnv_words(words, 0xDEADBEEF)
 
-        # --- memo dedup: 1 gather + 1 scatter + 1 verify gather ------
-        table, seen = probe_insert(table, s0, s1, s2, explore, probes, H)
-        new = explore & ~seen
-
-        # --- compact survivors into frontier + backlog ---------------
         succ = jnp.concatenate(
             [base_s[:, None],
              _i32(win_s)[:, None],
              mst_s[:, None],
              _i32(info_s)], axis=1)                           # (R, C)
+        base_max = jnp.max(jnp.where(legal, base_s, 0))
+        return succ, explore, found, s0, s1, s2, base_max
 
+    def round_body(consts, carry):
+        (fr, fr_cnt, bk, bk_cnt, table, flags, stats) = carry
+        succ, explore, found, s0, s1, s2, base_max = \
+            _expand(consts, fr, fr_cnt)
+
+        # --- memo dedup: 1 gather + 1 scatter + 1 verify gather ------
+        table, seen = probe_insert(table, s0, s1, s2, explore, probes, H)
+        new = explore & ~seen
+
+        # --- compact survivors into frontier + backlog ---------------
         R = succ.shape[0]
         posn = jnp.cumsum(new.astype(jnp.int32)) - 1          # (R,)
         total = jnp.sum(new.astype(jnp.int32))
@@ -366,10 +390,126 @@ def _build_search32(n_pad: int, ic_pad: int, S: int, O: int,
         nstats = jnp.stack([
             stats[0] + fr_cnt,
             stats[1] + 1,
-            jnp.maximum(stats[2], jnp.max(jnp.where(legal, base_s, 0))),
+            jnp.maximum(stats[2], base_max),
             stats[3] + jnp.sum(seen.astype(jnp.int32)),
             stats[4] + total,
             stats[5] + 1])
+        return (nfr, nfr_cnt, bk, nbk_cnt, table, nflags, nstats)
+
+    MAXU = jnp.uint32(0xFFFFFFFF)
+
+    def round_body_deep(consts, carry):
+        """Depth-fused accel round: `depth` expansion levels per
+        memo/backlog commit. The per-level critical path shrinks to
+        one grand-table gather + one check-only probe gather + a
+        sort (sorts are ~free on the VPU); the insert scatter runs
+        ONCE for all levels. Within a super-round a config reached
+        at two different levels may be expanded twice (check-only
+        probes can't see uninserted siblings) — bounded by depth,
+        sound, and irrelevant on the near-linear wavefronts this
+        path exists for."""
+        (fr, fr_cnt, bk, bk_cnt, table, flags, stats) = carry
+        found = flags[0]
+        overflow = flags[1]
+        base_max = stats[2]
+        explored_add = jnp.int32(0)
+        hits_add = jnp.int32(0)
+        ins_add = jnp.int32(0)
+        ins_widx = []
+        ins_entry = []
+        cur, cnt = fr, fr_cnt
+        for _lvl in range(depth):
+            succ, explore, found_l, s0, s1, s2, bmax = \
+                _expand(consts, cur, cnt)
+            R = succ.shape[0]
+            found = found | found_l
+            base_max = jnp.maximum(base_max, bmax)
+            explored_add = explored_add + cnt
+
+            seen0, ins_idx, has_empty = probe_check(
+                table, s0, s1, s2, probes, H)
+
+            # sort-dedup in the signature domain. Liveness is its OWN
+            # leading sort key — overloading the hash domain with a
+            # sentinel would misclassify a live row whose s0 happens
+            # to equal the sentinel (p ~ 2^-31/row, a silently
+            # dropped subtree and a potential wrong False).
+            live = explore & ~seen0
+            dead = (~live).astype(jnp.uint32)
+            rid = jnp.arange(R, dtype=jnp.int32)
+            ds, k0s, k1s, k2s, perm = lax.sort(
+                (dead, s0, s1, s2, rid), num_keys=4)
+            live_s = ds == 0
+            samep = (k0s == jnp.roll(k0s, 1)) \
+                & (k1s == jnp.roll(k1s, 1)) \
+                & (k2s == jnp.roll(k2s, 1)) \
+                & live_s & jnp.roll(live_s, 1)
+            samep = samep.at[0].set(False)
+            new_s = live_s & ~samep                           # sorted dom
+            n_new = jnp.sum(new_s.astype(jnp.int32))
+            hits_add = hits_add \
+                + jnp.sum((seen0 & explore).astype(jnp.int32)) \
+                + jnp.sum((live_s & samep).astype(jnp.int32))
+            ins_add = ins_add + n_new
+
+            # collect this level's inserts (batched scatter at end);
+            # entries carry the sorted position as the row id — only
+            # uniqueness within the batch matters
+            insable = new_s & has_empty[perm]
+            ins_widx.append(jnp.where(insable, ins_idx[perm], H))
+            ins_entry.append(jnp.stack(
+                [k0s, k1s, k2s,
+                 lax.convert_element_type(perm, jnp.uint32)], axis=1))
+
+            # next level's frontier: first K unique rows (top_k, no
+            # scatter), overflow spills to the backlog under cond
+            rank = jnp.cumsum(new_s.astype(jnp.int32)) - 1
+            score = jnp.where(new_s & (rank < K), R + K - rank, 0)
+            _, sel = lax.top_k(score, K)
+            rid_sel = perm[sel]
+            cur = succ[rid_sel]
+            spill_s = new_s & (rank >= K)
+            n_spill = jnp.maximum(n_new - K, 0)
+            sidx = jnp.where(spill_s, bk_cnt + rank - K, B)
+            overflow = overflow | jnp.any(spill_s & (sidx >= B))
+            sidx = jnp.minimum(sidx, B)
+
+            def do_spill(b, sidx=sidx, perm=perm, succ=succ):
+                return b.at[sidx].set(succ[perm], mode="drop")
+
+            bk = lax.cond(n_spill > 0, do_spill, lambda b: b, bk)
+            bk_cnt = jnp.minimum(bk_cnt + n_spill, B)
+            cnt = jnp.minimum(n_new, K)
+
+        # one insert scatter for every level's survivors; slot races
+        # across levels lose soundly (re-explored later, never unsound)
+        table = table.at[jnp.concatenate(ins_widx)].set(
+            jnp.concatenate(ins_entry), mode="drop")
+
+        nfr, nfr_cnt = cur, cnt
+        room = K - nfr_cnt
+        take = jnp.minimum(room, bk_cnt)
+
+        def do_refill(args):
+            nfr, bk = args
+            kidx = jnp.arange(K, dtype=jnp.int32)
+            taking = kidx < take
+            src = jnp.where(taking, jnp.maximum(bk_cnt - 1 - kidx, 0), 0)
+            dst = jnp.where(taking, nfr_cnt + kidx, K)
+            return nfr.at[dst].set(bk[src], mode="drop")
+
+        nfr = lax.cond(take > 0, do_refill, lambda a: a[0], (nfr, bk))
+        nfr_cnt = nfr_cnt + take
+        nbk_cnt = bk_cnt - take
+
+        nflags = jnp.stack([found, overflow, nfr_cnt == 0])
+        nstats = jnp.stack([
+            stats[0] + explored_add,
+            stats[1] + 1,
+            base_max,
+            stats[3] + hits_add,
+            stats[4] + ins_add,
+            stats[5] + depth])
         return (nfr, nfr_cnt, bk, nbk_cnt, table, nflags, nstats)
 
     def chunk_fn(consts, carry):
@@ -413,6 +553,8 @@ def _build_search32(n_pad: int, ic_pad: int, S: int, O: int,
                 & (stats[1] < chunk) & (stats[0] < max_cfg)
 
         def body(c):
+            if depth > 1:
+                return round_body_deep(rconsts, c)
             return round_body(rconsts, c)
 
         stats = carry[STATS]
@@ -433,10 +575,10 @@ def _build_search32(n_pad: int, ic_pad: int, S: int, O: int,
 @functools.lru_cache(maxsize=32)
 def compiled_search32(n_pad: int, ic_pad: int, S: int, O: int,
                       K: int, H: int, B: int, chunk: int, probes: int,
-                      W: int = 32, accel: bool = False):
+                      W: int = 32, accel: bool = False, depth: int = 1):
     import jax
 
     init_fn, chunk_fn = _build_search32(n_pad, ic_pad, S, O,
                                         K, H, B, chunk, probes, W=W,
-                                        accel=accel)
+                                        accel=accel, depth=depth)
     return init_fn, jax.jit(chunk_fn, donate_argnums=(1,))

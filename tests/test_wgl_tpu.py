@@ -21,6 +21,14 @@ def run_both(model, hist):
     assert tpu["valid?"] == ref["valid?"], (
         f"kernel={tpu!r}\noracle={ref!r}\n"
         f"history={[o.to_dict() for o in hist]}")
+    # The ACCELERATOR layout (grand-table gather, top_k compaction,
+    # cond-guarded backlog — wgl32/wgln accel=True) compiles and runs
+    # on any backend; platform="tpu" forces it here so CI covers both
+    # builds differentially, not just the host layout.
+    acc = wgl_tpu.check(model, hist, frontier=FRONTIER, platform="tpu")
+    assert acc["valid?"] == ref["valid?"], (
+        f"accel-layout={acc!r}\noracle={ref!r}\n"
+        f"history={[o.to_dict() for o in hist]}")
     return tpu
 
 
