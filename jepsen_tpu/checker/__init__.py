@@ -207,6 +207,17 @@ class Linearizable(Checker):
         self.time_limit = time_limit
 
     def check(self, test, history, opts=None):
+        from ..trace import NULL_TRACER
+        # a test-map tracer nests the whole analysis under ONE trace
+        # alongside client spans (core.py exports both to trace.jsonl):
+        # the root span here parents the engine phase spans (encode /
+        # compile / device-round / host-poll / oracle-race / enrich)
+        tracer = (test or {}).get("tracer") or NULL_TRACER
+        with tracer.span("check linearizable",
+                         attrs={"algorithm": self.algorithm}):
+            return self._check(test, history, opts, tracer)
+
+    def _check(self, test, history, opts, tracer):
         from ..history import strip_nemesis
         from ..ops import wgl_ref
         h = strip_nemesis(history)
@@ -241,9 +252,11 @@ class Linearizable(Checker):
         elif algo == "tpu-wgl":
             from ..ops import wgl as wgl_tpu
             res = wgl_tpu.check_with_diagnostics(
-                self.model, h, time_limit=self.time_limit)
+                self.model, h, time_limit=self.time_limit,
+                tracer=tracer)
         elif algo == "competition":
-            res = _race_competition(self.model, h, self.time_limit)
+            res = _race_competition(self.model, h, self.time_limit,
+                                    tracer=tracer)
         else:
             raise ValueError(f"unknown linearizability algorithm {algo!r}")
         # Truncate expensive diagnostics (checker.clj:213-216).
@@ -257,11 +270,20 @@ class Linearizable(Checker):
             p = linear_report.render_analysis(test, h, res, opts)
             if p:
                 res["counterexample-svg"] = p
+        if (res.get("telemetry") or {}).get("chunks") \
+                and (test or {}).get("name"):
+            # telemetry-enabled device runs get a search-progress
+            # panel next to the latency/rate plots
+            from . import plots
+            p = plots.search_progress_graph(
+                test, res["telemetry"]["chunks"], opts)
+            if p:
+                res["search-progress-png"] = p
         return res
 
 
 def _race_competition(model, h, time_limit, device=None,
-                      max_configs=None, enc=None):
+                      max_configs=None, enc=None, tracer=None):
     """knossos.competition semantics: run the device search and the
     host oracle CONCURRENTLY; the first definitive verdict wins and
     cancels the loser (serial device-then-oracle left pathological
@@ -270,12 +292,17 @@ def _race_competition(model, h, time_limit, device=None,
 
     `device` pins the device-engine thread (jax.default_device is
     thread-local, so a caller's pin would not reach it otherwise);
-    `max_configs`/`enc` pass through to the device search."""
+    `max_configs`/`enc` pass through to the device search. `tracer`
+    emits an "oracle-race" phase span around the race, and each
+    engine thread's spans adopt it as an explicit parent
+    (trace.Tracer.span nesting is thread-local)."""
     import importlib.util
     import queue
     import threading
 
     from ..ops import wgl_ref
+    from ..trace import NULL_TRACER
+    tracer = tracer or NULL_TRACER
 
     if importlib.util.find_spec("jax") is None:
         # no accelerator stack at all: the quiet, expected path — the
@@ -300,7 +327,8 @@ def _race_competition(model, h, time_limit, device=None,
                else contextlib.nullcontext())
         with pin:
             return wgl_tpu.check(model, h, time_limit=budget,
-                                 stop=stop, enc=enc, **kw)
+                                 stop=stop, enc=enc, tracer=tracer,
+                                 **kw)
 
     def enrich_spare(r, t_start):
         """Post-verdict counterexample enrichment riding only the
@@ -311,7 +339,8 @@ def _race_competition(model, h, time_limit, device=None,
                  if time_limit is not None else 10.0)
         if spare > 0.1:
             r = wgl_tpu.enrich_diagnostics(model, h, r,
-                                           time_limit=min(10.0, spare))
+                                           time_limit=min(10.0, spare),
+                                           tracer=tracer)
         return r
 
     if safe_backend() == "cpu" and time_limit is not None:
@@ -328,36 +357,43 @@ def _race_competition(model, h, time_limit, device=None,
         #      the narrow fast path wins by orders of magnitude;
         #   3. oracle on whatever is left, in case the device came up
         #      unknown with budget remaining.
-        t0 = time.monotonic()
-        slice1 = min(5.0, time_limit / 6)
-        r = wgl_ref.check(model, h, time_limit=slice1)
-        if r.get("valid?") != UNKNOWN:
-            r["engine"] = "oracle"
+        with tracer.span("oracle-race",
+                         attrs={"mode": "serial-ladder"}):
+            t0 = time.monotonic()
+            slice1 = min(5.0, time_limit / 6)
+            r = wgl_ref.check(model, h, time_limit=slice1)
+            if r.get("valid?") != UNKNOWN:
+                r["engine"] = "oracle"
+                return r
+            left = max(1.0, time_limit - (time.monotonic() - t0))
+            try:
+                r = run_device(left * 0.75)
+            except Exception:  # noqa: BLE001 — encode/step failures
+                logging.getLogger(__name__).warning(
+                    "device engine failed in serial competition",
+                    exc_info=True)
+                r = {"valid?": UNKNOWN, "cause": "engine-error"}
+            if r.get("valid?") != UNKNOWN:
+                r["engine"] = "device"
+                return enrich_spare(r, t0)
+            left = max(1.0, time_limit - (time.monotonic() - t0))
+            r = wgl_ref.check(model, h, time_limit=left)
+            if r.get("valid?") != UNKNOWN:
+                r["engine"] = "oracle"
             return r
-        left = max(1.0, time_limit - (time.monotonic() - t0))
-        try:
-            r = run_device(left * 0.75)
-        except Exception:  # noqa: BLE001 — encode/step failures
-            logging.getLogger(__name__).warning(
-                "device engine failed in serial competition",
-                exc_info=True)
-            r = {"valid?": UNKNOWN, "cause": "engine-error"}
-        if r.get("valid?") != UNKNOWN:
-            r["engine"] = "device"
-            return enrich_spare(r, t0)
-        left = max(1.0, time_limit - (time.monotonic() - t0))
-        r = wgl_ref.check(model, h, time_limit=left)
-        if r.get("valid?") != UNKNOWN:
-            r["engine"] = "oracle"
-        return r
 
     winner = threading.Event()
     outcomes: queue.Queue = queue.Queue()
+    race_ctx: dict = {}  # the oracle-race span's context, set below
 
     def arm(name, fn):
         def run():
             try:
-                r = fn()
+                # engine spans adopt the race span as an explicit
+                # parent (span nesting is thread-local otherwise)
+                with tracer.span(f"engine {name}",
+                                 parent=race_ctx.get("ctx")):
+                    r = fn()
             except Exception:  # noqa: BLE001 — device init failure etc.
                 logging.getLogger(__name__).warning(
                     "%s engine failed in competition", name,
@@ -400,7 +436,7 @@ def _race_competition(model, h, time_limit, device=None,
             kw["max_configs"] = max_configs
         return wgl_tpu.check(model, h, time_limit=time_limit,
                              stop=winner.is_set, enc=enc,
-                             platform="cpu", **kw)
+                             platform="cpu", tracer=tracer, **kw)
 
     def device_engine():
         # The engine's FIRST device call would trigger backend init,
@@ -434,29 +470,33 @@ def _race_competition(model, h, time_limit, device=None,
         # lane already IS the cpu build, and a second identical
         # kernel would just contend for the same cores
         threads.append(arm("device@cpu", device_cpu))
-    for t in threads:
-        t.start()
-    res: dict = {}
-    unknowns: dict = {}
-    for _ in range(len(threads)):  # take the FIRST definitive verdict
-        name, r = outcomes.get()
-        if r.get("valid?") != UNKNOWN:
-            r["engine"] = name
-            res = r
-            break
-        unknowns[name] = r
-    else:
-        # all unknown: prefer the oracle's cause (it has diagnostics)
-        res = unknowns.get("oracle") or unknowns.get("device") \
-            or unknowns.get("device@cpu") or {"valid?": UNKNOWN}
-    # Reap the loser without gating the fast win (it self-cancels at
-    # its next stop poll; an uninterruptible first compile can outlive
-    # any wait) — flag a still-draining loser so downstream timings
-    # are explicable.
-    for t in threads:
-        t.join(timeout=0.1)
-        if t.is_alive():
-            res["loser_draining"] = t.name
+    with tracer.span("oracle-race",
+                     attrs={"engines": [t.name for t in threads]}):
+        race_ctx["ctx"] = tracer.context()
+        for t in threads:
+            t.start()
+        res: dict = {}
+        unknowns: dict = {}
+        for _ in range(len(threads)):  # take FIRST definitive verdict
+            name, r = outcomes.get()
+            if r.get("valid?") != UNKNOWN:
+                r["engine"] = name
+                res = r
+                break
+            unknowns[name] = r
+        else:
+            # all unknown: prefer the oracle's cause (it has
+            # diagnostics)
+            res = unknowns.get("oracle") or unknowns.get("device") \
+                or unknowns.get("device@cpu") or {"valid?": UNKNOWN}
+        # Reap the loser without gating the fast win (it self-cancels
+        # at its next stop poll; an uninterruptible first compile can
+        # outlive any wait) — flag a still-draining loser so
+        # downstream timings are explicable.
+        for t in threads:
+            t.join(timeout=0.1)
+            if t.is_alive():
+                res["loser_draining"] = t.name
     if str(res.get("engine", "")).startswith("device"):
         res = enrich_spare(res, t_race0)
     return res

@@ -89,6 +89,25 @@ def render(history: History, analysis: dict) -> Optional[str]:
              f"History is not linearizable — "
              f"{_esc(analysis.get('algorithm', ''))}</text>"]
 
+    # search telemetry footer: how hard the kernel worked for this
+    # verdict (the util block every device result carries)
+    util = analysis.get("util") or {}
+    if util or analysis.get("configs_explored") is not None:
+        bits = []
+        if analysis.get("configs_explored") is not None:
+            bits.append(f"{analysis['configs_explored']} configs")
+        if util.get("rounds") is not None:
+            bits.append(f"{util['rounds']} rounds")
+        if util.get("memo_hit_rate") is not None:
+            bits.append(f"memo hit rate {util['memo_hit_rate']}")
+        if analysis.get("wall_s") is not None:
+            bits.append(f"{analysis['wall_s']} s")
+        if bits:
+            parts.append(
+                f"<text x='{LEFT}' y='32' font-size='10' "
+                f"fill='#666'>device search: "
+                f"{_esc(', '.join(bits))}</text>")
+
     for p in procs:
         parts.append(f"<text x='8' y='{y_of(p) + 13}'>"
                      f"process {_esc(p)}</text>")

@@ -183,6 +183,68 @@ def quantiles_graph(test, history, opts=None, pts=None) -> Optional[str]:
     return out
 
 
+def search_progress_graph(test, chunks, opts=None) -> Optional[str]:
+    """search-progress.png: the WGL device search's own trajectory
+    from the per-chunk telemetry timeseries (metrics.py `wgl_chunks`
+    points / a result's `telemetry.chunks`): frontier + backlog
+    occupancy, cumulative configs explored with the per-poll
+    exploration rate, and the memo-table hit rate, all over search
+    wall clock. Never raises — a malformed point list must not mask
+    the verdict it rides along with."""
+    try:
+        pts = [p for p in (chunks or []) if "wall_s" in p]
+        if not pts:
+            return None
+        plt = _plt()
+        t = [p["wall_s"] for p in pts]
+        fig, axes = plt.subplots(3, 1, figsize=(10, 7), sharex=True)
+        ax = axes[0]
+        ax.plot(t, [p.get("frontier", 0) for p in pts], marker="o",
+                markersize=3, lw=1, color=Q_COLORS[0.95],
+                label="frontier")
+        ax.plot(t, [p.get("backlog", 0) for p in pts], marker="s",
+                markersize=3, lw=1, color=Q_COLORS[1.0],
+                label="backlog")
+        if any(p.get("K") for p in pts):
+            ax.plot(t, [p.get("K", 0) for p in pts], lw=1, ls="--",
+                    color="#888888", label="K (beam)")
+        ax.set_yscale("symlog")
+        ax.set_ylabel("configs")
+        ax.legend(loc="upper right", fontsize=7)
+        ax.set_title(f"{test.get('name', '')} search progress")
+
+        ax = axes[1]
+        ax.plot(t, [p.get("explored", 0) for p in pts], marker="o",
+                markersize=3, lw=1, color=TYPE_COLORS["ok"],
+                label="explored (cumulative)")
+        rate = [p.get("explored_delta", 0) / max(p.get("poll_s", 0),
+                                                 1e-9) for p in pts]
+        ax2 = ax.twinx()
+        ax2.plot(t, rate, marker="^", markersize=3, lw=1,
+                 color=TYPE_COLORS["info"], label="configs/s")
+        ax.set_ylabel("explored")
+        ax2.set_ylabel("configs/s")
+        h1, l1 = ax.get_legend_handles_labels()
+        h2, l2 = ax2.get_legend_handles_labels()
+        ax.legend(h1 + h2, l1 + l2, loc="upper left", fontsize=7)
+
+        ax = axes[2]
+        ax.plot(t, [p.get("memo_hit_rate", 0) for p in pts],
+                marker="o", markersize=3, lw=1, color=Q_COLORS[0.99],
+                label="memo hit rate")
+        ax.set_ylim(0, 1)
+        ax.set_ylabel("hit rate")
+        ax.set_xlabel("Search wall clock (s)")
+        ax.legend(loc="upper right", fontsize=7)
+
+        out = _save(fig, test, opts, "search-progress.png")
+        plt.close(fig)
+        return out
+    except Exception:  # noqa: BLE001
+        log.warning("search-progress rendering failed", exc_info=True)
+        return None
+
+
 def rate_graph(test, history, opts=None) -> Optional[str]:
     """Completion rate (hz) in 10 s buckets by f and type
     (perf.clj:559-599)."""

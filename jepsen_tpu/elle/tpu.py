@@ -209,6 +209,19 @@ def cycle_queries(g: DepGraph,
             "compile_s": round(compile_s, 3),
             "achieved_tflops": round(flops / 1e12 / max(kernel_s, 1e-9),
                                      2)}
+    from .. import metrics as _metrics
+    mx = _metrics.get_default()
+    if mx.enabled:
+        # the MXU plane's telemetry rides the same registry as the
+        # search kernels' (doc/OBSERVABILITY.md)
+        mx.series("elle_closure",
+                  "per-call Elle closure-kernel telemetry").append(
+            {"edges": int(len(src)), "n": n, **util})
+        mx.counter("elle_closure_calls_total",
+                   "batched closure kernel invocations").inc()
+        mx.histogram("elle_closure_seconds",
+                     "closure kernel wall (post-compile)").observe(
+            kernel_s)
     labels = np.asarray(labels)[:, :n]
     closed = np.asarray(closed)[:, :len(rw_edges)]
 

@@ -41,6 +41,34 @@ V5E_PEAK_BF16_FLOPS = 197e12
 V5E_PEAK_HBM_BYTES = 819e9
 V5E_NAME = "tpu v5e (v5 lite)"
 
+# bf16 single-chip peaks by detected device kind (public spec sheets),
+# substring-matched: jax `device_kind` strings vary by runtime plugin
+# ("TPU v5 lite" from libtpu, "TPU v5e" from some plugins). MFU ratios
+# in BENCH must divide by the peak of the chip that RAN, not a
+# hardcoded v5e number (round-5 ADVICE).
+PEAK_BF16_BY_KIND = (
+    ("v6 lite", 918e12, "tpu v6e (trillium)"),
+    ("v6e", 918e12, "tpu v6e (trillium)"),
+    ("v5 lite", V5E_PEAK_BF16_FLOPS, V5E_NAME),
+    ("v5e", V5E_PEAK_BF16_FLOPS, V5E_NAME),
+    ("v5p", 459e12, "tpu v5p"),
+    ("v5", 459e12, "tpu v5p"),
+    ("v4", 275e12, "tpu v4"),
+)
+
+
+def peak_bf16_flops(device_kind: Optional[str] = None
+                    ) -> tuple[float, str]:
+    """(peak bf16 FLOP/s, chip label) for a detected jax device kind.
+    Unknown/absent kinds fall back to the v5e spec numbers the AOT
+    roofline model uses — labeled as a default so the fallback is
+    visible in the published ratio."""
+    kind = (device_kind or "").lower()
+    for pat, peak, label in PEAK_BF16_BY_KIND:
+        if pat in kind:
+            return peak, label
+    return V5E_PEAK_BF16_FLOPS, f"{V5E_NAME} (default: unknown kind)"
+
 _TOPOLOGY = "v5e:2x2"  # smallest layout divisible by the 2x2x1 host
 
 

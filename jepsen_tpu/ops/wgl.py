@@ -400,7 +400,9 @@ def check(model: Model, history: History, time_limit: Optional[float] = None,
           max_configs: int = 200_000_000, frontier: Optional[int] = None,
           enc: Optional[Encoded] = None,
           stop: Optional[Callable[[], bool]] = None,
-          platform: Optional[str] = None) -> dict:
+          platform: Optional[str] = None,
+          metrics=None, tracer=None,
+          profile_dir: Optional[str] = None) -> dict:
     """Decide linearizability on the accelerator.
 
     Returns {"valid?": True/False/"unknown", ...}. "unknown" (deadline,
@@ -417,8 +419,25 @@ def check(model: Model, history: History, time_limit: Optional[float] = None,
     device@cpu because small/near-serial shapes are latency-bound and
     the host core wins them (round-4 VERDICT #3). The result carries
     `platform` so route_reason/engine rows can name it.
+
+    Telemetry (doc/OBSERVABILITY.md): `metrics` is a
+    `jepsen_tpu.metrics.Registry` (default: the ambient registry —
+    NULL unless enabled, so the instrumented path costs nothing);
+    when enabled, every device chunk's packed poll summary lands in
+    the `wgl_chunks` timeseries and the result carries a
+    `telemetry.chunks` copy. `tracer` is a `trace.Tracer`; phase
+    spans (encode / compile / device-round / host-poll) nest under
+    the caller's current span. `profile_dir` (or env
+    JEPSEN_TPU_PROFILE_DIR) opt-in wraps the search in a
+    `jax.profiler` capture whose Perfetto-ingestible trace lands in
+    that directory; capture failures never block the verdict.
     """
+    from .. import metrics as _metrics_mod
+    from .. import trace as _trace_mod
     from ..util import backend_ready
+
+    mx = metrics if metrics is not None else _metrics_mod.get_default()
+    tracer = tracer if tracer is not None else _trace_mod.NULL_TRACER
 
     # The first device call triggers backend init, which hangs forever
     # on a wedged accelerator runtime (this environment's default
@@ -440,7 +459,8 @@ def check(model: Model, history: History, time_limit: Optional[float] = None,
     max_configs = min(max_configs, 2**30)
     try:
         if enc is None:
-            enc = encode(model, history)
+            with tracer.span("encode", attrs={"ops": len(history)}):
+                enc = encode(model, history)
     except EncodingUnsupported as e:
         return {"valid?": "unknown", "cause": f"encoding: {e}",
                 "op_count": len(history)}
@@ -565,19 +585,49 @@ def check(model: Model, history: History, time_limit: Optional[float] = None,
                 jax.local_devices(backend="cpu")[0])
         except Exception:  # noqa: BLE001 — no cpu backend: stay put
             pass
-    with dev_ctx:
-        res = _run_search(enc, init_fn, chunk_jit, iinv, iopc, n,
-                          max_configs, frontier, K, H, B, W, W_eff,
-                          ic_eff, chunk, probes_used, row_cols, accel,
-                          t_enter, time_limit, stop, depth=depth)
-    res.setdefault("platform", platform or safe_backend() or "cpu")
+    # Opt-in hardware profile: a jax.profiler capture around the whole
+    # search, dropping a Perfetto/xprof-ingestible trace into the
+    # run's artifact dir. start/stop (not the context manager) so a
+    # capture failure is contained without re-running the search.
+    profile_dir = profile_dir or os.environ.get("JEPSEN_TPU_PROFILE_DIR")
+    profiled = False
+    if profile_dir:
+        try:
+            jax.profiler.start_trace(profile_dir)
+            profiled = True
+        except Exception:  # noqa: BLE001 — profiling never blocks
+            pass           # the verdict
+    plat_label = platform or safe_backend() or "cpu"
+    try:
+        with dev_ctx:
+            res = _run_search(enc, init_fn, chunk_jit, iinv, iopc, n,
+                              max_configs, frontier, K, H, B, W, W_eff,
+                              ic_eff, chunk, probes_used, row_cols,
+                              accel, t_enter, time_limit, stop,
+                              depth=depth, mx=mx, tracer=tracer,
+                              plat=plat_label)
+    finally:
+        if profiled:
+            try:
+                jax.profiler.stop_trace()
+            except Exception:  # noqa: BLE001
+                profiled = False
+    if profiled:
+        res["profile_dir"] = profile_dir
+    res.setdefault("platform", plat_label)
     return res
 
 
 def _run_search(enc, init_fn, chunk_jit, iinv, iopc, n, max_configs,
                 frontier, K, H, B, W, W_eff, ic_eff, chunk, probes_used,
-                row_cols, accel, t_enter, time_limit, stop, depth=1):
+                row_cols, accel, t_enter, time_limit, stop, depth=1,
+                mx=None, tracer=None, plat="cpu"):
     import jax.numpy as jnp
+
+    from .. import metrics as _metrics_mod
+    from .. import trace as _trace_mod
+    mx = mx if mx is not None else _metrics_mod.get_default()
+    tracer = tracer if tracer is not None else _trace_mod.NULL_TRACER
 
     consts = (jnp.asarray(enc.inv), jnp.asarray(enc.ret),
               jnp.asarray(enc.opcode), jnp.asarray(enc.sufminret),
@@ -588,12 +638,41 @@ def _run_search(enc, init_fn, chunk_jit, iinv, iopc, n, max_configs,
     deadline = t_enter + time_limit if time_limit else None
     t0 = _time.monotonic()
     first_call_s = None
+    n_chunks = 0
+    bk_peak = 0
+    # per-chunk telemetry: the kernel's cumulative device stats turn
+    # into per-poll deltas here; None when disabled so the hot loop
+    # pays nothing (metrics.py's zero-cost contract)
+    tl_points: Optional[list] = [] if mx.enabled else None
+    kern = "wgl32" if enc.window_raw <= 32 else "wgln"
+    # the compute/transfer split below costs one extra device sync per
+    # poll — only pay it when someone is recording (the disabled run
+    # must keep the original single-transfer poll, overhead-free)
+    instrumented = tl_points is not None or tracer.sampled
     while True:
-        carry, summary = chunk_jit(consts, carry)
-        # ONE device->host transfer per poll: the packed summary is
-        # [fr_cnt, found, overflow, exhausted, stats...]
-        s = np.asarray(summary)
-        fr_cnt, flags, stats = int(s[0]), s[1:4], s[4:]
+        t_call = _time.monotonic()
+        # the first call folds in compile (the cold/warm split every
+        # result reports); later calls are pure device rounds
+        with tracer.span("compile" if n_chunks == 0 else "device-round",
+                         attrs={"chunk": n_chunks}):
+            carry, summary = chunk_jit(consts, carry)
+            # async dispatch returns immediately — when instrumented,
+            # block here so the device-round span (and poll_s) covers
+            # device compute and the host-poll span/transfer_s below
+            # isolates the actual device->host transfer of the packed
+            # (11,) summary [fr_cnt, found, overflow, exhausted,
+            # stats x6, bk_cnt] (~75 ms round-trip, tunneled v5e)
+            if instrumented:
+                summary.block_until_ready()
+            with tracer.span("host-poll"):
+                t_xfer = _time.monotonic()
+                s = np.asarray(summary)
+                xfer_s = _time.monotonic() - t_xfer
+        poll_s = _time.monotonic() - t_call
+        fr_cnt, flags, stats = int(s[0]), s[1:4], s[4:10]
+        bk_cnt = int(s[10])
+        n_chunks += 1
+        bk_peak = max(bk_peak, bk_cnt)
         if first_call_s is None:
             # compile + first chunk: the cold/warm split every result
             # reports (a persistent compilation cache turns this into
@@ -601,6 +680,60 @@ def _run_search(enc, init_fn, chunk_jit, iinv, iopc, n, max_configs,
             first_call_s = _time.monotonic() - t0
         found, overflow = bool(flags[0]), bool(flags[1])
         total_explored = int(stats[0])
+        if tl_points is not None:
+            prev = tl_points[-1] if tl_points else {}
+            memo_hits_c, inserted_c = int(stats[3]), int(stats[4])
+            point = {
+                "chunk": n_chunks - 1,
+                "cold": n_chunks == 1,
+                "wall_s": round(_time.monotonic() - t0, 6),
+                "poll_s": round(poll_s, 6),
+                "transfer_s": round(xfer_s, 6),
+                "frontier": fr_cnt,
+                "backlog": bk_cnt,
+                "K": K,
+                "rounds": int(stats[5]),
+                "explored": total_explored,
+                "memo_hits": memo_hits_c,
+                "memo_inserts": inserted_c,
+                "memo_hit_rate": round(
+                    memo_hits_c / max(memo_hits_c + inserted_c, 1), 4),
+                "rounds_delta": int(stats[5]) - prev.get("rounds", 0),
+                "explored_delta": (total_explored
+                                   - prev.get("explored", 0)),
+                "kernel": kern,
+                # platform distinguishes raced lanes: competition runs
+                # device@accel and device@cpu over the SAME history
+                # with the same kernel, concurrently
+                "platform": plat,
+            }
+            tl_points.append(point)
+            mx.series("wgl_chunks",
+                      "per-chunk packed poll summaries of the WGL "
+                      "device search").append(point)
+            lbl = {"kernel": kern, "platform": plat}
+            mx.counter("wgl_chunks_total",
+                       "device chunk calls").inc(**lbl)
+            mx.counter("wgl_rounds_total",
+                       "search rounds executed on device").inc(
+                point["rounds_delta"], **lbl)
+            mx.counter("wgl_configs_explored_total",
+                       "configurations expanded").inc(
+                point["explored_delta"], **lbl)
+            mx.counter("wgl_memo_hits_total",
+                       "memo-table dedup hits").inc(
+                memo_hits_c - prev.get("memo_hits", 0), **lbl)
+            mx.counter("wgl_memo_inserts_total",
+                       "memo-table inserts").inc(
+                inserted_c - prev.get("memo_inserts", 0), **lbl)
+            mx.gauge("wgl_frontier_size",
+                     "beam occupancy at last poll").set(fr_cnt, **lbl)
+            mx.gauge("wgl_backlog_size",
+                     "backlog depth at last poll").set(bk_cnt, **lbl)
+            mx.histogram("wgl_poll_seconds",
+                         "host<->device chunk latency (device compute "
+                         "+ packed-summary transfer)").observe(
+                poll_s, **lbl)
         if (not found and fr_cnt > 0 and not frontier
                 and enc.window_raw <= 32 and K < _K_BIG
                 and total_explored >= _ESCALATE_AT):
@@ -636,12 +769,18 @@ def _run_search(enc, init_fn, chunk_jit, iinv, iopc, n, max_configs,
             "est_table_mb_per_round": round(
                 K * row_cols * 16 * probes_used / 1e6, 3),
             "first_call_s": round(first_call_s, 3),
+            "chunks": n_chunks,
+            "backlog_peak": bk_peak,
         }
         # W is the history's actual window; W_pad the kernel's padded
         # width (equal for the narrow path, 32-padded for wide lanes)
         detail = {"W": enc.window_raw, "W_pad": W, "K": K,
                   "configs_explored": total_explored,
                   "wall_s": round(wall, 4), "util": util}
+        if tl_points is not None:
+            # the run's own copy of the per-chunk timeseries (the
+            # registry keeps the cross-run series)
+            detail["telemetry"] = {"chunks": tl_points}
         if found:
             return {"valid?": True, "op_count": n + enc.n_info, **detail}
         if fr_cnt == 0:
@@ -663,16 +802,19 @@ def _run_search(enc, init_fn, chunk_jit, iinv, iopc, n, max_configs,
 
 def enrich_diagnostics(model: Model, history: History, res: dict,
                        time_limit: float = 30.0,
-                       stop: Optional[Callable[[], bool]] = None
-                       ) -> dict:
+                       stop: Optional[Callable[[], bool]] = None,
+                       tracer=None) -> dict:
     """On a device False verdict, re-run the host oracle briefly to
     extract counterexample diagnostics (final_paths / configs),
     matching the reference's expectation that invalid results explain
     themselves (checker.clj:205-212 renders linear.svg from them)."""
+    from .. import trace as _trace_mod
+    tracer = tracer if tracer is not None else _trace_mod.NULL_TRACER
     if res.get("valid?") is False and "final_paths" not in res \
             and not (stop is not None and stop()):
-        ref = wgl_ref.check(model, history, time_limit=time_limit,
-                            stop=stop)
+        with tracer.span("enrich"):
+            ref = wgl_ref.check(model, history, time_limit=time_limit,
+                                stop=stop)
         if ref.get("valid?") is False:
             for k in ("final_paths", "configs", "max_linearized"):
                 if k in ref:
@@ -682,10 +824,12 @@ def enrich_diagnostics(model: Model, history: History, res: dict,
 
 def check_with_diagnostics(model: Model, history: History,
                            time_limit: Optional[float] = None,
-                           stop: Optional[Callable[[], bool]] = None
-                           ) -> dict:
+                           stop: Optional[Callable[[], bool]] = None,
+                           metrics=None, tracer=None) -> dict:
     """TPU verdict + counterexample enrichment (enrich_diagnostics)."""
-    res = check(model, history, time_limit=time_limit, stop=stop)
+    res = check(model, history, time_limit=time_limit, stop=stop,
+                metrics=metrics, tracer=tracer)
     # stop still threads through: in a competition race the oracle
     # runs concurrently anyway, and the loser must stay cancellable
-    return enrich_diagnostics(model, history, res, stop=stop)
+    return enrich_diagnostics(model, history, res, stop=stop,
+                              tracer=tracer)

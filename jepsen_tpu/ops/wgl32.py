@@ -396,8 +396,6 @@ def _build_search32(n_pad: int, ic_pad: int, S: int, O: int,
             stats[5] + 1])
         return (nfr, nfr_cnt, bk, nbk_cnt, table, nflags, nstats)
 
-    MAXU = jnp.uint32(0xFFFFFFFF)
-
     def round_body_deep(consts, carry):
         """Depth-fused accel round: `depth` expansion levels per
         memo/backlog commit. The per-level critical path shrinks to
@@ -560,13 +558,15 @@ def _build_search32(n_pad: int, ic_pad: int, S: int, O: int,
         stats = carry[STATS]
         carry = carry[:STATS] + (stats.at[1].set(0),)
         out = lax.while_loop(cond, body, carry)
-        # one packed (10,) summary so the host polls with a SINGLE
+        # one packed (11,) summary so the host polls with a SINGLE
         # device->host transfer per chunk (each transfer costs a full
         # runtime round-trip — ~75 ms through the tunneled v5e, which
-        # dominated the headline wall before this)
+        # dominated the headline wall before this): [fr_cnt, flags x3,
+        # stats x6, bk_cnt] — bk_cnt feeds the telemetry timeseries
+        # (metrics.py); existing consumers index the leading 10.
         summary = jnp.concatenate(
             [out[FR_CNT][None], out[FLAGS].astype(jnp.int32),
-             out[STATS]])
+             out[STATS], out[BK_CNT][None]])
         return out, summary
 
     return init_fn, chunk_fn

@@ -44,8 +44,8 @@ import functools
 
 import numpy as np
 
-from .wgl32 import FLAGS, FR_CNT, STATS, _ctz32, _fnv_words, _i32, _u32, \
-    probe_insert
+from .wgl32 import BK_CNT, FLAGS, FR_CNT, STATS, _ctz32, _fnv_words, \
+    _i32, _u32, probe_insert
 
 INF = np.int32(2**31 - 1)
 
@@ -330,10 +330,10 @@ def _build_searchN(n_pad: int, ic_pad: int, S: int, O: int,
         stats = carry[STATS]
         carry = carry[:STATS] + (stats.at[1].set(0),)
         out = lax.while_loop(cond, body, carry)
-        # single packed host-poll summary (see wgl32.chunk_fn)
+        # single packed (11,) host-poll summary (see wgl32.chunk_fn)
         summary = jnp.concatenate(
             [out[FR_CNT][None], out[FLAGS].astype(jnp.int32),
-             out[STATS]])
+             out[STATS], out[BK_CNT][None]])
         return out, summary
 
     return init_fn, chunk_fn

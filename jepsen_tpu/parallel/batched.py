@@ -23,6 +23,7 @@ from typing import Optional, Sequence
 
 import numpy as np
 
+from .. import metrics as _metrics
 from ..history import History
 from ..models.core import Model
 from ..ops import wgl_ref
@@ -451,16 +452,30 @@ def check_batched(model: Model, histories: Sequence[History],
     deadline = _time.monotonic() + time_limit if time_limit else None
     t0 = _time.monotonic()
     timed_out = False
+    mx = _metrics.get_default()
     while True:
+        t_poll = _time.monotonic()
         carry, summary = vchunk(consts, carry)
-        # one packed (Bk, 10) poll transfer: [fr_cnt, flags, stats]
+        # one packed (Bk, 11) poll transfer: [fr_cnt, flags, stats, bk]
         s = np.asarray(summary)
-        fr_cnt, flags, stats = s[:, 0], s[:, 1:4], s[:, 4:]
+        fr_cnt, flags, stats = s[:, 0], s[:, 1:4], s[:, 4:10]
         found = flags[:, 0] != 0
         empty = fr_cnt == 0
         budget = stats[:, 0] >= max_configs
         live = ~(found | empty | budget)
         live[batch.n_keys:] = False
+        if mx.enabled:
+            mx.series(
+                "wgl_batched_chunks",
+                "per-poll state of the mesh-sharded batched search"
+            ).append({
+                "wall_s": round(_time.monotonic() - t0, 4),
+                "poll_s": round(_time.monotonic() - t_poll, 4),
+                "live_keys": int(live.sum()),
+                "decided_keys": int((found | empty)[:batch.n_keys].sum()),
+                "frontier_total": int(fr_cnt[:batch.n_keys].sum()),
+                "backlog_total": int(s[:batch.n_keys, 10].sum()),
+                "explored_total": int(stats[:batch.n_keys, 0].sum())})
         if not live.any():
             break
         if deadline is not None and _time.monotonic() > deadline:

@@ -81,18 +81,30 @@ class Tracer:
         return st[-1] if st else None
 
     @contextlib.contextmanager
-    def span(self, name: str, attrs: Optional[dict] = None):
+    def span(self, name: str, attrs: Optional[dict] = None,
+             parent: Optional[dict] = None):
         """Scoped span (with-trace, trace.clj:40-49): nested spans in
-        the same thread share the trace id and chain parent ids."""
+        the same thread share the trace id and chain parent ids.
+        `parent` — a {"trace-id", "span-id"} context captured via
+        `context()` — adopts an EXPLICIT parent when the thread-local
+        stack is empty: the competition checker's engine threads use
+        it so their spans nest under the caller's check() trace
+        instead of starting disconnected roots."""
         if not self.sampled:
             yield None
             return
-        parent = self.current()
+        cur = self.current()
+        if cur is not None:
+            trace_id, parent_id = cur.trace_id, cur.span_id
+        elif parent:
+            trace_id = parent.get("trace-id") or secrets.token_hex(16)
+            parent_id = parent.get("span-id")
+        else:
+            trace_id, parent_id = secrets.token_hex(16), None
         sp = Span(name=name,
-                  trace_id=(parent.trace_id if parent
-                            else secrets.token_hex(16)),
+                  trace_id=trace_id,
                   span_id=secrets.token_hex(8),
-                  parent_id=parent.span_id if parent else None,
+                  parent_id=parent_id,
                   start_s=time.time(),
                   attrs=dict(attrs or {}))
         self._stack().append(sp)
@@ -141,6 +153,11 @@ class Tracer:
                     {"resource": {"service.name": self.service},
                      **sp.to_json()}) + "\n")
         return len(spans)
+
+
+# Shared disabled tracer: the default for instrumented hot paths
+# (checker kernels, phase spans) — every span() is a two-line no-op.
+NULL_TRACER = Tracer(sampled=False)
 
 
 def tracing(endpoint: Optional[str] = None,

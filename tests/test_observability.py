@@ -1,13 +1,17 @@
 """Observability checker tests: set-full, log-file-pattern, timeline
 HTML, latency/rate plots, clock plot — golden-style expected-map
 assertions in the reference's checker_test.clj style
-(checker_test.clj:516-698)."""
+(checker_test.clj:516-698) — plus the search-telemetry subsystem
+(doc/OBSERVABILITY.md): per-chunk metrics timeseries from the WGL
+kernels, checker phase spans in the clients' trace.jsonl format, and
+the search-progress panel."""
 
+import json
 import os
 
 import pytest
 
-from jepsen_tpu import checker
+from jepsen_tpu import checker, metrics, trace
 from jepsen_tpu.checker import clock as clock_mod
 from jepsen_tpu.checker import plots, timeline
 from jepsen_tpu.history import History, Op
@@ -231,6 +235,186 @@ class TestPlots:
         # bucket 0 (mid 15): values 10,20,30 -> q0.5=20, q1=30
         assert qs[0.5] == ([15.0, 45.0], [20.0, 5.0])
         assert qs[1.0] == ([15.0, 45.0], [30.0, 5.0])
+
+
+class TestSearchTelemetry:
+    """The tentpole acceptance surface: a CPU-platform wgl.check with
+    telemetry enabled yields (a) a per-chunk timeseries exportable as
+    JSONL and Prometheus text, (b) checker phase spans in the same
+    trace.jsonl format clients use, (c) a util block with rounds /
+    frontier / memo-hit-rate stats — while a disabled run carries no
+    telemetry and an identical verdict."""
+
+    CHUNK_KEYS = {"chunk", "wall_s", "poll_s", "transfer_s",
+                  "frontier", "backlog", "K", "rounds", "explored",
+                  "memo_hits", "memo_inserts", "memo_hit_rate",
+                  "kernel", "platform"}
+
+    def _hist(self, n=300, seed=5):
+        from jepsen_tpu import synth
+        return synth.cas_register_history(n, n_procs=4, seed=seed,
+                                          crash_p=0.005)
+
+    def _model(self):
+        from jepsen_tpu.models import cas_register
+        return cas_register()
+
+    def test_enabled_run_produces_timeseries_spans_util(self, tmp_path):
+        from jepsen_tpu.ops import wgl
+        reg = metrics.Registry()
+        tr = trace.Tracer(sampled=True)
+        # a caller-side root span: every phase span must nest under it
+        # into ONE trace (checker.Linearizable opens the same root)
+        with tr.span("check linearizable"):
+            res = wgl.check(self._model(), self._hist(), time_limit=60,
+                            metrics=reg, tracer=tr)
+        assert res["valid?"] is True
+        # (c) util block
+        util = res["util"]
+        for k in ("rounds", "frontier_fill", "memo_hit_rate",
+                  "configs_per_s", "first_call_s", "chunks",
+                  "backlog_peak"):
+            assert k in util, k
+        assert util["chunks"] >= 1
+        # (a) per-chunk timeseries, in the result AND the registry
+        pts = res["telemetry"]["chunks"]
+        assert len(pts) == util["chunks"]
+        assert self.CHUNK_KEYS <= set(pts[0])
+        assert pts[0]["cold"] is True
+        assert pts[-1]["explored"] == res["configs_explored"]
+        assert pts[0]["kernel"] == "wgl32"
+        assert reg.series("wgl_chunks").points[-1]["explored"] == \
+            res["configs_explored"]
+        # instruments are labeled by kernel AND platform so raced
+        # competition lanes (same kernel, different platform) stay
+        # distinguishable
+        assert reg.counter("wgl_configs_explored_total").value(
+            kernel="wgl32", platform="cpu") == res["configs_explored"]
+        assert reg.histogram("wgl_poll_seconds").count(
+            kernel="wgl32", platform="cpu") == util["chunks"]
+        # JSONL + Prometheus exports parse
+        p = str(tmp_path / "m.jsonl")
+        assert reg.export_jsonl(p) > 0
+        for line in open(p):
+            json.loads(line)
+        text = reg.prometheus_text()
+        assert "# TYPE wgl_configs_explored_total counter" in text
+        assert "# TYPE wgl_poll_seconds histogram" in text
+        # (b) phase spans, one trace, rooted where the caller is
+        names = {s.name for s in tr.spans}
+        assert {"encode", "compile", "host-poll"} <= names
+        tp = str(tmp_path / "trace.jsonl")
+        assert tr.export(tp) == len(tr.spans)
+        rows = [json.loads(x) for x in open(tp)]
+        # same OTLP-flavored shape TracedClient spans use
+        for r in rows:
+            assert {"name", "traceId", "spanId", "startTimeUnixNano",
+                    "endTimeUnixNano", "attributes"} <= set(r)
+        assert len({r["traceId"] for r in rows}) == 1
+
+    def test_disabled_run_is_clean_and_verdict_identical(self):
+        from jepsen_tpu.ops import wgl
+        h, m = self._hist(), self._model()
+        reg = metrics.Registry()
+        r_on = wgl.check(m, h, time_limit=60, metrics=reg)
+        # pin the disabled registry explicitly so a JEPSEN_TPU_METRICS
+        # env enable in the outer environment can't flip this test
+        with metrics.use(metrics.NULL):
+            r_off = wgl.check(m, h, time_limit=60)
+        assert "telemetry" not in r_off
+        assert r_off["valid?"] == r_on["valid?"]
+        # the search itself is deterministic: telemetry must not
+        # perturb what was explored
+        assert r_off["configs_explored"] == r_on["configs_explored"]
+        assert r_off["util"]["rounds"] == r_on["util"]["rounds"]
+
+    def test_cpu_platform_strategy_carries_telemetry(self):
+        # the platform="cpu" lane (host kernel layout) must report the
+        # same telemetry surface as the default strategy
+        from jepsen_tpu.ops import wgl
+        reg = metrics.Registry()
+        res = wgl.check(self._model(), self._hist(seed=11),
+                        time_limit=60, platform="cpu", metrics=reg)
+        assert res["valid?"] is True
+        assert res["platform"] == "cpu"
+        assert self.CHUNK_KEYS <= set(res["telemetry"]["chunks"][0])
+        assert res["util"]["chunks"] >= 1
+
+    def test_wide_window_kernel_labels_wgln(self):
+        from jepsen_tpu import synth
+        from jepsen_tpu.ops import wgl
+        reg = metrics.Registry()
+        ht = synth.long_tail_history(60, seed=3)
+        res = wgl.check(self._model(), ht, time_limit=120, metrics=reg)
+        assert res["valid?"] is True
+        assert res["telemetry"]["chunks"][0]["kernel"] == "wgln"
+        assert reg.counter("wgl_chunks_total").value(
+            kernel="wgln", platform="cpu") >= 1
+
+    def test_checker_renders_search_progress_panel(self, tmp_path):
+        tr = trace.Tracer(sampled=True)
+        test = {"name": "prog", "start_time": "t0",
+                "store_root": str(tmp_path), "tracer": tr}
+        with metrics.use(metrics.Registry()):
+            res = checker.linearizable(
+                self._model(), algorithm="tpu-wgl",
+                time_limit=60).check(test, self._hist(seed=7), {})
+        assert res["valid?"] is True
+        p = res["search-progress-png"]
+        assert os.path.exists(p)
+        assert p.endswith("search-progress.png")
+        # the whole analysis nests under one root span
+        roots = [s for s in tr.spans if s.parent_id is None]
+        assert [s.name for s in roots] == ["check linearizable"]
+        assert len({s.trace_id for s in tr.spans}) == 1
+
+    def test_competition_emits_oracle_race_span(self, tmp_path):
+        tr = trace.Tracer(sampled=True)
+        test = {"name": "race", "start_time": "t0",
+                "store_root": str(tmp_path), "tracer": tr}
+        res = checker.linearizable(
+            self._model(), algorithm="competition",
+            time_limit=30).check(test, self._hist(seed=13), {})
+        assert res["valid?"] is True
+        names = {s.name for s in tr.spans}
+        assert "oracle-race" in names
+        assert len({s.trace_id for s in tr.spans}) == 1
+
+    def test_search_progress_graph_direct(self, tmp_path):
+        test = {"name": "sp", "start_time": "t0",
+                "store_root": str(tmp_path)}
+        chunks = [{"wall_s": 0.1 * i, "poll_s": 0.1, "frontier": 16,
+                   "backlog": i * 10, "K": 16, "explored": 100 * i,
+                   "explored_delta": 100, "memo_hit_rate": 0.5}
+                  for i in range(1, 5)]
+        p = plots.search_progress_graph(test, chunks)
+        assert p and os.path.exists(p)
+        # malformed input never raises (the verdict rides along)
+        assert plots.search_progress_graph(test, None) is None
+        assert plots.search_progress_graph(test, [{"bogus": 1}]) is None
+
+    def test_linear_report_carries_search_stats(self):
+        from jepsen_tpu.checker import linear_report
+        h = hist([op("invoke", 0, "read", None, 0),
+                  op("ok", 0, "read", 1, 1_000_000)])
+        doc = linear_report.render(h, {
+            "algorithm": "tpu-wgl", "configs_explored": 1234,
+            "wall_s": 0.5,
+            "util": {"rounds": 7, "memo_hit_rate": 0.25},
+            "op": {"index": 0, "f": "read", "process": 0}})
+        assert "device search: 1234 configs, 7 rounds" in doc
+        assert "memo hit rate 0.25" in doc
+
+    def test_profiler_hook_is_opt_in_and_nonfatal(self, tmp_path):
+        # capture failures must never block the verdict; success drops
+        # a trace dir and records it on the result
+        from jepsen_tpu.ops import wgl
+        d = str(tmp_path / "prof")
+        res = wgl.check(self._model(), self._hist(seed=17),
+                        time_limit=60, profile_dir=d)
+        assert res["valid?"] is True
+        if res.get("profile_dir"):  # capture worked on this stack
+            assert os.path.isdir(d) and os.listdir(d)
 
 
 class TestClock:

@@ -41,8 +41,13 @@ def test_adversarial_exhaustive_differential():
     # exhaustive searches agree up to sound re-exploration from lost
     # memo-insert races (the scatter-lean probe computes all candidate
     # slots before its single insert, so same-round foreign-signature
-    # collisions occasionally drop an insert — wgl32.probe_insert)
-    assert abs(dev["configs_explored"] - ora["configs_explored"]) <= 64
+    # collisions occasionally drop an insert — wgl32.probe_insert).
+    # The bound is RELATIVE: re-exploration scales with table
+    # contention, i.e. with the config mass, so a fixed 64 flakes on
+    # larger instances.
+    total = ora["configs_explored"]
+    assert abs(dev["configs_explored"] - total) \
+        <= max(64, int(total * 1e-3))
     assert dev["util"]["memo_hit_rate"] > 0  # dedup engaged
 
 
@@ -77,9 +82,11 @@ def test_packed_kernel_randomized_differential():
         if invalid and enc.window_raw > 32:
             hit_packed += 1
             # exhaustive searches agree up to sound re-exploration
-            # from failed memo inserts (a handful of configs)
-            assert abs(dev["configs_explored"]
-                       - ora["configs_explored"]) <= 64
+            # from failed memo inserts (scales with table contention,
+            # hence the relative bound)
+            total = ora["configs_explored"]
+            assert abs(dev["configs_explored"] - total) \
+                <= max(64, int(total * 1e-3))
     # the parameter ranges MUST drive the packed (W > 32) kernel on
     # invalid shapes, or this test silently stops covering wgln.py
     assert hit_packed >= 1
