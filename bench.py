@@ -49,7 +49,11 @@ budget across attempts), JEPSEN_TPU_BENCH_EXTRAS (default 1; 0 =
 headline only), JEPSEN_TPU_BENCH_TOTAL_S (default 780, global wall
 budget — extra configs that would start too close to it are recorded
 as skipped; SIGTERM mid-run still emits the partial JSON line),
-JEPSEN_TPU_BENCH_KEYS / _PER_KEY (independent config, default 100x2000).
+JEPSEN_TPU_BENCH_KEYS / _PER_KEY (independent config, default 100x2000),
+JEPSEN_TPU_BENCH_REGRESSION_X (default 1.5 — flag a config whose wall
+exceeds this multiple of its best same-platform prior round; the trend
+report lands in artifacts/telemetry/regressions.json +
+bench-trajectory.png).
 """
 
 from __future__ import annotations
@@ -802,6 +806,158 @@ def _export_telemetry(out: dict) -> None:
 DETAILS_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                             "BENCH_DETAILS.json")
 
+REPO_ROOT = os.path.dirname(os.path.abspath(__file__))
+
+
+# -- regression tracking ------------------------------------------------------
+# Each driver round snapshots the bench's JSON line into BENCH_rNN.json;
+# these functions turn that sequence into artifacts/telemetry/
+# regressions.json (per-config wall-time deltas, slowdowns beyond a
+# threshold flagged) + bench-trajectory.png, so a perf regression is
+# caught by diffing the tree, not by a judge re-reading every round.
+
+def load_bench_rounds(root: str = REPO_ROOT) -> list:
+    """Prior rounds from BENCH_r*.json: [{"round", "file", "value",
+    "platform", "verdict", "configs": {name: wall_s}}], round-ordered.
+    Rounds whose JSON didn't parse (or never banked a number) are
+    skipped — they carry no comparable wall times."""
+    import glob
+    import re
+
+    rounds = []
+    for path in sorted(glob.glob(os.path.join(root, "BENCH_r*.json"))):
+        m = re.search(r"BENCH_r(\d+)\.json$", path)
+        if not m:
+            continue
+        try:
+            with open(path) as fh:
+                data = json.load(fh)
+        except (OSError, ValueError):
+            continue
+        parsed = data.get("parsed")
+        if not isinstance(parsed, dict) or parsed.get("value") is None:
+            continue
+        configs = {}
+        for name, c in (parsed.get("configs") or {}).items():
+            if isinstance(c, dict) and isinstance(
+                    c.get("wall_s"), (int, float)):
+                configs[name] = c["wall_s"]
+        rounds.append({"round": int(m.group(1)),
+                       "file": os.path.basename(path),
+                       "value": parsed.get("value"),
+                       "platform": parsed.get("platform"),
+                       "verdict": parsed.get("verdict"),
+                       "configs": configs})
+    rounds.sort(key=lambda r: r["round"])
+    return rounds
+
+
+def _delta_row(latest, priors: list, threshold: float) -> dict:
+    prev = priors[-1] if priors else None
+    best = min(priors) if priors else None
+    row = {"latest": latest, "prev": prev, "best_prior": best}
+    if prev is not None:
+        row["delta_vs_prev_s"] = round(latest - prev, 3)
+    if best is not None and best > 0:
+        row["ratio_vs_best"] = round(latest / best, 3)
+        row["regressed"] = latest > threshold * best
+    return row
+
+
+def compute_regressions(rounds: list, current=None,
+                        threshold: float = 1.5) -> dict:
+    """Per-config wall-time deltas of `current` (or the last round)
+    against prior rounds; slowdowns beyond `threshold`x the best prior
+    wall are flagged. Only same-platform rounds are comparable (a cpu
+    round next to a tpu round is a hardware change, not a regression)
+    — when no same-platform prior exists the comparison is skipped and
+    recorded as such."""
+    rounds = list(rounds)
+    if current is None:
+        if not rounds:
+            return {"schema": 1, "threshold_x": threshold,
+                    "rounds": [], "current": None, "headline": {},
+                    "configs": {}, "regressions": [],
+                    "note": "no parseable rounds"}
+        current = rounds[-1]
+        rounds = rounds[:-1]
+    plat = current.get("platform")
+    prior = [r for r in rounds if r.get("platform") == plat]
+    out: dict = {"schema": 1, "threshold_x": threshold,
+                 "platform": plat,
+                 "compared_rounds": [r["round"] for r in prior],
+                 "rounds": rounds, "current": current,
+                 "headline": {}, "configs": {}, "regressions": []}
+    if not prior:
+        out["note"] = (f"no prior rounds on platform {plat!r}; "
+                       "nothing comparable")
+        return out
+    if current.get("value") is not None:
+        out["headline"] = _delta_row(
+            current["value"],
+            [r["value"] for r in prior if r.get("value") is not None],
+            threshold)
+        if out["headline"].get("regressed"):
+            out["regressions"].append("headline")
+    for name in sorted({n for r in prior + [current]
+                        for n in (r.get("configs") or {})}):
+        latest = (current.get("configs") or {}).get(name)
+        priors = [r["configs"][name] for r in prior
+                  if name in (r.get("configs") or {})]
+        if latest is None or not priors:
+            continue
+        row = _delta_row(latest, priors, threshold)
+        out["configs"][name] = row
+        if row.get("regressed"):
+            out["regressions"].append(name)
+    return out
+
+
+def _export_regressions(out: dict) -> None:
+    """Wire regression tracking into emit(): compare this run against
+    the banked BENCH_r*.json rounds, persist artifacts/telemetry/
+    regressions.json + bench-trajectory.png, and surface the flagged
+    names on the output line. Never raises — the JSON-line contract
+    outranks the trend report."""
+    try:
+        rounds = load_bench_rounds()
+        if out.get("value") is None:
+            return
+        current = {
+            "round": (rounds[-1]["round"] + 1) if rounds else 1,
+            "file": None, "value": out.get("value"),
+            "platform": out.get("platform"),
+            "verdict": out.get("verdict"),
+            "configs": {
+                name: c["wall_s"]
+                for name, c in (out.get("configs") or {}).items()
+                if isinstance(c, dict) and isinstance(
+                    c.get("wall_s"), (int, float))}}
+        threshold = float(os.environ.get(
+            "JEPSEN_TPU_BENCH_REGRESSION_X", "1.5"))
+        report = compute_regressions(rounds, current,
+                                     threshold=threshold)
+        art = os.path.join(REPO_ROOT, "artifacts", "telemetry")
+        os.makedirs(art, exist_ok=True)
+        with open(os.path.join(art, "regressions.json"), "w") as fh:
+            json.dump(report, fh, indent=1)
+        files = ["artifacts/telemetry/regressions.json"]
+        from jepsen_tpu.checker import plots
+        png = plots.bench_trajectory_graph(
+            report, os.path.join(art, "bench-trajectory.png"))
+        if png:
+            files.append("artifacts/telemetry/bench-trajectory.png")
+        out["regressions"] = {"flagged": report.get("regressions"),
+                              "threshold_x": threshold,
+                              "compared_rounds":
+                                  report.get("compared_rounds"),
+                              "files": files}
+        if report.get("regressions"):
+            print(f"REGRESSION flagged (> {threshold}x best prior "
+                  f"wall): {report['regressions']}", file=sys.stderr)
+    except Exception:  # noqa: BLE001
+        traceback.print_exc(file=sys.stderr)
+
 
 def emit(out: dict) -> None:
     """The stdout contract is ONE parseable JSON line — and the
@@ -812,6 +968,7 @@ def emit(out: dict) -> None:
     the judge), and stdout gets a compact summary line that always
     fits the window."""
     _export_telemetry(out)
+    _export_regressions(out)
     try:
         with open(DETAILS_PATH, "w") as f:
             json.dump(out, f, indent=1)
@@ -821,7 +978,7 @@ def emit(out: dict) -> None:
     compact = {k: out.get(k) for k in
                ("metric", "value", "unit", "vs_baseline", "verdict",
                 "platform", "cold_s", "terminated", "error", "cause",
-                "tpu_measured")
+                "tpu_measured", "regressions")
                if out.get(k) is not None}
     aot = out.get("tpu_aot")
     if isinstance(aot, dict):

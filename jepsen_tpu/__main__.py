@@ -5,7 +5,9 @@ mirroring how per-DB suites wire `cli/single-test-cmd` in the reference
 (e.g. `zookeeper/src/jepsen/zookeeper.clj:131-145`): `test` runs one
 demo test end to end (dummy remote, in-process register, WGL checker)
 and exits by validity; `test-all` sweeps seeds; `analyze` re-checks the
-latest stored run; `serve` browses the store.
+latest stored run; `serve` browses the store and exposes the live run
+status at `/status.json` (+ the auto-refreshing `/status` panel —
+doc/OBSERVABILITY.md "watching a live run").
 
 Usage:
   python -m jepsen_tpu test --time-limit 5 --concurrency 2n

@@ -207,15 +207,24 @@ class Linearizable(Checker):
         self.time_limit = time_limit
 
     def check(self, test, history, opts=None):
+        from .. import fleet as _fleet
         from ..trace import NULL_TRACER
         # a test-map tracer nests the whole analysis under ONE trace
         # alongside client spans (core.py exports both to trace.jsonl):
         # the root span here parents the engine phase spans (encode /
         # compile / device-round / host-poll / oracle-race / enrich)
         tracer = (test or {}).get("tracer") or NULL_TRACER
-        with tracer.span("check linearizable",
-                         attrs={"algorithm": self.algorithm}):
-            return self._check(test, history, opts, tracer)
+        status = _fleet.get_default()
+        if status.enabled and tracer.sampled:
+            # live status follows the phase spans (fleet.RunStatus)
+            tracer.add_listener(status.on_span)
+        status.phase(f"check linearizable ({self.algorithm})")
+        try:
+            with tracer.span("check linearizable",
+                             attrs={"algorithm": self.algorithm}):
+                return self._check(test, history, opts, tracer)
+        finally:
+            status.phase("analyze")
 
     def _check(self, test, history, opts, tracer):
         from ..history import strip_nemesis

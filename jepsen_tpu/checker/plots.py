@@ -19,6 +19,7 @@ TPU checkers consume, so the perf plane reuses it.
 from __future__ import annotations
 
 import logging
+import os
 from typing import Optional
 
 import numpy as np
@@ -242,6 +243,74 @@ def search_progress_graph(test, chunks, opts=None) -> Optional[str]:
         return out
     except Exception:  # noqa: BLE001
         log.warning("search-progress rendering failed", exc_info=True)
+        return None
+
+
+def bench_trajectory_graph(report: dict, out_path: str) -> Optional[str]:
+    """bench-trajectory.png: wall-time trajectory across BENCH rounds
+    from a `bench.compute_regressions` report — the headline number
+    per round on top, per-config walls below, with flagged
+    regressions marked red. Path-based (the bench has no test map);
+    never raises — a malformed report must not mask the bench's JSON
+    line."""
+    try:
+        rounds = list(report.get("rounds") or [])
+        cur = report.get("current")
+        if cur and cur.get("value") is not None:
+            rounds = rounds + [cur]
+        rounds = [r for r in rounds if r.get("value") is not None]
+        if len(rounds) < 2:
+            return None
+        plt = _plt()
+        xs = [r.get("round") for r in rounds]
+        fig, axes = plt.subplots(2, 1, figsize=(10, 7), sharex=True)
+
+        ax = axes[0]
+        ax.plot(xs, [r["value"] for r in rounds], marker="o", lw=1.5,
+                color=Q_COLORS[0.95], label="headline wall_s")
+        if (report.get("headline") or {}).get("regressed"):
+            ax.plot([xs[-1]], [rounds[-1]["value"]], marker="o",
+                    markersize=10, color=Q_COLORS[1.0], ls="none",
+                    label="REGRESSED")
+        for x, r in zip(xs, rounds):
+            ax.annotate(str(r.get("platform") or ""), (x, r["value"]),
+                        fontsize=6, textcoords="offset points",
+                        xytext=(0, 6))
+        ax.set_yscale("log")
+        ax.set_ylabel("headline wall (s)")
+        ax.set_title("BENCH trajectory")
+        ax.legend(loc="upper right", fontsize=7)
+
+        ax = axes[1]
+        names = sorted({n for r in rounds
+                        for n in (r.get("configs") or {})})
+        flagged = set(report.get("regressions") or [])
+        for i, name in enumerate(names):
+            pts = [(x, (r.get("configs") or {}).get(name))
+                   for x, r in zip(xs, rounds)]
+            pts = [(x, v) for x, v in pts if v is not None]
+            if not pts:
+                continue
+            px, py = zip(*pts)
+            color = (Q_COLORS[1.0] if name in flagged
+                     else f"C{i % 10}")
+            ax.plot(px, py, marker=MARKERS[i % len(MARKERS)],
+                    markersize=4, lw=1, color=color,
+                    label=name + (" (REGRESSED)" if name in flagged
+                                  else ""))
+        ax.set_yscale("log")
+        ax.set_xlabel("BENCH round")
+        ax.set_ylabel("config wall (s)")
+        ax.legend(loc="upper left", fontsize=6, ncol=2)
+
+        parent = os.path.dirname(out_path)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+        fig.savefig(out_path, dpi=90, bbox_inches="tight")
+        plt.close(fig)
+        return out_path
+    except Exception:  # noqa: BLE001
+        log.warning("bench-trajectory rendering failed", exc_info=True)
         return None
 
 

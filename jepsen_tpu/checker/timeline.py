@@ -36,6 +36,9 @@ body        { font-family: sans-serif; }
 .op.info    { background: #f7c36b; }
 .op.fail    { background: #f7a8c8; }
 .op:target  { box-shadow: 0 10px 20px rgba(0,0,0,0.3); }
+.truncation-warning { background: #f7c36b; border: 1px solid #c08020;
+              border-radius: 3px; padding: 8px 12px; margin: 8px 0;
+              font-weight: bold; }
 """
 
 
@@ -115,9 +118,12 @@ def render(test: dict, history: History, history_key=None) -> str:
     head += "</h1>"
     warn = ""
     if truncated:
-        warn = (f"<div class='truncation-warning'>Showing only "
-                f"{OP_LIMIT} of {len(all_pairs)} "
-                f"operations in this history.</div>")
+        # a VISIBLE banner (styled above): silently dropping the tail
+        # made huge histories look complete
+        warn = (f"<div class='truncation-warning'>&#9888; truncated: "
+                f"showing {OP_LIMIT:,} of {len(all_pairs):,} ops "
+                f"(the remaining {len(all_pairs) - OP_LIMIT:,} are in "
+                f"history.txt)</div>")
     return (f"<!doctype html><html><head><meta charset='utf-8'>"
             f"<style>{STYLESHEET}</style></head><body>{head}{warn}"
             f"<div class='ops'>{''.join(divs)}</div></body></html>")

@@ -523,7 +523,10 @@ def serve_cmd() -> dict:
         o = parsed.options
         server = web.serve(host=o["host"], port=o["port"],
                            store_root=o["store_root"])
-        print(f"Listening on http://{o['host']}:{server.server_port}/")
+        base = f"http://{o['host']}:{server.server_port}"
+        print(f"Listening on {base}/")
+        print(f"Live run status: {base}/status "
+              f"(JSON: {base}/status.json)")
         try:
             server.serve_forever()
         except KeyboardInterrupt:

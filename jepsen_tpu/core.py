@@ -167,8 +167,20 @@ def run(test: dict) -> dict:
     "results" (core.clj:327-406). See module docstring for phases."""
     test = prepare_test(test)
 
-    from . import store
+    from . import fleet, store
     writer = store.Writer(test) if test.get("name") else None
+    # Live run status (fleet.RunStatus, doc/OBSERVABILITY.md): ambient
+    # for the whole run — the interpreter, checker phase spans, and the
+    # device fan-out all update it; `serve` exposes it at /status.json.
+    # Updates land at poll/key boundaries only, so this is always on.
+    # The throttled file mirror under the STORE ROOT lets an
+    # out-of-process `serve` watch the run live.
+    status_file = (os.path.join(test.get("store_root") or store.BASE_DIR,
+                                fleet.STATUS_FILENAME)
+                   if writer else None)
+    status = fleet.RunStatus(test=test.get("name"),
+                             status_file=status_file)
+    prev_status = fleet.set_default(status)
     if writer:
         test["store_dir"] = writer.dir
         store.start_logging(test)
@@ -188,6 +200,7 @@ def run(test: dict) -> dict:
                         try:
                             if test.get("db"):
                                 jdb.cycle(test)
+                            status.phase("run")
                             with util.with_relative_time():
                                 test = {**test,
                                         "history": run_case(test)}
@@ -205,11 +218,14 @@ def run(test: dict) -> dict:
                         if os_obj:
                             control.on_nodes(
                                 test, lambda t, n: os_obj.teardown(t, n))
+                    status.phase("analyze")
                     test = analyze(test)
                     if writer:
                         writer.save_2(test)
         return log_results(test)
     finally:
+        status.finish(valid=(test.get("results") or {}).get("valid?"))
+        fleet.set_default(prev_status)
         # a test-map tracer's spans land in the run dir (the dgraph
         # suites' span-export artifact, trace.clj + trace.py) — in the
         # outer finally so crashed runs (when the trace matters most)

@@ -1,0 +1,532 @@
+"""Fleet observability: per-device shard telemetry + live run status.
+
+PR 2 gave the WGL kernels a metrics/trace plane (metrics.py,
+doc/OBSERVABILITY.md); this module extends it UP to the fleet level —
+the `jepsen.independent` fan-out that shards per-key sub-histories
+across devices (`parallel/batched.py`). Before it, that plane was a
+black box: worker threads swallowed device faults into generic
+results, nothing recorded which key ran on which device or how
+imbalanced the shards were, and long searches gave no live progress.
+
+Two surfaces, Dapper-style always-on (Sigelman et al., 2010):
+
+  * **Shard telemetry** — every per-key check emits one `shard` block
+    (device, key index, engine, wall, retries, fault) onto its result
+    and into the ambient metrics registry (`fleet_shards` series,
+    `fleet_keys_total` / `fleet_faults_total` / `fleet_fallbacks_total`
+    counters, `fleet_shard_seconds` histogram). `summarize()` derives
+    the fleet aggregates (per-device shard counts and busy fraction,
+    max-vs-median straggler ratio, fault/fallback counts) that
+    `independent.py` attaches to results as `util.fleet`.
+  * **RunStatus** — a thread-safe live-status object updated from the
+    checker phase spans, the `ops/wgl.py` poll loop, the batched
+    workers, and the interpreter's nemesis ops. `python -m jepsen_tpu
+    serve` exposes its snapshot at `/status.json` (plus an
+    auto-refreshing `/status` HTML panel); `JEPSEN_TPU_PROGRESS=1`
+    renders the same source as a one-line console progress ticker.
+    `core.run` installs one per run and mirrors throttled snapshots to
+    `<store_root>/current-status.json` so an out-of-process `serve`
+    can watch a live run.
+
+Zero-cost contract (matching metrics.py): the module default is a
+disabled `RunStatus` whose recording methods return immediately — no
+locks, no dict traffic. `core.run` / callers install a real one via
+`set_default()` / `use()`; updates happen at poll boundaries
+(~100 ms+) and per-key completion, never inside device rounds.
+
+The JSONL schemas recorded here are validated by
+`scripts/telemetry_lint.py` (wired as a tier-1 test) so schema drift
+is caught before a BENCH round, and documented in
+doc/OBSERVABILITY.md.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import sys
+import threading
+import time
+import traceback
+from typing import Iterator, Optional
+
+from . import metrics as _metrics
+
+# Structured fault events carry the worker traceback, bounded so a
+# pathological recursion error can't bloat results/JSONL.
+FAULT_TB_LIMIT = 4000
+
+# Faults kept on the live status object (results/metrics keep them all).
+STATUS_FAULT_CAP = 32
+
+STATUS_FILENAME = "current-status.json"
+
+
+# Nemesis op names that CLOSE a fault window, per the nemesis package
+# conventions (nemesis/combined.py): the kill/pause package heals with
+# f="start"/"resume", the partitioner closes with f="stop-partition"
+# (any "stop*"), and "heal"/"recover" are the generic spellings.
+# Everything else ("kill", "pause", "start-partition", clock faults)
+# opens or renames the window.
+NEMESIS_HEAL_FS = frozenset({"start", "heal", "resume", "recover"})
+
+
+def nemesis_opens_window(f) -> bool:
+    """Whether a nemesis op with this `f` opens (True) or closes
+    (False) the live fault window shown on /status."""
+    name = str(f)
+    return not (name.startswith("stop") or name in NEMESIS_HEAL_FS)
+
+
+def device_label(dev) -> str:
+    """A stable short label for a jax device (or any stand-in)."""
+    try:
+        return str(dev)
+    except Exception:  # noqa: BLE001 — a label must never raise
+        return "device-?"
+
+
+def fault_event(exc: BaseException, *, device: Optional[str] = None,
+                key_index: Optional[int] = None,
+                stage: str = "device-worker") -> dict:
+    """A device fault as a structured fleet event: type, message, the
+    worker traceback (bounded), and where it happened — instead of the
+    old `f"error: {e}"` string that threw the stack away."""
+    return {"type": type(exc).__name__,
+            "error": str(exc)[:300],
+            "stage": stage,
+            "device": device,
+            "key_index": key_index,
+            "traceback": traceback.format_exc()[-FAULT_TB_LIMIT:]}
+
+
+def record_shard(shard: dict, mx=None, status=None) -> None:
+    """Record one per-key shard block into the ambient metrics
+    registry (`fleet_shards` series + counters/histogram) and the
+    ambient RunStatus. No-op when both are disabled."""
+    mx = mx if mx is not None else _metrics.get_default()
+    st = status if status is not None else get_default()
+    if mx.enabled:
+        fault = shard.get("fault")
+        point = {k: v for k, v in shard.items() if k != "fault"}
+        if fault:
+            point["fault_type"] = fault.get("type")
+        mx.series("fleet_shards",
+                  "per-key shard telemetry of the independent "
+                  "fan-out (device, engine, wall, faults)"
+                  ).append(point)
+        lbl = {"device": shard.get("device", "host"),
+               "engine": shard.get("engine", "unknown")}
+        mx.counter("fleet_keys_total",
+                   "per-key checks completed by the fleet").inc(**lbl)
+        mx.histogram("fleet_shard_seconds",
+                     "wall seconds per per-key shard check").observe(
+            float(shard.get("wall_s") or 0.0), **lbl)
+        if fault:
+            mx.counter("fleet_faults_total",
+                       "device faults captured by fleet workers").inc(
+                device=lbl["device"])
+            mx.series("fleet_faults",
+                      "structured device fault events").append(
+                dict(fault))
+        if shard.get("engine") == "oracle-fallback":
+            mx.counter("fleet_fallbacks_total",
+                       "keys re-decided by the host oracle after a "
+                       "device decline").inc(device=lbl["device"])
+    if st.enabled:
+        st.key_done(shard)
+
+
+def summarize(shards: list) -> dict:
+    """Fleet aggregates over per-key shard blocks: per-device shard
+    counts / wall / busy fraction, straggler ratio (max vs median
+    shard wall), engine mix, fault and fallback counts. Tolerates
+    None entries (skipped keys) and missing fields."""
+    shards = [s for s in shards if isinstance(s, dict)]
+    if not shards:
+        return {"keys": 0, "devices": {}, "engines": {},
+                "faults": 0, "fallbacks": 0}
+    per_dev: dict = {}
+    engines: dict = {}
+    faults = 0
+    fallbacks = 0
+    for s in shards:
+        dev = str(s.get("device", "host"))
+        d = per_dev.setdefault(dev, {"keys": 0, "wall_s": 0.0,
+                                     "faults": 0, "fallbacks": 0})
+        d["keys"] += 1
+        d["wall_s"] += float(s.get("wall_s") or 0.0)
+        eng = str(s.get("engine", "unknown"))
+        engines[eng] = engines.get(eng, 0) + 1
+        if s.get("fault"):
+            d["faults"] += 1
+            faults += 1
+        if eng == "oracle-fallback":
+            d["fallbacks"] += 1
+            fallbacks += 1
+    walls = sorted(float(s.get("wall_s") or 0.0) for s in shards)
+    w_median = walls[len(walls) // 2]
+    w_max = walls[-1]
+    # busy fraction: each device's summed shard wall over the fleet
+    # span (first shard start -> last shard end); needs t0 stamps
+    t0s = [s["t0"] for s in shards if s.get("t0") is not None]
+    span = None
+    if t0s:
+        ends = [s["t0"] + float(s.get("wall_s") or 0.0)
+                for s in shards if s.get("t0") is not None]
+        span = max(ends) - min(t0s)
+        for d in per_dev.values():
+            d["busy_frac"] = (round(min(1.0, d["wall_s"] / span), 4)
+                              if span > 0 else 1.0)
+    for d in per_dev.values():
+        d["wall_s"] = round(d["wall_s"], 4)
+    keys_per_dev = [d["keys"] for d in per_dev.values()]
+    return {
+        "keys": len(shards),
+        "device_count": len(per_dev),
+        "devices": per_dev,
+        "engines": engines,
+        "faults": faults,
+        "fallbacks": fallbacks,
+        "wall_s": {"max": round(w_max, 4),
+                   "median": round(w_median, 4),
+                   "total": round(sum(walls), 4)},
+        # lockstep/batched fleets pay max while a balanced one pays
+        # ~median — this ratio IS the straggler cost
+        "straggler_ratio": round(w_max / max(w_median, 1e-9), 3),
+        "imbalance": {"max_keys": max(keys_per_dev),
+                      "min_keys": min(keys_per_dev),
+                      "mean_keys": round(len(shards) / len(per_dev), 2)},
+        "span_s": round(span, 4) if span is not None else None,
+    }
+
+
+class RunStatus:
+    """Thread-safe live status of a run: phase, per-device state, key
+    frontier/backlog, search progress, nemesis window, ETA.
+
+    Writers call the small record methods (each takes the lock once);
+    readers call `snapshot()` for a JSON-safe copy with derived
+    fields (elapsed, ETA, rates). All record methods return
+    immediately on a disabled instance."""
+
+    def __init__(self, enabled: bool = True, test: Optional[str] = None,
+                 status_file: Optional[str] = None,
+                 progress: Optional[bool] = None):
+        self.enabled = enabled
+        self.status_file = status_file
+        self.progress = (progress if progress is not None else
+                         os.environ.get("JEPSEN_TPU_PROGRESS", "")
+                         not in ("", "0"))
+        self._lock = threading.Lock()
+        self._t0 = time.monotonic()
+        self._last_write = 0.0
+        self._last_tick = 0.0
+        self._d: dict = {
+            "schema": 1,
+            "active": bool(enabled),
+            "test": test,
+            "phase": None,
+            "started": time.time(),
+            "updated": time.time(),
+            "keys": {"total": 0, "decided": 0, "live": 0,
+                     "failures": 0},
+            "devices": {},
+            "search": {},
+            "nemesis": {"active": False, "f": None, "since_s": None},
+            "ops": {"invoked": 0, "completed": 0},
+            "faults": [],
+        }
+
+    # -- writers ------------------------------------------------------
+    def _touch_locked(self) -> None:
+        self._d["updated"] = time.time()
+
+    def _after(self) -> None:
+        """Post-update side channels (outside the lock): throttled
+        status-file mirror + console progress line."""
+        now = time.monotonic()
+        if self.status_file and now - self._last_write > 1.0:
+            self._last_write = now
+            self._write_file()
+        if self.progress and now - self._last_tick > 0.5:
+            self._last_tick = now
+            self._print_progress()
+
+    def phase(self, name: Optional[str]) -> None:
+        if not self.enabled:
+            return
+        with self._lock:
+            self._d["phase"] = name
+            self._touch_locked()
+        self._after()
+
+    def on_span(self, event: str, span) -> None:
+        """trace.Tracer listener: phase follows the innermost checker
+        phase span (encode / compile / device-round / oracle-race /
+        enrich ...)."""
+        if not self.enabled:
+            return
+        if event == "start":
+            self.phase(span.name)
+        elif event == "end" and span.parent_id is None:
+            self.phase(None)
+
+    def begin_keys(self, total: int) -> None:
+        if not self.enabled:
+            return
+        with self._lock:
+            k = self._d["keys"]
+            k["total"] = int(total)
+            k["decided"] = 0
+            k["live"] = 0
+            k["failures"] = 0
+            self._d["keys_started"] = time.time()
+            self._keys_t0 = time.monotonic()
+            self._touch_locked()
+        self._after()
+
+    def device_state(self, device: str, state: str,
+                     key_index: Optional[int] = None) -> None:
+        if not self.enabled:
+            return
+        with self._lock:
+            d = self._d["devices"].setdefault(
+                str(device), {"state": "idle", "keys_done": 0,
+                              "last_key": None, "busy_s": 0.0,
+                              "faults": 0})
+            d["state"] = state
+            if key_index is not None:
+                d["last_key"] = key_index
+            self._touch_locked()
+        self._after()
+
+    def key_done(self, shard: dict) -> None:
+        """One per-key shard finished (called via record_shard)."""
+        if not self.enabled:
+            return
+        with self._lock:
+            k = self._d["keys"]
+            # cap at total: the batched vmap path reports decided
+            # counts per poll AND per-key shards at assembly
+            k["decided"] = (min(k["decided"] + 1, k["total"])
+                            if k["total"] else k["decided"] + 1)
+            if shard.get("valid?") is False:
+                k["failures"] += 1
+            d = self._d["devices"].setdefault(
+                str(shard.get("device", "host")),
+                {"state": "idle", "keys_done": 0, "last_key": None,
+                 "busy_s": 0.0, "faults": 0})
+            d["keys_done"] += 1
+            d["last_key"] = shard.get("key_index")
+            d["busy_s"] = round(d["busy_s"]
+                                + float(shard.get("wall_s") or 0.0), 4)
+            d["state"] = "idle"
+            if shard.get("fault"):
+                d["faults"] += 1
+            self._touch_locked()
+        self._after()
+
+    def fault(self, event: dict) -> None:
+        if not self.enabled:
+            return
+        with self._lock:
+            faults = self._d["faults"]
+            faults.append({k: event.get(k) for k in
+                           ("type", "error", "stage", "device",
+                            "key_index")})
+            del faults[:-STATUS_FAULT_CAP]
+            self._touch_locked()
+        self._after()
+
+    def search_poll(self, point: dict, search_id=None) -> None:
+        """One `wgl_chunks`-shaped poll from the single-search loop:
+        frontier/backlog/explored plus the per-poll rate. `search_id`
+        identifies WHICH search polled — concurrent searches (streamed
+        multi-device workers, raced competition lanes) each diff their
+        own cumulative `explored`, never each other's; the displayed
+        `search` block is simply the last poll."""
+        if not self.enabled:
+            return
+        with self._lock:
+            prev_map = getattr(self, "_search_prev", None)
+            if prev_map is None:
+                prev_map = self._search_prev = {}
+            prev = prev_map.get(search_id)
+            p = dict(point)
+            if prev is not None and prev.get("explored") is not None \
+                    and p.get("explored") is not None:
+                delta = p["explored"] - prev["explored"]
+                dt = max(float(p.get("poll_s") or 0.0), 1e-9)
+                if delta >= 0:
+                    p["configs_per_s"] = int(delta / dt)
+            prev_map[search_id] = {"explored": p.get("explored")}
+            if len(prev_map) > 64:  # bounded: drop the oldest search
+                prev_map.pop(next(iter(prev_map)))
+            self._d["search"] = p
+            self._touch_locked()
+        self._after()
+
+    def batched_poll(self, *, live: int, decided: int, total: int,
+                     frontier_total: int, backlog_total: int,
+                     explored_total: int) -> None:
+        """One poll of the mesh-batched lockstep search."""
+        if not self.enabled:
+            return
+        with self._lock:
+            k = self._d["keys"]
+            k["total"] = max(k["total"], int(total))
+            k["decided"] = min(int(decided), k["total"])
+            k["live"] = int(live)
+            if not hasattr(self, "_keys_t0"):
+                self._keys_t0 = time.monotonic()
+            self._d["search"] = {
+                "mode": "batched-vmap",
+                "frontier": int(frontier_total),
+                "backlog": int(backlog_total),
+                "explored": int(explored_total)}
+            self._touch_locked()
+        self._after()
+
+    def nemesis_event(self, f, active: bool) -> None:
+        if not self.enabled:
+            return
+        with self._lock:
+            n = self._d["nemesis"]
+            n["active"] = bool(active)
+            n["f"] = None if f is None else str(f)
+            n["since_s"] = round(time.monotonic() - self._t0, 3)
+            self._touch_locked()
+        self._after()
+
+    def op_event(self, invoked: bool) -> None:
+        if not self.enabled:
+            return
+        with self._lock:
+            self._d["ops"]["invoked" if invoked else "completed"] += 1
+            self._touch_locked()
+        # no _after(): op events are the hottest writer; the next
+        # poll/key boundary refreshes the side channels
+
+    def finish(self, valid=None) -> None:
+        if not self.enabled:
+            return
+        with self._lock:
+            self._d["phase"] = "done"
+            self._d["active"] = False
+            if valid is not None:
+                self._d["valid?"] = valid
+            self._touch_locked()
+        if self.status_file:
+            self._write_file()
+        if self.progress:
+            self._print_progress(final=True)
+
+    # -- readers ------------------------------------------------------
+    def snapshot(self) -> dict:
+        """JSON-safe copy plus derived fields: elapsed_s, decided-rate
+        ETA (extrapolated from the per-key completion rate the
+        `wgl_chunks`/`fleet_shards` stream feeds)."""
+        with self._lock:
+            d = json.loads(json.dumps(self._d, default=str))
+            keys_t0 = getattr(self, "_keys_t0", None)
+        d["elapsed_s"] = round(time.monotonic() - self._t0, 3)
+        k = d["keys"]
+        d["eta_s"] = None
+        if keys_t0 is not None and k["total"] and k["decided"]:
+            spent = max(time.monotonic() - keys_t0, 1e-9)
+            rate = k["decided"] / spent
+            remaining = max(k["total"] - k["decided"], 0)
+            if rate > 0:
+                d["eta_s"] = round(remaining / rate, 1)
+        return d
+
+    # -- side channels ------------------------------------------------
+    def _write_file(self) -> None:
+        """Atomic throttled mirror for out-of-process `serve`."""
+        try:
+            snap = self.snapshot()
+            tmp = self.status_file + ".tmp"
+            parent = os.path.dirname(self.status_file)
+            if parent:
+                os.makedirs(parent, exist_ok=True)
+            with open(tmp, "w") as fh:
+                json.dump(snap, fh)
+            os.replace(tmp, self.status_file)
+        except OSError:
+            pass  # a full disk must never fail the run
+
+    def _print_progress(self, final: bool = False) -> None:
+        try:
+            s = self.snapshot()
+            k = s["keys"]
+            parts = [f"phase={s.get('phase') or '-'}"]
+            if k["total"]:
+                parts.append(f"keys {k['decided']}/{k['total']}")
+                if k["failures"]:
+                    parts.append(f"bad={k['failures']}")
+            sr = s.get("search") or {}
+            if sr.get("frontier") is not None:
+                parts.append(f"frontier={sr['frontier']}")
+            if sr.get("backlog"):
+                parts.append(f"backlog={sr['backlog']}")
+            if sr.get("configs_per_s"):
+                parts.append(f"{sr['configs_per_s']} cfg/s")
+            if s.get("eta_s") is not None:
+                parts.append(f"eta={s['eta_s']}s")
+            n = s.get("nemesis") or {}
+            if n.get("active"):
+                parts.append(f"nemesis={n.get('f')}")
+            line = "[jepsen_tpu] " + " ".join(parts)
+            end = "\n" if final else ""
+            sys.stderr.write("\r" + line.ljust(78)[:120] + end)
+            sys.stderr.flush()
+        except Exception:  # noqa: BLE001 — progress never kills a run
+            pass
+
+
+NULL_STATUS = RunStatus(enabled=False, progress=False)
+
+
+# -- ambient default ---------------------------------------------------------
+# A plain module global (NOT thread-local), like metrics._default: the
+# batched workers / engine threads must see the status the run installed.
+_default: RunStatus = (
+    RunStatus() if os.environ.get("JEPSEN_TPU_STATUS", "")
+    not in ("", "0") else NULL_STATUS)
+
+
+def get_default() -> RunStatus:
+    """The ambient RunStatus — NULL_STATUS unless JEPSEN_TPU_STATUS=1
+    was set at import or a caller installed one (core.run does, for
+    every named run)."""
+    return _default
+
+
+def set_default(status: Optional[RunStatus]) -> RunStatus:
+    global _default
+    prev = _default
+    _default = status if status is not None else NULL_STATUS
+    return prev
+
+
+@contextlib.contextmanager
+def use(status: RunStatus) -> Iterator[RunStatus]:
+    """Scoped ambient status (restores the previous on exit)."""
+    prev = set_default(status)
+    try:
+        yield status
+    finally:
+        set_default(prev)
+
+
+def read_status_file(store_root: str) -> Optional[dict]:
+    """The throttled snapshot a (possibly other-process) run mirrors
+    into its store root, or None."""
+    path = os.path.join(store_root, STATUS_FILENAME)
+    try:
+        with open(path) as fh:
+            return json.load(fh)
+    except (OSError, ValueError):
+        return None

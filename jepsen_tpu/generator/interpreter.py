@@ -154,6 +154,8 @@ def run(test: dict):
     """Evaluate all ops from test["generator"], returning the history as
     a list of op dicts (interpreter.clj:181-310). The caller wraps this
     with the relative-time clock (util.with_relative_time)."""
+    from .. import fleet as _fleet
+    status = _fleet.get_default()
     ctx = make_context(test)
     completions: _queue.Queue = _queue.Queue()
     factory = client_nemesis_worker()
@@ -190,6 +192,8 @@ def run(test: dict):
                     ctx = replace(ctx, workers=workers_map)
                 if goes_in_history(op2):
                     history.append(op2)
+                    if status.enabled:
+                        status.op_event(invoked=False)
                 outstanding -= 1
                 poll_timeout = 0.0
                 continue
@@ -223,6 +227,13 @@ def run(test: dict):
             gen = gen_update(gen2, test, ctx, op)
             if goes_in_history(op):
                 history.append(op)
+                if status.enabled:
+                    status.op_event(invoked=True)
+                    if thread == NEMESIS:
+                        status.nemesis_event(
+                            op.get("f"),
+                            active=_fleet.nemesis_opens_window(
+                                op.get("f")))
             outstanding += 1
             poll_timeout = 0.0
     finally:

@@ -21,6 +21,9 @@ from __future__ import annotations
 from dataclasses import dataclass
 from typing import Any, Callable, Iterable, Optional
 
+import time as _time
+
+from . import fleet as _fleet
 from . import generator as gen
 from .checker import Checker, check_safe, merge_valid
 from .history import History, Op, strip_nemesis
@@ -255,13 +258,27 @@ class IndependentChecker(Checker):
     def check(self, test, history, opts=None):
         opts = opts or {}
         ks = history_keys(history)
+        key_idx = {k: i for i, k in enumerate(ks)}
+        status = _fleet.get_default()
+        if status.enabled and ks:
+            status.begin_keys(len(ks))
 
         def check_key(k):
+            i = key_idx[k]
+            t0 = _time.monotonic()
             h = subhistory(k, history)
             subdir = list(opts.get("subdirectory", [])) + [DIR, str(k)]
             res = check_safe(self.checker, test, h,
                              {**opts, "subdirectory": subdir,
                               "history_key": k})
+            shard = {"key_index": i, "key": str(k), "device": "host",
+                     "engine": str(res.get("engine") or "host"),
+                     "t0": round(t0, 4),
+                     "wall_s": round(_time.monotonic() - t0, 4),
+                     "valid?": res.get("valid?"),
+                     "op_count": res.get("op_count")}
+            res["shard"] = shard
+            _fleet.record_shard(shard)
             _write_key_artifacts(test, subdir, h, res)
             return k, res
 
@@ -270,7 +287,9 @@ class IndependentChecker(Checker):
         return {"valid?": merge_valid(r.get("valid?")
                                       for r in results.values()),
                 "results": results,
-                "failures": failures}
+                "failures": failures,
+                "util": {"fleet": _fleet.summarize(
+                    [r.get("shard") for r in results.values()])}}
 
 
 def checker(c: Checker) -> Checker:
@@ -312,19 +331,24 @@ class TPULinearizableIndependent(Checker):
         from .parallel import check_batched
         opts = opts or {}
         ks = history_keys(history)
+        _fleet.get_default().phase("independent-check")
         subs = [subhistory(k, history) for k in ks]
         res_list = check_batched(self.model,
                                  [strip_nemesis(s) for s in subs],
                                  time_limit=self.time_limit, mesh=self.mesh)
         results = dict(zip(ks, res_list))
         for k, h, res in zip(ks, subs, res_list):
+            if isinstance(res.get("shard"), dict):
+                res["shard"]["key"] = str(k)
             subdir = list(opts.get("subdirectory", [])) + [DIR, str(k)]
             _write_key_artifacts(test, subdir, h, res)
         failures = [k for k in ks if not results[k].get("valid?")]
         return {"valid?": merge_valid(r.get("valid?")
                                       for r in results.values()),
                 "results": results,
-                "failures": failures}
+                "failures": failures,
+                "util": {"fleet": _fleet.summarize(
+                    [r.get("shard") for r in res_list])}}
 
 
 def tpu_checker(model: Model, time_limit: Optional[float] = None,

@@ -19,7 +19,9 @@ module is the sink those numbers flow into:
   * exporters — JSONL (one line per instrument / series point) and
     Prometheus text exposition, both file- and string-oriented so the
     bench can persist them into its artifact tree and a scrape
-    endpoint can serve them unchanged.
+    endpoint can serve them unchanged. The JSONL line schemas are a
+    CONTRACT: scripts/telemetry_lint.py validates persisted artifacts
+    against them (tier-1-gated), so evolve them additively.
 
 Zero-cost when disabled: the module default is a `NullRegistry` whose
 instruments are shared no-op singletons — a disabled `counter().inc()`
@@ -163,6 +165,13 @@ class Timeseries:
         with self._lock:
             return list(self._points)
 
+    @property
+    def last(self) -> Optional[dict]:
+        """The most recent point (None when empty) — the live view a
+        status panel or scraper wants without copying the series."""
+        with self._lock:
+            return dict(self._points[-1]) if self._points else None
+
     def __len__(self) -> int:
         return len(self._points)
 
@@ -175,6 +184,7 @@ class _NullInstrument:
     name = help = ""
     buckets = ()
     points: list = []
+    last = None
 
     def inc(self, n: float = 1, **labels) -> None:
         pass
@@ -334,10 +344,9 @@ class Registry:
                                  f"{_prom_num(st[1])}")
                     lines.append(f"{name}_count{_label_str(k)} {st[2]}")
             else:
-                pts = inst.points
-                if not pts:
+                last = inst.last
+                if last is None:
                     continue
-                last = pts[-1]
                 for field, v in sorted(last.items()):
                     if isinstance(v, bool) or not isinstance(
                             v, (int, float)):

@@ -624,10 +624,12 @@ def _run_search(enc, init_fn, chunk_jit, iinv, iopc, n, max_configs,
                 mx=None, tracer=None, plat="cpu"):
     import jax.numpy as jnp
 
+    from .. import fleet as _fleet_mod
     from .. import metrics as _metrics_mod
     from .. import trace as _trace_mod
     mx = mx if mx is not None else _metrics_mod.get_default()
     tracer = tracer if tracer is not None else _trace_mod.NULL_TRACER
+    status = _fleet_mod.get_default()
 
     consts = (jnp.asarray(enc.inv), jnp.asarray(enc.ret),
               jnp.asarray(enc.opcode), jnp.asarray(enc.sufminret),
@@ -680,6 +682,24 @@ def _run_search(enc, init_fn, chunk_jit, iinv, iopc, n, max_configs,
             first_call_s = _time.monotonic() - t0
         found, overflow = bool(flags[0]), bool(flags[1])
         total_explored = int(stats[0])
+        if status.enabled:
+            # live run status (fleet.RunStatus): one small dict per
+            # poll — ~75 ms+ apart on accel, a few Hz on cpu — so the
+            # /status.json panel and the JEPSEN_TPU_PROGRESS ticker
+            # track frontier/backlog/rate mid-search. The search id
+            # keys the rate bookkeeping: concurrent searches (streamed
+            # workers, raced lanes) run one per thread, so the thread
+            # id distinguishes their cumulative counters
+            import threading as _threading
+            status.search_poll({
+                "kernel": kern, "platform": plat,
+                "chunk": n_chunks - 1,
+                "wall_s": round(_time.monotonic() - t0, 4),
+                "poll_s": round(poll_s, 6),
+                "frontier": fr_cnt, "backlog": bk_cnt,
+                "explored": total_explored,
+                "rounds": int(stats[5])},
+                search_id=(_threading.get_ident(), plat))
         if tl_points is not None:
             prev = tl_points[-1] if tl_points else {}
             memo_hits_c, inserted_c = int(stats[3]), int(stats[4])

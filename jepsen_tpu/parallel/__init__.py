@@ -7,6 +7,12 @@ split becomes accelerator data parallelism: per-key histories are encoded
 into a shared shape bucket, the WGL search kernel is vmapped over the key
 axis, and the batch is laid out over a `jax.sharding.Mesh` so each device
 searches its own keys with zero cross-device communication.
+
+Fleet observability (doc/OBSERVABILITY.md): every per-key result
+carries a `shard` telemetry block (device, engine, wall, faults) —
+recorded into the ambient metrics registry and `fleet.RunStatus` —
+and `independent.py` derives the `util.fleet` straggler/imbalance
+aggregates from them via `fleet.summarize`.
 """
 
 from .batched import (BatchEncoded, check_batched, check_streamed,

@@ -23,6 +23,7 @@ from typing import Optional, Sequence
 
 import numpy as np
 
+from .. import fleet as _fleet
 from .. import metrics as _metrics
 from ..history import History
 from ..models.core import Model
@@ -186,16 +187,28 @@ def _backend_ready_or_fallback(time_limit: Optional[float]) -> bool:
 
 
 def _all_host(model: Model, histories: Sequence[History],
-              deadline: Optional[float],
-              oracle_fallback: bool) -> list[dict]:
+              deadline: Optional[float], oracle_fallback: bool,
+              key_indices: Optional[Sequence[int]] = None) -> list[dict]:
     """Device plane unavailable (init timeout): decide every key with
-    the host oracle inside the remaining budget, or report why not."""
+    the host oracle inside the remaining budget, or report why not.
+    `key_indices` maps positions to the caller's batch indices so the
+    recorded shard telemetry names the right key."""
     out = []
-    for h in histories:
+    for i, h in enumerate(histories):
+        t0 = _time.monotonic()
         base = {"valid?": "unknown", "cause": "backend-init-timeout",
                 "op_count": len(h)}
-        out.append(_oracle_fallback(model, h, deadline, base)
-                   if oracle_fallback else base)
+        res = (_oracle_fallback(model, h, deadline, base)
+               if oracle_fallback else base)
+        # engine "oracle-fallback" only when the oracle actually ran
+        # (_oracle_fallback skips past-deadline and sets no engine)
+        _annotate_shard(res,
+                        key_index=(key_indices[i] if key_indices
+                                   is not None else i),
+                        device="host",
+                        engine=str(res.get("engine") or "none"),
+                        t0=t0, wall_s=_time.monotonic() - t0)
+        out.append(res)
     return out
 
 
@@ -203,15 +216,50 @@ def _oracle_fallback(model: Model, history: History,
                      deadline: Optional[float], device_res: dict) -> dict:
     """Re-check a device-"unknown" history with the host oracle inside
     whatever time remains, annotating why the device declined
-    (competition semantics). Returns the device result untouched when
-    the deadline has already passed."""
+    (competition semantics). ALWAYS annotates `device_cause` — even on
+    the deadline-expired path that returns the device result untouched
+    otherwise — so a fallback verdict can never lose the reason the
+    device declined."""
     remaining = (deadline - _time.monotonic()
                  if deadline is not None else None)
+    cause = device_res.get("cause") or "undecided"
     if remaining is not None and remaining <= 0:
-        return device_res
+        out = dict(device_res)
+        out.setdefault("device_cause", cause)
+        out.setdefault("fallback", "skipped: deadline expired")
+        return out
     ref = wgl_ref.check(model, history, time_limit=remaining)
-    ref.setdefault("device_cause", device_res.get("cause"))
+    ref["device_cause"] = ref.get("device_cause", cause)
+    ref.setdefault("engine", "oracle-fallback")
     return ref
+
+
+def _annotate_shard(res: dict, *, key_index: int, device: str,
+                    engine: str, t0: float, wall_s: float,
+                    device_index: Optional[int] = None,
+                    fault: Optional[dict] = None,
+                    extra: Optional[dict] = None) -> dict:
+    """Stamp a per-key `shard` telemetry block onto a result and
+    record it into the ambient metrics registry + RunStatus
+    (fleet.record_shard). Returns the result for chaining."""
+    shard = {"key_index": key_index, "device": device,
+             "engine": engine, "t0": round(t0, 4),
+             "wall_s": round(wall_s, 4),
+             "valid?": res.get("valid?"),
+             "op_count": res.get("op_count")}
+    if device_index is not None:
+        shard["device_index"] = device_index
+    if res.get("cause") is not None:
+        shard["cause"] = res.get("cause")
+    if res.get("device_cause") is not None:
+        shard["device_cause"] = res.get("device_cause")
+    if fault is not None:
+        shard["fault"] = fault
+    if extra:
+        shard.update(extra)
+    res["shard"] = shard
+    _fleet.record_shard(shard)
+    return res
 
 
 def check_streamed(model: Model, histories: Sequence[History],
@@ -219,7 +267,10 @@ def check_streamed(model: Model, histories: Sequence[History],
                    max_configs: int = 50_000_000,
                    oracle_fallback: bool = True,
                    encs: Optional[Sequence[Encoded]] = None,
-                   race: Optional[bool] = None) -> list[dict]:
+                   race: Optional[bool] = None,
+                   register_keys: bool = True,
+                   key_indices: Optional[Sequence[int]] = None
+                   ) -> list[dict]:
     """Per-key single-kernel checks fanned out over the visible devices
     by a thread pool (one worker per device, `jax.default_device`
     pinning). This is the fast path for *large* per-key histories: the
@@ -235,7 +286,8 @@ def check_streamed(model: Model, histories: Sequence[History],
 
     deadline = _time.monotonic() + time_limit if time_limit else None
     if not _backend_ready_or_fallback(time_limit):
-        return _all_host(model, histories, deadline, oracle_fallback)
+        return _all_host(model, histories, deadline, oracle_fallback,
+                         key_indices=key_indices)
     devices = jax.devices()
     results: list[Optional[dict]] = [None] * len(histories)
     if race and not oracle_fallback:
@@ -252,35 +304,83 @@ def check_streamed(model: Model, histories: Sequence[History],
         race = oracle_fallback and \
             jax.default_backend() not in ("cpu",)
 
+    status = _fleet.get_default()
+    # register_keys=False: check_batched already registered the whole
+    # key set (host-decided keys included) with the run status
+    if status.enabled and register_keys and len(histories) > 1:
+        status.begin_keys(len(histories))
+
     def one(dev, i_hist):
+        label = _fleet.device_label(dev)
+        di = devices.index(dev) if dev in devices else None
+        # the index the TELEMETRY names: the caller's batch index when
+        # this is a sub-batch of a bigger key set (check_batched)
+        ki = (key_indices[i_hist] if key_indices is not None
+              else i_hist)
+        t0 = _time.monotonic()
+        retries = 0
+        status.device_state(label, "searching", key_index=ki)
         remaining = None
         if deadline is not None:
             remaining = deadline - _time.monotonic()
             if remaining <= 0:
-                return {"valid?": "unknown", "cause": "timeout",
-                        "op_count": len(histories[i_hist])}
+                res = {"valid?": "unknown", "cause": "timeout",
+                       "op_count": len(histories[i_hist])}
+                return _annotate_shard(
+                    res, key_index=ki, device=label,
+                    device_index=di, engine="none", t0=t0,
+                    wall_s=0.0)
         try:
             with jax.default_device(dev):
                 if race:
                     from ..checker import _race_competition
-                    return _race_competition(
+                    res = _race_competition(
                         model, histories[i_hist], remaining,
                         device=dev, max_configs=max_configs,
                         enc=encs[i_hist] if encs else None)
-                res = wgl.check(model, histories[i_hist],
-                                time_limit=remaining,
-                                max_configs=max_configs,
-                                enc=encs[i_hist] if encs else None)
-                if res.get("valid?") == "unknown" and oracle_fallback:
-                    res = _oracle_fallback(model, histories[i_hist],
-                                           deadline, res)
-                return res
+                    engine = str(res.get("engine") or "device")
+                else:
+                    res = wgl.check(model, histories[i_hist],
+                                    time_limit=remaining,
+                                    max_configs=max_configs,
+                                    enc=encs[i_hist] if encs else None)
+                    engine = "device"
+                    if res.get("valid?") == "unknown" and oracle_fallback:
+                        status.device_state(label, "fallback",
+                                            key_index=ki)
+                        retries = 1
+                        res = _oracle_fallback(model, histories[i_hist],
+                                               deadline, res)
+                        # a past-deadline skip sets no engine: the
+                        # shard stays "device" (the oracle never ran)
+                        engine = str(res.get("engine") or engine)
+                return _annotate_shard(
+                    res, key_index=ki, device=label,
+                    device_index=di, engine=engine, t0=t0,
+                    wall_s=_time.monotonic() - t0,
+                    extra={"retries": retries})
         except Exception as e:  # noqa: BLE001 — a device fault on one
             # key must not void the whole batch (and must not leave a
-            # None hole when raised inside a worker thread)
-            return {"valid?": "unknown",
-                    "cause": f"error: {type(e).__name__}: {e}"[:300],
-                    "op_count": len(histories[i_hist])}
+            # None hole when raised inside a worker thread): capture
+            # the traceback as a structured fleet event, keep going,
+            # and still let the host oracle decide the key
+            fault = _fleet.fault_event(e, device=label,
+                                       key_index=ki)
+            status.fault(fault)
+            status.device_state(label, "fault", key_index=ki)
+            res = {"valid?": "unknown",
+                   "cause": f"error: {type(e).__name__}: {e}"[:300],
+                   "op_count": len(histories[i_hist])}
+            engine = "fault"
+            if oracle_fallback:
+                res = _oracle_fallback(model, histories[i_hist],
+                                       deadline, res)
+                engine = str(res.get("engine") or engine)
+            res["fault"] = fault
+            return _annotate_shard(
+                res, key_index=ki, device=label, device_index=di,
+                engine=engine, t0=t0,
+                wall_s=_time.monotonic() - t0, fault=fault)
 
     if len(devices) == 1 or len(histories) == 1:
         for i in range(len(histories)):
@@ -336,20 +436,31 @@ def check_batched(model: Model, histories: Sequence[History],
     # reach it without wrapping (it grows by at most K per round).
     max_configs = min(max_configs, 2**30)
     results: list[Optional[dict]] = [None] * len(histories)
+    status = _fleet.get_default()
+    if status.enabled and len(histories) > 1:
+        status.begin_keys(len(histories))
     encs: list[Encoded] = []
     lanes: list[int] = []  # lane -> history index
     for i, h in enumerate(histories):
+        t_enc = _time.monotonic()
         try:
             e = encode(model, h)
         except EncodingUnsupported as exc:
             if oracle_fallback:
-                results[i] = wgl_ref.check(model, h, time_limit=time_limit)
+                res = wgl_ref.check(model, h, time_limit=time_limit)
+                res.setdefault("device_cause", f"encoding: {exc}")
             else:
-                results[i] = {"valid?": "unknown", "cause": f"encoding: {exc}",
-                              "op_count": len(h)}
+                res = {"valid?": "unknown", "cause": f"encoding: {exc}",
+                       "op_count": len(h)}
+            results[i] = _annotate_shard(
+                res, key_index=i, device="host", engine="host",
+                t0=t_enc, wall_s=_time.monotonic() - t_enc)
             continue
         if e.n_ok == 0:
-            results[i] = {"valid?": True, "op_count": e.n_info}
+            results[i] = _annotate_shard(
+                {"valid?": True, "op_count": e.n_info}, key_index=i,
+                device="host", engine="host", t0=t_enc,
+                wall_s=_time.monotonic() - t_enc)
             continue
         encs.append(e)
         lanes.append(i)
@@ -382,7 +493,7 @@ def check_batched(model: Model, histories: Sequence[History],
             model, [histories[i] for i in lanes],
             time_limit=time_limit, max_configs=max_configs,
             oracle_fallback=oracle_fallback,
-            encs=encs)
+            encs=encs, register_keys=False, key_indices=lanes)
         for i, res in zip(lanes, streamed):
             results[i] = res
         return results  # type: ignore[return-value]
@@ -392,7 +503,7 @@ def check_batched(model: Model, histories: Sequence[History],
     deadline0 = _time.monotonic() + time_limit if time_limit else None
     if not _backend_ready_or_fallback(time_limit):
         host = _all_host(model, [histories[i] for i in lanes],
-                         deadline0, oracle_fallback)
+                         deadline0, oracle_fallback, key_indices=lanes)
         for i, res in zip(lanes, host):
             results[i] = res
         return results  # type: ignore[return-value]
@@ -453,6 +564,10 @@ def check_batched(model: Model, histories: Sequence[History],
     t0 = _time.monotonic()
     timed_out = False
     mx = _metrics.get_default()
+    # keys already decided on the host (trivial/unsupported encodings)
+    # before the vmap loop — the live decided count builds on them
+    decided_base = (status.snapshot()["keys"]["decided"]
+                    if status.enabled else 0)
     while True:
         t_poll = _time.monotonic()
         carry, summary = vchunk(consts, carry)
@@ -476,6 +591,15 @@ def check_batched(model: Model, histories: Sequence[History],
                 "frontier_total": int(fr_cnt[:batch.n_keys].sum()),
                 "backlog_total": int(s[:batch.n_keys, 10].sum()),
                 "explored_total": int(stats[:batch.n_keys, 0].sum())})
+        if status.enabled:
+            status.batched_poll(
+                live=int(live.sum()),
+                decided=(decided_base
+                         + int((found | empty)[:batch.n_keys].sum())),
+                total=batch.n_keys,
+                frontier_total=int(fr_cnt[:batch.n_keys].sum()),
+                backlog_total=int(s[:batch.n_keys, 10].sum()),
+                explored_total=int(stats[:batch.n_keys, 0].sum()))
         if not live.any():
             break
         if deadline is not None and _time.monotonic() > deadline:
@@ -484,6 +608,10 @@ def check_batched(model: Model, histories: Sequence[History],
     wall = _time.monotonic() - t0
 
     overflow = flags[:, 1]
+    # lane -> device: the key axis is laid out in contiguous blocks of
+    # bk//nd lanes per mesh device (NamedSharding over the 1-D mesh)
+    devs_flat = list(mesh.devices.flat)
+    lanes_per_dev = max(1, bk // nd)
     for lane, hist_i in enumerate(lanes):
         e = encs[lane]
         n_total = int(e.n_ok + e.n_info)
@@ -500,6 +628,7 @@ def check_batched(model: Model, histories: Sequence[History],
                           int(stats[lane, 0]) / max(rounds * K, 1), 4),
                       "memo_hit_rate": round(
                           hits / max(hits + ins, 1), 4)}}
+        engine = "device-vmap"
         if found[lane]:
             res = {"valid?": True, "op_count": n_total, **detail}
         elif empty[lane] and not overflow[lane]:
@@ -513,5 +642,15 @@ def check_batched(model: Model, histories: Sequence[History],
             if oracle_fallback and not timed_out:
                 res = _oracle_fallback(model, histories[hist_i],
                                        deadline, res)
-        results[hist_i] = res
+                engine = str(res.get("engine") or engine)
+        di = min(lane // lanes_per_dev, nd - 1)
+        results[hist_i] = _annotate_shard(
+            res, key_index=hist_i,
+            device=_fleet.device_label(devs_flat[di]),
+            device_index=di, engine=engine, t0=t0,
+            # lockstep lanes all pay the batch wall; per-lane rounds /
+            # explored are the honest imbalance signal here
+            wall_s=wall,
+            extra={"rounds": rounds,
+                   "configs_explored": int(stats[lane, 0])})
     return results  # type: ignore[return-value]

@@ -68,6 +68,22 @@ class Tracer:
         self._local = threading.local()
         self._lock = threading.Lock()
         self.spans: list[Span] = []
+        self._listeners: list = []
+
+    def add_listener(self, fn) -> None:
+        """Register fn(event, span), called with event "start" when a
+        span opens and "end" when it closes — the hook
+        `fleet.RunStatus` uses to follow checker phase spans live.
+        Listener failures never break the traced code."""
+        if fn not in self._listeners:
+            self._listeners.append(fn)
+
+    def _notify(self, event: str, sp: "Span") -> None:
+        for fn in self._listeners:
+            try:
+                fn(event, sp)
+            except Exception:  # noqa: BLE001
+                pass
 
     # -- current-span plumbing ----------------------------------------
     def _stack(self) -> list:
@@ -108,6 +124,7 @@ class Tracer:
                   start_s=time.time(),
                   attrs=dict(attrs or {}))
         self._stack().append(sp)
+        self._notify("start", sp)
         try:
             yield sp
         finally:
@@ -115,6 +132,7 @@ class Tracer:
             self._stack().pop()
             with self._lock:
                 self.spans.append(sp)
+            self._notify("end", sp)
 
     # -- the trace.clj surface ----------------------------------------
     def context(self) -> Optional[dict]:

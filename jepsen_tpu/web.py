@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import html
 import io
+import json
 import logging
 import os
 import re
@@ -30,7 +31,7 @@ import urllib.parse
 import zipfile
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
-from . import store
+from . import fleet, store
 
 log = logging.getLogger("jepsen_tpu.web")
 
@@ -127,6 +128,102 @@ def _page(title: str, body: str) -> bytes:
             f"<body>{body}</body></html>").encode()
 
 
+def status_snapshot(store_root: str) -> dict:
+    """The live-run status served at /status.json: the in-process
+    ambient `fleet.RunStatus` when one is installed (a run in this
+    process — the serve-during-test path), else the throttled
+    `current-status.json` mirror a run in ANOTHER process writes under
+    the store root, else an explicit inactive stub. Always returns the
+    documented schema (schema/active keys present)."""
+    st = fleet.get_default()
+    if st.enabled:
+        return st.snapshot()
+    snap = fleet.read_status_file(store_root)
+    if snap is not None:
+        return snap
+    return {"schema": 1, "active": False, "test": None, "phase": None,
+            "started": None, "updated": None,
+            "elapsed_s": None, "eta_s": None,
+            "keys": {"total": 0, "decided": 0, "live": 0,
+                     "failures": 0},
+            "devices": {}, "search": {},
+            "nemesis": {"active": False, "f": None, "since_s": None},
+            "ops": {"invoked": 0, "completed": 0}, "faults": []}
+
+
+_DEV_STATE_COLORS = {"searching": "#79c7f7", "fallback": "#f2b75c",
+                     "fault": "#ee7785", "idle": "#e3e3e3"}
+
+
+def render_status(store_root: str) -> bytes:
+    """The auto-refreshing /status panel: frontier/backlog, per-device
+    state, decided-rate ETA, and the active nemesis window — all from
+    the same snapshot /status.json serves."""
+    s = status_snapshot(store_root)
+    k = s.get("keys") or {}
+    sr = s.get("search") or {}
+    n = s.get("nemesis") or {}
+    parts = ["<meta http-equiv='refresh' content='2'>",
+             "<a href='/'>jepsen_tpu</a> / status",
+             f"<h1>{_esc(s.get('test') or 'no active run')}</h1>"]
+    state = "RUNNING" if s.get("active") else "idle / finished"
+    parts.append(f"<p>state <b>{_esc(state)}</b>"
+                 f" &middot; phase <b>{_esc(s.get('phase'))}</b>"
+                 f" &middot; elapsed {_esc(s.get('elapsed_s', '?'))}s"
+                 + (f" &middot; ETA ~{_esc(s['eta_s'])}s"
+                    if s.get("eta_s") is not None else "")
+                 + "</p>")
+    if n.get("active"):
+        parts.append(
+            f"<p style='background:{VALID_COLORS['unknown']};"
+            f"padding:6px'>nemesis window OPEN: "
+            f"<b>{_esc(n.get('f'))}</b> since t+{_esc(n.get('since_s'))}s"
+            f"</p>")
+    if k.get("total"):
+        parts.append(
+            f"<p>keys decided {k.get('decided', 0)}/{k['total']}"
+            f" &middot; live {k.get('live', 0)}"
+            f" &middot; failures {k.get('failures', 0)}</p>")
+    if sr:
+        cells = "".join(
+            f"<tr><td>{_esc(f)}</td><td>{_esc(v)}</td></tr>"
+            for f, v in sorted(sr.items()))
+        parts.append("<h2>search</h2><table><tbody>"
+                     + cells + "</tbody></table>")
+    devs = s.get("devices") or {}
+    if devs:
+        rows = []
+        for name, d in sorted(devs.items()):
+            color = _DEV_STATE_COLORS.get(d.get("state"),
+                                          VALID_COLORS[None])
+            rows.append(
+                f"<tr><td>{_esc(name)}</td>"
+                f"<td style='background:{color}'>"
+                f"{_esc(d.get('state'))}</td>"
+                f"<td>{_esc(d.get('keys_done'))}</td>"
+                f"<td>{_esc(d.get('last_key'))}</td>"
+                f"<td>{_esc(d.get('busy_s'))}</td>"
+                f"<td>{_esc(d.get('faults'))}</td></tr>")
+        parts.append(
+            "<h2>devices</h2><table><thead><tr><th>device</th>"
+            "<th>state</th><th>keys</th><th>last key</th>"
+            "<th>busy s</th><th>faults</th></tr></thead><tbody>"
+            + "".join(rows) + "</tbody></table>")
+    ops = s.get("ops") or {}
+    if ops.get("invoked"):
+        parts.append(f"<p>ops invoked {ops['invoked']} / completed "
+                     f"{ops.get('completed', 0)}</p>")
+    faults = s.get("faults") or []
+    if faults:
+        items = "".join(
+            f"<li><b>{_esc(f.get('type'))}</b> on "
+            f"{_esc(f.get('device'))} key {_esc(f.get('key_index'))}: "
+            f"{_esc(f.get('error'))}</li>" for f in faults[-8:])
+        parts.append("<h2>faults</h2><ul>" + items + "</ul>")
+    parts.append("<p><a href='/status.json'>status.json</a></p>")
+    return _page("status", "".join(parts))
+
+
 def render_home(cache: _ValidityCache) -> bytes:
     """The test table (web.clj:146-159)."""
     rows = []
@@ -141,7 +238,9 @@ def render_home(cache: _ValidityCache) -> bytes:
             f"<td><a href='{href}/history.txt'>history.txt</a></td>"
             f"<td><a href='{href}/jepsen.log'>jepsen.log</a></td>"
             f"<td><a href='{href}.zip'>zip</a></td></tr>")
-    body = ("<h1>jepsen_tpu</h1><table><thead><tr><th>Name</th>"
+    body = ("<h1>jepsen_tpu</h1>"
+            "<p><a href='/status'>live run status</a></p>"
+            "<table><thead><tr><th>Name</th>"
             "<th>Time</th><th>Valid?</th><th>Results</th><th>History</th>"
             "<th>Log</th><th>Zip</th></tr></thead><tbody>"
             + "".join(rows) + "</tbody></table>")
@@ -265,6 +364,21 @@ class Handler(BaseHTTPRequestHandler):
             if uri == "/":
                 self._send(200, "text/html; charset=utf-8",
                            render_home(self.cache))
+                return
+            if uri == "/status.json":
+                body = json.dumps(
+                    status_snapshot(self.cache.store_root),
+                    default=str).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Cache-Control", "no-store")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return
+            if uri == "/status":
+                self._send(200, "text/html; charset=utf-8",
+                           render_status(self.cache.store_root))
                 return
             m = re.match(r"^/files/(.+)$", uri)
             if not m:

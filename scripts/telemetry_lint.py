@@ -1,0 +1,186 @@
+#!/usr/bin/env python
+"""Validate telemetry artifacts against the documented schema.
+
+The telemetry plane (doc/OBSERVABILITY.md) is consumed by diffing
+tools, the regression tracker, and downstream scrapers — silent schema
+drift (a renamed field, a stringified count) breaks them long after
+the commit that caused it. This linter checks every
+`artifacts/telemetry/*.jsonl` line (and `regressions.json`) against
+the schemas metrics.py / fleet.py / bench.py emit, and exits non-zero
+on drift so a tier-1 test run catches it before a BENCH round does.
+
+Usage:
+    python scripts/telemetry_lint.py [paths...]
+    # no args: lints artifacts/telemetry/* under the repo root
+    # (missing dir or empty files lint clean: nothing has drifted)
+
+Importable: `lint_jsonl_file` / `lint_regressions_file` return error
+lists so tests can assert on specific drift.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+NUM = (int, float)
+
+# type -> required fields (name -> allowed types); extra fields are
+# ALLOWED (additive evolution is not drift), missing/mistyped are not.
+LINE_SCHEMAS = {
+    "sample": {"series": str, "t": NUM},
+    "counter": {"name": str, "labels": dict, "value": NUM},
+    "gauge": {"name": str, "labels": dict, "value": NUM},
+    "histogram": {"name": str, "labels": dict, "buckets": list,
+                  "bucket_counts": list, "sum": NUM, "count": int},
+}
+
+# well-known series carry documented point fields on top of `t`
+SERIES_SCHEMAS = {
+    "wgl_chunks": {"chunk": int, "wall_s": NUM, "poll_s": NUM,
+                   "frontier": int, "backlog": int, "explored": int,
+                   "rounds": int, "kernel": str, "platform": str},
+    "wgl_batched_chunks": {"wall_s": NUM, "poll_s": NUM,
+                           "live_keys": int, "decided_keys": int,
+                           "frontier_total": int, "backlog_total": int,
+                           "explored_total": int},
+    "fleet_shards": {"key_index": int, "device": str, "engine": str,
+                     "wall_s": NUM},
+    "fleet_faults": {"type": str, "error": str, "stage": str},
+}
+
+REGRESSIONS_SCHEMA = {"schema": int, "threshold_x": NUM,
+                      "rounds": list, "configs": dict,
+                      "regressions": list}
+
+
+def _check_fields(obj: dict, schema: dict, where: str) -> list:
+    errors = []
+    for field, typ in schema.items():
+        if field not in obj:
+            errors.append(f"{where}: missing required field "
+                          f"{field!r}")
+        elif not isinstance(obj[field], typ) or (
+                typ is int and isinstance(obj[field], bool)):
+            errors.append(
+                f"{where}: field {field!r} should be "
+                f"{getattr(typ, '__name__', typ)}, got "
+                f"{type(obj[field]).__name__} ({obj[field]!r})")
+    return errors
+
+
+def lint_line(obj: dict, where: str) -> list:
+    typ = obj.get("type")
+    if typ not in LINE_SCHEMAS:
+        return [f"{where}: unknown line type {typ!r} "
+                f"(known: {sorted(LINE_SCHEMAS)})"]
+    errors = _check_fields(obj, LINE_SCHEMAS[typ], where)
+    if typ == "sample":
+        series_schema = SERIES_SCHEMAS.get(obj.get("series"))
+        if series_schema:
+            errors += _check_fields(obj, series_schema,
+                                    f"{where} [{obj.get('series')}]")
+    elif typ == "histogram" and not errors:
+        buckets, counts = obj["buckets"], obj["bucket_counts"]
+        if len(buckets) != len(counts):
+            errors.append(f"{where}: {len(buckets)} buckets but "
+                          f"{len(counts)} bucket_counts")
+        if sorted(buckets) != buckets:
+            errors.append(f"{where}: buckets not ascending")
+        if counts != sorted(counts):
+            errors.append(f"{where}: bucket_counts not cumulative "
+                          "(must be non-decreasing)")
+        if counts and max(counts) > obj["count"]:
+            errors.append(f"{where}: largest bucket count "
+                          f"{max(counts)} exceeds count "
+                          f"{obj['count']}")
+    return errors
+
+
+def lint_jsonl_file(path: str) -> list:
+    errors = []
+    try:
+        with open(path) as fh:
+            for i, line in enumerate(fh, 1):
+                line = line.strip()
+                if not line:
+                    continue
+                where = f"{os.path.basename(path)}:{i}"
+                try:
+                    obj = json.loads(line)
+                except ValueError as e:
+                    errors.append(f"{where}: not JSON ({e})")
+                    continue
+                if not isinstance(obj, dict):
+                    errors.append(f"{where}: line is not an object")
+                    continue
+                errors += lint_line(obj, where)
+    except OSError as e:
+        errors.append(f"{path}: unreadable ({e})")
+    return errors
+
+
+def lint_regressions_file(path: str) -> list:
+    where = os.path.basename(path)
+    try:
+        with open(path) as fh:
+            obj = json.load(fh)
+    except (OSError, ValueError) as e:
+        return [f"{where}: not JSON ({e})"]
+    if not isinstance(obj, dict):
+        return [f"{where}: not an object"]
+    errors = _check_fields(obj, REGRESSIONS_SCHEMA, where)
+    for name, row in (obj.get("configs") or {}).items():
+        if not isinstance(row, dict) or not isinstance(
+                row.get("latest"), NUM):
+            errors.append(f"{where}: configs[{name!r}] needs a "
+                          "numeric 'latest'")
+    for r in obj.get("rounds") or []:
+        if not isinstance(r, dict) or not isinstance(
+                r.get("round"), int):
+            errors.append(f"{where}: rounds entries need an int "
+                          "'round'")
+            break
+    return errors
+
+
+def lint_path(path: str) -> list:
+    if path.endswith("regressions.json"):
+        return lint_regressions_file(path)
+    if path.endswith(".jsonl"):
+        return lint_jsonl_file(path)
+    return []  # .prom / .png etc.: out of scope
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if argv:
+        paths = argv
+    else:
+        art = os.path.join(REPO_ROOT, "artifacts", "telemetry")
+        paths = sorted(glob.glob(os.path.join(art, "*")))
+        if not paths:
+            print(f"telemetry lint: nothing to lint under {art}")
+            return 0
+    errors = []
+    linted = 0
+    for p in paths:
+        if os.path.isdir(p):
+            paths += sorted(glob.glob(os.path.join(p, "*")))
+            continue
+        errs = lint_path(p)
+        if p.endswith((".jsonl", "regressions.json")):
+            linted += 1
+        errors += errs
+    for e in errors:
+        print(f"DRIFT: {e}", file=sys.stderr)
+    print(f"telemetry lint: {linted} file(s), {len(errors)} error(s)")
+    return 1 if errors else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

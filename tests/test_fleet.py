@@ -1,0 +1,523 @@
+"""Fleet observability tests: shard-telemetry aggregation, structured
+fault-event capture, imbalance math, RunStatus live snapshots, the
+batched/streamed instrumentation, bench regression tracking, and the
+telemetry schema linter (scripts/telemetry_lint.py).
+
+Runs on the 8-device virtual CPU mesh from conftest.py, like
+test_parallel.py.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+from unittest import mock
+
+import pytest
+
+from jepsen_tpu import fleet, metrics, synth
+from jepsen_tpu.models import core as models
+from jepsen_tpu.parallel import check_batched, check_streamed, default_mesh
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LINT = os.path.join(REPO, "scripts", "telemetry_lint.py")
+
+
+# --- summarize: imbalance math on synthetic per-key results ----------------
+
+def shard(key, dev, wall, t0=0.0, engine="device", fault=None):
+    s = {"key_index": key, "device": dev, "engine": engine,
+         "t0": t0, "wall_s": wall, "valid?": True}
+    if fault:
+        s["fault"] = fault
+    return s
+
+
+class TestSummarize:
+    def test_empty(self):
+        assert fleet.summarize([])["keys"] == 0
+        assert fleet.summarize([None, None])["keys"] == 0
+
+    def test_per_device_counts_and_straggler(self):
+        shards = [shard(0, "d0", 1.0), shard(1, "d0", 1.0),
+                  shard(2, "d1", 1.0), shard(3, "d1", 4.0)]
+        s = fleet.summarize(shards)
+        assert s["keys"] == 4
+        assert s["device_count"] == 2
+        assert s["devices"]["d0"]["keys"] == 2
+        assert s["devices"]["d1"]["wall_s"] == pytest.approx(5.0)
+        # median wall of [1,1,1,4] (upper median) = 1, max = 4
+        assert s["wall_s"]["max"] == pytest.approx(4.0)
+        assert s["straggler_ratio"] == pytest.approx(4.0)
+        assert s["imbalance"] == {"max_keys": 2, "min_keys": 2,
+                                  "mean_keys": 2.0}
+
+    def test_busy_fraction(self):
+        # span 0..10: d0 busy 10s (frac 1.0), d1 busy 2s (frac 0.2)
+        shards = [shard(0, "d0", 10.0, t0=0.0),
+                  shard(1, "d1", 2.0, t0=0.0)]
+        s = fleet.summarize(shards)
+        assert s["span_s"] == pytest.approx(10.0)
+        assert s["devices"]["d0"]["busy_frac"] == pytest.approx(1.0)
+        assert s["devices"]["d1"]["busy_frac"] == pytest.approx(0.2)
+
+    def test_fault_and_fallback_counts(self):
+        shards = [shard(0, "d0", 1.0),
+                  shard(1, "d0", 1.0, engine="oracle-fallback"),
+                  shard(2, "d1", 1.0, fault={"type": "RuntimeError"})]
+        s = fleet.summarize(shards)
+        assert s["faults"] == 1
+        assert s["fallbacks"] == 1
+        assert s["devices"]["d0"]["fallbacks"] == 1
+        assert s["devices"]["d1"]["faults"] == 1
+        assert s["engines"] == {"device": 2, "oracle-fallback": 1}
+
+
+# --- RunStatus --------------------------------------------------------------
+
+class TestRunStatus:
+    def test_disabled_is_noop(self):
+        st = fleet.NULL_STATUS
+        st.phase("x")
+        st.begin_keys(10)
+        st.key_done(shard(0, "d0", 1.0))
+        st.nemesis_event("kill", True)
+        assert st.snapshot()["keys"]["decided"] == 0
+
+    def test_snapshot_schema_and_eta(self):
+        st = fleet.RunStatus(test="t", progress=False)
+        st.phase("run")
+        st.begin_keys(4)
+        st.key_done(shard(0, "d0", 0.5))
+        st.key_done({**shard(1, "d1", 0.5), "valid?": False})
+        s = st.snapshot()
+        assert s["schema"] == 1 and s["active"] is True
+        assert s["test"] == "t" and s["phase"] == "run"
+        assert s["keys"] == {"total": 4, "decided": 2, "live": 0,
+                             "failures": 1}
+        assert s["devices"]["d0"]["keys_done"] == 1
+        assert s["eta_s"] is not None  # decided-rate extrapolation
+        st.finish(valid=False)
+        s = st.snapshot()
+        assert s["active"] is False and s["phase"] == "done"
+        assert s["valid?"] is False
+
+    def test_nemesis_window(self):
+        st = fleet.RunStatus(progress=False)
+        st.nemesis_event("start-partition", True)
+        n = st.snapshot()["nemesis"]
+        assert n["active"] is True and n["f"] == "start-partition"
+        st.nemesis_event("stop-partition", False)
+        assert st.snapshot()["nemesis"]["active"] is False
+
+    def test_nemesis_window_classification(self):
+        """The interpreter classifies ops with
+        fleet.nemesis_opens_window, which must follow the nemesis
+        package conventions (nemesis/combined.py): the kill/pause
+        package heals with f='start'/'resume'."""
+        assert fleet.nemesis_opens_window("kill")
+        assert fleet.nemesis_opens_window("pause")
+        assert fleet.nemesis_opens_window("start-partition")
+        assert not fleet.nemesis_opens_window("start")  # kill heal
+        assert not fleet.nemesis_opens_window("resume")
+        assert not fleet.nemesis_opens_window("heal")
+        assert not fleet.nemesis_opens_window("stop-partition")
+
+    def test_search_poll_rate(self):
+        st = fleet.RunStatus(progress=False)
+        st.search_poll({"explored": 100, "poll_s": 1.0, "frontier": 5})
+        st.search_poll({"explored": 300, "poll_s": 0.5, "frontier": 7})
+        sr = st.snapshot()["search"]
+        assert sr["frontier"] == 7
+        assert sr["configs_per_s"] == 400  # (300-100)/0.5
+
+    def test_thread_safety(self):
+        st = fleet.RunStatus(progress=False)
+        st.begin_keys(200)
+
+        def worker(dev):
+            for i in range(50):
+                st.key_done(shard(i, dev, 0.01))
+
+        ts = [threading.Thread(target=worker, args=(f"d{j}",))
+              for j in range(4)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        s = st.snapshot()
+        assert s["keys"]["decided"] == 200
+        assert sum(d["keys_done"] for d in s["devices"].values()) == 200
+
+    def test_status_file_mirror(self, tmp_path):
+        p = str(tmp_path / "current-status.json")
+        st = fleet.RunStatus(test="m", status_file=p, progress=False)
+        st.begin_keys(2)
+        st.finish(valid=True)
+        snap = fleet.read_status_file(str(tmp_path))
+        assert snap is not None and snap["test"] == "m"
+        assert snap["phase"] == "done"
+
+    def test_fault_cap(self):
+        st = fleet.RunStatus(progress=False)
+        for i in range(fleet.STATUS_FAULT_CAP + 10):
+            st.fault({"type": "E", "error": str(i), "stage": "s",
+                      "device": "d", "key_index": i})
+        faults = st.snapshot()["faults"]
+        assert len(faults) == fleet.STATUS_FAULT_CAP
+        assert faults[-1]["key_index"] == fleet.STATUS_FAULT_CAP + 9
+
+
+# --- streamed / batched instrumentation ------------------------------------
+
+class TestShardTelemetry:
+    def test_streamed_shard_blocks_and_registry(self):
+        hists = [synth.cas_register_history(25, n_procs=3, seed=s)
+                 for s in range(6)]
+        reg = metrics.Registry()
+        st = fleet.RunStatus(progress=False)
+        with metrics.use(reg), fleet.use(st):
+            res = check_streamed(models.cas_register(), hists)
+        assert all(r["valid?"] is True for r in res)
+        shards = [r["shard"] for r in res]
+        assert sorted(s["key_index"] for s in shards) == list(range(6))
+        # work-stealing over the 8-device mesh: >= 2 devices used
+        assert len({s["device"] for s in shards}) >= 2
+        assert all(s["engine"] == "device" for s in shards)
+        assert all(s["wall_s"] >= 0 for s in shards)
+        pts = reg.series("fleet_shards").points
+        assert len(pts) == 6
+        assert reg.counter("fleet_keys_total").samples()
+        snap = st.snapshot()
+        assert snap["keys"]["decided"] == 6
+        summ = fleet.summarize(shards)
+        assert summ["keys"] == 6 and summ["device_count"] >= 2
+        assert summ["straggler_ratio"] >= 1.0
+
+    def test_streamed_fault_captured_and_run_survives(self):
+        from jepsen_tpu.ops import wgl
+        hists = [synth.cas_register_history(20, n_procs=2, seed=s)
+                 for s in range(3)]
+        marked = hists[1]
+        real = wgl.check
+
+        def flaky(model, history, **kw):
+            if history is marked:
+                raise RuntimeError("injected device fault")
+            return real(model, history, **kw)
+
+        reg = metrics.Registry()
+        st = fleet.RunStatus(progress=False)
+        with mock.patch.object(wgl, "check", flaky), \
+                metrics.use(reg), fleet.use(st):
+            res = check_streamed(models.cas_register(), hists)
+        # the run stayed alive AND the faulted key was still decided
+        # by the host oracle
+        assert [r["valid?"] for r in res] == [True, True, True]
+        fault = res[1]["fault"]
+        assert fault["type"] == "RuntimeError"
+        assert "injected device fault" in fault["traceback"]
+        assert fault["stage"] == "device-worker"
+        assert res[1]["shard"]["engine"] == "oracle-fallback"
+        assert reg.series("fleet_faults").points
+        assert reg.counter("fleet_faults_total").samples()
+        sf = st.snapshot()["faults"]
+        assert sf and sf[0]["type"] == "RuntimeError"
+
+    def test_streamed_fault_no_fallback_stays_unknown(self):
+        from jepsen_tpu.ops import wgl
+        hists = [synth.cas_register_history(20, n_procs=2, seed=s)
+                 for s in range(2)]
+
+        def boom(model, history, **kw):
+            raise RuntimeError("kaboom")
+
+        with mock.patch.object(wgl, "check", boom):
+            res = check_streamed(models.cas_register(), hists,
+                                 oracle_fallback=False)
+        assert all(r["valid?"] == "unknown" for r in res)
+        assert all(r["shard"]["engine"] == "fault" for r in res)
+        assert all("kaboom" in r["fault"]["error"] for r in res)
+
+    def test_batched_vmap_shard_blocks(self):
+        hists = [synth.cas_register_history(30, n_procs=3, seed=s)
+                 for s in range(5)]
+        st = fleet.RunStatus(progress=False)
+        with fleet.use(st):
+            res = check_batched(models.cas_register(), hists,
+                                mesh=default_mesh())
+        assert all(r["valid?"] is True for r in res)
+        for r in res:
+            s = r["shard"]
+            assert s["engine"] == "device-vmap"
+            assert "TFRT_CPU" in s["device"] or "cpu" in s["device"]
+            assert s["rounds"] >= 1
+        # lanes spread over distinct mesh devices
+        assert len({r["shard"]["device"] for r in res}) >= 2
+        snap = st.snapshot()
+        assert snap["keys"]["total"] == 5
+        assert snap["keys"]["decided"] == 5
+        assert snap["search"].get("mode") == "batched-vmap"
+
+    def test_key_indices_survive_stream_delegation(self):
+        """check_batched's streamed sub-batch records BATCH indices
+        into the telemetry, not sub-batch-relative ones: a trivial
+        host-decided key 0 + a streamed key 1 must not both record
+        key_index 0 in fleet_shards."""
+        from jepsen_tpu import history as h
+        hists = [h.History(),  # n_ok == 0: host short-circuit
+                 synth.cas_register_history(30, n_procs=3, seed=1)]
+        reg = metrics.Registry()
+        with metrics.use(reg):
+            res = check_batched(models.cas_register(), hists,
+                                strategy="stream")
+        assert res[0]["shard"]["key_index"] == 0
+        assert res[1]["shard"]["key_index"] == 1
+        recorded = sorted(p["key_index"]
+                          for p in reg.series("fleet_shards").points)
+        assert recorded == [0, 1]
+
+    def test_search_poll_concurrent_searches_do_not_contaminate(self):
+        st = fleet.RunStatus(progress=False)
+        st.search_poll({"explored": 1000, "poll_s": 1.0}, search_id="a")
+        st.search_poll({"explored": 10, "poll_s": 1.0}, search_id="b")
+        # a's next poll diffs against A's own counter, not b's
+        st.search_poll({"explored": 1500, "poll_s": 1.0}, search_id="a")
+        assert st.snapshot()["search"]["configs_per_s"] == 500
+        st.search_poll({"explored": 20, "poll_s": 1.0}, search_id="b")
+        assert st.snapshot()["search"]["configs_per_s"] == 10
+
+    def test_oracle_fallback_always_annotates_device_cause(self):
+        from jepsen_tpu.parallel.batched import _oracle_fallback
+        import time
+        h = synth.cas_register_history(20, n_procs=2, seed=0)
+        m = models.cas_register()
+        # normal path: device_cause copied from the device result
+        res = _oracle_fallback(m, h, None,
+                               {"valid?": "unknown",
+                                "cause": "config-limit"})
+        assert res["device_cause"] == "config-limit"
+        assert res["engine"] == "oracle-fallback"
+        # causeless device result still gets an annotation
+        res = _oracle_fallback(m, h, None, {"valid?": "unknown"})
+        assert res["device_cause"] == "undecided"
+        # deadline-expired path annotates too (it used to return the
+        # device result untouched)
+        res = _oracle_fallback(m, h, time.monotonic() - 1,
+                               {"valid?": "unknown",
+                                "cause": "timeout"})
+        assert res["valid?"] == "unknown"
+        assert res["device_cause"] == "timeout"
+        assert "fallback" in res
+
+    def test_wgl_search_poll_feeds_status(self):
+        from jepsen_tpu.ops import wgl
+        st = fleet.RunStatus(progress=False)
+        h = synth.cas_register_history(60, n_procs=3, seed=1)
+        with fleet.use(st):
+            res = wgl.check(models.cas_register(), h)
+        assert res["valid?"] is True
+        sr = st.snapshot()["search"]
+        assert sr["kernel"] in ("wgl32", "wgln")
+        assert sr["explored"] >= 1
+        assert sr["frontier"] >= 0
+
+
+# --- independent lifting: util.fleet ---------------------------------------
+
+def multikey_history(n_keys=4, ops_per_key=24):
+    import random
+
+    from jepsen_tpu import history as h
+    from jepsen_tpu import independent
+    rng = random.Random(7)
+    hist = h.History()
+    streams = [(k, list(synth.cas_register_history(
+        ops_per_key, n_procs=3, seed=100 + k))) for k in range(n_keys)]
+    while any(ops for _, ops in streams):
+        k, ops = rng.choice([s for s in streams if s[1]])
+        op = ops.pop(0)
+        hist.append(op.with_(process=(op.process, k),
+                             value=independent.tuple_(k, op.value)))
+    return hist.index()
+
+
+class TestIndependentFleet:
+    def test_tpu_checker_populates_util_fleet(self):
+        from jepsen_tpu import independent
+        hist = multikey_history(n_keys=5)
+        reg = metrics.Registry()
+        with metrics.use(reg):
+            res = independent.tpu_checker(
+                models.cas_register()).check({}, hist, {})
+        assert res["valid?"] is True
+        fl = res["util"]["fleet"]
+        assert fl["keys"] == 5
+        # >= 2 devices on the 8-device mesh (the acceptance bar)
+        assert fl["device_count"] >= 2
+        assert "straggler_ratio" in fl and fl["straggler_ratio"] >= 1.0
+        assert "imbalance" in fl and fl["imbalance"]["max_keys"] >= 1
+        assert all(d["keys"] >= 1 for d in fl["devices"].values())
+        assert reg.series("fleet_shards").points
+
+    def test_host_checker_populates_util_fleet(self):
+        from jepsen_tpu import checker, independent
+        hist = multikey_history(n_keys=3)
+        res = independent.checker(
+            checker.linearizable(models.cas_register(),
+                                 algorithm="wgl")).check({}, hist, {})
+        assert res["valid?"] is True
+        fl = res["util"]["fleet"]
+        assert fl["keys"] == 3
+        assert fl["devices"]["host"]["keys"] == 3
+        assert fl["engines"] == {"host": 3}
+
+
+# --- bench regression tracking ---------------------------------------------
+
+class TestRegressionTracking:
+    def rounds(self):
+        return [
+            {"round": 1, "file": "BENCH_r01.json", "value": 1.0,
+             "platform": "cpu", "verdict": True,
+             "configs": {"a": 2.0, "b": 10.0}},
+            {"round": 2, "file": "BENCH_r02.json", "value": 1.1,
+             "platform": "cpu", "verdict": True,
+             "configs": {"a": 2.2, "b": 9.0}},
+            {"round": 3, "file": "BENCH_r03.json", "value": 5.0,
+             "platform": "tpu", "verdict": True,
+             "configs": {"a": 0.1}},
+        ]
+
+    def test_flags_slowdowns_beyond_threshold(self):
+        sys.path.insert(0, REPO)
+        import bench
+        cur = {"round": 4, "value": 1.05, "platform": "cpu",
+               "configs": {"a": 4.0, "b": 9.5}}
+        rep = bench.compute_regressions(self.rounds(), cur,
+                                        threshold=1.5)
+        # same-platform comparison only: the tpu round is excluded
+        assert rep["compared_rounds"] == [1, 2]
+        assert rep["regressions"] == ["a"]  # 4.0 > 1.5 * best(2.0)
+        assert rep["configs"]["a"]["regressed"] is True
+        assert rep["configs"]["b"]["regressed"] is False
+        assert rep["configs"]["b"]["delta_vs_prev_s"] == \
+            pytest.approx(0.5)
+        assert rep["headline"]["regressed"] is False
+
+    def test_no_comparable_platform(self):
+        sys.path.insert(0, REPO)
+        import bench
+        cur = {"round": 4, "value": 9.9, "platform": "axon",
+               "configs": {}}
+        rep = bench.compute_regressions(self.rounds(), cur)
+        assert rep["regressions"] == []
+        assert "note" in rep
+
+    def test_load_real_rounds(self):
+        """The repo's own BENCH_r*.json snapshots parse into
+        comparable rounds (the ones whose JSON line was captured)."""
+        sys.path.insert(0, REPO)
+        import bench
+        rounds = bench.load_bench_rounds()
+        assert all(r["value"] is not None for r in rounds)
+        assert rounds == sorted(rounds, key=lambda r: r["round"])
+
+    def test_trajectory_png(self, tmp_path):
+        sys.path.insert(0, REPO)
+        import bench
+        from jepsen_tpu.checker import plots
+        rep = bench.compute_regressions(
+            self.rounds(),
+            {"round": 4, "value": 2.0, "platform": "cpu",
+             "configs": {"a": 4.0, "b": 9.5}}, threshold=1.5)
+        out = plots.bench_trajectory_graph(
+            rep, str(tmp_path / "bench-trajectory.png"))
+        assert out and os.path.exists(out)
+        # malformed report never raises
+        assert plots.bench_trajectory_graph(
+            {"rounds": "garbage"}, str(tmp_path / "x.png")) is None
+
+
+# --- telemetry schema lint (scripts/telemetry_lint.py) ----------------------
+
+class TestTelemetryLint:
+    def test_real_registry_export_lints_clean(self, tmp_path):
+        """Everything the instrumented kernels actually emit passes
+        the documented schema — run a search with metrics on, export,
+        lint via the script's exit code (the CI contract)."""
+        from jepsen_tpu.ops import wgl
+        reg = metrics.Registry()
+        hists = [synth.cas_register_history(25, n_procs=3, seed=s)
+                 for s in range(3)]
+        with metrics.use(reg):
+            wgl.check(models.cas_register(), hists[0])
+            check_batched(models.cas_register(), hists,
+                          mesh=default_mesh())
+        path = str(tmp_path / "metrics.jsonl")
+        assert reg.export_jsonl(path) > 0
+        proc = subprocess.run([sys.executable, LINT, path],
+                              capture_output=True, text=True)
+        assert proc.returncode == 0, proc.stderr
+        # names the emitting series it checked
+        series = {json.loads(ln)["series"]
+                  for ln in open(path) if '"sample"' in ln}
+        assert "wgl_chunks" in series
+        assert "fleet_shards" in series
+
+    def test_drift_exits_nonzero(self, tmp_path):
+        p = tmp_path / "drifted.jsonl"
+        p.write_text(json.dumps(
+            {"type": "sample", "series": "fleet_shards", "t": 1.0,
+             "key_index": "zero", "device": "d", "engine": "e",
+             "wall_s": 0.1}) + "\n")
+        proc = subprocess.run([sys.executable, LINT, str(p)],
+                              capture_output=True, text=True)
+        assert proc.returncode == 1
+        assert "key_index" in proc.stderr
+
+    def test_unknown_type_is_drift(self, tmp_path):
+        p = tmp_path / "unknown.jsonl"
+        p.write_text('{"type": "mystery"}\n')
+        proc = subprocess.run([sys.executable, LINT, str(p)],
+                              capture_output=True, text=True)
+        assert proc.returncode == 1
+
+    def test_histogram_consistency(self, tmp_path):
+        p = tmp_path / "hist.jsonl"
+        p.write_text(json.dumps(
+            {"type": "histogram", "name": "h", "labels": {},
+             "buckets": [1.0, 0.5], "bucket_counts": [3, 1],
+             "sum": 1.0, "count": 2}) + "\n")
+        from importlib import util as iu
+        spec = iu.spec_from_file_location("telemetry_lint", LINT)
+        tl = iu.module_from_spec(spec)
+        spec.loader.exec_module(tl)
+        errs = tl.lint_jsonl_file(str(p))
+        assert any("ascending" in e for e in errs)
+        assert any("cumulative" in e for e in errs)
+        assert any("exceeds count" in e for e in errs)
+
+    def test_repo_artifacts_lint_clean(self):
+        """artifacts/telemetry in the tree (when a bench round has
+        populated it) must always pass — this is the tier-1 gate that
+        catches schema drift before a BENCH round."""
+        proc = subprocess.run([sys.executable, LINT],
+                              capture_output=True, text=True)
+        assert proc.returncode == 0, proc.stderr
+
+    def test_regressions_schema(self, tmp_path):
+        sys.path.insert(0, REPO)
+        import bench
+        rep = bench.compute_regressions(
+            [{"round": 1, "file": "f", "value": 1.0,
+              "platform": "cpu", "verdict": True,
+              "configs": {"a": 1.0}}],
+            {"round": 2, "value": 1.0, "platform": "cpu",
+             "configs": {"a": 1.1}})
+        p = tmp_path / "regressions.json"
+        p.write_text(json.dumps(rep))
+        proc = subprocess.run([sys.executable, LINT, str(p)],
+                              capture_output=True, text=True)
+        assert proc.returncode == 0, proc.stderr

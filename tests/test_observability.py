@@ -209,7 +209,11 @@ class TestTimeline:
         timeline.html().check(test, hist(ops), {})
         doc = open(os.path.join(str(tmp_path), "tl3", "t0",
                                 "timeline.html")).read()
-        assert "Showing only" in doc
+        # the visible truncation banner: styled, and it names N of M
+        assert "truncated: showing" in doc
+        assert f"{timeline.OP_LIMIT:,}" in doc
+        assert f"{timeline.OP_LIMIT + 5:,}" in doc
+        assert ".truncation-warning" in doc  # the banner style exists
 
 
 class TestPlots:

@@ -90,3 +90,80 @@ def test_path_traversal_rejected(base_url):
 
 def test_missing_file_404(base_url):
     get(base_url + "/files/nope/nothing.txt", expect=404)
+
+
+# --- live run status (doc/OBSERVABILITY.md "watching a live run") ----------
+
+STATUS_KEYS = {"schema", "active", "test", "phase", "started",
+               "updated", "elapsed_s", "eta_s", "keys", "devices",
+               "search", "nemesis", "ops", "faults"}
+
+
+def test_status_json_idle_schema(base_url):
+    """With no run in flight, /status.json still answers with the full
+    documented schema (active: false stub)."""
+    import json
+
+    from jepsen_tpu import fleet
+    assert not fleet.get_default().enabled  # no ambient run status
+    snap = json.loads(get(base_url + "/status.json"))
+    assert STATUS_KEYS <= set(snap)
+    assert snap["active"] is False
+    assert snap["keys"] == {"total": 0, "decided": 0, "live": 0,
+                            "failures": 0}
+
+
+def test_status_json_mid_run(base_url):
+    """serve answers /status.json MID-RUN: an ambient RunStatus fed by
+    the fan-out is visible through the endpoint while keys are still
+    live."""
+    import json
+
+    from jepsen_tpu import fleet
+    st = fleet.RunStatus(test="live-run", progress=False)
+    with fleet.use(st):
+        st.phase("independent-check")
+        st.begin_keys(10)
+        st.device_state("TFRT_CPU_0", "searching", key_index=3)
+        st.key_done({"key_index": 0, "device": "TFRT_CPU_0",
+                     "engine": "device", "wall_s": 0.2, "valid?": True})
+        st.nemesis_event("start-partition", True)
+        st.search_poll({"frontier": 12, "backlog": 3, "explored": 500,
+                        "poll_s": 0.1})
+        snap = json.loads(get(base_url + "/status.json"))
+    assert STATUS_KEYS <= set(snap)
+    assert snap["active"] is True
+    assert snap["test"] == "live-run"
+    assert snap["phase"] == "independent-check"
+    assert snap["keys"]["total"] == 10
+    assert snap["keys"]["decided"] == 1
+    assert snap["devices"]["TFRT_CPU_0"]["keys_done"] == 1
+    assert snap["search"]["frontier"] == 12
+    assert snap["nemesis"] == {"active": True, "f": "start-partition",
+                               "since_s": snap["nemesis"]["since_s"]}
+    assert snap["eta_s"] is not None
+
+    # the HTML panel renders the same source and auto-refreshes
+    with fleet.use(st):
+        body = get(base_url + "/status").decode()
+    assert "http-equiv='refresh'" in body
+    assert "live-run" in body
+    assert "TFRT_CPU_0" in body
+    assert "nemesis window OPEN" in body
+
+
+def test_status_json_file_fallback(base_url, store_root):
+    """An out-of-process run is visible via the current-status.json
+    mirror under the store root."""
+    import json
+
+    from jepsen_tpu import fleet
+    st = fleet.RunStatus(
+        test="other-proc",
+        status_file=f"{store_root}/{fleet.STATUS_FILENAME}",
+        progress=False)
+    st.begin_keys(3)
+    st.finish(valid=True)
+    snap = json.loads(get(base_url + "/status.json"))
+    assert snap["test"] == "other-proc"
+    assert snap["phase"] == "done"
