@@ -585,6 +585,9 @@ def run_bench() -> tuple[dict, int]:
             except Exception:  # noqa: BLE001 — profiling never kills
                 pass
 
+    from jepsen_tpu.analysis import guards as guards_mod
+    guard_reports: list = []
+
     def headline():
         res_cold, cold_s = _timed(wgl.check, model, hist,
                                   time_limit=budget, tracer=_TRACER)
@@ -592,9 +595,17 @@ def run_bench() -> tuple[dict, int]:
               f"{_drop_telemetry(res_cold)}", file=sys.stderr)
         if res_cold.get("valid?") == "unknown":
             return res_cold, cold_s, None
-        res, warm_s = _timed(wgl.check, model, hist,
-                             time_limit=budget, tracer=_TRACER)
-        print(f"warm: {warm_s:.2f}s -> {_drop_telemetry(res)}",
+        # The warm run re-checks the SAME history: the compile guard
+        # (analysis/guards) counts jit cache misses and the poll
+        # loop's device transfers — a warm recompile is a shape-
+        # bucketing regression the budget makes loud.
+        g = guards_mod.CompileGuard(name="bench-warm")
+        with g:
+            res, warm_s = _timed(wgl.check, model, hist,
+                                 time_limit=budget, tracer=_TRACER)
+        guard_reports.append(g.report())
+        print(f"warm: {warm_s:.2f}s -> {_drop_telemetry(res)} "
+              f"[{g.compiles} compiles, {g.d2h} polls]",
               file=sys.stderr)
         return res, cold_s, warm_s
 
@@ -698,6 +709,17 @@ def run_bench() -> tuple[dict, int]:
            "util": res.get("util"),
            "telemetry": res.get("telemetry"),
            "probe_diagnostics": probe_diags}
+    if guard_reports:
+        # warm-run compile/transfer accounting; the adopted platform's
+        # report is last. JEPSEN_TPU_BENCH_COMPILE_BUDGET (int) turns
+        # a warm recompile into a flagged regression on the line.
+        out["compile_guard"] = guard_reports[-1]
+        cb = os.environ.get("JEPSEN_TPU_BENCH_COMPILE_BUDGET")
+        if cb is not None and guard_reports[-1]["compiles"] > int(cb):
+            out["compile_budget_exceeded"] = True
+            print(f"COMPILE BUDGET EXCEEDED: "
+                  f"{guard_reports[-1]['compiles']} > {cb}",
+                  file=sys.stderr)
     if cpu_baseline:
         out["cpu_baseline"] = cpu_baseline
     if tpu_aot is not None:
@@ -978,7 +1000,8 @@ def emit(out: dict) -> None:
     compact = {k: out.get(k) for k in
                ("metric", "value", "unit", "vs_baseline", "verdict",
                 "platform", "cold_s", "terminated", "error", "cause",
-                "tpu_measured", "regressions")
+                "tpu_measured", "regressions",
+                "compile_budget_exceeded")
                if out.get(k) is not None}
     aot = out.get("tpu_aot")
     if isinstance(aot, dict):

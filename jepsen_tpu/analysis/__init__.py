@@ -1,0 +1,35 @@
+"""Static + runtime analysis for the checker pipeline.
+
+Two planes, both cheap enough to run always:
+
+  * `history_lint`  — a vectorized well-formedness pass over histories,
+                      run BEFORE every WGL/Elle search. The device
+                      kernels assume well-formed input (one outstanding
+                      op per process, monotone clocks, values inside
+                      the encoded alphabet); a malformed history used
+                      to silently corrupt the encoded tensors and
+                      return a garbage verdict. Now it fast-fails as
+                      `{"valid?": "unknown", "anomalies": [...]}` with
+                      rule ids and exact op coordinates.
+  * `jaxlint`       — an AST linter over the kernel modules
+                      (`jepsen_tpu/ops/`, `jepsen_tpu/elle/`) for the
+                      classic JAX footguns: host syncs inside jitted
+                      regions, Python branches on tracers, per-call
+                      `jax.jit` construction, closure captures that
+                      force retraces, implicit dtype promotion, and
+                      Python loops that belong in `lax` control flow.
+                      `scripts/jax_lint.py` is the CLI; CI keeps the
+                      tree lint-clean.
+  * `guards`        — runtime budget guards: a context manager that
+                      counts XLA compilations (via `jax.monitoring`)
+                      and the framework's own host<->device transfers
+                      during a checker run, and asserts budgets (e.g.
+                      re-checking a same-shape history must not
+                      recompile). Used by tests and `bench.py`.
+
+Rule catalogs and allowlist syntax: doc/STATIC_ANALYSIS.md.
+"""
+
+from . import guards, history_lint, jaxlint  # noqa: F401
+
+__all__ = ["history_lint", "jaxlint", "guards"]

@@ -1,0 +1,186 @@
+"""Runtime compile/transfer guards for checker runs.
+
+The static linter (`jaxlint`) catches footguns it can see; this module
+catches the ones only the runtime reveals — a shape-bucketing bug that
+recompiles a "same-shape" re-check, a poll loop that starts syncing
+per round. A `CompileGuard` wraps any block of checker work and
+counts:
+
+  * **compilations** — every XLA backend compile, observed through
+    `jax.monitoring`'s `/jax/core/compile/backend_compile_duration`
+    event (cache hits fire nothing, so the count IS the cache-miss
+    count);
+  * **host<->device transfers** — the framework's own transfer points
+    (`ops/wgl.py`'s const upload + per-chunk poll, `elle/tpu.py`'s
+    kernel I/O) report through `note_transfer()`. This is cooperative
+    by design: `jax.transfer_guard` is inert on the CPU backend where
+    tier-1 runs, while the framework's transfer points are exactly the
+    ones with latency budgets (each device->host poll is a ~75 ms
+    round-trip on a tunneled v5e).
+
+Budgets are asserted on exit:
+
+    with guards.CompileGuard(max_compiles=0):
+        wgl.check(model, history)       # same shape as a prior check
+        wgl.check(model, history2)      # must be all cache hits
+
+raises `BudgetExceeded` (an AssertionError) naming the counts. Used
+by `tests/test_analysis.py` and opt-in by `bench.py`
+(JEPSEN_TPU_BENCH_COMPILE_BUDGET). Zero-cost when no guard is active: the
+module keeps a plain list of active guards, and both the monitoring
+listener and `note_transfer` return immediately on empty.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+# Active guards (a stack: guards may nest). Plain list — appends and
+# removals take the module lock; the hot-path emptiness check doesn't.
+_ACTIVE: list = []
+_LOCK = threading.Lock()
+_LISTENER_INSTALLED = False
+
+
+class BudgetExceeded(AssertionError):
+    """A guard's compile/transfer budget was exceeded."""
+
+
+def _on_duration(name: str, secs: float, **_kw) -> None:
+    if name != COMPILE_EVENT or not _ACTIVE:
+        return
+    for g in list(_ACTIVE):
+        g._record_compile(secs)
+
+
+def _install_listener() -> bool:
+    """Register the module's jax.monitoring listener once per process.
+    Returns False when jax is unavailable (counts stay zero)."""
+    global _LISTENER_INSTALLED
+    with _LOCK:
+        if _LISTENER_INSTALLED:
+            return True
+        try:
+            import jax.monitoring as _mon
+        except Exception:  # noqa: BLE001 — no jax: guard is inert
+            return False
+        _mon.register_event_duration_secs_listener(_on_duration)
+        _LISTENER_INSTALLED = True
+        return True
+
+
+def note_transfer(direction: str, nbytes: int = 0,
+                  what: str = "") -> None:
+    """Report one host<->device transfer from an instrumented
+    framework transfer point. `direction` is "h2d" or "d2h". No-op
+    (one truthiness check) when no guard is active."""
+    if not _ACTIVE:
+        return
+    for g in list(_ACTIVE):
+        g._record_transfer(direction, nbytes, what)
+
+
+class CompileGuard:
+    """Context manager counting compiles + framework transfers, with
+    budget asserts on exit (see module docstring).
+
+    Counts are process-global while active (the competition checker
+    runs engines in threads; their compiles all count). `report()`
+    returns the counts as a plain dict; on exit with budgets exceeded
+    (and no in-flight exception) raises BudgetExceeded."""
+
+    def __init__(self, max_compiles: Optional[int] = None,
+                 max_d2h: Optional[int] = None,
+                 max_h2d: Optional[int] = None,
+                 name: str = "compile-guard"):
+        self.name = name
+        self.max_compiles = max_compiles
+        self.max_d2h = max_d2h
+        self.max_h2d = max_h2d
+        self.compiles = 0
+        self.compile_s = 0.0
+        self.d2h = 0
+        self.h2d = 0
+        self.d2h_bytes = 0
+        self.h2d_bytes = 0
+        self.active = False
+        self._t0: Optional[float] = None
+        self._lock = threading.Lock()
+
+    # -- recording (called from the module hooks) ---------------------
+    def _record_compile(self, secs: float) -> None:
+        with self._lock:
+            self.compiles += 1
+            self.compile_s += float(secs)
+
+    def _record_transfer(self, direction: str, nbytes: int,
+                         _what: str) -> None:
+        with self._lock:
+            if direction == "d2h":
+                self.d2h += 1
+                self.d2h_bytes += int(nbytes)
+            else:
+                self.h2d += 1
+                self.h2d_bytes += int(nbytes)
+
+    # -- context protocol ---------------------------------------------
+    def __enter__(self) -> "CompileGuard":
+        _install_listener()
+        self._t0 = time.monotonic()
+        self.active = True
+        with _LOCK:
+            _ACTIVE.append(self)
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        with _LOCK:
+            try:
+                _ACTIVE.remove(self)
+            except ValueError:
+                pass
+        self.active = False
+        if exc_type is not None:
+            return  # don't mask the in-flight exception
+        over = self.over_budget()
+        if over:
+            raise BudgetExceeded(
+                f"{self.name}: {'; '.join(over)} — report: "
+                f"{self.report()}")
+
+    def over_budget(self) -> list:
+        """The list of violated budgets (empty when within budget)."""
+        over = []
+        if self.max_compiles is not None \
+                and self.compiles > self.max_compiles:
+            over.append(f"{self.compiles} compiles > budget "
+                        f"{self.max_compiles}")
+        if self.max_d2h is not None and self.d2h > self.max_d2h:
+            over.append(f"{self.d2h} device->host transfers > budget "
+                        f"{self.max_d2h}")
+        if self.max_h2d is not None and self.h2d > self.max_h2d:
+            over.append(f"{self.h2d} host->device transfers > budget "
+                        f"{self.max_h2d}")
+        return over
+
+    def report(self) -> dict:
+        return {
+            "name": self.name,
+            "compiles": self.compiles,
+            "compile_s": round(self.compile_s, 4),
+            "d2h": self.d2h, "d2h_bytes": self.d2h_bytes,
+            "h2d": self.h2d, "h2d_bytes": self.h2d_bytes,
+            "wall_s": (round(time.monotonic() - self._t0, 4)
+                       if self._t0 is not None else None),
+            "budgets": {"compiles": self.max_compiles,
+                        "d2h": self.max_d2h, "h2d": self.max_h2d},
+        }
+
+
+def assert_no_recompile(name: str = "no-recompile") -> CompileGuard:
+    """Sugar for the common budget: a block that must be all jit
+    cache hits (e.g. re-checking a same-shape history)."""
+    return CompileGuard(max_compiles=0, name=name)

@@ -71,11 +71,18 @@ class FnChecker(Checker):
 def check_safe(checker: Checker, test: dict, history: History,
                opts: Optional[dict] = None) -> dict:
     """Like check, but captures exceptions as {"valid?": "unknown"}
-    (checker.clj:74-85)."""
+    (checker.clj:74-85). The swallowed exception is recorded as a
+    structured fault event (fleet_faults series + live status), not
+    just a traceback string on the result."""
     try:
         return checker.check(test, history, opts or {})
-    except Exception:  # noqa: BLE001
-        return {"valid?": UNKNOWN, "error": traceback.format_exc()}
+    except Exception as e:  # noqa: BLE001
+        from .. import fleet as _fleet
+        ev = _fleet.fault_event(
+            e, stage=f"checker/{type(checker).__name__}")
+        _fleet.record_fault(ev)
+        return {"valid?": UNKNOWN, "error": traceback.format_exc(),
+                "fault": {k: ev[k] for k in ("type", "error", "stage")}}
 
 
 class Compose(Checker):
@@ -227,10 +234,21 @@ class Linearizable(Checker):
             status.phase("analyze")
 
     def _check(self, test, history, opts, tracer):
+        from ..analysis import history_lint
         from ..history import strip_nemesis
         from ..ops import wgl_ref
         h = strip_nemesis(history)
         algo = self.algorithm
+        # Pre-search well-formedness gate (doc/STATIC_ANALYSIS.md): a
+        # malformed history (double-invoke race, unmatched completion,
+        # clock regression, ...) silently corrupts the encoded tensors
+        # — diagnose it here instead of burning device time on a
+        # garbage verdict.
+        with tracer.span("history-lint", attrs={"ops": len(h)}):
+            bad = history_lint.gate(h, where="checker.linearizable")
+        if bad is not None:
+            bad["algorithm"] = algo
+            return bad
         res: dict
         if algo in ("competition", "queue-poly") and isinstance(
                 self.model, models.FIFOQueue):
@@ -377,10 +395,13 @@ def _race_competition(model, h, time_limit, device=None,
             left = max(1.0, time_limit - (time.monotonic() - t0))
             try:
                 r = run_device(left * 0.75)
-            except Exception:  # noqa: BLE001 — encode/step failures
+            except Exception as e:  # noqa: BLE001 — encode/step failures
+                from .. import fleet as _fleet
                 logging.getLogger(__name__).warning(
                     "device engine failed in serial competition",
                     exc_info=True)
+                _fleet.record_fault(_fleet.fault_event(
+                    e, stage="competition/serial-device"))
                 r = {"valid?": UNKNOWN, "cause": "engine-error"}
             if r.get("valid?") != UNKNOWN:
                 r["engine"] = "device"
@@ -403,10 +424,13 @@ def _race_competition(model, h, time_limit, device=None,
                 with tracer.span(f"engine {name}",
                                  parent=race_ctx.get("ctx")):
                     r = fn()
-            except Exception:  # noqa: BLE001 — device init failure etc.
+            except Exception as e:  # noqa: BLE001 — device init failure etc.
+                from .. import fleet as _fleet
                 logging.getLogger(__name__).warning(
                     "%s engine failed in competition", name,
                     exc_info=True)
+                _fleet.record_fault(_fleet.fault_event(
+                    e, stage=f"competition/{name}"))
                 r = {"valid?": UNKNOWN, "cause": "engine-error"}
             outcomes.put((name, r))
             if r.get("valid?") != UNKNOWN:

@@ -66,6 +66,16 @@ def check(history: History, anomalies: Iterable[str] = DEFAULT_ANOMALIES,
 
     cycle_backend: "host" (Tarjan oracle), "tpu" (batched
     closure-matmul kernel, elle/tpu.py), or "auto"."""
+    from ..analysis import history_lint
+    bad = history_lint.gate(history, where="elle.append",
+                            rules=history_lint.ELLE_GATE_RULES)
+    if bad is not None:
+        # malformed input: version-order inference over a corrupted
+        # event order would fabricate anomalies — fast-fail instead
+        return {"valid?": "unknown",
+                "anomaly-types": ["malformed-history"],
+                "anomalies": {"malformed-history": bad["anomalies"]},
+                "not": [], "analyzer": bad["analyzer"]}
     anomalies = set(anomalies)
     found: dict[str, list] = {}
 

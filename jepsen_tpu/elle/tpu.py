@@ -193,12 +193,15 @@ def cycle_queries(g: DepGraph,
     import time as _t
 
     import jax
+
+    from ..analysis import guards as _guards
     t0 = _t.monotonic()
-    labels, closed = kernel(np.asarray(src_p, np.int32),
-                            np.asarray(dst_p, np.int32),
-                            np.asarray(w_p, np.float32),
-                            np.asarray(q_src_p, np.int32),
-                            np.asarray(q_dst_p, np.int32))
+    ins = (np.asarray(src_p, np.int32), np.asarray(dst_p, np.int32),
+           np.asarray(w_p, np.float32), np.asarray(q_src_p, np.int32),
+           np.asarray(q_dst_p, np.int32))
+    _guards.note_transfer("h2d", sum(a.nbytes for a in ins),
+                          what="elle-closure-inputs")
+    labels, closed = kernel(*ins)
     jax.block_until_ready((labels, closed))
     kernel_s = _t.monotonic() - t0
     # Achieved matmul throughput vs the flop model in the module
@@ -224,6 +227,8 @@ def cycle_queries(g: DepGraph,
             kernel_s)
     labels = np.asarray(labels)[:, :n]
     closed = np.asarray(closed)[:, :len(rw_edges)]
+    _guards.note_transfer("d2h", labels.nbytes + closed.nbytes,
+                          what="elle-closure-outputs")
 
     sccs: list = []
     for si in range(n_sub):

@@ -49,6 +49,14 @@ def check(history: History, anomalies: Iterable[str] = DEFAULT_ANOMALIES,
           cycle_backend: str = "auto") -> dict:
     """Analyze a write/read register history. cycle_backend as in
     append.check: "host" | "tpu" | "auto"."""
+    from ..analysis import history_lint
+    bad = history_lint.gate(history, where="elle.wr",
+                            rules=history_lint.ELLE_GATE_RULES)
+    if bad is not None:
+        return {"valid?": "unknown",
+                "anomaly-types": ["malformed-history"],
+                "anomalies": {"malformed-history": bad["anomalies"]},
+                "not": [], "analyzer": bad["analyzer"]}
     anomalies = set(anomalies)
     found: dict[str, list] = {}
 

@@ -101,6 +101,26 @@ def fault_event(exc: BaseException, *, device: Optional[str] = None,
             "traceback": traceback.format_exc()[-FAULT_TB_LIMIT:]}
 
 
+def record_fault(event: dict, mx=None, status=None) -> None:
+    """Record one structured fault event (usually `fault_event(exc)`)
+    that is NOT attached to a per-key shard — checker-level engine
+    failures, profiler/device-pin declines, malformed-history gates.
+    Lands in the `fleet_faults` series + `fleet_faults_total` counter
+    and on the live RunStatus fault list. No-op when both planes are
+    disabled — swallowing an exception without calling this is what
+    the PR-5 audit removed."""
+    mx = mx if mx is not None else _metrics.get_default()
+    st = status if status is not None else get_default()
+    if mx.enabled:
+        mx.counter("fleet_faults_total",
+                   "device faults captured by fleet workers").inc(
+            device=str(event.get("device") or "host"))
+        mx.series("fleet_faults",
+                  "structured device fault events").append(dict(event))
+    if st.enabled:
+        st.fault(event)
+
+
 def record_shard(shard: dict, mx=None, status=None) -> None:
     """Record one per-key shard block into the ambient metrics
     registry (`fleet_shards` series + counters/histogram) and the

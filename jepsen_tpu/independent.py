@@ -256,7 +256,16 @@ class IndependentChecker(Checker):
         self.checker = checker
 
     def check(self, test, history, opts=None):
+        from .analysis import history_lint
         opts = opts or {}
+        # One well-formedness pass over the WHOLE history before the
+        # fan-out: a malformed run fast-fails with op-level diagnoses
+        # instead of spending a device (or a thread pool) per key.
+        bad = history_lint.gate(
+            strip_nemesis(history), where="independent",
+            rules=history_lint.INDEPENDENT_GATE_RULES)
+        if bad is not None:
+            return {**bad, "results": {}, "failures": []}
         ks = history_keys(history)
         key_idx = {k: i for i, k in enumerate(ks)}
         status = _fleet.get_default()
@@ -328,8 +337,14 @@ class TPULinearizableIndependent(Checker):
         self.mesh = mesh
 
     def check(self, test, history, opts=None):
+        from .analysis import history_lint
         from .parallel import check_batched
         opts = opts or {}
+        bad = history_lint.gate(
+            strip_nemesis(history), where="independent.tpu",
+            rules=history_lint.INDEPENDENT_GATE_RULES)
+        if bad is not None:
+            return {**bad, "results": {}, "failures": []}
         ks = history_keys(history)
         _fleet.get_default().phase("independent-check")
         subs = [subhistory(k, history) for k in ks]

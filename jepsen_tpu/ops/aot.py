@@ -107,7 +107,9 @@ def aot_compile(fn, arg_specs: tuple, label: str,
     sh = _single_chip_sharding(topo)
     try:
         n_args = len(arg_specs)
-        lowered = jax.jit(fn, in_shardings=(sh,) * n_args,
+        # one-shot AOT evidence path: a fresh lower+compile per call
+        # is the point here, not a hot-loop footgun
+        lowered = jax.jit(fn, in_shardings=(sh,) * n_args,  # jaxlint: ok(J003)
                           out_shardings=sh).lower(*arg_specs)
         compiled = lowered.compile()
     except Exception as e:  # noqa: BLE001 — a kernel that fails to
@@ -116,6 +118,9 @@ def aot_compile(fn, arg_specs: tuple, label: str,
                 "error": f"{type(e).__name__}: {e}"[:400]}
     compile_s = time.monotonic() - t0
     ca = compiled.cost_analysis() or {}
+    if isinstance(ca, (list, tuple)):
+        # newer jax returns one analysis dict per device/computation
+        ca = ca[0] if ca else {}
     flops = float(ca.get("flops", 0.0))
     byts = float(ca.get("bytes accessed", 0.0))
     t_compute = flops / V5E_PEAK_BF16_FLOPS

@@ -39,7 +39,27 @@ INF = np.int32(2**31 - 1)  # event indices are small; x64 stays off
 
 class EncodingUnsupported(Exception):
     """The history/model cannot be encoded within kernel limits; callers
-    should fall back to the host oracle."""
+    should fall back to the host oracle.
+
+    Carries machine-readable coordinates of the offending op so the
+    history analyzer (`analysis/history_lint`) and error reports can
+    point at the exact op instead of re-deriving it from the message:
+    `op_index` (the op's :index), `process`, `value`, and `rule`
+    (which limit tripped: "info-cap" | "state-space" | "window")."""
+
+    def __init__(self, message: str, *, op_index: Optional[int] = None,
+                 process: Any = None, value: Any = None,
+                 rule: Optional[str] = None):
+        super().__init__(message)
+        self.op_index = op_index
+        self.process = process
+        self.value = value
+        self.rule = rule
+
+    def to_dict(self) -> dict:
+        return {"message": str(self), "rule": self.rule,
+                "op_index": self.op_index, "process": self.process,
+                "value": self.value}
 
 
 def _hashable(v):
@@ -76,7 +96,9 @@ def build_table(model: Model, alphabet: list, max_states: int = 1 << 16,
                 if j is None:
                     if len(order) >= max_states:
                         raise EncodingUnsupported(
-                            f"model state space exceeds {max_states}")
+                            f"model state space exceeds {max_states}",
+                            op_index=op.index, process=op.process,
+                            value=op.value, rule="state-space")
                     j = len(order)
                     states[m2] = j
                     order.append(m2)
@@ -117,7 +139,11 @@ def encode(model: Model, history: History, max_window: int = 1024,
     info_ops = [o for o in ops if not o.ok]
     n, ni = len(ok_ops), len(info_ops)
     if ni > max_info:
-        raise EncodingUnsupported(f"{ni} crashed ops exceeds cap {max_info}")
+        first_over = info_ops[max_info]  # the op past the cap
+        raise EncodingUnsupported(
+            f"{ni} crashed ops exceeds cap {max_info}",
+            op_index=first_over.orig_index, process=first_over.process,
+            value=first_over.value, rule="info-cap")
 
     # Distinct op alphabet over every op the search might apply.
     key_of = {}
@@ -159,9 +185,14 @@ def encode(model: Model, history: History, max_window: int = 1024,
     # history length.
     W = _pad_to(w_needed, 32 if w_needed <= 256 else 128)
     if W > max_window:
+        # the op whose open window drives the requirement
+        widest = ok_ops[int(np.argmax(hi - np.arange(n)))] if n else None
         raise EncodingUnsupported(
             f"window {w_needed} exceeds max {max_window} "
-            "(extremely skewed op latencies)")
+            "(extremely skewed op latencies)",
+            op_index=widest.orig_index if widest else None,
+            process=widest.process if widest else None,
+            value=widest.value if widest else None, rule="window")
 
     n_pad = _pad_to(n, 64)
     ic_pad = _pad_to(ni, 32)

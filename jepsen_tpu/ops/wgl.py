@@ -462,8 +462,10 @@ def check(model: Model, history: History, time_limit: Optional[float] = None,
             with tracer.span("encode", attrs={"ops": len(history)}):
                 enc = encode(model, history)
     except EncodingUnsupported as e:
+        # e carries the offending op's coordinates (encode.py) so
+        # reports can point at the exact op, not just a message
         return {"valid?": "unknown", "cause": f"encoding: {e}",
-                "op_count": len(history)}
+                "encoding": e.to_dict(), "op_count": len(history)}
     n = enc.n_ok
     if n == 0:
         # with no must-linearize ops, skipping every crashed op is a
@@ -583,8 +585,12 @@ def check(model: Model, history: History, time_limit: Optional[float] = None,
         try:
             dev_ctx = jax.default_device(
                 jax.local_devices(backend="cpu")[0])
-        except Exception:  # noqa: BLE001 — no cpu backend: stay put
-            pass
+        except Exception as e:  # noqa: BLE001 — no cpu backend: stay
+            # put, but record the decline (the lane then runs on the
+            # default backend, which skews competition timings)
+            from .. import fleet as _fleet
+            _fleet.record_fault(_fleet.fault_event(
+                e, stage="wgl/cpu-pin"))
     # Opt-in hardware profile: a jax.profiler capture around the whole
     # search, dropping a Perfetto/xprof-ingestible trace into the
     # run's artifact dir. start/stop (not the context manager) so a
@@ -595,8 +601,12 @@ def check(model: Model, history: History, time_limit: Optional[float] = None,
         try:
             jax.profiler.start_trace(profile_dir)
             profiled = True
-        except Exception:  # noqa: BLE001 — profiling never blocks
-            pass           # the verdict
+        except Exception as e:  # noqa: BLE001 — profiling never
+            # blocks the verdict, but a silently-missing capture
+            # wastes the whole opted-in run: record the decline
+            from .. import fleet as _fleet
+            _fleet.record_fault(_fleet.fault_event(
+                e, stage="wgl/profiler-start"))
     plat_label = platform or safe_backend() or "cpu"
     try:
         with dev_ctx:
@@ -610,7 +620,11 @@ def check(model: Model, history: History, time_limit: Optional[float] = None,
         if profiled:
             try:
                 jax.profiler.stop_trace()
-            except Exception:  # noqa: BLE001
+            except Exception as e:  # noqa: BLE001 — capture lost;
+                # record it so the missing trace file is explicable
+                from .. import fleet as _fleet
+                _fleet.record_fault(_fleet.fault_event(
+                    e, stage="wgl/profiler-stop"))
                 profiled = False
     if profiled:
         res["profile_dir"] = profile_dir
@@ -631,11 +645,19 @@ def _run_search(enc, init_fn, chunk_jit, iinv, iopc, n, max_configs,
     tracer = tracer if tracer is not None else _trace_mod.NULL_TRACER
     status = _fleet_mod.get_default()
 
+    from ..analysis import guards as _guards
+
     consts = (jnp.asarray(enc.inv), jnp.asarray(enc.ret),
               jnp.asarray(enc.opcode), jnp.asarray(enc.sufminret),
               jnp.asarray(iinv), jnp.asarray(iopc),
               jnp.asarray(enc.table), jnp.int32(n), jnp.int32(enc.n_info),
               jnp.int32(min(max_configs, 2**31 - 1)))
+    # the search's one const upload (analysis/guards budget point)
+    _guards.note_transfer(
+        "h2d",
+        enc.inv.nbytes + enc.ret.nbytes + enc.opcode.nbytes
+        + enc.sufminret.nbytes + iinv.nbytes + iopc.nbytes
+        + enc.table.nbytes, what="wgl-consts")
     carry = init_fn(0)
     deadline = t_enter + time_limit if time_limit else None
     t0 = _time.monotonic()
@@ -670,6 +692,11 @@ def _run_search(enc, init_fn, chunk_jit, iinv, iopc, n, max_configs,
                 t_xfer = _time.monotonic()
                 s = np.asarray(summary)
                 xfer_s = _time.monotonic() - t_xfer
+                # one packed (11,) poll per chunk — the ONLY
+                # device->host transfer in the loop by design; the
+                # guard budget catches anyone adding another
+                _guards.note_transfer("d2h", s.nbytes,
+                                      what="wgl-poll")
         poll_s = _time.monotonic() - t_call
         fr_cnt, flags, stats = int(s[0]), s[1:4], s[4:10]
         bk_cnt = int(s[10])

@@ -416,7 +416,11 @@ def _build_search32(n_pad: int, ic_pad: int, S: int, O: int,
         ins_widx = []
         ins_entry = []
         cur, cnt = fr, fr_cnt
-        for _lvl in range(depth):
+        # bounded unroll BY DESIGN: depth is a static build parameter
+        # (<= 4) and fusing levels per memo commit is this round's
+        # whole reason to exist — lax.fori_loop would forbid the
+        # per-level insert batching below
+        for _lvl in range(depth):  # jaxlint: ok(J006)
             succ, explore, found_l, s0, s1, s2, bmax = \
                 _expand(consts, cur, cnt)
             R = succ.shape[0]

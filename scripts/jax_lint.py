@@ -1,0 +1,61 @@
+#!/usr/bin/env python
+"""jit-safety lint over the kernel modules (CLI for analysis.jaxlint).
+
+Flags the classic JAX footguns in `jepsen_tpu/ops/` and
+`jepsen_tpu/elle/` — host syncs inside jitted regions, per-call
+`jax.jit` construction, Python branches on tracers, closure captures
+that force retraces, implicit integer dtype promotion, and Python
+loops that belong in `lax` control flow. Rule catalog + allowlist
+syntax: doc/STATIC_ANALYSIS.md.
+
+Usage:
+    python scripts/jax_lint.py [--check] [--list-rules] [paths...]
+    # no paths: lints jepsen_tpu/ops and jepsen_tpu/elle
+    # exit 1 when findings remain after the inline allowlist
+    # (`# jaxlint: ok(<rule>)`); --check only changes verbosity
+
+Wired as a tier-1 test (tests/test_analysis.py), same pattern as
+scripts/telemetry_lint.py: the tree starts lint-clean and CI keeps it
+that way.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+from jepsen_tpu.analysis import jaxlint  # noqa: E402
+
+DEFAULT_PATHS = (
+    os.path.join(REPO_ROOT, "jepsen_tpu", "ops"),
+    os.path.join(REPO_ROOT, "jepsen_tpu", "elle"),
+)
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    quiet = "--check" in argv
+    argv = [a for a in argv if a != "--check"]
+    if "--list-rules" in argv:
+        for rule, name in sorted(jaxlint.RULES.items()):
+            print(f"{rule}  {name}")
+        return 0
+    paths = argv or list(DEFAULT_PATHS)
+    findings = jaxlint.lint_paths(paths)
+    for f in findings:
+        print(f, file=sys.stderr)
+    n_files = sum(
+        (len([x for x in os.listdir(p) if x.endswith(".py")])
+         if os.path.isdir(p) else 1)
+        for p in paths if os.path.exists(p))
+    if not quiet or findings:
+        print(f"jax lint: {n_files} file(s), "
+              f"{len(findings)} finding(s)")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

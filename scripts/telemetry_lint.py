@@ -51,6 +51,8 @@ SERIES_SCHEMAS = {
     "fleet_shards": {"key_index": int, "device": str, "engine": str,
                      "wall_s": NUM},
     "fleet_faults": {"type": str, "error": str, "stage": str},
+    "history_lint": {"where": str, "op_count": int,
+                     "rule_counts": dict},
 }
 
 REGRESSIONS_SCHEMA = {"schema": int, "threshold_x": NUM,

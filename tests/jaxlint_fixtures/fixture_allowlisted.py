@@ -1,0 +1,17 @@
+"""jaxlint fixture: allowlisted violations must NOT be reported."""
+import jax
+import jax.numpy as jnp
+
+
+def kernel(x):
+    y = jnp.cumsum(x)
+    y.block_until_ready()  # jaxlint: ok(J001)
+    for _ in range(4):  # jaxlint: ok(J006)
+        y = y + jnp.tanh(y)
+    # allowlist on the line above the finding also works
+    # jaxlint: ok
+    y.block_until_ready()
+    return y
+
+
+run = jax.jit(kernel)

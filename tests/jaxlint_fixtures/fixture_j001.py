@@ -1,0 +1,14 @@
+"""jaxlint fixture: J001 host-sync-in-jit must fire (3 sites)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def kernel(x):
+    y = jnp.cumsum(x)
+    y.block_until_ready()        # J001: sync inside jit
+    z = np.asarray(y)            # J001: host materialization
+    return z + float(y[0])       # J001: concretization
+
+
+run = jax.jit(kernel)

@@ -1,0 +1,25 @@
+"""jaxlint fixture: J002 tracer-branch must fire."""
+import jax
+import jax.numpy as jnp
+
+
+def kernel(x, flag):
+    if flag:                     # J002: Python branch on a traced arg
+        return jnp.sum(x)
+    total = jnp.sum(x)
+    while total:                 # J002: Python while on a traced value
+        total = total - 1
+    return total
+
+
+run = jax.jit(kernel)
+
+
+def static_ok(x):
+    # shape/dtype/len conditions are static — must NOT fire
+    if x.shape[0] > 4:
+        return jnp.sum(x)
+    return x
+
+
+run2 = jax.jit(static_ok)

@@ -1,0 +1,16 @@
+"""jaxlint fixture: J003 uncached-jit must fire."""
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def check(x):
+    f = jax.jit(lambda v: jnp.sum(v * 2))   # J003: fresh jit per call
+    return f(x)
+
+
+@functools.lru_cache(maxsize=8)
+def cached_builder(n):
+    # cached builder: must NOT fire
+    return jax.jit(lambda v: jnp.sum(v) + n)

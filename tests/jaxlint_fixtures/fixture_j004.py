@@ -1,0 +1,11 @@
+"""jaxlint fixture: J004 scalar-closure must fire."""
+import jax
+import jax.numpy as jnp
+
+
+def check(x, scale):
+    def kernel(v):
+        return jnp.sum(v) * scale   # captures the uncached param
+
+    f = jax.jit(kernel)             # J004 (and J003): retrace per scale
+    return f(x)

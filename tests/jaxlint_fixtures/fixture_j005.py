@@ -1,0 +1,14 @@
+"""jaxlint fixture: J005 dtype-promotion must fire."""
+import jax
+import jax.numpy as jnp
+
+
+def kernel(x):
+    a = jnp.arange(8, dtype=jnp.int32)
+    b = jnp.uint32(3) + jnp.int32(4)        # J005: mixed int dtypes
+    c = a * (x.astype(jnp.int64) + jnp.int32(1) * jnp.uint32(2))  # J005
+    same = jnp.uint32(1) + jnp.uint32(2)    # same dtype: must NOT fire
+    return b + c + same
+
+
+run = jax.jit(kernel)

@@ -1,0 +1,13 @@
+"""jaxlint fixture: J006 python-loop-jnp must fire."""
+import jax
+import jax.numpy as jnp
+
+
+def kernel(x, n_steps):
+    acc = jnp.zeros_like(x)
+    for _ in range(64):             # J006: belongs in lax.fori_loop
+        acc = acc + jnp.tanh(x)
+    return acc
+
+
+run = jax.jit(kernel)

@@ -1,0 +1,394 @@
+"""The analysis subsystem: history analyzer, jaxlint, runtime guards.
+
+Three planes under test (doc/STATIC_ANALYSIS.md):
+
+  * history_lint — a malformed-history corpus (double-invoke race,
+    unmatched complete, time regression, out-of-alphabet value,
+    crashed pairing) asserting rule ids AND op indices, plus the
+    fast-fail gates in checker.Linearizable / elle / independent;
+  * jaxlint — fixture files that must trip each rule, allowlist
+    suppression, and the CI contract that the shipped ops/elle tree
+    lints clean (scripts/jax_lint.py exit codes);
+  * guards — compile counting via jax.monitoring and the proof that
+    re-checking a same-shape history triggers zero recompiles.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from jepsen_tpu import checker as c
+from jepsen_tpu import independent, metrics, synth
+from jepsen_tpu.analysis import guards, history_lint, jaxlint
+from jepsen_tpu.history import History, info, invoke, ok
+from jepsen_tpu.models import cas_register
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LINT_CLI = os.path.join(REPO, "scripts", "jax_lint.py")
+FIXTURES = os.path.join(REPO, "tests", "jaxlint_fixtures")
+
+
+def H(ops):
+    return History(ops).index()
+
+
+# ---------------------------------------------------------------------------
+# history_lint: the malformed-history corpus
+# ---------------------------------------------------------------------------
+
+class TestHistoryLint:
+    def test_clean_history_passes(self):
+        h = H([invoke(0, "write", 1, time=0), ok(0, "write", 1, time=1),
+               invoke(1, "read", None, time=2), ok(1, "read", 1, time=3)])
+        rep = history_lint.analyze(h)
+        assert rep["ok"] is True and rep["valid"] is True
+        assert rep["anomalies"] == []
+        assert history_lint.gate(h) is None
+
+    def test_double_invoke_race(self):
+        h = H([invoke(0, "write", 1, time=0),
+               invoke(0, "write", 2, time=1),   # <- op index 1
+               ok(0, "write", 1, time=2)])
+        rep = history_lint.analyze(h)
+        assert rep["valid"] == "unknown"
+        d = [a for a in rep["anomalies"] if a["rule"] == "H001"]
+        assert d and d[0]["op_index"] == 1 and d[0]["process"] == 0
+
+    def test_unmatched_complete(self):
+        h = H([invoke(0, "write", 1, time=0), ok(0, "write", 1, time=1),
+               ok(1, "write", 2, time=2)])     # <- nothing pending
+        rep = history_lint.analyze(h)
+        d = [a for a in rep["anomalies"] if a["rule"] == "H002"]
+        assert d and d[0]["op_index"] == 2 and d[0]["process"] == 1
+
+    def test_time_regression(self):
+        h = H([invoke(0, "write", 1, time=10), ok(0, "write", 1, time=3)])
+        rep = history_lint.analyze(h)
+        d = [a for a in rep["anomalies"] if a["rule"] == "H003"]
+        assert d and d[0]["op_index"] == 1
+
+    def test_unset_times_are_not_regressions(self):
+        h = H([invoke(0, "write", 1), ok(0, "write", 1)])  # time=-1
+        rep = history_lint.analyze(h)
+        assert not [a for a in rep["anomalies"]
+                    if a["rule"] in ("H003", "H004")]
+
+    def test_negative_time(self):
+        h = H([invoke(0, "write", 1, time=-44)])
+        rep = history_lint.analyze(h)
+        d = [a for a in rep["anomalies"] if a["rule"] == "H004"]
+        assert d and d[0]["op_index"] == 0
+
+    def test_index_disorder(self):
+        h = History([invoke(0, "write", 1, time=0).with_(index=5),
+                     ok(0, "write", 1, time=1).with_(index=5)])
+        rep = history_lint.analyze(h)
+        d = [a for a in rep["anomalies"] if a["rule"] == "H005"]
+        assert d and d[0]["op_index"] == 5 and d[0]["position"] == 1
+
+    def test_strip_preserved_gaps_are_fine(self):
+        # nemesis stripping leaves index gaps — NOT disorder
+        h = History([invoke(0, "write", 1, time=0).with_(index=0),
+                     ok(0, "write", 1, time=1).with_(index=4)])
+        rep = history_lint.analyze(h)
+        assert not [a for a in rep["anomalies"] if a["rule"] == "H005"]
+
+    def test_crashed_pairing(self):
+        h = H([invoke(0, "write", 1, time=0),
+               info(0, "write", 1, time=1),
+               invoke(0, "write", 2, time=2)])  # <- process reused
+        rep = history_lint.analyze(h)
+        d = [a for a in rep["anomalies"]
+             if a["rule"] == "H007" and a["severity"] == "error"]
+        assert d and d[0]["op_index"] == 2
+
+    def test_out_of_alphabet_value(self):
+        # read of a value no reachable cas-register state can hold
+        h = H([invoke(0, "write", 1, time=0), ok(0, "write", 1, time=1),
+               invoke(1, "read", None, time=2),
+               ok(1, "read", 99, time=3)])
+        rep = history_lint.analyze(h, model=cas_register())
+        d = [a for a in rep["anomalies"] if a["rule"] == "H006"]
+        assert d and d[0]["op_index"] == 2  # the read's invocation
+        assert d[0]["value"] == 99
+        # advisory: H006 must NOT flip the structural verdict
+        assert rep["ok"] is True
+
+    def test_diagnostics_capped_per_rule(self):
+        ops = []
+        for i in range(40):
+            ops.append(ok(i, "write", 1, time=i))  # 40 unmatched
+        rep = history_lint.analyze(H(ops))
+        h002 = [a for a in rep["anomalies"] if a["rule"] == "H002"]
+        assert len(h002) == history_lint.MAX_PER_RULE + 1
+        assert "more" in h002[-1]["message"]
+
+    def test_self_check(self):
+        res = history_lint.self_check()
+        assert res["ok"], res["failures"]
+
+    def test_synth_histories_are_clean(self):
+        # every generator-shaped history the suite leans on must pass
+        for h in (synth.cas_register_history(200, n_procs=5, seed=7,
+                                             crash_p=0.05),
+                  synth.mutex_history(100, seed=3),
+                  synth.long_tail_history(50)):
+            rep = history_lint.analyze(h)
+            assert rep["ok"], rep["anomalies"]
+
+
+class TestCheckerGate:
+    def test_linearizable_fast_fails_on_race(self):
+        h = H([invoke(0, "write", 1, time=0),
+               invoke(0, "write", 2, time=1),
+               ok(0, "write", 1, time=2)])
+        # tpu-wgl: the gate must answer BEFORE any device search
+        res = c.linearizable(algorithm="tpu-wgl").check({}, h, {})
+        assert res["valid?"] == "unknown"
+        assert res["cause"] == "malformed-history"
+        a = res["anomalies"][0]
+        assert a["rule"] == "H001" and a["op_index"] == 1
+        assert "configs_explored" not in res  # search never launched
+
+    def test_gate_applies_to_every_algorithm(self):
+        h = H([ok(0, "write", 1, time=0)])
+        for algo in ("wgl", "linear", "competition"):
+            res = c.linearizable(algorithm=algo).check({}, h, {})
+            assert res["valid?"] == "unknown", algo
+            assert res["cause"] == "malformed-history"
+
+    def test_gate_records_metrics(self):
+        reg = metrics.Registry()
+        h = H([invoke(0, "write", 1, time=0),
+               invoke(0, "write", 2, time=1)])
+        with metrics.use(reg):
+            c.linearizable(algorithm="wgl").check({}, h, {})
+        assert reg.counter("history_lint_checks_total").value(
+            where="checker.linearizable", verdict="malformed") == 1
+        assert reg.counter("history_lint_anomalies_total").value(
+            rule="H001", where="checker.linearizable") >= 1
+        pts = reg.series("history_lint").points
+        assert pts and pts[0]["where"] == "checker.linearizable"
+
+    def test_clean_checks_count_too(self):
+        reg = metrics.Registry()
+        h = H([invoke(0, "write", 1, time=0), ok(0, "write", 1, time=1)])
+        with metrics.use(reg):
+            res = c.linearizable(algorithm="wgl").check({}, h, {})
+        assert res["valid?"] is True
+        assert reg.counter("history_lint_checks_total").value(
+            where="checker.linearizable", verdict="clean") == 1
+
+    def test_independent_gate(self):
+        kv = independent.tuple_
+        h = H([invoke(0, "write", kv("k", 1), time=0),
+               invoke(0, "write", kv("k", 2), time=1)])
+        res = independent.checker(
+            c.linearizable(algorithm="wgl")).check({}, h, {})
+        assert res["valid?"] == "unknown"
+        assert res["cause"] == "malformed-history"
+        assert res["results"] == {} and res["failures"] == []
+
+    def test_elle_gate(self):
+        from jepsen_tpu.elle import append as ea
+        h = History([
+            ok(0, "txn", [["append", "x", 1]], time=5).with_(index=0),
+            ok(0, "txn", [["r", "x", [1]]], time=1).with_(index=1),
+        ])  # time regression
+        res = ea.check(h)
+        assert res["valid?"] == "unknown"
+        assert res["anomaly-types"] == ["malformed-history"]
+        assert res["anomalies"]["malformed-history"][0]["rule"] == "H003"
+
+    def test_elle_tolerates_completion_only(self):
+        # elle's reduced rule set: completion-only histories are legal
+        from jepsen_tpu.elle import append as ea
+        h = History([
+            ok(0, "txn", [["append", "x", 1]], time=0).with_(index=0),
+            ok(0, "txn", [["r", "x", [1]]], time=1).with_(index=1),
+        ])
+        assert ea.check(h)["valid?"] is True
+
+    def test_check_safe_records_structured_fault(self):
+        class Boom(c.Checker):
+            def check(self, test, history, opts=None):
+                raise RuntimeError("kaput")
+
+        reg = metrics.Registry()
+        with metrics.use(reg):
+            res = c.check_safe(Boom(), {}, H([]))
+        assert res["valid?"] == "unknown"
+        assert res["fault"]["type"] == "RuntimeError"
+        assert res["fault"]["stage"] == "checker/Boom"
+        pts = reg.series("fleet_faults").points
+        assert pts and pts[0]["type"] == "RuntimeError"
+        assert pts[0]["stage"] == "checker/Boom"
+        assert "kaput" in pts[0]["error"]
+
+
+class TestEncodingUnsupported:
+    def test_info_cap_carries_op_coordinates(self):
+        from jepsen_tpu.ops.encode import EncodingUnsupported, encode
+        ops = []
+        t = 0
+        for p in range(4):  # 4 crashed writes, cap at 2
+            ops.append(invoke(p, "write", p, time=t)); t += 1
+            ops.append(info(p, "write", p, time=t)); t += 1
+        h = H(ops)
+        with pytest.raises(EncodingUnsupported) as ei:
+            encode(cas_register(), h, max_info=2)
+        e = ei.value
+        assert e.rule == "info-cap"
+        assert e.op_index is not None and e.process is not None
+        d = e.to_dict()
+        assert d["rule"] == "info-cap" and d["op_index"] == e.op_index
+
+    def test_window_carries_op_coordinates(self):
+        from jepsen_tpu.ops.encode import EncodingUnsupported, encode
+        h = synth.adversarial_wave_history(2, width=10)
+        with pytest.raises(EncodingUnsupported) as ei:
+            encode(cas_register(), h, max_window=4)
+        assert ei.value.rule == "window"
+        assert ei.value.op_index is not None
+
+    def test_wgl_result_carries_encoding_block(self):
+        from jepsen_tpu.ops import wgl
+        ops = []
+        t = 0
+        for p in range(300):  # past the default 256 info cap
+            ops.append(invoke(p, "write", 1, time=t)); t += 1
+            ops.append(info(p, "write", 1, time=t)); t += 1
+        res = wgl.check(cas_register(), H(ops), time_limit=5)
+        assert res["valid?"] == "unknown"
+        assert res["encoding"]["rule"] == "info-cap"
+        assert isinstance(res["encoding"]["op_index"], int)
+
+
+# ---------------------------------------------------------------------------
+# jaxlint
+# ---------------------------------------------------------------------------
+
+class TestJaxLint:
+    @pytest.mark.parametrize("rule", sorted(jaxlint.RULES))
+    def test_fixture_trips_rule(self, rule):
+        path = os.path.join(FIXTURES, f"fixture_{rule.lower()}.py")
+        found = {f.rule for f in jaxlint.lint_file(path)}
+        assert rule in found, (rule, found)
+
+    def test_allowlist_suppresses(self):
+        path = os.path.join(FIXTURES, "fixture_allowlisted.py")
+        assert jaxlint.lint_file(path) == []
+
+    def test_static_shape_branch_not_flagged(self):
+        path = os.path.join(FIXTURES, "fixture_j002.py")
+        findings = jaxlint.lint_file(path)
+        assert all(f.line < 17 for f in findings), findings
+
+    def test_cached_builder_not_flagged(self):
+        src = (
+            "import functools, jax, jax.numpy as jnp\n"
+            "@functools.lru_cache(maxsize=4)\n"
+            "def build(n):\n"
+            "    def k(x):\n"
+            "        return jnp.sum(x) * n\n"
+            "    return jax.jit(k)\n")
+        assert jaxlint.lint_source(src, "cached.py") == []
+
+    def test_module_level_jit_not_flagged(self):
+        src = ("import jax, jax.numpy as jnp\n"
+               "def k(x):\n"
+               "    return jnp.sum(x)\n"
+               "run = jax.jit(k)\n")
+        assert jaxlint.lint_source(src, "mod.py") == []
+
+    def test_cli_exits_nonzero_on_fixture(self):
+        proc = subprocess.run(
+            [sys.executable, LINT_CLI,
+             os.path.join(FIXTURES, "fixture_j001.py")],
+            capture_output=True, text=True)
+        assert proc.returncode == 1
+        assert "J001" in proc.stderr
+
+    def test_shipped_tree_lints_clean(self):
+        """The CI contract (tier-1): jepsen_tpu/ops + jepsen_tpu/elle
+        must stay jit-safety clean — fix or allowlist every finding."""
+        proc = subprocess.run([sys.executable, LINT_CLI, "--check"],
+                              capture_output=True, text=True)
+        assert proc.returncode == 0, proc.stderr
+
+
+# ---------------------------------------------------------------------------
+# runtime guards
+# ---------------------------------------------------------------------------
+
+class TestGuards:
+    def test_counts_fresh_compile(self):
+        import jax
+        import jax.numpy as jnp
+        with guards.CompileGuard(name="t") as g:
+            # a fresh closure constant + fresh shape forces a compile
+            jax.jit(lambda x: x * 17.77)(jnp.arange(101)).block_until_ready()
+        assert g.compiles >= 1
+        assert g.compile_s > 0
+
+    def test_budget_exceeded_raises(self):
+        import jax
+        import jax.numpy as jnp
+        with pytest.raises(guards.BudgetExceeded):
+            with guards.CompileGuard(max_compiles=0, name="t2"):
+                jax.jit(lambda x: x * 33.33)(
+                    jnp.arange(103)).block_until_ready()
+
+    def test_inflight_exception_not_masked(self):
+        import jax
+        import jax.numpy as jnp
+        with pytest.raises(KeyError):
+            with guards.CompileGuard(max_compiles=0, name="t3"):
+                jax.jit(lambda x: x * 51.51)(
+                    jnp.arange(107)).block_until_ready()
+                raise KeyError("original")
+
+    def test_note_transfer_zero_cost_when_inactive(self):
+        guards.note_transfer("d2h", 1234)  # must not raise
+
+    def test_same_shape_recheck_does_not_recompile(self):
+        """The acceptance budget: two same-shape WGL checks after a
+        warmup trigger <= 1 compilation (expected: zero — the shape
+        bucket's kernel is already jitted)."""
+        from jepsen_tpu.ops import wgl
+        model = cas_register()
+        h1 = synth.cas_register_history(40, n_procs=3, seed=11)
+        wgl.check(model, h1, time_limit=30)  # warmup: absorbs compiles
+        h2 = History(list(h1))               # same shape, re-check
+        with guards.CompileGuard(max_compiles=1, name="recheck") as g:
+            r1 = wgl.check(model, h1, time_limit=30)
+            r2 = wgl.check(model, h2, time_limit=30)
+        assert r1["valid?"] is True and r2["valid?"] is True
+        assert g.compiles <= 1, g.report()
+        # the poll loop reported its packed device->host transfers
+        assert g.d2h >= 2
+        assert g.h2d >= 2
+
+    def test_report_shape(self):
+        with guards.CompileGuard(max_compiles=5, name="r") as g:
+            guards.note_transfer("h2d", 64, what="x")
+            guards.note_transfer("d2h", 44, what="y")
+        rep = g.report()
+        assert rep["h2d"] == 1 and rep["h2d_bytes"] == 64
+        assert rep["d2h"] == 1 and rep["d2h_bytes"] == 44
+        assert rep["budgets"]["compiles"] == 5
+
+
+# ---------------------------------------------------------------------------
+# CI wiring: the analyzer self-check as a CLI (tier-1 gate)
+# ---------------------------------------------------------------------------
+
+def test_history_lint_self_check_cli():
+    proc = subprocess.run(
+        [sys.executable, "-m", "jepsen_tpu.analysis.history_lint"],
+        capture_output=True, text=True, cwd=REPO)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert json.loads(proc.stdout)["ok"] is True
