@@ -215,6 +215,7 @@ class Linearizable(Checker):
 
     def check(self, test, history, opts=None):
         from .. import fleet as _fleet
+        from .. import ledger as _ledger
         from ..trace import NULL_TRACER
         # a test-map tracer nests the whole analysis under ONE trace
         # alongside client spans (core.py exports both to trace.jsonl):
@@ -226,12 +227,31 @@ class Linearizable(Checker):
             # live status follows the phase spans (fleet.RunStatus)
             tracer.add_listener(status.on_span)
         status.phase(f"check linearizable ({self.algorithm})")
+        t0 = time.monotonic()
+        res = None
         try:
             with tracer.span("check linearizable",
                              attrs={"algorithm": self.algorithm}):
-                return self._check(test, history, opts, tracer)
+                res = self._check(test, history, opts, tracer)
+            return res
         finally:
             status.phase("analyze")
+            if res is not None and (test or {}).get("name") \
+                    and "history_key" not in (opts or {}):
+                # run-ledger accounting (ledger.py): one record per
+                # TOP-LEVEL analysis — no-op unless a ledger is
+                # installed. Per-key sub-checks (opts carries
+                # history_key under the independent fan-out) and
+                # anonymous internal calls (bench configs record
+                # their own kind="bench" entry) are skipped: they
+                # would double-count device-seconds in aggregate()
+                # and pollute the (name, platform) regression groups
+                # with per-key walls.
+                _ledger.record_result(
+                    "checker", (test or {}).get("name"),
+                    res, wall_s=time.monotonic() - t0,
+                    model=type(self.model).__name__,
+                    extra={"algorithm": self.algorithm})
 
     def _check(self, test, history, opts, tracer):
         from ..analysis import history_lint

@@ -303,6 +303,16 @@ def bench_trajectory_graph(report: dict, out_path: str) -> Optional[str]:
         ax.set_ylabel("config wall (s)")
         ax.legend(loc="upper left", fontsize=6, ncol=2)
 
+        srcs = report.get("sources") or {}
+        if srcs:
+            # where the rounds came from: the run ledger is primary,
+            # the BENCH_r*.json glob backfills pre-ledger rounds
+            fig.text(0.01, 0.01,
+                     "rounds: " + ", ".join(
+                         f"{n} from {s}" for s, n in sorted(
+                             srcs.items()) if n),
+                     fontsize=6, color="#666666")
+
         parent = os.path.dirname(out_path)
         if parent:
             os.makedirs(parent, exist_ok=True)

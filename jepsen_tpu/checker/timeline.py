@@ -39,6 +39,11 @@ body        { font-family: sans-serif; }
 .truncation-warning { background: #f7c36b; border: 1px solid #c08020;
               border-radius: 3px; padding: 8px 12px; margin: 8px 0;
               font-weight: bold; }
+.nemesis-band { position: absolute; left: 0; z-index: -1;
+              background: rgba(247, 195, 107, 0.30);
+              border-left: 3px solid #c08020;
+              border-top: 1px dashed #c08020;
+              border-bottom: 1px dashed #c08020; }
 """
 
 
@@ -90,6 +95,30 @@ def process_index(history) -> dict:
     return {p: i for i, p in enumerate(nums + names)}
 
 
+def nemesis_bands(history, pairs) -> list:
+    """Fault windows in ROW coordinates: [(row_open, row_close, f)],
+    using the same start/stop pairing the latency plots shade with
+    (util.nemesis_intervals) so both renderings agree on what counts
+    as a window. Ops truncated off the page clamp to the last row; a
+    window still open at the end extends there too."""
+    from ..util import nemesis_intervals
+    row_of = {}
+    for row, (start, stop) in enumerate(pairs):
+        if start.index is not None:
+            row_of[start.index] = row
+        if stop is not None and stop.index is not None:
+            row_of[stop.index] = row
+    bands = set()
+    for s, e in nemesis_intervals(history):
+        r0 = row_of.get(s.index)
+        if r0 is None:
+            continue  # the opening op fell past the truncation cap
+        r1 = (row_of.get(e.index, len(pairs))
+              if e is not None else len(pairs))
+        bands.add((r0, max(r1, r0 + 1), str(s.f)))
+    return sorted(bands)
+
+
 def render(test: dict, history: History, history_key=None) -> str:
     """The timeline page as an HTML string."""
     all_pairs = History(history).pairs()
@@ -99,6 +128,17 @@ def render(test: dict, history: History, history_key=None) -> str:
     pindex = process_index([s for s, _ in pairs])
 
     divs = []
+    # nemesis fault windows as shaded bands BEHIND the op boxes, so
+    # fault injection and the anomalies it provoked line up visually
+    band_width = GUTTER_WIDTH * max(len(pindex), 1)
+    for r0, r1, f in nemesis_bands(history, pairs):
+        top = HEIGHT * (r0 + 1)
+        height = HEIGHT * max(r1 - r0, 1)
+        divs.append(
+            f"<div class='nemesis-band' style='top:{top}px;"
+            f"height:{height}px;width:{band_width}px' "
+            f"title='nemesis window: {_esc(f)} "
+            f"(rows {r0}&#8211;{r1})'></div>")
     for row, (start, stop) in enumerate(pairs):
         op = stop or start
         typ = op.type

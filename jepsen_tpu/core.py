@@ -168,6 +168,9 @@ def run(test: dict) -> dict:
     test = prepare_test(test)
 
     from . import fleet, store
+    from . import ledger as ledger_mod
+    from . import watchdog as watchdog_mod
+    t_run0 = _time.monotonic()
     writer = store.Writer(test) if test.get("name") else None
     # Live run status (fleet.RunStatus, doc/OBSERVABILITY.md): ambient
     # for the whole run — the interpreter, checker phase spans, and the
@@ -181,6 +184,19 @@ def run(test: dict) -> dict:
     status = fleet.RunStatus(test=test.get("name"),
                              status_file=status_file)
     prev_status = fleet.set_default(status)
+    # Run-ledger + stall-watchdog accounting (doc/OBSERVABILITY.md):
+    # named runs append per-analysis + per-run records under the store
+    # root's ledger/, and a heartbeat watchdog surveils the device
+    # loops so a hang INSIDE a device round is detected and recorded
+    # instead of blocking silently. Both restore the previous ambient
+    # defaults on exit.
+    prev_ledger = ledger_mod.set_default(
+        ledger_mod.Ledger(test.get("store_root") or store.BASE_DIR)
+        if writer else ledger_mod.get_default())
+    wd_installed = None
+    if not watchdog_mod.get_default().enabled:
+        wd_installed = watchdog_mod.Watchdog()
+        prev_wd = watchdog_mod.set_default(wd_installed)
     if writer:
         test["store_dir"] = writer.dir
         store.start_logging(test)
@@ -224,7 +240,8 @@ def run(test: dict) -> dict:
                         writer.save_2(test)
         return log_results(test)
     finally:
-        status.finish(valid=(test.get("results") or {}).get("valid?"))
+        valid = (test.get("results") or {}).get("valid?")
+        status.finish(valid=valid)
         fleet.set_default(prev_status)
         # a test-map tracer's spans land in the run dir (the dgraph
         # suites' span-export artifact, trace.clj + trace.py) — in the
@@ -232,13 +249,51 @@ def run(test: dict) -> dict:
         # still export, and guarded so a broken tracer can't void the
         # run's other artifacts
         tracer = test.get("tracer")
+        artifacts = {}
         if tracer is not None and writer:
             try:
                 n = tracer.export(os.path.join(writer.dir,
                                                "trace.jsonl"))
+                # the same spans in Chrome/Perfetto trace_event form:
+                # drop the file in ui.perfetto.dev and the run's
+                # encode/compile/device-round/fan-out phases render as
+                # a flame chart (doc/OBSERVABILITY.md walkthrough)
+                tracer.export_perfetto(os.path.join(
+                    writer.dir, "trace.perfetto.json"))
                 log.info("Exported %d spans", n)
+                root = test.get("store_root") or store.BASE_DIR
+                artifacts = {
+                    "trace": os.path.relpath(
+                        os.path.join(writer.dir, "trace.jsonl"), root),
+                    "perfetto": os.path.relpath(
+                        os.path.join(writer.dir,
+                                     "trace.perfetto.json"), root)}
             except Exception:  # noqa: BLE001
                 log.warning("trace export failed", exc_info=True)
+        led = ledger_mod.get_default()
+        if writer and led.enabled:
+            # the run-level ledger record: per-analysis records were
+            # appended by the checkers; this one ties them to the run
+            # dir, the verdict, and the end-to-end wall
+            try:
+                root = test.get("store_root") or store.BASE_DIR
+                wd_now = watchdog_mod.get_default()
+                led.record({
+                    "kind": "run", "name": test.get("name"),
+                    "verdict": valid,
+                    "wall_s": round(_time.monotonic() - t_run0, 4),
+                    "ops": len(test.get("history") or []),
+                    "stalls": len(wd_now.stalls) if wd_now.enabled
+                    else 0,
+                    "artifacts": {
+                        "dir": os.path.relpath(writer.dir, root),
+                        **artifacts}})
+            except Exception:  # noqa: BLE001
+                log.warning("ledger record failed", exc_info=True)
+        ledger_mod.set_default(prev_ledger)
+        if wd_installed is not None:
+            wd_installed.stop()
+            watchdog_mod.set_default(prev_wd)
         if writer:
             store.stop_logging()
             writer.close()

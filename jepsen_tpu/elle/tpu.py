@@ -195,14 +195,27 @@ def cycle_queries(g: DepGraph,
     import jax
 
     from ..analysis import guards as _guards
+    from .. import watchdog as _watchdog
     t0 = _t.monotonic()
     ins = (np.asarray(src_p, np.int32), np.asarray(dst_p, np.int32),
            np.asarray(w_p, np.float32), np.asarray(q_src_p, np.int32),
            np.asarray(q_dst_p, np.int32))
     _guards.note_transfer("h2d", sum(a.nbytes for a in ins),
                           what="elle-closure-inputs")
-    labels, closed = kernel(*ins)
-    jax.block_until_ready((labels, closed))
+    # watchdog coverage for the one blocking device call here: the
+    # closure kernel has no poll loop to heartbeat from, so the beat
+    # lands just before the call — a hung MXU dispatch leaves the
+    # source beating-silent and the monitor flags it (doc/
+    # OBSERVABILITY.md "stall watchdog")
+    wd = _watchdog.get_default()
+    # stall_s override: the closure at capacity is a known-slow
+    # healthy call (BENCH_r04: ~57 s of dense f32 matmuls on cpu) —
+    # only a multi-minute silence is a hang here
+    with wd.watch("elle-closure", device="tpu",
+                  stall_s=300.0) as hb:
+        wd.beat(hb, edges=int(len(src)), n=n, n_pad=n_pad, iters=iters)
+        labels, closed = kernel(*ins)
+        jax.block_until_ready((labels, closed))
     kernel_s = _t.monotonic() - t0
     # Achieved matmul throughput vs the flop model in the module
     # docstring: iters squarings x n_sub batched (n_pad)^3 matmuls.

@@ -101,6 +101,17 @@ def fault_event(exc: BaseException, *, device: Optional[str] = None,
             "traceback": traceback.format_exc()[-FAULT_TB_LIMIT:]}
 
 
+def _fault_point(event: dict) -> dict:
+    """A fault event as a `fleet_faults` series point: the event's
+    own "type" key moves to "fault_type" — the JSONL exporter stamps
+    every series line with {"type": "sample"}, and a point key named
+    "type" would clobber that envelope (the fleet_shards series
+    already uses fault_type for the same reason)."""
+    p = {k: v for k, v in event.items() if k != "type"}
+    p["fault_type"] = str(event.get("type"))
+    return p
+
+
 def record_fault(event: dict, mx=None, status=None) -> None:
     """Record one structured fault event (usually `fault_event(exc)`)
     that is NOT attached to a per-key shard — checker-level engine
@@ -116,7 +127,8 @@ def record_fault(event: dict, mx=None, status=None) -> None:
                    "device faults captured by fleet workers").inc(
             device=str(event.get("device") or "host"))
         mx.series("fleet_faults",
-                  "structured device fault events").append(dict(event))
+                  "structured device fault events").append(
+            _fault_point(event))
     if st.enabled:
         st.fault(event)
 
@@ -149,7 +161,7 @@ def record_shard(shard: dict, mx=None, status=None) -> None:
                 device=lbl["device"])
             mx.series("fleet_faults",
                       "structured device fault events").append(
-                dict(fault))
+                _fault_point(fault))
         if shard.get("engine") == "oracle-fallback":
             mx.counter("fleet_fallbacks_total",
                        "keys re-decided by the host oracle after a "
@@ -257,6 +269,7 @@ class RunStatus:
             "nemesis": {"active": False, "f": None, "since_s": None},
             "ops": {"invoked": 0, "completed": 0},
             "faults": [],
+            "watchdog": {"stalls": 0, "last_source": None},
         }
 
     # -- writers ------------------------------------------------------
@@ -357,6 +370,21 @@ class RunStatus:
                            ("type", "error", "stage", "device",
                             "key_index")})
             del faults[:-STATUS_FAULT_CAP]
+            self._touch_locked()
+        self._after()
+
+    def stall(self, event: dict) -> None:
+        """One watchdog stall detection (watchdog.py feeds this on top
+        of the fault it records): the /status panel shows a stalled run
+        as stalled, not merely quiet."""
+        if not self.enabled:
+            return
+        with self._lock:
+            w = self._d.setdefault("watchdog",
+                                   {"stalls": 0, "last_source": None})
+            w["stalls"] += 1
+            w["last_source"] = event.get("source")
+            w["last_age_s"] = event.get("age_s")
             self._touch_locked()
         self._after()
 

@@ -249,6 +249,24 @@ def subhistory(k, history: History) -> History:
     return out
 
 
+def _record_fanout_ledger(test, name, out, ks, model=None,
+                          engine=None) -> None:
+    """One run-ledger record per independent fan-out: verdict, key
+    count, failures, and the fleet summary's device/straggler columns
+    (ledger.summarize_result lifts util.fleet). No-op without an
+    installed ledger; never raises."""
+    from . import ledger as _ledger
+    wall = None
+    fleet_sum = (out.get("util") or {}).get("fleet") or {}
+    if fleet_sum.get("span_s") is not None:
+        wall = fleet_sum["span_s"]
+    _ledger.record_result(
+        "independent", (test or {}).get("name") or name, out,
+        wall_s=wall, model=model, engine=engine,
+        extra={"keys": len(ks),
+               "failures": len(out.get("failures") or [])})
+
+
 class IndependentChecker(Checker):
     """Host-parallel per-key checking (independent.clj:266-317)."""
 
@@ -293,12 +311,14 @@ class IndependentChecker(Checker):
 
         results = dict(bounded_pmap(check_key, ks))
         failures = [k for k in ks if not results[k].get("valid?")]
-        return {"valid?": merge_valid(r.get("valid?")
-                                      for r in results.values()),
-                "results": results,
-                "failures": failures,
-                "util": {"fleet": _fleet.summarize(
-                    [r.get("shard") for r in results.values()])}}
+        out = {"valid?": merge_valid(r.get("valid?")
+                                     for r in results.values()),
+               "results": results,
+               "failures": failures,
+               "util": {"fleet": _fleet.summarize(
+                   [r.get("shard") for r in results.values()])}}
+        _record_fanout_ledger(test, "independent", out, ks)
+        return out
 
 
 def checker(c: Checker) -> Checker:
@@ -358,12 +378,16 @@ class TPULinearizableIndependent(Checker):
             subdir = list(opts.get("subdirectory", [])) + [DIR, str(k)]
             _write_key_artifacts(test, subdir, h, res)
         failures = [k for k in ks if not results[k].get("valid?")]
-        return {"valid?": merge_valid(r.get("valid?")
-                                      for r in results.values()),
-                "results": results,
-                "failures": failures,
-                "util": {"fleet": _fleet.summarize(
-                    [r.get("shard") for r in res_list])}}
+        out = {"valid?": merge_valid(r.get("valid?")
+                                     for r in results.values()),
+               "results": results,
+               "failures": failures,
+               "util": {"fleet": _fleet.summarize(
+                   [r.get("shard") for r in res_list])}}
+        _record_fanout_ledger(test, "independent", out, ks,
+                              model=type(self.model).__name__,
+                              engine="device-mesh")
+        return out
 
 
 def tpu_checker(model: Model, time_limit: Optional[float] = None,

@@ -636,14 +636,46 @@ def _run_search(enc, init_fn, chunk_jit, iinv, iopc, n, max_configs,
                 frontier, K, H, B, W, W_eff, ic_eff, chunk, probes_used,
                 row_cols, accel, t_enter, time_limit, stop, depth=1,
                 mx=None, tracer=None, plat="cpu"):
+    # Stall surveillance (watchdog.py): the loop below heartbeats once
+    # per poll, so a device round that hangs INSIDE chunk_jit — which
+    # the between-chunk deadline checks can never observe — stops
+    # beating and the watchdog declares the source stalled. This thin
+    # wrapper owns the source lifetime; the loop body lives in
+    # _search_loop.
+    from .. import watchdog as _watchdog_mod
+    wd = _watchdog_mod.get_default()
+    # grace until the first beat: the first chunk folds in XLA
+    # compile (measured up to ~14 s at K=4096 on cpu, more on a cold
+    # accelerator cache), which must not read as a stall
+    hb = wd.register(f"wgl/{plat}", device=plat, grace_s=300.0)
+    try:
+        return _search_loop(enc, init_fn, chunk_jit, iinv, iopc, n,
+                            max_configs, frontier, K, H, B, W, W_eff,
+                            ic_eff, chunk, probes_used, row_cols,
+                            accel, t_enter, time_limit, stop,
+                            depth=depth, mx=mx, tracer=tracer,
+                            plat=plat, wd=wd, hb=hb)
+    finally:
+        wd.unregister(hb)
+
+
+def _search_loop(enc, init_fn, chunk_jit, iinv, iopc, n, max_configs,
+                 frontier, K, H, B, W, W_eff, ic_eff, chunk,
+                 probes_used, row_cols, accel, t_enter, time_limit,
+                 stop, depth=1, mx=None, tracer=None, plat="cpu",
+                 wd=None, hb=None):
     import jax.numpy as jnp
 
     from .. import fleet as _fleet_mod
     from .. import metrics as _metrics_mod
     from .. import trace as _trace_mod
+    from .. import watchdog as _watchdog_mod
     mx = mx if mx is not None else _metrics_mod.get_default()
     tracer = tracer if tracer is not None else _trace_mod.NULL_TRACER
     status = _fleet_mod.get_default()
+    if wd is None:
+        wd = _watchdog_mod.get_default()
+        hb = None
 
     from ..analysis import guards as _guards
 
@@ -673,7 +705,19 @@ def _run_search(enc, init_fn, chunk_jit, iinv, iopc, n, max_configs,
     # poll — only pay it when someone is recording (the disabled run
     # must keep the original single-transfer poll, overhead-free)
     instrumented = tl_points is not None or tracer.sampled
+    total_explored = 0
+    max_lin = 0
     while True:
+        if hb is not None and wd.cancelled(hb):
+            # soft-cancel between chunks (an escalated stall elsewhere,
+            # or an operator cancel): return partial progress instead
+            # of burning budget on a run already declared stalled
+            return {"valid?": "unknown", "cause": "stalled",
+                    "op_count": n + enc.n_info,
+                    "partial": {"configs_explored": total_explored,
+                                "ops_linearized": max_lin,
+                                "chunks": n_chunks},
+                    "stall": _watchdog_mod.stall_result(hb)["stall"]}
         t_call = _time.monotonic()
         # the first call folds in compile (the cold/warm split every
         # result reports); later calls are pure device rounds
@@ -702,6 +746,13 @@ def _run_search(enc, init_fn, chunk_jit, iinv, iopc, n, max_configs,
         bk_cnt = int(s[10])
         n_chunks += 1
         bk_peak = max(bk_peak, bk_cnt)
+        max_lin = max(max_lin, int(stats[2]))
+        if hb is not None:
+            # heartbeat + partial-progress counters: what a stalled
+            # verdict will report if the NEXT chunk never returns
+            wd.beat(hb, configs_explored=int(stats[0]),
+                    ops_linearized=max_lin, chunks=n_chunks,
+                    frontier=fr_cnt, backlog=bk_cnt)
         if first_call_s is None:
             # compile + first chunk: the cold/warm split every result
             # reports (a persistent compilation cache turns this into

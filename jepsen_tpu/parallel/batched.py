@@ -25,6 +25,7 @@ import numpy as np
 
 from .. import fleet as _fleet
 from .. import metrics as _metrics
+from .. import watchdog as _watchdog
 from ..history import History
 from ..models.core import Model
 from ..ops import wgl_ref
@@ -382,8 +383,14 @@ def check_streamed(model: Model, histories: Sequence[History],
                 engine=engine, t0=t0,
                 wall_s=_time.monotonic() - t0, fault=fault)
 
+    wd = _watchdog.get_default()
     if len(devices) == 1 or len(histories) == 1:
         for i in range(len(histories)):
+            if wd.cancelled():
+                # run-wide soft-cancel (an escalated stall): the
+                # remaining keys report partial progress, not silence
+                _fill_stalled(results, histories, key_indices, wd)
+                break
             results[i] = one(devices[0], i)
         return results  # type: ignore[return-value]
 
@@ -397,17 +404,66 @@ def check_streamed(model: Model, histories: Sequence[History],
     def worker(dev):
         while True:
             i = next(counter)
-            if i >= len(histories):
+            if i >= len(histories) or wd.cancelled():
                 return
             results[i] = one(dev, i)
 
-    threads = [threading.Thread(target=worker, args=(d,))
+    # daemon only under cancel-escalation: that is the one mode where
+    # the join below may abandon a hung worker, and a non-daemon zombie
+    # would then block interpreter exit forever
+    abandonable = wd.enabled and wd.escalation == "cancel"
+    threads = [threading.Thread(target=worker, args=(d,),
+                                daemon=abandonable)
                for d in devices]
     for t in threads:
         t.start()
-    for t in threads:
-        t.join()
+    if not abandonable:
+        for t in threads:
+            t.join()
+        return results  # type: ignore[return-value]
+    # Bounded wait: a worker hung inside a device round never returns
+    # — per-chunk deadline checks cannot reach it (they run BETWEEN
+    # chunks). Once the watchdog escalates, healthy workers wind down
+    # at their next poll; give them a short grace, then abandon the
+    # hung remainder and report stalled partials for their keys.
+    grace_until = None
+    while True:
+        alive = [t for t in threads if t.is_alive()]
+        if not alive:
+            break
+        alive[0].join(min(0.25, wd.poll_s))
+        if wd.cancelled():
+            now = _time.monotonic()
+            if grace_until is None:
+                grace_until = now + min(5.0, wd.stall_s)
+            elif now > grace_until:
+                break
+    _fill_stalled(results, histories, key_indices, wd)
     return results  # type: ignore[return-value]
+
+
+def _fill_stalled(results: list, histories, key_indices, wd) -> None:
+    """Stalled partial verdicts for keys the abandoned/cancelled
+    fan-out never decided: {"valid?": "unknown", "cause": "stalled"}
+    plus the fleet-level progress counters (keys decided so far)."""
+    decided = sum(1 for r in results if r is not None)
+    ev = (wd.stalls or [{}])[-1]
+    stall = {k: ev.get(k) for k in ("source", "age_s", "beats",
+                                    "escalation") if ev.get(k)
+             is not None}
+    for i, r in enumerate(results):
+        if r is not None:
+            continue
+        ki = key_indices[i] if key_indices is not None else i
+        t0 = _time.monotonic()
+        res = {"valid?": "unknown", "cause": "stalled",
+               "op_count": len(histories[i]),
+               "partial": {"keys_decided": decided,
+                           "keys_total": len(results)},
+               "stall": dict(stall)}
+        results[i] = _annotate_shard(
+            res, key_index=ki, device="fleet", engine="stalled",
+            t0=t0, wall_s=0.0)
 
 
 def check_batched(model: Model, histories: Sequence[History],
@@ -563,50 +619,82 @@ def check_batched(model: Model, histories: Sequence[History],
     deadline = _time.monotonic() + time_limit if time_limit else None
     t0 = _time.monotonic()
     timed_out = False
+    stalled = False
     mx = _metrics.get_default()
     # keys already decided on the host (trivial/unsupported encodings)
     # before the vmap loop — the live decided count builds on them
     decided_base = (status.snapshot()["keys"]["decided"]
                     if status.enabled else 0)
-    while True:
-        t_poll = _time.monotonic()
-        carry, summary = vchunk(consts, carry)
-        # one packed (Bk, 11) poll transfer: [fr_cnt, flags, stats, bk]
-        s = np.asarray(summary)
-        fr_cnt, flags, stats = s[:, 0], s[:, 1:4], s[:, 4:10]
-        found = flags[:, 0] != 0
-        empty = fr_cnt == 0
-        budget = stats[:, 0] >= max_configs
-        live = ~(found | empty | budget)
-        live[batch.n_keys:] = False
-        if mx.enabled:
-            mx.series(
-                "wgl_batched_chunks",
-                "per-poll state of the mesh-sharded batched search"
-            ).append({
-                "wall_s": round(_time.monotonic() - t0, 4),
-                "poll_s": round(_time.monotonic() - t_poll, 4),
-                "live_keys": int(live.sum()),
-                "decided_keys": int((found | empty)[:batch.n_keys].sum()),
-                "frontier_total": int(fr_cnt[:batch.n_keys].sum()),
-                "backlog_total": int(s[:batch.n_keys, 10].sum()),
-                "explored_total": int(stats[:batch.n_keys, 0].sum())})
-        if status.enabled:
-            status.batched_poll(
-                live=int(live.sum()),
-                decided=(decided_base
-                         + int((found | empty)[:batch.n_keys].sum())),
-                total=batch.n_keys,
-                frontier_total=int(fr_cnt[:batch.n_keys].sum()),
-                backlog_total=int(s[:batch.n_keys, 10].sum()),
-                explored_total=int(stats[:batch.n_keys, 0].sum()))
-        if not live.any():
-            break
-        if deadline is not None and _time.monotonic() > deadline:
-            timed_out = True
-            break
+    wd = _watchdog.get_default()
+    # the watchdog heartbeat for the whole lockstep batch: one beat
+    # per poll; a vchunk call that hangs on a wedged mesh stops
+    # beating and the monitor declares the batch stalled. First-beat
+    # grace covers the vmapped kernel's compile (folded into the
+    # first vchunk call).
+    hb = wd.register("wgl-batched", device=f"mesh[{nd}]",
+                     grace_s=300.0)
+    s = None  # last packed poll; None if cancelled before any poll
+    try:
+        while True:
+            if wd.cancelled(hb):
+                stalled = True
+                break
+            t_poll = _time.monotonic()
+            carry, summary = vchunk(consts, carry)
+            # one packed (Bk, 11) poll transfer:
+            # [fr_cnt, flags, stats, bk]
+            s = np.asarray(summary)
+            fr_cnt, flags, stats = s[:, 0], s[:, 1:4], s[:, 4:10]
+            found = flags[:, 0] != 0
+            empty = fr_cnt == 0
+            budget = stats[:, 0] >= max_configs
+            live = ~(found | empty | budget)
+            live[batch.n_keys:] = False
+            wd.beat(hb, live_keys=int(live.sum()),
+                    decided_keys=int(
+                        (found | empty)[:batch.n_keys].sum()),
+                    configs_explored=int(
+                        stats[:batch.n_keys, 0].sum()))
+            if mx.enabled:
+                mx.series(
+                    "wgl_batched_chunks",
+                    "per-poll state of the mesh-sharded batched search"
+                ).append({
+                    "wall_s": round(_time.monotonic() - t0, 4),
+                    "poll_s": round(_time.monotonic() - t_poll, 4),
+                    "live_keys": int(live.sum()),
+                    "decided_keys": int(
+                        (found | empty)[:batch.n_keys].sum()),
+                    "frontier_total": int(fr_cnt[:batch.n_keys].sum()),
+                    "backlog_total": int(s[:batch.n_keys, 10].sum()),
+                    "explored_total": int(
+                        stats[:batch.n_keys, 0].sum())})
+            if status.enabled:
+                status.batched_poll(
+                    live=int(live.sum()),
+                    decided=(decided_base
+                             + int((found | empty)[:batch.n_keys].sum())),
+                    total=batch.n_keys,
+                    frontier_total=int(fr_cnt[:batch.n_keys].sum()),
+                    backlog_total=int(s[:batch.n_keys, 10].sum()),
+                    explored_total=int(stats[:batch.n_keys, 0].sum()))
+            if not live.any():
+                break
+            if deadline is not None and _time.monotonic() > deadline:
+                timed_out = True
+                break
+    finally:
+        wd.unregister(hb)
     wall = _time.monotonic() - t0
 
+    if s is None:
+        # soft-cancelled before the first poll landed: synthesize an
+        # all-undecided summary so every lane reports a stalled partial
+        s = np.zeros((bk, 11), dtype=np.int32)
+        fr_cnt, flags, stats = s[:, 0], s[:, 1:4], s[:, 4:10]
+        found = flags[:, 0] != 0
+        empty = np.zeros(bk, dtype=bool)
+        budget = np.zeros(bk, dtype=bool)
     overflow = flags[:, 1]
     # lane -> device: the key axis is laid out in contiguous blocks of
     # bk//nd lanes per mesh device (NamedSharding over the 1-D mesh)
@@ -635,11 +723,19 @@ def check_batched(model: Model, histories: Sequence[History],
             res = {"valid?": False, "op_count": n_total,
                    "max_linearized": int(stats[lane, 2]), **detail}
         else:
-            cause = ("backlog-overflow" if overflow[lane]
+            cause = ("stalled" if stalled
+                     else "backlog-overflow" if overflow[lane]
                      else "config-limit" if budget[lane] else "timeout")
             res = {"valid?": "unknown", "cause": cause,
                    "op_count": n_total, **detail}
-            if oracle_fallback and not timed_out:
+            if stalled:
+                # the anti-"nothing to show" contract: what this lane
+                # had explored when the run was declared stalled
+                res["partial"] = {
+                    "configs_explored": int(stats[lane, 0]),
+                    "rounds": rounds,
+                    "ops_linearized": int(stats[lane, 2])}
+            if oracle_fallback and not timed_out and not stalled:
                 res = _oracle_fallback(model, histories[hist_i],
                                        deadline, res)
                 engine = str(res.get("engine") or engine)

@@ -172,6 +172,105 @@ class Tracer:
                      **sp.to_json()}) + "\n")
         return len(spans)
 
+    def export_perfetto(self, path: str) -> int:
+        """Write collected spans as a Chrome/Perfetto `trace_event`
+        JSON file (see `to_perfetto`); returns span count."""
+        with self._lock:
+            spans = list(self.spans)
+        doc = to_perfetto([sp.to_json() for sp in spans],
+                          service=self.service)
+        parent = os.path.dirname(path)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+        with open(path, "w") as fh:
+            json.dump(doc, fh)
+        return len(spans)
+
+
+# ---------------------------------------------------------------------------
+# Chrome/Perfetto trace_event export
+# ---------------------------------------------------------------------------
+# The OTLP-flavored JSONL above is for Jaeger-shaped tooling; Perfetto
+# (ui.perfetto.dev) and chrome://tracing want the trace_event format
+# instead — and they render the checker's phase spans (encode /
+# compile / device-round / host-poll, per-key fan-out, engine races)
+# as a zoomable flame chart with zero extra tooling. Mapping: one
+# process per service, one thread LANE per trace id (each analysis /
+# engine thread gets its own row), spans as "X" complete events in
+# microseconds, annotations as "i" instant events.
+
+def perfetto_events(spans: list, service: str = "jepsen_tpu") -> list:
+    """`trace_event` dicts from span dicts (the `Span.to_json` /
+    exported-JSONL shape). Unfinished spans (no end time) are emitted
+    with zero duration rather than dropped — a crashed run's last open
+    span is exactly the interesting one."""
+    events: list = []
+    lanes: dict = {}
+    pid = 1
+    events.append({"ph": "M", "name": "process_name", "pid": pid,
+                   "tid": 0, "args": {"name": str(service)}})
+    for sp in spans:
+        if not isinstance(sp, dict) or sp.get("startTimeUnixNano") \
+                is None:
+            continue
+        trace_id = str(sp.get("traceId"))
+        tid = lanes.get(trace_id)
+        if tid is None:
+            tid = lanes[trace_id] = len(lanes) + 1
+            events.append({"ph": "M", "name": "thread_name",
+                           "pid": pid, "tid": tid,
+                           "args": {"name": f"trace {trace_id[:8]}"}})
+        ts = int(sp["startTimeUnixNano"]) / 1e3  # ns -> us
+        end = sp.get("endTimeUnixNano")
+        dur = max(0.0, (int(end) / 1e3 - ts)) if end else 0.0
+        args = {k: v for k, v in (sp.get("attributes") or {}).items()}
+        for k in ("spanId", "parentSpanId"):
+            if sp.get(k):
+                args[k] = sp[k]
+        events.append({"ph": "X", "name": str(sp.get("name")),
+                       "cat": "span", "ts": ts, "dur": dur,
+                       "pid": pid, "tid": tid, "args": args})
+        for ann in sp.get("events") or []:
+            if not isinstance(ann, dict) or ann.get("time") is None:
+                continue
+            events.append({"ph": "i", "s": "t",
+                           "name": str(ann.get("message"))[:80],
+                           "cat": "annotation",
+                           "ts": float(ann["time"]) * 1e6,
+                           "pid": pid, "tid": tid})
+    return events
+
+
+def to_perfetto(spans: list, service: str = "jepsen_tpu") -> dict:
+    """The loadable document: {"traceEvents": [...]} — the JSON object
+    form both Perfetto and chrome://tracing ingest directly."""
+    return {"traceEvents": perfetto_events(spans, service=service),
+            "displayTimeUnit": "ms"}
+
+
+def perfetto_from_jsonl(jsonl_path: str,
+                        service: str = "jepsen_tpu") -> dict:
+    """Convert an exported OTLP-flavored trace.jsonl (Tracer.export)
+    into the Perfetto document — the on-the-fly converter behind
+    web.py's /runs/<id>/perfetto.json. Unparseable lines are skipped
+    (a live run's file may end mid-line)."""
+    spans = []
+    with open(jsonl_path) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                obj = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(obj, dict):
+                svc = (obj.get("resource") or {}).get("service.name")
+                if svc:
+                    service = svc
+                spans.append(obj)
+    return to_perfetto(spans, service=service)
+
 
 # Shared disabled tracer: the default for instrumented hot paths
 # (checker kernels, phase spans) — every span() is a two-line no-op.

@@ -30,10 +30,15 @@ import threading
 import urllib.parse
 import zipfile
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
 
 from . import fleet, store
+from . import ledger as ledger_mod
 
 log = logging.getLogger("jepsen_tpu.web")
+
+# Ledger entries surfaced on /status.json's last_runs block.
+LAST_RUNS = 8
 
 VALID_COLORS = {
     True: "#79c77a",       # ok: green
@@ -137,18 +142,50 @@ def status_snapshot(store_root: str) -> dict:
     documented schema (schema/active keys present)."""
     st = fleet.get_default()
     if st.enabled:
-        return st.snapshot()
-    snap = fleet.read_status_file(store_root)
-    if snap is not None:
-        return snap
-    return {"schema": 1, "active": False, "test": None, "phase": None,
-            "started": None, "updated": None,
-            "elapsed_s": None, "eta_s": None,
-            "keys": {"total": 0, "decided": 0, "live": 0,
-                     "failures": 0},
-            "devices": {}, "search": {},
-            "nemesis": {"active": False, "f": None, "since_s": None},
-            "ops": {"invoked": 0, "completed": 0}, "faults": []}
+        snap = st.snapshot()
+    else:
+        snap = fleet.read_status_file(store_root)
+    if snap is None:
+        snap = {"schema": 1, "active": False, "test": None,
+                "phase": None, "started": None, "updated": None,
+                "elapsed_s": None, "eta_s": None,
+                "keys": {"total": 0, "decided": 0, "live": 0,
+                         "failures": 0},
+                "devices": {}, "search": {},
+                "nemesis": {"active": False, "f": None,
+                            "since_s": None},
+                "ops": {"invoked": 0, "completed": 0}, "faults": [],
+                "watchdog": {"stalls": 0, "last_source": None}}
+    # history, not just the live run: the last N ledger entries ride
+    # every status answer so the fleet dashboard shows what the fleet
+    # has DONE, not only what it is doing
+    try:
+        snap["last_runs"] = _last_runs(store_root)
+    except Exception:  # noqa: BLE001 — a torn ledger never breaks
+        snap["last_runs"] = []  # the live panel
+    return snap
+
+
+# last_runs cache: /status auto-refreshes every 2 s, and re-parsing a
+# long-lived index.jsonl per request would scale with total records;
+# the (mtime_ns, size) key invalidates on any append.
+_LAST_RUNS_CACHE: dict = {}
+
+
+def _last_runs(store_root: str) -> list:
+    led = ledger_mod.Ledger(store_root)
+    try:
+        st = os.stat(led.index_path)
+        key = (st.st_mtime_ns, st.st_size)
+    except OSError:
+        return []
+    cached = _LAST_RUNS_CACHE.get(store_root)
+    if cached is not None and cached[0] == key:
+        return cached[1]
+    rows = ledger_mod.compact(
+        led.query(limit=LAST_RUNS, newest_first=True))
+    _LAST_RUNS_CACHE[store_root] = (key, rows)
+    return rows
 
 
 _DEV_STATE_COLORS = {"searching": "#79c7f7", "fallback": "#f2b75c",
@@ -220,8 +257,111 @@ def render_status(store_root: str) -> bytes:
             f"{_esc(f.get('device'))} key {_esc(f.get('key_index'))}: "
             f"{_esc(f.get('error'))}</li>" for f in faults[-8:])
         parts.append("<h2>faults</h2><ul>" + items + "</ul>")
-    parts.append("<p><a href='/status.json'>status.json</a></p>")
+    w = s.get("watchdog") or {}
+    if w.get("stalls"):
+        parts.append(
+            f"<p style='background:{VALID_COLORS[False]};padding:6px'>"
+            f"watchdog: <b>{_esc(w['stalls'])}</b> stall(s), last "
+            f"source {_esc(w.get('last_source'))}</p>")
+    last = s.get("last_runs") or []
+    if last:
+        rows = "".join(
+            f"<tr><td><a href='/runs/{_esc(r.get('id'))}'>"
+            f"{_esc(r.get('id'))}</a></td>"
+            f"<td>{_esc(r.get('kind'))}</td><td>{_esc(r.get('name'))}"
+            f"</td><td style='background:"
+            f"{VALID_COLORS.get(r.get('verdict'), VALID_COLORS[None])}'>"
+            f"{_esc(r.get('verdict'))}</td>"
+            f"<td>{_esc(r.get('wall_s'))}</td></tr>" for r in last)
+        parts.append("<h2>recent runs</h2><table><thead><tr>"
+                     "<th>id</th><th>kind</th><th>name</th>"
+                     "<th>verdict</th><th>wall s</th></tr></thead>"
+                     f"<tbody>{rows}</tbody></table>")
+    parts.append("<p><a href='/status.json'>status.json</a> &middot; "
+                 "<a href='/runs'>run ledger</a></p>")
     return _page("status", "".join(parts))
+
+
+def _fmt_epoch(t) -> str:
+    import time as _time
+    try:
+        return _time.strftime("%Y-%m-%d %H:%M:%S",
+                              _time.localtime(float(t)))
+    except (TypeError, ValueError):
+        return "?"
+
+
+def render_runs(store_root: str) -> bytes:
+    """/runs: the ledger as a table, newest first, with cross-run
+    aggregates (device-seconds per model, verdict mix) on top."""
+    led = ledger_mod.Ledger(store_root)
+    recs = led.query(newest_first=True)
+    agg = led.aggregate(records=recs)
+    parts = ["<a href='/'>jepsen_tpu</a> / runs",
+             f"<h1>run ledger ({len(recs)} records)</h1>"]
+    dev = agg.get("device_s") or {}
+    wall = agg.get("wall_s") or {}
+    parts.append(
+        "<p>"
+        f"verdicts {_esc(agg.get('verdicts'))} &middot; "
+        f"device-seconds {_esc(dev.get('total'))} "
+        f"(by model {_esc(dev.get('by_model'))}) &middot; "
+        f"wall p50 {_esc(wall.get('p50'))}s / p95 "
+        f"{_esc(wall.get('p95'))}s &middot; "
+        f"stalls {_esc(agg.get('stalls'))}</p>")
+    rows = []
+    for r in recs:
+        color = VALID_COLORS.get(r.get("verdict"), VALID_COLORS[None])
+        rows.append(
+            f"<tr><td><a href='/runs/{_esc(r.get('id'))}'>"
+            f"{_esc(r.get('id'))}</a></td>"
+            f"<td>{_esc(r.get('kind'))}</td>"
+            f"<td>{_esc(r.get('name'))}</td>"
+            f"<td>{_esc(r.get('model') or '')}</td>"
+            f"<td>{_esc(r.get('engine') or '')}</td>"
+            f"<td style='background:{color}'>"
+            f"{_esc(r.get('verdict'))}</td>"
+            f"<td>{_esc(r.get('wall_s'))}</td>"
+            f"<td>{_esc(r.get('device_s') or '')}</td>"
+            f"<td>{_esc(_fmt_epoch(r.get('t')))}</td></tr>")
+    parts.append(
+        "<table><thead><tr><th>id</th><th>kind</th><th>name</th>"
+        "<th>model</th><th>engine</th><th>verdict</th><th>wall s</th>"
+        "<th>device s</th><th>when</th></tr></thead><tbody>"
+        + "".join(rows) + "</tbody></table>"
+        "<p><a href='/runs.json'>runs.json</a></p>")
+    return _page("runs", "".join(parts))
+
+
+def render_run(store_root: str, run_id: str) -> Optional[bytes]:
+    """/runs/<id>: one full ledger record, with artifact links and —
+    when the run exported a trace — the one-click Perfetto handoff."""
+    rec = ledger_mod.Ledger(store_root).get(run_id)
+    if rec is None:
+        return None
+    parts = ["<a href='/'>jepsen_tpu</a> / "
+             "<a href='/runs'>runs</a> / " + _esc(run_id),
+             f"<h1>{_esc(rec.get('kind'))} · {_esc(rec.get('name'))}"
+             f"</h1>"]
+    color = VALID_COLORS.get(rec.get("verdict"), VALID_COLORS[None])
+    parts.append(f"<p>verdict <b style='background:{color};"
+                 f"padding:2px 8px'>{_esc(rec.get('verdict'))}</b>"
+                 f" &middot; wall {_esc(rec.get('wall_s'))}s"
+                 f" &middot; {_esc(_fmt_epoch(rec.get('t')))}</p>")
+    arts = rec.get("artifacts") or {}
+    links = [f"<a href='/runs/{_esc(run_id)}.json'>record.json</a>"]
+    for label, rel in sorted(arts.items()):
+        links.append(f"<a href='/files/"
+                     f"{_esc(str(rel).replace(os.sep, '/'))}'>"
+                     f"{_esc(label)}</a>")
+    if arts.get("trace"):
+        links.append(f"<a href='/runs/{_esc(run_id)}/perfetto.json'>"
+                     "perfetto.json</a> (open in ui.perfetto.dev)")
+    parts.append("<p>" + " &middot; ".join(links) + "</p>")
+    parts.append("<pre style='background:#f4f4f4;padding:10px'>"
+                 + _esc(json.dumps(rec, indent=2, default=str))
+                 + "</pre>")
+    return _page(f"run {run_id}", "".join(parts))
 
 
 def render_home(cache: _ValidityCache) -> bytes:
@@ -239,7 +379,8 @@ def render_home(cache: _ValidityCache) -> bytes:
             f"<td><a href='{href}/jepsen.log'>jepsen.log</a></td>"
             f"<td><a href='{href}.zip'>zip</a></td></tr>")
     body = ("<h1>jepsen_tpu</h1>"
-            "<p><a href='/status'>live run status</a></p>"
+            "<p><a href='/status'>live run status</a> &middot; "
+            "<a href='/runs'>run ledger</a></p>"
             "<table><thead><tr><th>Name</th>"
             "<th>Time</th><th>Valid?</th><th>Results</th><th>History</th>"
             "<th>Log</th><th>Zip</th></tr></thead><tbody>"
@@ -357,6 +498,25 @@ class Handler(BaseHTTPRequestHandler):
     def _404(self):
         self._send(404, "text/plain", b"404 not found")
 
+    def _serve_perfetto(self, run_id: str):
+        """Convert a ledger record's exported trace.jsonl into the
+        Chrome/Perfetto trace_event document, on the fly — the file a
+        browser drops straight into ui.perfetto.dev."""
+        root = self.cache.store_root
+        rec = ledger_mod.Ledger(root).get(run_id)
+        rel = (rec or {}).get("artifacts", {}).get("trace")
+        if not rel:
+            self._404()
+            return
+        path = os.path.join(root, *str(rel).split("/"))
+        if not in_scope(root, path) or not os.path.isfile(path):
+            self._404()
+            return
+        from . import trace as trace_mod
+        doc = trace_mod.perfetto_from_jsonl(path)
+        self._send(200, "application/json",
+                   json.dumps(doc).encode())
+
     def do_GET(self):  # noqa: N802 (http.server API)
         try:
             uri = urllib.parse.unquote(
@@ -379,6 +539,34 @@ class Handler(BaseHTTPRequestHandler):
             if uri == "/status":
                 self._send(200, "text/html; charset=utf-8",
                            render_status(self.cache.store_root))
+                return
+            if uri in ("/runs", "/runs/"):
+                self._send(200, "text/html; charset=utf-8",
+                           render_runs(self.cache.store_root))
+                return
+            if uri == "/runs.json":
+                led = ledger_mod.Ledger(self.cache.store_root)
+                body = json.dumps(led.query(newest_first=True),
+                                  default=str).encode()
+                self._send(200, "application/json", body)
+                return
+            m = re.match(r"^/runs/([A-Za-z0-9][\w.-]*?)(\.json)?$", uri)
+            if m:
+                rid, as_json = m.group(1), bool(m.group(2))
+                rec = ledger_mod.Ledger(self.cache.store_root).get(rid)
+                if rec is None:
+                    self._404()
+                elif as_json:
+                    self._send(200, "application/json",
+                               json.dumps(rec, default=str).encode())
+                else:
+                    self._send(200, "text/html; charset=utf-8",
+                               render_run(self.cache.store_root, rid))
+                return
+            m = re.match(r"^/runs/([A-Za-z0-9][\w.-]*)/perfetto\.json$",
+                         uri)
+            if m:
+                self._serve_perfetto(m.group(1))
                 return
             m = re.match(r"^/files/(.+)$", uri)
             if not m:

@@ -50,14 +50,29 @@ SERIES_SCHEMAS = {
                            "explored_total": int},
     "fleet_shards": {"key_index": int, "device": str, "engine": str,
                      "wall_s": NUM},
-    "fleet_faults": {"type": str, "error": str, "stage": str},
+    "fleet_faults": {"fault_type": str, "error": str, "stage": str},
     "history_lint": {"where": str, "op_count": int,
                      "rule_counts": dict},
+    "watchdog_heartbeats": {"source": str, "beats": int},
+    "watchdog_stalls": {"source": str, "age_s": NUM, "beats": int,
+                        "escalation": str},
 }
 
 REGRESSIONS_SCHEMA = {"schema": int, "threshold_x": NUM,
                       "rounds": list, "configs": dict,
                       "regressions": list}
+
+# run-ledger records (jepsen_tpu/ledger.py index.jsonl + records/*)
+LEDGER_SCHEMA = {"schema": int, "id": str, "kind": str, "name": str,
+                 "t": NUM}
+
+# OTLP-flavored span lines (trace.Tracer.export — *_trace.jsonl)
+SPAN_SCHEMA = {"name": str, "traceId": str, "spanId": str,
+               "startTimeUnixNano": int}
+
+# Chrome/Perfetto trace_event phases the exporter emits; anything
+# else in a *.perfetto.json is drift
+PERFETTO_PHASES = {"X", "B", "E", "i", "I", "M", "C"}
 
 
 def _check_fields(obj: dict, schema: dict, where: str) -> list:
@@ -150,10 +165,127 @@ def lint_regressions_file(path: str) -> list:
     return errors
 
 
+def lint_ledger_file(path: str) -> list:
+    """Run-ledger lines/records (ledger.py): the required envelope
+    plus type sanity on the documented optional fields."""
+    errors = []
+
+    def check(obj, where):
+        errs = _check_fields(obj, LEDGER_SCHEMA, where)
+        v = obj.get("verdict", None)
+        if v is not None and not isinstance(v, (bool, str)):
+            errs.append(f"{where}: 'verdict' should be bool/str/null, "
+                        f"got {type(v).__name__}")
+        for f in ("wall_s", "device_s"):
+            if obj.get(f) is not None and not isinstance(obj[f], NUM):
+                errs.append(f"{where}: {f!r} should be numeric, got "
+                            f"{type(obj[f]).__name__}")
+        return errs
+
+    if path.endswith(".jsonl"):
+        try:
+            with open(path) as fh:
+                for i, line in enumerate(fh, 1):
+                    line = line.strip()
+                    if not line:
+                        continue
+                    where = f"{os.path.basename(path)}:{i}"
+                    try:
+                        obj = json.loads(line)
+                    except ValueError as e:
+                        errors.append(f"{where}: not JSON ({e})")
+                        continue
+                    errors += check(obj, where)
+        except OSError as e:
+            errors.append(f"{path}: unreadable ({e})")
+        return errors
+    try:
+        with open(path) as fh:
+            obj = json.load(fh)
+    except (OSError, ValueError) as e:
+        return [f"{os.path.basename(path)}: not JSON ({e})"]
+    return check(obj, os.path.basename(path))
+
+
+def lint_span_file(path: str) -> list:
+    """OTLP-flavored trace JSONL (trace.Tracer.export)."""
+    errors = []
+    try:
+        with open(path) as fh:
+            for i, line in enumerate(fh, 1):
+                line = line.strip()
+                if not line:
+                    continue
+                where = f"{os.path.basename(path)}:{i}"
+                try:
+                    obj = json.loads(line)
+                except ValueError as e:
+                    errors.append(f"{where}: not JSON ({e})")
+                    continue
+                if not isinstance(obj, dict):
+                    errors.append(f"{where}: line is not an object")
+                    continue
+                errors += _check_fields(obj, SPAN_SCHEMA, where)
+    except OSError as e:
+        errors.append(f"{path}: unreadable ({e})")
+    return errors
+
+
+def lint_perfetto_file(path: str) -> list:
+    """Chrome/Perfetto trace_event export (trace.to_perfetto): the
+    structural contract ui.perfetto.dev / chrome://tracing require —
+    a traceEvents list of events with a known phase, microsecond ts
+    (plus dur for complete events) and pid/tid lanes."""
+    where = os.path.basename(path)
+    try:
+        with open(path) as fh:
+            doc = json.load(fh)
+    except (OSError, ValueError) as e:
+        return [f"{where}: not JSON ({e})"]
+    events = doc.get("traceEvents") if isinstance(doc, dict) else doc
+    if not isinstance(events, list):
+        return [f"{where}: no traceEvents list"]
+    errors = []
+    for i, ev in enumerate(events):
+        ew = f"{where}[{i}]"
+        if not isinstance(ev, dict):
+            errors.append(f"{ew}: event is not an object")
+            continue
+        ph = ev.get("ph")
+        if ph not in PERFETTO_PHASES:
+            errors.append(f"{ew}: unknown phase {ph!r} "
+                          f"(known: {sorted(PERFETTO_PHASES)})")
+            continue
+        if not isinstance(ev.get("name"), str):
+            errors.append(f"{ew}: 'name' should be str")
+        for f in ("pid", "tid"):
+            if not isinstance(ev.get(f), int):
+                errors.append(f"{ew}: {f!r} should be int")
+        if ph in ("X", "B", "E", "i", "I", "C") \
+                and not isinstance(ev.get("ts"), NUM):
+            errors.append(f"{ew}: {ph!r} event needs numeric 'ts'")
+        if ph == "X" and not isinstance(ev.get("dur"), NUM):
+            errors.append(f"{ew}: complete event needs numeric 'dur'")
+    return errors
+
+
 def lint_path(path: str) -> list:
+    base = os.path.basename(path)
+    parent = os.path.basename(os.path.dirname(path))
+    gparent = os.path.basename(os.path.dirname(os.path.dirname(path)))
     if path.endswith("regressions.json"):
         return lint_regressions_file(path)
+    if path.endswith("perfetto.json"):
+        return lint_perfetto_file(path)
+    # ledger/index.jsonl AND ledger/records/<id>.json — the record
+    # files are the source of truth, so they lint too
+    if "ledger" in (parent, gparent) or base.startswith("ledger"):
+        return lint_ledger_file(path) if path.endswith(
+            (".json", ".jsonl")) else []
     if path.endswith(".jsonl"):
+        # exported span streams carry spans, not metrics lines
+        if "trace" in base:
+            return lint_span_file(path)
         return lint_jsonl_file(path)
     return []  # .prom / .png etc.: out of scope
 
@@ -165,6 +297,14 @@ def main(argv=None) -> int:
     else:
         art = os.path.join(REPO_ROOT, "artifacts", "telemetry")
         paths = sorted(glob.glob(os.path.join(art, "*")))
+        # the bench's run ledger, when a round has populated it —
+        # both the index and the record files (the source of truth)
+        ledger_dir = os.path.join(REPO_ROOT, "store", "ledger")
+        ledger_idx = os.path.join(ledger_dir, "index.jsonl")
+        if os.path.isfile(ledger_idx):
+            paths.append(ledger_idx)
+        paths += sorted(glob.glob(
+            os.path.join(ledger_dir, "records", "*.json")))
         if not paths:
             print(f"telemetry lint: nothing to lint under {art}")
             return 0
@@ -175,7 +315,9 @@ def main(argv=None) -> int:
             paths += sorted(glob.glob(os.path.join(p, "*")))
             continue
         errs = lint_path(p)
-        if p.endswith((".jsonl", "regressions.json")):
+        if p.endswith((".jsonl", "regressions.json",
+                       "perfetto.json")) or \
+                os.path.basename(os.path.dirname(p)) == "records":
             linted += 1
         errors += errs
     for e in errors:

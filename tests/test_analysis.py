@@ -224,7 +224,9 @@ class TestCheckerGate:
         assert res["fault"]["type"] == "RuntimeError"
         assert res["fault"]["stage"] == "checker/Boom"
         pts = reg.series("fleet_faults").points
-        assert pts and pts[0]["type"] == "RuntimeError"
+        # series points carry the event type as fault_type ("type"
+        # would clobber the JSONL exporter's line envelope)
+        assert pts and pts[0]["fault_type"] == "RuntimeError"
         assert pts[0]["stage"] == "checker/Boom"
         assert "kaput" in pts[0]["error"]
 

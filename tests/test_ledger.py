@@ -1,0 +1,520 @@
+"""Run-ledger tests (jepsen_tpu/ledger.py + doc/OBSERVABILITY.md):
+append/query/aggregate round-trips, concurrent-writer atomicity, the
+generalized regression tracking, bench rounds read back from the
+ledger (glob fallback for pre-ledger rounds), the /runs web surfaces,
+and the telemetry-lint schemas for ledger records and the Perfetto
+trace export."""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from jepsen_tpu import ledger, trace
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LINT = os.path.join(REPO, "scripts", "telemetry_lint.py")
+
+
+def mk(tmp_path) -> ledger.Ledger:
+    return ledger.Ledger(str(tmp_path))
+
+
+class TestRecordQuery:
+    def test_round_trip(self, tmp_path):
+        led = mk(tmp_path)
+        rid = led.record({"kind": "checker", "name": "demo",
+                          "model": "CASRegister", "verdict": True,
+                          "wall_s": 1.25})
+        assert rid is not None
+        rec = led.get(rid)
+        assert rec["schema"] == ledger.SCHEMA
+        assert rec["kind"] == "checker"
+        assert rec["verdict"] is True
+        assert rec["wall_s"] == 1.25
+        # and the index view agrees with the record file
+        (idx,) = led.query()
+        assert idx["id"] == rid
+        assert idx["model"] == "CASRegister"
+
+    def test_filters(self, tmp_path):
+        led = mk(tmp_path)
+        led.record({"kind": "checker", "name": "a",
+                    "model": "Register", "engine": "device",
+                    "platform": "cpu", "verdict": True, "t": 100.0})
+        led.record({"kind": "bench", "name": "b", "model": "Mutex",
+                    "engine": "oracle", "platform": "tpu",
+                    "verdict": "unknown", "t": 200.0})
+        assert len(led.query(kind="checker")) == 1
+        assert len(led.query(model="Mutex")) == 1
+        assert len(led.query(engine="device")) == 1
+        assert len(led.query(platform="tpu")) == 1
+        assert len(led.query(verdict="unknown")) == 1
+        assert len(led.query(verdict=True)) == 1
+        assert [r["name"] for r in led.query(since=150.0)] == ["b"]
+        assert [r["name"] for r in led.query(until=150.0)] == ["a"]
+
+    def test_limit_and_order(self, tmp_path):
+        led = mk(tmp_path)
+        for i in range(5):
+            led.record({"kind": "run", "name": f"r{i}",
+                        "t": 100.0 + i})
+        q = led.query(limit=2)
+        assert [r["name"] for r in q] == ["r3", "r4"]
+        q = led.query(limit=2, newest_first=True)
+        assert [r["name"] for r in q] == ["r4", "r3"]
+
+    def test_index_loss_rebuilds_from_records(self, tmp_path):
+        led = mk(tmp_path)
+        rid = led.record({"kind": "run", "name": "survivor"})
+        os.remove(led.index_path)
+        assert [r["id"] for r in led.query()] == [rid]
+
+    def test_torn_index_line_skipped(self, tmp_path):
+        led = mk(tmp_path)
+        led.record({"kind": "run", "name": "good"})
+        with open(led.index_path, "a") as fh:
+            fh.write('{"truncated": ')
+        assert [r["name"] for r in led.query()] == ["good"]
+
+    def test_unserializable_entry_sanitized_not_raised(self, tmp_path):
+        """Accounting never fails a run: non-string dict keys (which
+        json rejects regardless of default=) are stringified, and a
+        hopeless entry returns None instead of raising."""
+        led = mk(tmp_path)
+        rid = led.record({"kind": "checker", "name": "weird",
+                          "shapes": {(1, 2): 3},
+                          "blob": object()})
+        assert rid is not None
+        rec = led.get(rid)
+        assert rec["shapes"] == {"(1, 2)": 3}
+        (idx,) = led.query()
+        assert idx["id"] == rid  # the index line parsed too
+
+    def test_disabled_ledger_noop(self, tmp_path):
+        assert ledger.NULL_LEDGER.record({"kind": "x", "name": "y"}) \
+            is None
+        assert ledger.NULL_LEDGER.query() == []
+        # ambient default starts disabled (no env opt-in in tests)
+        assert ledger.record_result("checker", "n", {"valid?": True}) \
+            is None
+
+
+class TestResultBuilder:
+    def test_summarize_result(self):
+        res = {"valid?": False, "cause": None, "op_count": 100,
+               "W": 7, "K": 16, "configs_explored": 1234,
+               "util": {"configs_per_s": 5000, "rounds": 9,
+                        "frontier_fill": 0.5, "weird": object()},
+               "telemetry": {"chunks": [{"poll_s": 0.25},
+                                        {"poll_s": 0.75}]}}
+        s = ledger.summarize_result(res)
+        assert s["verdict"] is False
+        assert s["shapes"] == {"W": 7, "K": 16,
+                               "configs_explored": 1234}
+        assert s["util"]["configs_per_s"] == 5000
+        assert "weird" not in s["util"]
+        assert s["telemetry"] == {"chunks": 2}
+        # device-seconds: the summed per-chunk poll walls
+        assert s["device_s"] == 1.0
+
+    def test_device_seconds_elle_kernel(self):
+        assert ledger.device_seconds(
+            {"util": {"kernel_s": 0.125}}) == 0.125
+        assert ledger.device_seconds({"valid?": True}) is None
+
+    def test_record_result(self, tmp_path):
+        led = mk(tmp_path)
+        rid = led.record_result(
+            "checker", "demo",
+            {"valid?": True, "op_count": 10, "engine": "device"},
+            wall_s=2.5, model="CASRegister", platform="cpu",
+            artifacts={"trace": "demo/t/trace.jsonl"},
+            extra={"algorithm": "competition"})
+        rec = led.get(rid)
+        assert rec["model"] == "CASRegister"
+        assert rec["engine"] == "device"
+        assert rec["algorithm"] == "competition"
+        assert rec["artifacts"]["trace"] == "demo/t/trace.jsonl"
+        assert rec["wall_s"] == 2.5
+
+
+class TestConcurrentWriters:
+    def test_parallel_appends_never_tear(self, tmp_path):
+        led = mk(tmp_path)
+        n_threads, per = 8, 20
+
+        def writer(t):
+            for i in range(per):
+                led.record({"kind": "checker", "name": f"w{t}-{i}",
+                            "verdict": True, "wall_s": 0.01})
+
+        threads = [threading.Thread(target=writer, args=(t,))
+                   for t in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        # every index line parses and every record is queryable
+        with open(led.index_path) as fh:
+            lines = [ln for ln in fh if ln.strip()]
+        assert len(lines) == n_threads * per
+        for ln in lines:
+            json.loads(ln)
+        recs = led.query(kind="checker")
+        assert len(recs) == n_threads * per
+        assert len({r["id"] for r in recs}) == n_threads * per
+
+
+class TestAggregate:
+    def test_device_seconds_and_verdicts(self, tmp_path):
+        led = mk(tmp_path)
+        led.record({"kind": "checker", "name": "a", "model": "Reg",
+                    "engine": "device", "verdict": True,
+                    "wall_s": 1.0, "device_s": 0.4, "compiles": 2})
+        led.record({"kind": "checker", "name": "b", "model": "Reg",
+                    "engine": "device", "verdict": False,
+                    "wall_s": 3.0, "device_s": 0.6})
+        led.record({"kind": "checker", "name": "c", "model": "Mutex",
+                    "engine": "oracle", "verdict": "unknown",
+                    "wall_s": 2.0, "stalls": 1})
+        agg = led.aggregate()
+        assert agg["runs"] == 3
+        assert agg["verdicts"] == {"true": 1, "false": 1,
+                                   "unknown": 1}
+        assert agg["device_s"]["total"] == pytest.approx(1.0)
+        assert agg["device_s"]["by_model"]["Reg"] == pytest.approx(1.0)
+        assert agg["device_s"]["by_engine"]["device"] == \
+            pytest.approx(1.0)
+        assert agg["wall_s"]["p50"] == 2.0
+        assert agg["wall_s"]["max"] == 3.0
+        assert agg["compiles"] == 2
+        assert agg["stalls"] == 1
+
+    def test_filtered_aggregate(self, tmp_path):
+        led = mk(tmp_path)
+        led.record({"kind": "bench", "name": "x", "wall_s": 1.0})
+        led.record({"kind": "run", "name": "y", "wall_s": 9.0})
+        assert led.aggregate(kind="bench")["runs"] == 1
+
+
+class TestGeneralizedRegressions:
+    def test_flags_same_platform_slowdown(self, tmp_path):
+        led = mk(tmp_path)
+        for i, wall in enumerate((1.0, 1.1, 2.0)):
+            led.record({"kind": "bench", "name": "mutex_1k",
+                        "platform": "cpu", "wall_s": wall,
+                        "t": 100.0 + i})
+        rep = led.regressions(threshold=1.5)
+        row = rep["groups"]["mutex_1k@cpu"]
+        assert row["best_prior"] == 1.0
+        assert row["ratio_vs_best"] == 2.0
+        assert row["regressed"] is True
+        assert rep["regressions"] == ["mutex_1k"]
+
+    def test_cross_platform_not_compared(self, tmp_path):
+        led = mk(tmp_path)
+        led.record({"kind": "bench", "name": "mutex_1k",
+                    "platform": "tpu", "wall_s": 0.1, "t": 100.0})
+        led.record({"kind": "bench", "name": "mutex_1k",
+                    "platform": "cpu", "wall_s": 9.0, "t": 101.0})
+        rep = led.regressions(threshold=1.5)
+        assert rep["regressions"] == []
+        assert rep["groups"]["mutex_1k@cpu"]["runs"] == 1
+
+
+class TestBenchRoundsFromLedger:
+    def test_merge_with_glob_fallback(self, tmp_path):
+        sys.path.insert(0, REPO)
+        import bench
+
+        # a pre-ledger round on disk (the glob path)
+        with open(tmp_path / "BENCH_r01.json", "w") as fh:
+            json.dump({"parsed": {"value": 2.0, "platform": "cpu",
+                                  "verdict": True,
+                                  "configs": {"mutex_1k": 5.0}}}, fh)
+        # a newer round in the ledger, plus a ledger OVERRIDE of r01
+        led = ledger.Ledger(str(tmp_path / "store"))
+        led.record({"kind": "bench-round", "name": "m", "round": 1,
+                    "value": 1.9, "platform": "cpu", "verdict": True,
+                    "configs": {"mutex_1k": 4.5}})
+        led.record({"kind": "bench-round", "name": "m", "round": 2,
+                    "value": 1.5, "platform": "cpu", "verdict": True,
+                    "configs": {"mutex_1k": 4.0}})
+        rounds = bench.load_bench_rounds(str(tmp_path))
+        assert [r["round"] for r in rounds] == [1, 2]
+        # the ledger record wins the round-1 collision
+        assert rounds[0]["value"] == 1.9
+        assert rounds[0]["source"] == "ledger"
+        assert rounds[1]["configs"] == {"mutex_1k": 4.0}
+        # and the regression math runs over the merged sequence
+        rep = bench.compute_regressions(rounds)
+        assert rep["configs"]["mutex_1k"]["latest"] == 4.0
+
+    def test_glob_only_when_no_ledger(self, tmp_path):
+        sys.path.insert(0, REPO)
+        import bench
+
+        with open(tmp_path / "BENCH_r03.json", "w") as fh:
+            json.dump({"parsed": {"value": 3.0, "platform": "cpu",
+                                  "verdict": True, "configs": {}}}, fh)
+        rounds = bench.load_bench_rounds(str(tmp_path))
+        assert [(r["round"], r["source"]) for r in rounds] == \
+            [(3, "glob")]
+
+
+class TestCheckerLedgerRecording:
+    def test_linearizable_appends_record(self, tmp_path):
+        from jepsen_tpu import checker, models, synth
+        led = mk(tmp_path)
+        h = synth.cas_register_history(30, n_procs=3, seed=1)
+        with ledger.use(led):
+            res = checker.linearizable(
+                models.cas_register(), algorithm="wgl").check(
+                {"name": "led-demo"}, h, {})
+        assert res["valid?"] is True
+        (rec,) = led.query(kind="checker")
+        assert rec["name"] == "led-demo"
+        assert rec["model"] == "CASRegister"
+        assert rec["algorithm"] == "wgl"
+        assert rec["verdict"] is True
+        assert rec["wall_s"] > 0
+
+    def test_per_key_and_anonymous_checks_not_recorded(self, tmp_path):
+        """The independent fan-out records ONE kind="independent"
+        entry — its per-key sub-checks (opts carries history_key) and
+        anonymous internal calls (no test name; bench configs record
+        their own kind="bench" entry) must not each append a
+        kind="checker" record, or aggregate() double-counts
+        device-seconds and regressions() groups run-level walls with
+        per-key walls."""
+        from jepsen_tpu import checker, independent, models, synth
+        from jepsen_tpu.history import History
+        led = mk(tmp_path)
+        ops = []
+        for k in range(3):
+            sub = synth.cas_register_history(20, n_procs=2, seed=k)
+            for op in sub:
+                # disjoint process ids per key: the merged history
+                # must stay well-formed (no cross-key double-invoke)
+                ops.append(op.with_(
+                    value=independent.tuple_(k, op.value),
+                    process=op.process + 10 * k))
+        h = History(sorted(ops, key=lambda o: o.time or 0)).index()
+        chk = independent.checker(checker.linearizable(
+            models.cas_register(), algorithm="wgl"))
+        with ledger.use(led):
+            out = chk.check({"name": "fanout"}, h, {})
+            # anonymous top-level call: nothing to group it under
+            checker.linearizable(
+                models.cas_register(), algorithm="wgl").check(
+                {}, synth.cas_register_history(10, n_procs=2, seed=9),
+                {})
+        assert out["valid?"] is True
+        assert led.query(kind="checker") == []
+        (rec,) = led.query(kind="independent")
+        assert rec["name"] == "fanout"
+        assert rec["keys"] == 3
+
+    def test_core_run_records_run_and_perfetto(self, tmp_path):
+        from jepsen_tpu import checker, core, fakes
+        from jepsen_tpu import generator as gen
+        root = str(tmp_path)
+        tracer = trace.Tracer(sampled=True)
+        test = core.run({
+            "name": "ledger-run",
+            "store_root": root,
+            "nodes": ["n1"],
+            "concurrency": 1,
+            "ssh": {"dummy?": True},
+            "client": trace.TracedClient(
+                fakes.AtomClient(fakes.SharedRegister()), tracer),
+            "checker": checker.stats(),
+            "tracer": tracer,
+            "generator": gen.limit(5, gen.clients(
+                gen.repeat(lambda: {"f": "read"}))),
+        })
+        assert test["results"]["valid?"] is True
+        led = ledger.Ledger(root)
+        (rec,) = led.query(kind="run")
+        assert rec["name"] == "ledger-run"
+        assert rec["verdict"] is True
+        assert rec["stalls"] == 0
+        # the run dir artifact pointers resolve, incl. the Perfetto
+        # export written next to trace.jsonl
+        pf = os.path.join(root, rec["artifacts"]["perfetto"])
+        assert os.path.isfile(pf)
+        doc = json.load(open(pf))
+        assert isinstance(doc["traceEvents"], list)
+        assert any(e["ph"] == "X" for e in doc["traceEvents"])
+
+
+# --- /runs web surfaces -----------------------------------------------------
+
+@pytest.fixture(scope="module")
+def runs_store(tmp_path_factory):
+    root = str(tmp_path_factory.mktemp("runsstore"))
+    led = ledger.Ledger(root)
+    tr = trace.Tracer(sampled=True)
+    with tr.span("check linearizable"):
+        with tr.span("device-round", attrs={"chunk": 0}):
+            tr.annotate("poll")
+    run_dir = os.path.join(root, "demo", "t1")
+    os.makedirs(run_dir)
+    tr.export(os.path.join(run_dir, "trace.jsonl"))
+    rid = led.record({"kind": "run", "name": "demo", "verdict": True,
+                      "wall_s": 1.5,
+                      "artifacts": {"trace": "demo/t1/trace.jsonl"}})
+    led.record_result("bench", "mutex_1k",
+                      {"valid?": "unknown", "cause": "timeout",
+                       "op_count": 1000},
+                      wall_s=4.2, platform="cpu")
+    return root, rid
+
+
+@pytest.fixture(scope="module")
+def runs_url(runs_store):
+    from jepsen_tpu import web
+    root, rid = runs_store
+    server = web.serve(host="127.0.0.1", port=0, store_root=root)
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{server.server_port}", rid
+    server.shutdown()
+
+
+def _get(url, expect=200):
+    try:
+        resp = urllib.request.urlopen(url, timeout=10)
+        assert resp.status == expect
+        return resp.read()
+    except urllib.error.HTTPError as e:
+        assert e.code == expect
+        return e.read()
+
+
+class TestWebRuns:
+    def test_runs_json_lists_records(self, runs_url):
+        base, rid = runs_url
+        runs = json.loads(_get(base + "/runs.json"))
+        assert len(runs) == 2
+        assert {r["kind"] for r in runs} == {"run", "bench"}
+
+    def test_runs_html_table(self, runs_url):
+        base, rid = runs_url
+        body = _get(base + "/runs").decode()
+        assert "run ledger" in body
+        assert rid in body
+        assert "mutex_1k" in body
+        assert "device-seconds" in body  # the aggregate header row
+
+    def test_run_detail_json_and_html(self, runs_url):
+        base, rid = runs_url
+        rec = json.loads(_get(f"{base}/runs/{rid}.json"))
+        assert rec["id"] == rid
+        assert rec["verdict"] is True
+        body = _get(f"{base}/runs/{rid}").decode()
+        assert "perfetto.json" in body
+        assert "trace" in body
+
+    def test_run_perfetto_conversion(self, runs_url):
+        base, rid = runs_url
+        doc = json.loads(_get(f"{base}/runs/{rid}/perfetto.json"))
+        evs = doc["traceEvents"]
+        names = {e["name"] for e in evs if e["ph"] == "X"}
+        assert {"check linearizable", "device-round"} <= names
+        # nested spans share a thread lane; annotation rides along
+        assert any(e["ph"] == "i" for e in evs)
+
+    def test_unknown_run_404(self, runs_url):
+        base, _ = runs_url
+        _get(base + "/runs/nope-123", expect=404)
+        _get(base + "/runs/nope-123/perfetto.json", expect=404)
+
+    def test_status_json_last_runs(self, runs_url):
+        base, rid = runs_url
+        snap = json.loads(_get(base + "/status.json"))
+        ids = [r["id"] for r in snap["last_runs"]]
+        assert rid in ids
+        # newest first, compact projection only
+        assert "results" not in snap["last_runs"][0]
+
+
+class TestLedgerLint:
+    def test_index_lints_clean(self, tmp_path):
+        led = mk(tmp_path)
+        led.record_result("checker", "demo", {"valid?": True},
+                          wall_s=0.5)
+        proc = subprocess.run(
+            [sys.executable, LINT, led.index_path],
+            capture_output=True, text=True)
+        assert proc.returncode == 0, proc.stderr
+
+    def test_record_files_linted_too(self, tmp_path):
+        """ledger/records/<id>.json is the source of truth — passing
+        the ledger dir must lint the record files, not just the
+        index (a drifted record must not pass the gate)."""
+        led = mk(tmp_path)
+        rid = led.record({"kind": "run", "name": "ok"})
+        ok = subprocess.run(
+            [sys.executable, LINT, led.record_path(rid)],
+            capture_output=True, text=True)
+        assert ok.returncode == 0, ok.stderr
+        bad = os.path.join(led.records_dir, "bad.json")
+        with open(bad, "w") as fh:
+            json.dump({"schema": 1, "id": "bad", "t": 1.0}, fh)
+        drift = subprocess.run([sys.executable, LINT, bad],
+                               capture_output=True, text=True)
+        assert drift.returncode == 1
+        assert "kind" in drift.stderr
+
+    def test_drifted_record_flagged(self, tmp_path):
+        p = tmp_path / "ledger-index.jsonl"
+        p.write_text(json.dumps(
+            {"schema": 1, "id": "x", "name": "y", "t": 1.0,
+             "verdict": 17}) + "\n")  # kind missing, verdict mistyped
+        proc = subprocess.run([sys.executable, LINT, str(p)],
+                              capture_output=True, text=True)
+        assert proc.returncode == 1
+        assert "kind" in proc.stderr
+        assert "verdict" in proc.stderr
+
+    def test_perfetto_export_lints_clean(self, tmp_path):
+        tr = trace.Tracer(sampled=True)
+        with tr.span("a"):
+            tr.annotate("x")
+        p = str(tmp_path / "run.perfetto.json")
+        tr.export_perfetto(p)
+        proc = subprocess.run([sys.executable, LINT, p],
+                              capture_output=True, text=True)
+        assert proc.returncode == 0, proc.stderr
+
+    def test_bad_perfetto_flagged(self, tmp_path):
+        p = tmp_path / "bad.perfetto.json"
+        p.write_text(json.dumps({"traceEvents": [
+            {"ph": "X", "name": "n", "pid": 1, "tid": 1, "ts": 1.0},
+            {"ph": "Z", "name": "n", "pid": 1, "tid": 1}]}))
+        proc = subprocess.run([sys.executable, LINT, str(p)],
+                              capture_output=True, text=True)
+        assert proc.returncode == 1
+        assert "dur" in proc.stderr  # X without dur
+        assert "Z" in proc.stderr    # unknown phase
+
+    def test_span_jsonl_lints_as_spans(self, tmp_path):
+        """Exported trace streams are span lines, not metrics lines —
+        the linter must route *trace*.jsonl to the span schema (a
+        bench round's bench_trace.jsonl previously tripped the
+        unknown-line-type rule)."""
+        tr = trace.Tracer(sampled=True)
+        with tr.span("a"):
+            pass
+        p = str(tmp_path / "bench_trace.jsonl")
+        tr.export(p)
+        proc = subprocess.run([sys.executable, LINT, p],
+                              capture_output=True, text=True)
+        assert proc.returncode == 0, proc.stderr
