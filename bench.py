@@ -53,7 +53,10 @@ JEPSEN_TPU_BENCH_KEYS / _PER_KEY (independent config, default 100x2000),
 JEPSEN_TPU_BENCH_REGRESSION_X (default 1.5 — flag a config whose wall
 exceeds this multiple of its best same-platform prior round; the trend
 report lands in artifacts/telemetry/regressions.json +
-bench-trajectory.png).
+bench-trajectory.png), JEPSEN_TPU_BENCH_FILL_TARGET (default 0.8 —
+ROADMAP item 5's frontier-fill target; the per-config utilization
+report lands in artifacts/telemetry/occupancy.json with fills below
+0.9x the best same-platform prior flagged via the ledger).
 """
 
 from __future__ import annotations
@@ -184,6 +187,14 @@ def _config_entry(res: dict, wall: float) -> dict:
               "oracle_row"):
         if res.get(k) is not None:
             out[k] = res[k]
+    occ = res.get("occupancy")
+    if isinstance(occ, dict):
+        # the compact per-config view: fill/roofline without the
+        # per-round rows (those stay in the telemetry series)
+        out["occupancy"] = {k: occ.get(k) for k in
+                            ("kernel", "K", "rounds_total",
+                             "rounds_dropped", "fill", "memo",
+                             "roofline")}
     return out
 
 
@@ -730,6 +741,7 @@ def run_bench() -> tuple[dict, int]:
            "cold_s": round(cold_s, 3),
            "configs_explored": res.get("configs_explored"),
            "util": res.get("util"),
+           "occupancy": res.get("occupancy"),
            "telemetry": res.get("telemetry"),
            "probe_diagnostics": probe_diags}
     if guard_reports:
@@ -861,9 +873,15 @@ def _export_telemetry(out: dict) -> None:
             _TRACER.export(os.path.join(art, "bench_trace.jsonl"))
             files.append("artifacts/telemetry/bench_trace.jsonl")
             # the same spans in Chrome/Perfetto trace_event form —
-            # drop into ui.perfetto.dev (doc/OBSERVABILITY.md)
+            # drop into ui.perfetto.dev (doc/OBSERVABILITY.md) —
+            # with the occupancy fill/frontier/backlog series as
+            # counter tracks under the spans
+            from jepsen_tpu import occupancy as occupancy_mod
+            counters = (occupancy_mod.perfetto_counter_tracks(
+                _REGISTRY) if _REGISTRY is not None else None)
             _TRACER.export_perfetto(
-                os.path.join(art, "bench_trace.perfetto.json"))
+                os.path.join(art, "bench_trace.perfetto.json"),
+                counters=counters)
             files.append(
                 "artifacts/telemetry/bench_trace.perfetto.json")
     except OSError:
@@ -1065,6 +1083,107 @@ def _export_regressions(out: dict) -> None:
         traceback.print_exc(file=sys.stderr)
 
 
+def _export_occupancy(out: dict) -> None:
+    """The per-config utilization report (ROADMAP item 5: >0.8
+    frontier fill becomes a TRACKED number): frontier fill / memo hit
+    rate / roofline per config into artifacts/telemetry/
+    occupancy.json, fill regressions flagged against the best
+    same-platform prior round read back from the ledger
+    (kind="bench-occupancy" — this round banks one so the next can
+    compare), and the round x lane heatmap of the batched fan-out
+    rendered beside it. Never raises — the JSON-line contract
+    outranks the report."""
+    try:
+        from jepsen_tpu import occupancy as occupancy_mod
+        target = float(os.environ.get("JEPSEN_TPU_BENCH_FILL_TARGET",
+                                      str(occupancy_mod.TARGET_FILL)))
+        plat = out.get("platform")
+        configs: dict = {}
+
+        def row(name, util, occ=None):
+            if not isinstance(util, dict):
+                return
+            r = {k: util[k] for k in
+                 ("frontier_fill", "memo_hit_rate", "configs_per_s",
+                  "rounds") if util.get(k) is not None}
+            if isinstance(occ, dict):
+                for k in ("fill", "roofline", "rounds_dropped", "K",
+                          "kernel"):
+                    if occ.get(k) is not None:
+                        r[k] = occ[k]
+            if r.get("frontier_fill") is None:
+                return
+            r["meets_target"] = bool(r["frontier_fill"] >= target)
+            configs[name] = r
+
+        row(out.get("metric") or "headline", out.get("util"),
+            out.get("occupancy"))
+        for name, c in (out.get("configs") or {}).items():
+            if isinstance(c, dict):
+                row(name, c.get("util"), c.get("occupancy"))
+        if not configs:
+            return
+        report = {"schema": 1, "target_fill": target,
+                  "platform": plat, "configs": configs,
+                  "below_target": sorted(
+                      n for n, r in configs.items()
+                      if not r["meets_target"]),
+                  "fill_regressions": []}
+        # fill regression: latest fill below 0.9x the best prior
+        # same-platform fill — a perf PR that wins wall time by
+        # emptying the lanes still gets flagged
+        try:
+            if _LEDGER is not None and _LEDGER.enabled:
+                best: dict = {}
+                for rec in _LEDGER.query(kind="bench-occupancy"):
+                    if rec.get("platform") != plat:
+                        continue
+                    for name, fill in (rec.get("configs") or {}).items():
+                        if isinstance(fill, (int, float)):
+                            best[name] = max(best.get(name, 0.0), fill)
+                for name, r in configs.items():
+                    prior = best.get(name)
+                    if prior and r["frontier_fill"] < 0.9 * prior:
+                        r["best_prior_fill"] = prior
+                        report["fill_regressions"].append(name)
+                _LEDGER.record({
+                    "kind": "bench-occupancy",
+                    "name": out.get("metric") or "bench",
+                    "platform": plat,
+                    "configs": {n: r["frontier_fill"]
+                                for n, r in configs.items()}})
+        except Exception:  # noqa: BLE001 — a torn ledger never hides
+            traceback.print_exc(file=sys.stderr)  # the report itself
+        art = os.path.join(REPO_ROOT, "artifacts", "telemetry")
+        os.makedirs(art, exist_ok=True)
+        with open(os.path.join(art, "occupancy.json"), "w") as fh:
+            json.dump(report, fh, indent=1)
+        files = ["artifacts/telemetry/occupancy.json"]
+        # round x lane heatmap of the batched fan-out, when the run
+        # recorded one (the independent config's straggler view)
+        if _REGISTRY is not None:
+            pts = _REGISTRY.series("wgl_batched_rounds").points
+            if pts:
+                from jepsen_tpu.checker import plots
+                png = plots.occupancy_heatmap(
+                    {"name": "bench batched"}, pts,
+                    out_path=os.path.join(art,
+                                          "occupancy-heatmap.png"))
+                if png:
+                    files.append(
+                        "artifacts/telemetry/occupancy-heatmap.png")
+        out["occupancy_report"] = {
+            "target_fill": target,
+            "below_target": report["below_target"],
+            "fill_regressions": report["fill_regressions"],
+            "files": files}
+        if report["fill_regressions"]:
+            print(f"FILL REGRESSION flagged (< 0.9x best prior): "
+                  f"{report['fill_regressions']}", file=sys.stderr)
+    except Exception:  # noqa: BLE001
+        traceback.print_exc(file=sys.stderr)
+
+
 def emit(out: dict) -> None:
     """The stdout contract is ONE parseable JSON line — and the
     driver records only a bounded TAIL of output, so a huge line gets
@@ -1075,6 +1194,7 @@ def emit(out: dict) -> None:
     fits the window."""
     _export_telemetry(out)
     _export_regressions(out)
+    _export_occupancy(out)
     try:
         with open(DETAILS_PATH, "w") as f:
             json.dump(out, f, indent=1)
@@ -1084,7 +1204,7 @@ def emit(out: dict) -> None:
     compact = {k: out.get(k) for k in
                ("metric", "value", "unit", "vs_baseline", "verdict",
                 "platform", "cold_s", "terminated", "error", "cause",
-                "tpu_measured", "regressions",
+                "tpu_measured", "regressions", "occupancy_report",
                 "compile_budget_exceeded")
                if out.get(k) is not None}
     aot = out.get("tpu_aot")

@@ -320,12 +320,22 @@ class Linearizable(Checker):
         if (res.get("telemetry") or {}).get("chunks") \
                 and (test or {}).get("name"):
             # telemetry-enabled device runs get a search-progress
-            # panel next to the latency/rate plots
+            # panel (with the per-round fill overlay) next to the
+            # latency/rate plots, plus the occupancy heatmap
             from . import plots
+            occ = res.get("occupancy") or {}
             p = plots.search_progress_graph(
-                test, res["telemetry"]["chunks"], opts)
+                test, res["telemetry"]["chunks"], opts,
+                rounds=occ.get("rounds"))
             if p:
                 res["search-progress-png"] = p
+            if occ.get("rounds"):
+                from .. import occupancy as occupancy_mod
+                hp = plots.occupancy_heatmap(
+                    test, occupancy_mod.heatmap_points(occ["rounds"]),
+                    opts)
+                if hp:
+                    res["occupancy-heatmap-png"] = hp
         return res
 
 

@@ -184,14 +184,18 @@ def quantiles_graph(test, history, opts=None, pts=None) -> Optional[str]:
     return out
 
 
-def search_progress_graph(test, chunks, opts=None) -> Optional[str]:
+def search_progress_graph(test, chunks, opts=None,
+                          rounds=None) -> Optional[str]:
     """search-progress.png: the WGL device search's own trajectory
     from the per-chunk telemetry timeseries (metrics.py `wgl_chunks`
     points / a result's `telemetry.chunks`): frontier + backlog
     occupancy, cumulative configs explored with the per-poll
     exploration rate, and the memo-table hit rate, all over search
-    wall clock. Never raises — a malformed point list must not mask
-    the verdict it rides along with."""
+    wall clock. `rounds` (a result's `occupancy.rounds` — per-round
+    drained counters) overlays per-round frontier FILL on the
+    hit-rate panel, so the progress graph shows occupancy alongside
+    configs_explored. Never raises — a malformed point list must not
+    mask the verdict it rides along with."""
     try:
         pts = [p for p in (chunks or []) if "wall_s" in p]
         if not pts:
@@ -233,8 +237,22 @@ def search_progress_graph(test, chunks, opts=None) -> Optional[str]:
         ax.plot(t, [p.get("memo_hit_rate", 0) for p in pts],
                 marker="o", markersize=3, lw=1, color=Q_COLORS[0.99],
                 label="memo hit rate")
+        rpts = [r for r in (rounds or [])
+                if r.get("wall_s") is not None
+                and r.get("fill") is not None]
+        if rpts:
+            # per-round frontier fill (occupancy plane) on the same
+            # 0..1 axis — the ROADMAP item-5 target line included
+            from .. import occupancy as occupancy_mod
+            target = occupancy_mod.TARGET_FILL
+            ax.plot([r["wall_s"] for r in rpts],
+                    [r["fill"] for r in rpts], lw=1,
+                    color=TYPE_COLORS["fail"], alpha=0.7,
+                    label="frontier fill (per round)")
+            ax.axhline(target, lw=0.8, ls=":", color="#888888",
+                       label=f"fill target {target}")
         ax.set_ylim(0, 1)
-        ax.set_ylabel("hit rate")
+        ax.set_ylabel("hit rate / fill")
         ax.set_xlabel("Search wall clock (s)")
         ax.legend(loc="upper right", fontsize=7)
 
@@ -243,6 +261,65 @@ def search_progress_graph(test, chunks, opts=None) -> Optional[str]:
         return out
     except Exception:  # noqa: BLE001
         log.warning("search-progress rendering failed", exc_info=True)
+        return None
+
+
+def occupancy_heatmap(test, points, opts=None,
+                      filename="occupancy-heatmap.png",
+                      out_path: Optional[str] = None) -> Optional[str]:
+    """occupancy-heatmap.png: frontier fill as a (lane x round) grid
+    from occupancy points [{"round", "lane", "fill"}] — the
+    single-search view is a 1-lane strip (occupancy.heatmap_points),
+    the mesh-batched fan-out one lane per key (`wgl_batched_rounds`
+    series), where stragglers show up as long hot rows and empty
+    lanes as cold ones. `out_path` renders to an explicit file (the
+    bench's artifact tree) instead of the test's store dir. Never
+    raises — occupancy rendering must not mask a verdict."""
+    try:
+        pts = [p for p in (points or [])
+               if isinstance(p, dict)
+               and isinstance(p.get("round"), int) and p["round"] >= 0
+               and isinstance(p.get("lane"), int) and p["lane"] >= 0
+               and isinstance(p.get("fill"), (int, float))]
+        if not pts:
+            return None
+        plt = _plt()
+        rounds = sorted({p["round"] for p in pts})
+        lanes = sorted({p["lane"] for p in pts})
+        ridx = {r: i for i, r in enumerate(rounds)}
+        lidx = {la: i for i, la in enumerate(lanes)}
+        grid = np.full((len(lanes), len(rounds)), np.nan)
+        for p in pts:
+            grid[lidx[p["lane"]], ridx[p["round"]]] = p["fill"]
+        fig, ax = plt.subplots(
+            figsize=(10, max(2.0, 0.25 * len(lanes) + 1.5)))
+        im = ax.imshow(grid, aspect="auto", origin="lower",
+                       interpolation="nearest", vmin=0.0, vmax=1.0,
+                       cmap="viridis",
+                       extent=(rounds[0] - 0.5, rounds[-1] + 0.5,
+                               -0.5, len(lanes) - 0.5))
+        ax.set_xlabel("round")
+        ax.set_ylabel("lane" if len(lanes) > 1 else "")
+        if len(lanes) > 1:
+            ax.set_yticks(range(len(lanes)))
+            ax.set_yticklabels([str(la) for la in lanes], fontsize=6)
+        else:
+            ax.set_yticks([])
+        ax.set_title(f"{(test or {}).get('name', '')} frontier fill "
+                     f"(round x lane)")
+        fig.colorbar(im, ax=ax, label="fill")
+        if out_path:
+            parent = os.path.dirname(out_path)
+            if parent:
+                os.makedirs(parent, exist_ok=True)
+            fig.savefig(out_path, dpi=90, bbox_inches="tight")
+            plt.close(fig)
+            return out_path
+        out = _save(fig, test, opts, filename)
+        plt.close(fig)
+        return out
+    except Exception:  # noqa: BLE001
+        log.warning("occupancy-heatmap rendering failed", exc_info=True)
         return None
 
 

@@ -257,9 +257,15 @@ def run(test: dict) -> dict:
                 # the same spans in Chrome/Perfetto trace_event form:
                 # drop the file in ui.perfetto.dev and the run's
                 # encode/compile/device-round/fan-out phases render as
-                # a flame chart (doc/OBSERVABILITY.md walkthrough)
-                tracer.export_perfetto(os.path.join(
-                    writer.dir, "trace.perfetto.json"))
+                # a flame chart (doc/OBSERVABILITY.md walkthrough) —
+                # with the occupancy plane's fill/frontier/backlog
+                # series embedded as counter tracks under the spans
+                from . import metrics as metrics_mod
+                from . import occupancy as occupancy_mod
+                tracer.export_perfetto(
+                    os.path.join(writer.dir, "trace.perfetto.json"),
+                    counters=occupancy_mod.perfetto_counter_tracks(
+                        metrics_mod.get_default()))
                 log.info("Exported %d spans", n)
                 root = test.get("store_root") or store.BASE_DIR
                 artifacts = {

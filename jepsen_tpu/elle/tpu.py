@@ -91,12 +91,24 @@ def make_closure_kernel(n_pad: int, n_sub: int, iters: int, dtype):
         eye = jnp.eye(n_pad, dtype=dtype)
         reach = jnp.maximum(adj, eye[None])
 
-        def square(_, r):
+        # per-iteration frontier of the label propagation: reachable
+        # pair count per subset after each squaring — the closure's
+        # occupancy counters, returned with the verdict outputs so
+        # they ride the SAME device->host fetch (no extra transfer,
+        # doc/OBSERVABILITY.md "Occupancy & roofline")
+        counts0 = jnp.zeros((iters, n_sub), jnp.int32)
+
+        def square(i, st):
+            r, cnt = st
             prod = jnp.einsum("sij,sjk->sik", r, r,
                               preferred_element_type=jnp.float32)
-            return (prod > 0).astype(dtype)
+            r2 = (prod > 0).astype(dtype)
+            cnt = cnt.at[i].set(
+                jnp.sum((r2 > 0).astype(jnp.int32), axis=(1, 2)))
+            return r2, cnt
 
-        reach = jax.lax.fori_loop(0, iters, square, reach)
+        reach, counts = jax.lax.fori_loop(0, iters, square,
+                                          (reach, counts0))
         rb = reach > 0
         mutual = rb & jnp.swapaxes(rb, 1, 2)
         cols = jnp.arange(n_pad, dtype=jnp.int32)
@@ -104,7 +116,7 @@ def make_closure_kernel(n_pad: int, n_sub: int, iters: int, dtype):
                            n_pad).min(axis=2)
         # rw-closure queries: path q_dst -> q_src under each subset
         closed = rb[:, q_dst, q_src]
-        return labels.astype(jnp.int32), closed
+        return labels.astype(jnp.int32), closed, counts
 
     return kernel
 
@@ -214,17 +226,34 @@ def cycle_queries(g: DepGraph,
     with wd.watch("elle-closure", device="tpu",
                   stall_s=300.0) as hb:
         wd.beat(hb, edges=int(len(src)), n=n, n_pad=n_pad, iters=iters)
-        labels, closed = kernel(*ins)
-        jax.block_until_ready((labels, closed))
+        labels, closed, iter_counts = kernel(*ins)
+        jax.block_until_ready((labels, closed, iter_counts))
     kernel_s = _t.monotonic() - t0
     # Achieved matmul throughput vs the flop model in the module
     # docstring: iters squarings x n_sub batched (n_pad)^3 matmuls.
     flops = 2.0 * n_sub * iters * float(n_pad) ** 3
+    # per-iteration frontier (occupancy plane): reachable-pair counts
+    # per subset after each squaring, and the first iteration at
+    # which the widest subset's closure stopped growing — iterations
+    # past it are pure wasted MXU work an early-exit variant could
+    # reclaim (ROADMAP item 2)
+    iter_counts = np.asarray(iter_counts)         # (iters, n_sub)
+    iter_reach = [[int(v) for v in row] for row in iter_counts]
+    widest = iter_counts[:, -1]
+    converged_at = int(iters)
+    for i in range(1, iters):
+        if widest[i] == widest[i - 1]:
+            converged_at = i
+            break
     util = {"n_pad": n_pad, "iters": iters,
             "kernel_s": round(kernel_s, 4),
             "compile_s": round(compile_s, 3),
             "achieved_tflops": round(flops / 1e12 / max(kernel_s, 1e-9),
-                                     2)}
+                                     2),
+            "iter_reach": iter_reach,
+            "converged_at": converged_at,
+            "reach_density": round(
+                float(widest[-1]) / float(n_pad) ** 2, 6)}
     from .. import metrics as _metrics
     mx = _metrics.get_default()
     if mx.enabled:
@@ -240,7 +269,9 @@ def cycle_queries(g: DepGraph,
             kernel_s)
     labels = np.asarray(labels)[:, :n]
     closed = np.asarray(closed)[:, :len(rw_edges)]
-    _guards.note_transfer("d2h", labels.nbytes + closed.nbytes,
+    _guards.note_transfer("d2h",
+                          labels.nbytes + closed.nbytes
+                          + iter_counts.nbytes,
                           what="elle-closure-outputs")
 
     sccs: list = []

@@ -270,6 +270,11 @@ class RunStatus:
             "ops": {"invoked": 0, "completed": 0},
             "faults": [],
             "watchdog": {"stalls": 0, "last_source": None},
+            "occupancy": {"active": False, "mode": None,
+                          "kernel": None, "platform": None, "K": None,
+                          "fill_last": None, "fill_mean": None,
+                          "rounds_seen": 0, "rounds_dropped": 0,
+                          "lanes": None, "recent": []},
         }
 
     # -- writers ------------------------------------------------------
@@ -413,6 +418,38 @@ class RunStatus:
             if len(prev_map) > 64:  # bounded: drop the oldest search
                 prev_map.pop(next(iter(prev_map)))
             self._d["search"] = p
+            self._touch_locked()
+        self._after()
+
+    def occupancy_poll(self, block: dict, search_id=None) -> None:
+        """One kernel-occupancy update (doc/OBSERVABILITY.md
+        "Occupancy & roofline"): the WGL poll loop reports last/mean
+        frontier fill plus a window of recent per-round points
+        (`recent_rounds`, folded into a bounded `recent` window the
+        /occupancy panel renders); the batched fan-out reports a
+        per-poll `lanes` summary instead. `search_id` keys the
+        recent-rounds bookkeeping, same contract as `search_poll`:
+        concurrent searches (streamed workers, raced lanes) each
+        accumulate their OWN window — the scalar fields show the
+        last poller (as the `search` block does), but its `recent`
+        strip is never interleaved with another search's rounds."""
+        if not self.enabled:
+            return
+        with self._lock:
+            o = self._d["occupancy"]
+            pts = block.pop("recent_rounds", None)
+            buf_map = getattr(self, "_occ_recent", None)
+            if buf_map is None:
+                buf_map = self._occ_recent = {}
+            buf = buf_map.setdefault(search_id, [])
+            if pts:
+                buf.extend(pts)
+                del buf[:-120]
+            if len(buf_map) > 64:  # bounded: drop the oldest search
+                buf_map.pop(next(iter(buf_map)))
+            o.update(block)
+            o["active"] = True
+            o["recent"] = list(buf)
             self._touch_locked()
         self._after()
 

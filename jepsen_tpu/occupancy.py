@@ -1,0 +1,301 @@
+"""Kernel occupancy observatory: per-round device counters + roofline.
+
+ROADMAP item 5 (adaptive-W/K, compact-before-expand, >0.8 frontier
+fill) needs per-round, per-lane visibility into what the WGL kernels
+actually do — the whole-search averages the util blocks report hide
+exactly the dynamics that matter (a search that runs full for 50
+rounds and empty for 500 averages to the same fill as one that idles
+uniformly). This module is the host half of that plane:
+
+  * **drain** — the `wgl32`/`wgln` kernels write one `(RING_COLS,)`
+    int32 row per round into an on-device ring (`wgl32.RING_ROWS`)
+    that rides the packed poll summary, so per-round counters reach
+    the host at existing poll boundaries with ZERO extra
+    host<->device transfers and ZERO kernel changes between
+    instrumented and uninstrumented runs (the CompileGuard proof in
+    tests/test_occupancy.py). `drain_chunk` turns one summary into
+    per-round dicts (`wgl_rounds` series points).
+  * **fill / rate math** — `memo_hit_rate` is the ONE place the
+    hits/(hits+inserts) ratio is computed (ops/wgl.py uses it for
+    both the per-chunk points and the final util block, so the two
+    can't drift); `build_block` folds drained rounds into the
+    per-search `occupancy` result block.
+  * **roofline attribution** — `roofline` classifies the search
+    compute- vs memory-bound and reports achieved-vs-peak, reusing
+    `ops.aot.peak_bf16_flops` for the chip peak and (when available)
+    the compiler's own `cost_analysis()` via `cost_for`, which goes
+    through `jax.stages.Lowered.cost_analysis` — tracing + lowering
+    only, NO backend compile, so a CompileGuard zero-compile budget
+    stays intact.
+  * **Perfetto counter tracks** — `perfetto_counter_tracks` turns
+    the registry's occupancy series into `trace_event` "C" counter
+    tracks so fill/frontier/backlog render as graphs under the phase
+    spans in ui.perfetto.dev.
+
+Schemas are documented in doc/OBSERVABILITY.md ("Occupancy &
+roofline") and linted by scripts/telemetry_lint.py.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from .ops.wgl32 import RING_COLS, RING_ROWS, SUMMARY_HEAD
+
+# Cap on per-round rows copied into a RESULT's occupancy block — the
+# registry series keeps everything the ring surfaced; the result copy
+# is for plots/reports and must not balloon a 100k-round search's
+# result dict. Overflow is counted in `rounds_truncated`, never silent.
+MAX_RESULT_ROUNDS = 2048
+
+# ROADMAP item 5's tracked target: mean frontier fill per config.
+TARGET_FILL = 0.8
+
+
+def memo_hit_rate(hits, inserts) -> float:
+    """hits / (hits + inserts), guarded — the single definition both
+    the per-chunk telemetry points and the final util block use."""
+    hits, inserts = int(hits), int(inserts)
+    return round(hits / max(hits + inserts, 1), 4)
+
+
+def drain_chunk(summary, rounds_before: int, K: int) -> tuple[list, int]:
+    """Per-round occupancy rows from ONE packed poll summary.
+
+    `summary` is the (SUMMARY_HEAD + RING_ROWS*RING_COLS,) int32 poll
+    vector (already on the host — the drain adds no transfer);
+    `rounds_before` is the cumulative rounds_total at the PREVIOUS
+    poll, which anchors the first row's round span; `K` is the beam
+    capacity fill is normalized by.
+
+    Returns (rows, rounds_dropped): `rows` are dicts with round id,
+    frontier (configs expanded), fill (frontier / (span * K) — span
+    covers the depth-fused accel rounds, where one ring row spans
+    `depth` levels), memo hits/inserts, survivors, post-compaction
+    frontier, backlog and max linearized base; `rounds_dropped`
+    counts rounds past RING_ROWS in this chunk (dropped on device,
+    reported so coverage gaps are visible, never silent)."""
+    s = np.asarray(summary).reshape(-1)
+    if s.shape[0] < SUMMARY_HEAD + RING_COLS:
+        return [], 0  # a ring-less summary (e.g. the legacy kernel)
+    ring = s[SUMMARY_HEAD:SUMMARY_HEAD + RING_ROWS * RING_COLS]
+    ring = ring.reshape(RING_ROWS, RING_COLS)
+    writes = int(s[5])           # stats[1]: round-body calls this chunk
+    rounds_total = int(s[9])     # stats[5]: cumulative rounds
+    rows: list = []
+    prev = int(rounds_before)
+    for r in ring[:min(writes, RING_ROWS)]:
+        rnd = int(r[0])
+        span = max(1, rnd - prev)
+        prev = rnd
+        frontier = int(r[1])
+        rows.append({
+            "round": rnd,
+            "span": span,
+            "frontier": frontier,
+            "fill": round(frontier / max(span * K, 1), 4),
+            "memo_hits": int(r[2]),
+            # memo inserts == compaction survivors by construction
+            # (a successor survives iff its signature inserted), so
+            # ONE field carries both meanings
+            "memo_inserts": int(r[3]),
+            "frontier_after": int(r[4]),
+            "backlog": int(r[5]),
+            "max_base": int(r[6]),
+        })
+    covered = (rows[-1]["round"] - int(rounds_before)) if rows else 0
+    dropped = max(0, (rounds_total - int(rounds_before)) - covered)
+    return rows, dropped
+
+
+def _fill_stats(rounds: Sequence[dict]) -> dict:
+    fills = [r["fill"] for r in rounds if r.get("fill") is not None]
+    if not fills:
+        return {"mean": None, "min": None, "max": None, "last": None}
+    return {"mean": round(float(np.mean(fills)), 4),
+            "min": round(float(np.min(fills)), 4),
+            "max": round(float(np.max(fills)), 4),
+            "last": fills[-1]}
+
+
+# Compiler cost analysis per kernel shape bucket, computed at most
+# once per process per key. `None` (analysis unavailable) is cached
+# too — a failing lowering must not be retried per search.
+_COST_CACHE: dict = {}
+
+
+def cost_for(key: tuple, lower_fn) -> Optional[dict]:
+    """{'flops', 'bytes_accessed'} per chunk call from the compiler's
+    own cost analysis, via `lower_fn() -> jax.stages.Lowered`.
+    Lowering traces the kernel but performs NO backend compile (no
+    `/jax/core/compile/backend_compile_duration` event), so calling
+    this under a CompileGuard zero-compile budget is safe — asserted
+    by tests/test_occupancy.py. Cached per shape-bucket `key`.
+
+    NB (same caveat as ops/aot.py): HloCostAnalysis counts a
+    while-loop body ONCE and charges gathers at full-operand width,
+    so these are per-ROUND numbers and an upper bound on traffic."""
+    if key in _COST_CACHE:
+        return _COST_CACHE[key]
+    out: Optional[dict] = None
+    try:
+        ca = lower_fn().cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        if isinstance(ca, dict):
+            out = {"flops": float(ca.get("flops", 0.0)),
+                   "bytes_accessed": float(
+                       ca.get("bytes accessed", 0.0))}
+    except Exception:  # noqa: BLE001 — cost analysis is best-effort;
+        out = None     # the analytic model below covers its absence
+    _COST_CACHE[key] = out
+    return out
+
+
+def roofline(*, K: int, row_cols: int, probes: int, rounds: int,
+             wall_s: float, device_kind: Optional[str] = None,
+             cost: Optional[dict] = None) -> dict:
+    """Classify the search compute- vs memory-bound and report
+    achieved-vs-peak, per round.
+
+    The peak comes from `ops.aot.peak_bf16_flops` for the detected
+    chip (v5e spec default, labeled, when unknown — e.g. on the cpu
+    tier-1 runs); HBM peak is the v5e spec number the AOT roofline
+    uses. Per-round flops/bytes come from the compiler's cost
+    analysis when `cost` is provided, else from the analytic memo-
+    stream model (K * row_cols successor rows x probes x 16 B — the
+    same currency ops/aot._wgl_analytic and the util block report).
+    `achieved_frac` = roofline-bound time / measured round time: how
+    close the measured rounds run to the modeled bound (latency-bound
+    rounds sit far below 1.0 — that gap IS the finding, see the
+    model_status note in ops/aot.py)."""
+    from .ops import aot as aot_mod
+
+    peak_flops, chip = aot_mod.peak_bf16_flops(device_kind)
+    peak_bytes = aot_mod.V5E_PEAK_HBM_BYTES
+    est_bytes = float(K * row_cols * probes * 16)
+    if cost:
+        flops = float(cost.get("flops") or 0.0)
+        byts = float(cost.get("bytes_accessed") or 0.0) or est_bytes
+        source = "compiler-cost-analysis"
+    else:
+        # the search is gather/hash-bound; a handful of int ops per
+        # successor word is a generous flop model
+        flops = float(K * row_cols * 64)
+        byts = est_bytes
+        source = "analytic"
+    t_comp = flops / peak_flops
+    t_mem = byts / peak_bytes
+    t_bound = max(t_comp, t_mem, 1e-12)
+    round_time = wall_s / max(rounds, 1)
+    return {
+        "source": source,
+        "bound": "compute" if t_comp >= t_mem else "memory",
+        "flops_per_round": flops,
+        "bytes_per_round": byts,
+        "arithmetic_intensity": round(flops / max(byts, 1.0), 6),
+        "peak_bf16_flops": peak_flops,
+        "peak_hbm_bytes_per_s": peak_bytes,
+        "peak_chip": chip,
+        "roofline_round_time_s": t_bound,
+        "measured_round_time_s": round(round_time, 9),
+        "achieved_frac": round(min(1.0, t_bound / max(round_time,
+                                                      1e-12)), 6),
+    }
+
+
+def build_block(rounds: Sequence[dict], *, K: int, row_cols: int,
+                probes: int, kernel: str, platform: str,
+                wall_s: float, rounds_total: int,
+                configs_explored: int, memo_hits: int,
+                memo_inserts: int, rounds_dropped: int = 0,
+                rounds_seen: Optional[int] = None,
+                device_kind: Optional[str] = None,
+                cost: Optional[dict] = None) -> dict:
+    """The per-search `occupancy` result block (doc/OBSERVABILITY.md):
+    drained per-round rows (capped at MAX_RESULT_ROUNDS, overflow
+    counted in `rounds_truncated` — `rounds_seen` is what the drain
+    surfaced in total, when the caller capped before passing), fill
+    statistics, memo dedup, expansion totals, and the roofline
+    attribution. Every count is device-measured; only the byte/flop
+    models are estimates (labeled by `roofline.source`)."""
+    rounds = list(rounds)
+    kept = rounds[:MAX_RESULT_ROUNDS]
+    seen = len(rounds) if rounds_seen is None else int(rounds_seen)
+    # compaction survivors == memo inserts (see drain_chunk)
+    survivors = sum(r.get("memo_inserts", 0) for r in rounds)
+    return {
+        "schema": 1,
+        "kernel": kernel,
+        "platform": platform,
+        "K": K,
+        "rounds_total": int(rounds_total),
+        "rounds_seen": seen,
+        "rounds_dropped": int(rounds_dropped),
+        "rounds_truncated": max(0, seen - len(kept)),
+        "fill": _fill_stats(rounds),
+        "memo": {"hits": int(memo_hits), "inserts": int(memo_inserts),
+                 "hit_rate": memo_hit_rate(memo_hits, memo_inserts)},
+        "expansion": {
+            "configs_explored": int(configs_explored),
+            "survivors_seen": int(survivors),
+            "expanded_per_round": round(
+                configs_explored / max(rounds_total, 1), 2)},
+        "roofline": roofline(K=K, row_cols=row_cols, probes=probes,
+                             rounds=rounds_total, wall_s=wall_s,
+                             device_kind=device_kind, cost=cost),
+        "rounds": kept,
+    }
+
+
+def safe_device_kind() -> Optional[str]:
+    """The jax device kind for roofline peak lookup, or None when the
+    backend is unavailable/wedged (peak then falls back to the
+    labeled v5e default — never a hang on this hot path: callers are
+    mid-search, so the backend is already initialized)."""
+    try:
+        import jax
+        return jax.devices()[0].device_kind
+    except Exception:  # noqa: BLE001
+        return None
+
+
+def heatmap_points(rounds: Sequence[dict], lane: int = 0) -> list:
+    """`{round, lane, fill}` triples for plots.occupancy_heatmap —
+    the single-search view is a 1-lane strip; the batched fan-out
+    emits one lane per key (parallel/batched.py)."""
+    return [{"round": int(r["round"]), "lane": int(lane),
+             "fill": float(r.get("fill") or 0.0)}
+            for r in rounds if r.get("round") is not None]
+
+
+def perfetto_counter_tracks(registry) -> dict:
+    """Counter tracks for trace.to_perfetto's `counters=` input, from
+    the occupancy/telemetry series a run recorded:
+
+      wgl fill        — per-round frontier fill (wgl_rounds)
+      wgl frontier/backlog — per-poll beam + backlog (wgl_chunks)
+      batched live_keys    — live lanes per poll (wgl_batched_chunks)
+
+    Points ride their metrics `t` wall-clock stamps, so the counter
+    graphs line up with the phase spans in ui.perfetto.dev."""
+    tracks: dict = {}
+
+    def add(series: str, field: str, track: str) -> None:
+        pts = registry.series(series).points
+        vals = [(p["t"], p[field]) for p in pts
+                if p.get("t") is not None
+                and isinstance(p.get(field), (int, float))]
+        if vals:
+            tracks[track] = vals
+
+    try:
+        add("wgl_rounds", "fill", "wgl fill")
+        add("wgl_chunks", "frontier", "wgl frontier")
+        add("wgl_chunks", "backlog", "wgl backlog")
+        add("wgl_batched_chunks", "live_keys", "batched live keys")
+    except Exception:  # noqa: BLE001 — a torn registry never blocks
+        pass           # the trace export itself
+    return tracks

@@ -424,8 +424,12 @@ def check(model: Model, history: History, time_limit: Optional[float] = None,
     `jepsen_tpu.metrics.Registry` (default: the ambient registry —
     NULL unless enabled, so the instrumented path costs nothing);
     when enabled, every device chunk's packed poll summary lands in
-    the `wgl_chunks` timeseries and the result carries a
-    `telemetry.chunks` copy. `tracer` is a `trace.Tracer`; phase
+    the `wgl_chunks` timeseries, the kernel's per-round occupancy
+    ring drains into the `wgl_rounds` timeseries (occupancy.py —
+    the rows ride the same packed summary, no extra transfer), and
+    the result carries a `telemetry.chunks` copy plus an
+    `occupancy` block (fill stats + roofline attribution).
+    `tracer` is a `trace.Tracer`; phase
     spans (encode / compile / device-round / host-poll) nest under
     the caller's current span. `profile_dir` (or env
     JEPSEN_TPU_PROFILE_DIR) opt-in wraps the search in a
@@ -668,6 +672,7 @@ def _search_loop(enc, init_fn, chunk_jit, iinv, iopc, n, max_configs,
 
     from .. import fleet as _fleet_mod
     from .. import metrics as _metrics_mod
+    from .. import occupancy as _occ
     from .. import trace as _trace_mod
     from .. import watchdog as _watchdog_mod
     mx = mx if mx is not None else _metrics_mod.get_default()
@@ -701,6 +706,14 @@ def _search_loop(enc, init_fn, chunk_jit, iinv, iopc, n, max_configs,
     # pays nothing (metrics.py's zero-cost contract)
     tl_points: Optional[list] = [] if mx.enabled else None
     kern = "wgl32" if enc.window_raw <= 32 else "wgln"
+    # per-round occupancy drain (occupancy.drain_chunk): the ring rows
+    # ride the packed poll summary either way; draining them is pure
+    # host numpy, paid only when metrics or the live status panel
+    # consume them
+    occ_rounds: list = []
+    occ_dropped = 0
+    occ_seen = 0
+    rounds_before = 0
     # the compute/transfer split below costs one extra device sync per
     # poll — only pay it when someone is recording (the disabled run
     # must keep the original single-transfer poll, overhead-free)
@@ -760,6 +773,26 @@ def _search_loop(enc, init_fn, chunk_jit, iinv, iopc, n, max_configs,
             first_call_s = _time.monotonic() - t0
         found, overflow = bool(flags[0]), bool(flags[1])
         total_explored = int(stats[0])
+        occ_new: list = []
+        if tl_points is not None or status.enabled:
+            # drain this chunk's per-round occupancy rows off the
+            # packed summary already in host memory — no transfer,
+            # no device work, just numpy over the ring tail
+            occ_new, dropped = _occ.drain_chunk(s, rounds_before, K)
+            occ_dropped += dropped
+            occ_seen += len(occ_new)
+            wall_now = _time.monotonic() - t0
+            wall_prev = max(wall_now - poll_s, 0.0)
+            n_new = len(occ_new)
+            for i, r in enumerate(occ_new):
+                # interpolated wall stamp: rounds are not host-timed
+                # individually (that would mean per-round syncs), so
+                # spread them across the chunk's wall for the
+                # progress-overlay x axis
+                r["wall_s"] = round(
+                    wall_prev + (i + 1) / n_new * (wall_now
+                                                   - wall_prev), 6)
+        rounds_before = int(stats[5])
         if status.enabled:
             # live run status (fleet.RunStatus): one small dict per
             # poll — ~75 ms+ apart on accel, a few Hz on cpu — so the
@@ -778,6 +811,22 @@ def _search_loop(enc, init_fn, chunk_jit, iinv, iopc, n, max_configs,
                 "explored": total_explored,
                 "rounds": int(stats[5])},
                 search_id=(_threading.get_ident(), plat))
+            # the /occupancy panel's live block: last/mean fill plus
+            # a bounded window of recent per-round points
+            fills = [r["fill"] for r in occ_new]
+            status.occupancy_poll({
+                "mode": "single", "kernel": kern, "platform": plat,
+                "K": K,
+                "fill_last": (fills[-1] if fills
+                              else round(fr_cnt / max(K, 1), 4)),
+                "fill_mean": (round(sum(fills) / len(fills), 4)
+                              if fills else None),
+                "rounds_seen": occ_seen,
+                "rounds_dropped": occ_dropped,
+                "recent_rounds": [
+                    {"round": r["round"], "fill": r["fill"]}
+                    for r in occ_new[-32:]]},
+                search_id=(_threading.get_ident(), plat))
         if tl_points is not None:
             prev = tl_points[-1] if tl_points else {}
             memo_hits_c, inserted_c = int(stats[3]), int(stats[4])
@@ -788,14 +837,15 @@ def _search_loop(enc, init_fn, chunk_jit, iinv, iopc, n, max_configs,
                 "poll_s": round(poll_s, 6),
                 "transfer_s": round(xfer_s, 6),
                 "frontier": fr_cnt,
+                "fill": round(fr_cnt / max(K, 1), 4),
                 "backlog": bk_cnt,
                 "K": K,
                 "rounds": int(stats[5]),
                 "explored": total_explored,
                 "memo_hits": memo_hits_c,
                 "memo_inserts": inserted_c,
-                "memo_hit_rate": round(
-                    memo_hits_c / max(memo_hits_c + inserted_c, 1), 4),
+                "memo_hit_rate": _occ.memo_hit_rate(memo_hits_c,
+                                                    inserted_c),
                 "rounds_delta": int(stats[5]) - prev.get("rounds", 0),
                 "explored_delta": (total_explored
                                    - prev.get("explored", 0)),
@@ -809,6 +859,26 @@ def _search_loop(enc, init_fn, chunk_jit, iinv, iopc, n, max_configs,
             mx.series("wgl_chunks",
                       "per-chunk packed poll summaries of the WGL "
                       "device search").append(point)
+            rounds_series = mx.series(
+                "wgl_rounds",
+                "per-round device occupancy counters drained from "
+                "the kernel ring buffer")
+            # epoch anchor for the interpolated wall stamps: rows are
+            # appended in one burst per poll, and the default
+            # append-time `t` would collapse a whole chunk's rounds
+            # onto one Perfetto counter-track timestamp
+            epoch_now = _time.time()
+            wall_ref = _time.monotonic() - t0
+            for r in occ_new:
+                r.update(kernel=kern, platform=plat, K=K,
+                         chunk=n_chunks - 1,
+                         t=round(epoch_now - (wall_ref
+                                              - r["wall_s"]), 6))
+                rounds_series.append(r)
+            if len(occ_rounds) < _occ.MAX_RESULT_ROUNDS:
+                occ_rounds.extend(
+                    occ_new[:_occ.MAX_RESULT_ROUNDS
+                            - len(occ_rounds)])
             lbl = {"kernel": kern, "platform": plat}
             mx.counter("wgl_chunks_total",
                        "device chunk calls").inc(**lbl)
@@ -846,6 +916,15 @@ def _search_loop(enc, init_fn, chunk_jit, iinv, iopc, n, max_configs,
                 accel=accel, depth=depth)
             carry = _widen_frontier(carry, _K_BIG)
             K = _K_BIG
+        # result assembly only when a stop condition holds — the
+        # common mid-search poll skips the util/occupancy block
+        # construction entirely (it is per-poll host work otherwise)
+        cancelled = stop is not None and stop()
+        if not (found or fr_cnt == 0
+                or total_explored >= max_configs or cancelled
+                or (deadline is not None
+                    and _time.monotonic() > deadline)):
+            continue
         wall = _time.monotonic() - t0
         rounds_total = int(stats[5])
         memo_hits, inserted = int(stats[3]), int(stats[4])
@@ -861,8 +940,9 @@ def _search_loop(enc, init_fn, chunk_jit, iinv, iopc, n, max_configs,
             "rounds": rounds_total,
             "frontier_fill": round(
                 total_explored / max(rounds_total * K, 1), 4),
-            "memo_hit_rate": round(
-                memo_hits / max(memo_hits + inserted, 1), 4),
+            # the ONE hit-rate definition (occupancy.memo_hit_rate) —
+            # shared with the per-chunk points so they can't drift
+            "memo_hit_rate": _occ.memo_hit_rate(memo_hits, inserted),
             "succ_rows_per_round": K * row_cols,
             "est_table_mb_per_round": round(
                 K * row_cols * 16 * probes_used / 1e6, 3),
@@ -879,6 +959,30 @@ def _search_loop(enc, init_fn, chunk_jit, iinv, iopc, n, max_configs,
             # the run's own copy of the per-chunk timeseries (the
             # registry keeps the cross-run series)
             detail["telemetry"] = {"chunks": tl_points}
+            # the per-search occupancy block: drained rounds + fill
+            # stats + roofline attribution. Cost analysis lowers the
+            # jitted chunk WITHOUT a backend compile (Lowered.
+            # cost_analysis), cached per shape bucket — safe under a
+            # CompileGuard zero-compile budget.
+            import jax as _jax
+
+            def _lower():
+                spec = _jax.tree.map(
+                    lambda a: _jax.ShapeDtypeStruct(a.shape, a.dtype),
+                    (consts, carry))
+                return chunk_jit.lower(*spec)
+
+            cost = _occ.cost_for(
+                (kern, len(enc.inv), ic_eff, W_eff, K, chunk, depth,
+                 accel), _lower)
+            detail["occupancy"] = _occ.build_block(
+                occ_rounds, K=K, row_cols=row_cols,
+                probes=probes_used, kernel=kern, platform=plat,
+                wall_s=wall, rounds_total=rounds_total,
+                configs_explored=total_explored,
+                memo_hits=memo_hits, memo_inserts=inserted,
+                rounds_dropped=occ_dropped, rounds_seen=occ_seen,
+                device_kind=_occ.safe_device_kind(), cost=cost)
         if found:
             return {"valid?": True, "op_count": n + enc.n_info, **detail}
         if fr_cnt == 0:
@@ -893,9 +997,8 @@ def _search_loop(enc, init_fn, chunk_jit, iinv, iopc, n, max_configs,
         if deadline is not None and _time.monotonic() > deadline:
             return {"valid?": "unknown", "cause": "timeout",
                     "op_count": n + enc.n_info, **detail}
-        if stop is not None and stop():
-            return {"valid?": "unknown", "cause": "cancelled",
-                    "op_count": n + enc.n_info, **detail}
+        return {"valid?": "unknown", "cause": "cancelled",
+                "op_count": n + enc.n_info, **detail}
 
 
 def enrich_diagnostics(model: Model, history: History, res: dict,

@@ -38,14 +38,16 @@ the round-3 layout (four frontier arrays + four backlog arrays + a
 
 Same consts contract as `wgl._build_search` (inv, ret, opcode,
 sufminret, inv_info, opcode_info, T, n_ok, n_info, max_cfg); the carry
-is the packed 7-tuple
+is the packed 8-tuple
 
-    (fr, fr_cnt, bk, bk_cnt, table, flags, stats)
+    (fr, fr_cnt, bk, bk_cnt, table, flags, stats, ring)
 
 shared with the packed wide-window kernel (`wgln.py`) so the host
 driver (`wgl.check`) and the batched mesh path (`parallel/batched.py`)
 read counters at fixed indices: fr_cnt = carry[1], flags = carry[5],
-stats = carry[6].
+stats = carry[6], and the per-round occupancy ring = carry[7] (see
+RING_ROWS below — one row per round, drained through the packed poll
+summary with no extra transfer).
 
 Reference parity: this is the knossos wgl/analysis engine the
 reference reaches through `jepsen/src/jepsen/checker.clj:199-202`.
@@ -60,7 +62,32 @@ import numpy as np
 INF = np.int32(2**31 - 1)
 
 # carry indices shared by wgl.py / parallel/batched.py
-FR, FR_CNT, BK, BK_CNT, TABLE, FLAGS, STATS = range(7)
+FR, FR_CNT, BK, BK_CNT, TABLE, FLAGS, STATS, RING_BUF = range(8)
+
+# Per-round occupancy ring (the kernel-occupancy plane, doc/
+# OBSERVABILITY.md "Occupancy & roofline"): each round writes ONE
+# (RING_COLS,) int32 row into a preallocated (RING_ROWS, RING_COLS)
+# buffer in the carry, indexed by the per-chunk round counter
+# (stats[1]) — rows past RING_ROWS in one chunk are dropped, never
+# wrapped, so the host reads ring[:min(stats[1], RING_ROWS)] with no
+# ordering reconstruction. The ring rides the packed poll summary
+# (flattened after the classic 11 words), so draining it costs ZERO
+# extra host<->device transfers and the kernel is identical whether
+# or not anyone reads it — the CompileGuard zero-recompile /
+# zero-transfer proof in tests/test_occupancy.py depends on both.
+# Cost: one small-row scatter per round (~30 us serialized on a TPU,
+# noise on cpu) — the price of per-round visibility.
+RING_ROWS = 512
+RING_COLS = 7
+# ring columns: [rounds_total after this round, frontier rows
+# expanded, memo hits, unique survivors (inserts), frontier after
+# compaction+refill, backlog depth, max linearized base]
+(RING_ROUND, RING_FRONTIER, RING_HITS, RING_INSERTS, RING_FR_AFTER,
+ RING_BACKLOG, RING_MAX_BASE) = range(RING_COLS)
+
+# leading words of the packed poll summary, before the flattened ring:
+# [fr_cnt, flags x3, stats x6, bk_cnt]
+SUMMARY_HEAD = 11
 
 
 def _popcount32(x):
@@ -198,7 +225,8 @@ def _build_search32(n_pad: int, ic_pad: int, S: int, O: int,
         # explored, rounds-in-chunk, max_base, memo_hits, inserted,
         # rounds_total — the last three feed the result's util block
         stats = jnp.zeros(6, dtype=jnp.int32)
-        return (fr, fr_cnt, bk, bk_cnt, table, flags, stats)
+        ring = jnp.zeros((RING_ROWS, RING_COLS), dtype=jnp.int32)
+        return (fr, fr_cnt, bk, bk_cnt, table, flags, stats, ring)
 
     jinfo_word = jnp.asarray(info_word.astype(np.int32))
     jinfo_bit = jnp.asarray(info_bit)
@@ -323,7 +351,7 @@ def _build_search32(n_pad: int, ic_pad: int, S: int, O: int,
         return succ, explore, found, s0, s1, s2, base_max
 
     def round_body(consts, carry):
-        (fr, fr_cnt, bk, bk_cnt, table, flags, stats) = carry
+        (fr, fr_cnt, bk, bk_cnt, table, flags, stats, ring) = carry
         succ, explore, found, s0, s1, s2, base_max = \
             _expand(consts, fr, fr_cnt)
 
@@ -387,14 +415,22 @@ def _build_search32(n_pad: int, ic_pad: int, S: int, O: int,
         nflags = jnp.stack([flags[0] | found,
                             flags[1] | overflow,
                             nfr_cnt == 0])
+        seen_n = jnp.sum(seen.astype(jnp.int32))
         nstats = jnp.stack([
             stats[0] + fr_cnt,
             stats[1] + 1,
             jnp.maximum(stats[2], base_max),
-            stats[3] + jnp.sum(seen.astype(jnp.int32)),
+            stats[3] + seen_n,
             stats[4] + total,
             stats[5] + 1])
-        return (nfr, nfr_cnt, bk, nbk_cnt, table, nflags, nstats)
+        # occupancy ring row for THIS round; index stats[1] = rounds
+        # already run this chunk, rows past RING_ROWS drop (mode=drop)
+        row = jnp.stack([nstats[5], fr_cnt, seen_n, total,
+                         nfr_cnt, nbk_cnt,
+                         jnp.maximum(stats[2], base_max)])
+        ring = ring.at[jnp.minimum(stats[1], RING_ROWS)].set(
+            row, mode="drop")
+        return (nfr, nfr_cnt, bk, nbk_cnt, table, nflags, nstats, ring)
 
     def round_body_deep(consts, carry):
         """Depth-fused accel round: `depth` expansion levels per
@@ -406,7 +442,7 @@ def _build_search32(n_pad: int, ic_pad: int, S: int, O: int,
         probes can't see uninserted siblings) — bounded by depth,
         sound, and irrelevant on the near-linear wavefronts this
         path exists for."""
-        (fr, fr_cnt, bk, bk_cnt, table, flags, stats) = carry
+        (fr, fr_cnt, bk, bk_cnt, table, flags, stats, ring) = carry
         found = flags[0]
         overflow = flags[1]
         base_max = stats[2]
@@ -512,7 +548,15 @@ def _build_search32(n_pad: int, ic_pad: int, S: int, O: int,
             stats[3] + hits_add,
             stats[4] + ins_add,
             stats[5] + depth])
-        return (nfr, nfr_cnt, bk, nbk_cnt, table, nflags, nstats)
+        # one occupancy ring row per SUPER-round: `frontier` counts
+        # expansions across all `depth` fused levels; the host
+        # normalizes fill by the round span it reads off the ring's
+        # rounds_total column deltas (occupancy.drain_chunk)
+        row = jnp.stack([nstats[5], explored_add, hits_add, ins_add,
+                         nfr_cnt, nbk_cnt, base_max])
+        ring = ring.at[jnp.minimum(stats[1], RING_ROWS)].set(
+            row, mode="drop")
+        return (nfr, nfr_cnt, bk, nbk_cnt, table, nflags, nstats, ring)
 
     def chunk_fn(consts, carry):
         (inv, ret, opc, suf, iinv, iopc, T, n_ok, n_info, max_cfg) = consts
@@ -560,17 +604,21 @@ def _build_search32(n_pad: int, ic_pad: int, S: int, O: int,
             return round_body(rconsts, c)
 
         stats = carry[STATS]
-        carry = carry[:STATS] + (stats.at[1].set(0),)
+        carry = carry[:STATS] + (stats.at[1].set(0),) \
+            + carry[STATS + 1:]
         out = lax.while_loop(cond, body, carry)
-        # one packed (11,) summary so the host polls with a SINGLE
+        # one packed summary so the host polls with a SINGLE
         # device->host transfer per chunk (each transfer costs a full
         # runtime round-trip — ~75 ms through the tunneled v5e, which
         # dominated the headline wall before this): [fr_cnt, flags x3,
-        # stats x6, bk_cnt] — bk_cnt feeds the telemetry timeseries
-        # (metrics.py); existing consumers index the leading 10.
+        # stats x6, bk_cnt] + the flattened per-round occupancy ring.
+        # Existing consumers index the leading SUMMARY_HEAD words;
+        # occupancy.drain_chunk reads the ring tail. bk_cnt feeds the
+        # telemetry timeseries (metrics.py).
         summary = jnp.concatenate(
             [out[FR_CNT][None], out[FLAGS].astype(jnp.int32),
-             out[STATS], out[BK_CNT][None]])
+             out[STATS], out[BK_CNT][None],
+             out[RING_BUF].reshape(-1)])
         return out, summary
 
     return init_fn, chunk_fn

@@ -28,9 +28,10 @@ This kernel generalizes the packing to L lanes:
     and the transition table ride fused row-gathers, and the memo
     probe is `wgl32.probe_insert` (one gather + one scatter + one
     verify gather). Same consts contract as `wgl._build_search`; same
-    packed carry (fr, fr_cnt, bk, bk_cnt, table, flags, stats) as
-    wgl32, so the host driver (`wgl.check`) dispatches by window
-    width alone and `parallel/batched.py` vmaps either kernel.
+    packed carry (fr, fr_cnt, bk, bk_cnt, table, flags, stats, ring)
+    as wgl32 — including the per-round occupancy ring — so the host
+    driver (`wgl.check`) dispatches by window width alone and
+    `parallel/batched.py` vmaps either kernel.
 
 Measured (cpu backend, adversarial_wave 6x14 span 5, W=71 -> L=3):
 the bool kernel decides 811k configs in ~103 s; this kernel in ~9 s
@@ -44,8 +45,8 @@ import functools
 
 import numpy as np
 
-from .wgl32 import BK_CNT, FLAGS, FR_CNT, STATS, _ctz32, _fnv_words, \
-    _i32, _u32, probe_insert
+from .wgl32 import BK_CNT, FLAGS, FR_CNT, RING_BUF, RING_COLS, \
+    RING_ROWS, STATS, _ctz32, _fnv_words, _i32, _u32, probe_insert
 
 INF = np.int32(2**31 - 1)
 
@@ -86,7 +87,9 @@ def _build_searchN(n_pad: int, ic_pad: int, S: int, O: int,
         # explored, rounds-in-chunk, max_base, memo_hits, inserted,
         # rounds_total (util contract, wgl.py)
         stats = jnp.zeros(6, dtype=jnp.int32)
-        return (fr, fr_cnt, bk, bk_cnt, table, flags, stats)
+        # per-round occupancy ring (wgl32.RING_ROWS docs)
+        ring = jnp.zeros((RING_ROWS, RING_COLS), dtype=jnp.int32)
+        return (fr, fr_cnt, bk, bk_cnt, table, flags, stats, ring)
 
     jlane = jnp.asarray(lane_of_j)
     jshift = jnp.asarray(shift_of_j)
@@ -97,7 +100,7 @@ def _build_searchN(n_pad: int, ic_pad: int, S: int, O: int,
 
     def round_body(consts, carry):
         (GT, iinv, iopc_c, n_ok, n_info, max_cfg) = consts
-        (fr, fr_cnt, bk, bk_cnt, table, flags, stats) = carry
+        (fr, fr_cnt, bk, bk_cnt, table, flags, stats, ring) = carry
 
         fr_base = fr[:, 0]
         fr_win = _u32(fr[:, 1:1 + L])                     # (K, L)
@@ -281,14 +284,22 @@ def _build_searchN(n_pad: int, ic_pad: int, S: int, O: int,
         nflags = jnp.stack([flags[0] | found,
                             flags[1] | overflow,
                             nfr_cnt == 0])
+        seen_n = jnp.sum(seen.astype(jnp.int32))
+        base_max = jnp.maximum(stats[2],
+                               jnp.max(jnp.where(legal, base_s, 0)))
         nstats = jnp.stack([
             stats[0] + fr_cnt,
             stats[1] + 1,
-            jnp.maximum(stats[2], jnp.max(jnp.where(legal, base_s, 0))),
-            stats[3] + jnp.sum(seen.astype(jnp.int32)),
+            base_max,
+            stats[3] + seen_n,
             stats[4] + total,
             stats[5] + 1])
-        return (nfr, nfr_cnt, bk, nbk_cnt, table, nflags, nstats)
+        # per-round occupancy row (wgl32 ring contract)
+        row = jnp.stack([nstats[5], fr_cnt, seen_n, total,
+                         nfr_cnt, nbk_cnt, base_max])
+        ring = ring.at[jnp.minimum(stats[1], RING_ROWS)].set(
+            row, mode="drop")
+        return (nfr, nfr_cnt, bk, nbk_cnt, table, nflags, nstats, ring)
 
     def chunk_fn(consts, carry):
         (inv, ret, opc, suf, iinv, iopc, T, n_ok, n_info, max_cfg) = consts
@@ -328,12 +339,15 @@ def _build_searchN(n_pad: int, ic_pad: int, S: int, O: int,
             return round_body(rconsts, c)
 
         stats = carry[STATS]
-        carry = carry[:STATS] + (stats.at[1].set(0),)
+        carry = carry[:STATS] + (stats.at[1].set(0),) \
+            + carry[STATS + 1:]
         out = lax.while_loop(cond, body, carry)
-        # single packed (11,) host-poll summary (see wgl32.chunk_fn)
+        # single packed host-poll summary + flattened occupancy ring
+        # (see wgl32.chunk_fn)
         summary = jnp.concatenate(
             [out[FR_CNT][None], out[FLAGS].astype(jnp.int32),
-             out[STATS], out[BK_CNT][None]])
+             out[STATS], out[BK_CNT][None],
+             out[RING_BUF].reshape(-1)])
         return out, summary
 
     return init_fn, chunk_fn

@@ -25,6 +25,7 @@ import numpy as np
 
 from .. import fleet as _fleet
 from .. import metrics as _metrics
+from .. import occupancy as _occ
 from .. import watchdog as _watchdog
 from ..history import History
 from ..models.core import Model
@@ -634,6 +635,14 @@ def check_batched(model: Model, histories: Sequence[History],
     hb = wd.register("wgl-batched", device=f"mesh[{nd}]",
                      grace_s=300.0)
     s = None  # last packed poll; None if cancelled before any poll
+    kern = "wgl32" if not L else "wgln"
+    n_polls = 0
+    # per-lane occupancy bookkeeping: previous cumulative rounds per
+    # lane (anchors each drain) and a bounded budget of heatmap
+    # points — silent caps read as full coverage, so exhaustion is
+    # recorded on the series itself
+    prev_rounds = np.zeros(bk, dtype=np.int64)
+    occ_budget = 8192
     try:
         while True:
             if wd.cancelled(hb):
@@ -641,9 +650,10 @@ def check_batched(model: Model, histories: Sequence[History],
                 break
             t_poll = _time.monotonic()
             carry, summary = vchunk(consts, carry)
-            # one packed (Bk, 11) poll transfer:
-            # [fr_cnt, flags, stats, bk]
+            # one packed (Bk, SUMMARY_HEAD + ring) poll transfer:
+            # [fr_cnt, flags, stats, bk, per-round occupancy ring]
             s = np.asarray(summary)
+            n_polls += 1
             fr_cnt, flags, stats = s[:, 0], s[:, 1:4], s[:, 4:10]
             found = flags[:, 0] != 0
             empty = fr_cnt == 0
@@ -655,6 +665,8 @@ def check_batched(model: Model, histories: Sequence[History],
                         (found | empty)[:batch.n_keys].sum()),
                     configs_explored=int(
                         stats[:batch.n_keys, 0].sum()))
+            fr_real = fr_cnt[:batch.n_keys]
+            fills = np.round(fr_real / max(K, 1), 4)
             if mx.enabled:
                 mx.series(
                     "wgl_batched_chunks",
@@ -669,6 +681,44 @@ def check_batched(model: Model, histories: Sequence[History],
                     "backlog_total": int(s[:batch.n_keys, 10].sum()),
                     "explored_total": int(
                         stats[:batch.n_keys, 0].sum())})
+                # per-lane fill, one vector per poll: stragglers and
+                # empty lanes visible without per-lane transfers (the
+                # fill rides the same packed summary)
+                mx.series(
+                    "wgl_batched_lanes",
+                    "per-poll per-lane frontier fill of the "
+                    "mesh-batched search").append({
+                        "poll": n_polls - 1,
+                        "wall_s": round(_time.monotonic() - t0, 4),
+                        "K": K, "kernel": kern,
+                        "live": int(live.sum()),
+                        "empty_lanes": int((fr_real == 0).sum()),
+                        "fill": [float(f) for f in fills]})
+                # per-lane per-ROUND drain for the round x lane
+                # heatmap, bounded; exhaustion is recorded, not silent
+                rounds_series = mx.series(
+                    "wgl_batched_rounds",
+                    "per-round per-lane frontier fill drained from "
+                    "the vmapped kernel rings (round x lane heatmap "
+                    "input)")
+                if occ_budget > 0:
+                    for lane in range(batch.n_keys):
+                        rows, _ = _occ.drain_chunk(
+                            s[lane], int(prev_rounds[lane]), K)
+                        for r in rows[:max(0, occ_budget)]:
+                            occ_budget -= 1
+                            rounds_series.append({
+                                "round": r["round"], "lane": lane,
+                                "fill": r["fill"],
+                                "frontier": r["frontier"]})
+                    if occ_budget <= 0:
+                        rounds_series.append({
+                            "round": -1, "lane": -1, "fill": 0.0,
+                            "frontier": 0,
+                            "note": "point budget exhausted; later "
+                                    "rounds not drained"})
+                        occ_budget = -1  # emit the marker once
+                prev_rounds = stats[:, 5].astype(np.int64)
             if status.enabled:
                 status.batched_poll(
                     live=int(live.sum()),
@@ -678,6 +728,18 @@ def check_batched(model: Model, histories: Sequence[History],
                     frontier_total=int(fr_cnt[:batch.n_keys].sum()),
                     backlog_total=int(s[:batch.n_keys, 10].sum()),
                     explored_total=int(stats[:batch.n_keys, 0].sum()))
+                status.occupancy_poll({
+                    "mode": "batched", "kernel": kern,
+                    "platform": f"mesh[{nd}]",
+                    "K": K,
+                    "fill_last": round(float(fills.mean()), 4),
+                    "fill_mean": round(float(fills.mean()), 4),
+                    "lanes": {
+                        "n": batch.n_keys,
+                        "fill_min": round(float(fills.min()), 4),
+                        "fill_max": round(float(fills.max()), 4),
+                        "empty": int((fr_real == 0).sum())}},
+                    search_id="batched")
             if not live.any():
                 break
             if deadline is not None and _time.monotonic() > deadline:
@@ -714,8 +776,15 @@ def check_batched(model: Model, histories: Sequence[History],
                       "rounds": rounds,
                       "frontier_fill": round(
                           int(stats[lane, 0]) / max(rounds * K, 1), 4),
-                      "memo_hit_rate": round(
-                          hits / max(hits + ins, 1), 4)}}
+                      "memo_hit_rate": _occ.memo_hit_rate(hits, ins)},
+                  # the lane's occupancy coordinates: which heatmap
+                  # row (wgl_batched_rounds series) this key is, and
+                  # where its beam ended up
+                  "occupancy": {
+                      "lane": lane, "K": K,
+                      "fill_last": round(
+                          int(fr_cnt[lane]) / max(K, 1), 4),
+                      "rounds": rounds}}
         engine = "device-vmap"
         if found[lane]:
             res = {"valid?": True, "op_count": n_total, **detail}

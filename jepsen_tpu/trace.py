@@ -172,13 +172,19 @@ class Tracer:
                      **sp.to_json()}) + "\n")
         return len(spans)
 
-    def export_perfetto(self, path: str) -> int:
+    def export_perfetto(self, path: str,
+                        counters: Optional[dict] = None) -> int:
         """Write collected spans as a Chrome/Perfetto `trace_event`
-        JSON file (see `to_perfetto`); returns span count."""
+        JSON file (see `to_perfetto`); returns span count.
+        `counters` — {track: [(t_epoch_s, value), ...]} — renders as
+        counter tracks under the spans (the occupancy plane's
+        per-round fill / frontier / backlog graphs;
+        `occupancy.perfetto_counter_tracks` builds them from a
+        metrics registry)."""
         with self._lock:
             spans = list(self.spans)
         doc = to_perfetto([sp.to_json() for sp in spans],
-                          service=self.service)
+                          service=self.service, counters=counters)
         parent = os.path.dirname(path)
         if parent:
             os.makedirs(parent, exist_ok=True)
@@ -241,11 +247,35 @@ def perfetto_events(spans: list, service: str = "jepsen_tpu") -> list:
     return events
 
 
-def to_perfetto(spans: list, service: str = "jepsen_tpu") -> dict:
+def counter_events(tracks: dict, pid: int = 1) -> list:
+    """`trace_event` "C" (counter) events from
+    {track_name: [(t_epoch_seconds, value), ...]} — Perfetto renders
+    each named track as a step graph on its own row, time-aligned
+    with the span lanes. Non-numeric values are skipped (a torn
+    series point must not sink the whole export)."""
+    events: list = []
+    for name, pts in sorted((tracks or {}).items()):
+        for p in pts:
+            try:
+                t, v = float(p[0]), float(p[1])
+            except (TypeError, ValueError, IndexError):
+                continue
+            events.append({"ph": "C", "name": str(name),
+                           "cat": "counter", "ts": t * 1e6,
+                           "pid": pid, "tid": 0,
+                           "args": {"value": v}})
+    return events
+
+
+def to_perfetto(spans: list, service: str = "jepsen_tpu",
+                counters: Optional[dict] = None) -> dict:
     """The loadable document: {"traceEvents": [...]} — the JSON object
-    form both Perfetto and chrome://tracing ingest directly."""
-    return {"traceEvents": perfetto_events(spans, service=service),
-            "displayTimeUnit": "ms"}
+    form both Perfetto and chrome://tracing ingest directly.
+    `counters` adds counter tracks (see `counter_events`)."""
+    events = perfetto_events(spans, service=service)
+    if counters:
+        events += counter_events(counters)
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
 
 
 def perfetto_from_jsonl(jsonl_path: str,

@@ -155,7 +155,11 @@ def status_snapshot(store_root: str) -> dict:
                 "nemesis": {"active": False, "f": None,
                             "since_s": None},
                 "ops": {"invoked": 0, "completed": 0}, "faults": [],
-                "watchdog": {"stalls": 0, "last_source": None}}
+                "watchdog": {"stalls": 0, "last_source": None},
+                "occupancy": {"active": False}}
+    # pre-occupancy mirrors (an older run's current-status.json) still
+    # answer the documented schema
+    snap.setdefault("occupancy", {"active": False})
     # history, not just the live run: the last N ledger entries ride
     # every status answer so the fleet dashboard shows what the fleet
     # has DONE, not only what it is doing
@@ -277,9 +281,87 @@ def render_status(store_root: str) -> bytes:
                      "<th>id</th><th>kind</th><th>name</th>"
                      "<th>verdict</th><th>wall s</th></tr></thead>"
                      f"<tbody>{rows}</tbody></table>")
+    occ = s.get("occupancy") or {}
+    if occ.get("active"):
+        parts.append(
+            f"<p>occupancy: fill last <b>{_esc(occ.get('fill_last'))}"
+            f"</b> &middot; mean {_esc(occ.get('fill_mean'))} &middot; "
+            f"<a href='/occupancy'>occupancy panel</a></p>")
     parts.append("<p><a href='/status.json'>status.json</a> &middot; "
+                 "<a href='/occupancy'>occupancy</a> &middot; "
                  "<a href='/runs'>run ledger</a></p>")
     return _page("status", "".join(parts))
+
+
+def _fill_color(fill) -> str:
+    """Green past the ROADMAP fill target (occupancy.TARGET_FILL —
+    the one policy number plots/bench/web share), amber midway, red
+    when the lanes are mostly empty."""
+    from . import occupancy as occupancy_mod
+    try:
+        f = float(fill)
+    except (TypeError, ValueError):
+        return VALID_COLORS[None]
+    if f >= occupancy_mod.TARGET_FILL:
+        return VALID_COLORS[True]
+    if f >= occupancy_mod.TARGET_FILL / 2:
+        return VALID_COLORS["unknown"]
+    return VALID_COLORS[False]
+
+
+def render_occupancy(store_root: str) -> bytes:
+    """The auto-refreshing /occupancy panel: the kernel-occupancy
+    block from the same snapshot /status.json serves — last/mean
+    frontier fill against the 0.8 target, per-lane stats for the
+    batched fan-out, and a bar strip of the most recent per-round
+    fills (doc/OBSERVABILITY.md "Occupancy & roofline")."""
+    s = status_snapshot(store_root)
+    occ = s.get("occupancy") or {}
+    parts = ["<meta http-equiv='refresh' content='2'>",
+             "<a href='/'>jepsen_tpu</a> / "
+             "<a href='/status'>status</a> / occupancy",
+             f"<h1>kernel occupancy"
+             f" &middot; {_esc(s.get('test') or 'no active run')}</h1>"]
+    if not occ.get("active"):
+        parts.append("<p>no occupancy data yet — runs record it when "
+                     "metrics or a RunStatus are enabled "
+                     "(doc/OBSERVABILITY.md)</p>")
+        return _page("occupancy", "".join(parts))
+    rows = "".join(
+        f"<tr><td>{_esc(k)}</td><td>{_esc(occ.get(k))}</td></tr>"
+        for k in ("mode", "kernel", "platform", "K", "rounds_seen",
+                  "rounds_dropped"))
+    fill_cells = "".join(
+        f"<tr><td>{_esc(k)}</td><td style='background:"
+        f"{_fill_color(occ.get(k))}'>{_esc(occ.get(k))}</td></tr>"
+        for k in ("fill_last", "fill_mean"))
+    from . import occupancy as occupancy_mod
+    parts.append("<table><tbody>" + rows + fill_cells
+                 + "</tbody></table>"
+                 f"<p>target: mean fill &ge; "
+                 f"{occupancy_mod.TARGET_FILL} (ROADMAP item 5)</p>")
+    lanes = occ.get("lanes") or {}
+    if lanes:
+        parts.append(
+            f"<h2>lanes</h2><p>{_esc(lanes.get('n'))} lanes &middot; "
+            f"fill min {_esc(lanes.get('fill_min'))} / max "
+            f"{_esc(lanes.get('fill_max'))} &middot; "
+            f"<b>{_esc(lanes.get('empty'))}</b> empty</p>")
+    recent = occ.get("recent") or []
+    if recent:
+        bars = "".join(
+            f"<div title='round {_esc(r.get('round'))}: "
+            f"{_esc(r.get('fill'))}' style='display:inline-block;"
+            f"width:6px;margin:0 1px;vertical-align:bottom;"
+            f"height:{max(2, int(float(r.get('fill') or 0) * 80))}px;"
+            f"background:{_fill_color(r.get('fill'))}'></div>"
+            for r in recent[-80:])
+        parts.append("<h2>recent rounds (fill)</h2>"
+                     "<div style='height:84px;border-bottom:1px solid "
+                     "#ccc'>" + bars + "</div>")
+    parts.append("<p><a href='/status.json'>status.json</a> (the "
+                 "`occupancy` block)</p>")
+    return _page("occupancy", "".join(parts))
 
 
 def _fmt_epoch(t) -> str:
@@ -380,6 +462,7 @@ def render_home(cache: _ValidityCache) -> bytes:
             f"<td><a href='{href}.zip'>zip</a></td></tr>")
     body = ("<h1>jepsen_tpu</h1>"
             "<p><a href='/status'>live run status</a> &middot; "
+            "<a href='/occupancy'>occupancy</a> &middot; "
             "<a href='/runs'>run ledger</a></p>"
             "<table><thead><tr><th>Name</th>"
             "<th>Time</th><th>Valid?</th><th>Results</th><th>History</th>"
@@ -539,6 +622,10 @@ class Handler(BaseHTTPRequestHandler):
             if uri == "/status":
                 self._send(200, "text/html; charset=utf-8",
                            render_status(self.cache.store_root))
+                return
+            if uri == "/occupancy":
+                self._send(200, "text/html; charset=utf-8",
+                           render_occupancy(self.cache.store_root))
                 return
             if uri in ("/runs", "/runs/"):
                 self._send(200, "text/html; charset=utf-8",

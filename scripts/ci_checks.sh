@@ -1,0 +1,18 @@
+#!/usr/bin/env bash
+# One-gate CI: the three tier-1 checks in the order a fast failure is
+# cheapest — jax_lint (pure AST, seconds), telemetry_lint (schema
+# drift over artifacts/, seconds), then the tier-1 pytest line from
+# ROADMAP.md. Any failure exits non-zero; pytest runs on the cpu
+# backend so a wedged accelerator runtime can't hang the gate.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== jax_lint =="
+python scripts/jax_lint.py
+
+echo "== telemetry_lint =="
+python scripts/telemetry_lint.py
+
+echo "== tier-1 pytest =="
+JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
+    --continue-on-collection-errors -p no:cacheprovider

@@ -42,12 +42,22 @@ LINE_SCHEMAS = {
 # well-known series carry documented point fields on top of `t`
 SERIES_SCHEMAS = {
     "wgl_chunks": {"chunk": int, "wall_s": NUM, "poll_s": NUM,
-                   "frontier": int, "backlog": int, "explored": int,
-                   "rounds": int, "kernel": str, "platform": str},
+                   "frontier": int, "fill": NUM, "backlog": int,
+                   "explored": int, "rounds": int, "kernel": str,
+                   "platform": str},
+    "wgl_rounds": {"round": int, "span": int, "frontier": int,
+                   "fill": NUM, "memo_hits": int, "memo_inserts": int,
+                   "frontier_after": int, "backlog": int, "K": int,
+                   "kernel": str, "platform": str},
     "wgl_batched_chunks": {"wall_s": NUM, "poll_s": NUM,
                            "live_keys": int, "decided_keys": int,
                            "frontier_total": int, "backlog_total": int,
                            "explored_total": int},
+    "wgl_batched_lanes": {"poll": int, "wall_s": NUM, "K": int,
+                          "kernel": str, "live": int,
+                          "empty_lanes": int, "fill": list},
+    "wgl_batched_rounds": {"round": int, "lane": int, "fill": NUM,
+                           "frontier": int},
     "fleet_shards": {"key_index": int, "device": str, "engine": str,
                      "wall_s": NUM},
     "fleet_faults": {"fault_type": str, "error": str, "stage": str},
@@ -61,6 +71,11 @@ SERIES_SCHEMAS = {
 REGRESSIONS_SCHEMA = {"schema": int, "threshold_x": NUM,
                       "rounds": list, "configs": dict,
                       "regressions": list}
+
+# bench per-config utilization report (bench._export_occupancy)
+OCCUPANCY_SCHEMA = {"schema": int, "target_fill": NUM,
+                    "configs": dict, "below_target": list,
+                    "fill_regressions": list}
 
 # run-ledger records (jepsen_tpu/ledger.py index.jsonl + records/*)
 LEDGER_SCHEMA = {"schema": int, "id": str, "kind": str, "name": str,
@@ -162,6 +177,28 @@ def lint_regressions_file(path: str) -> list:
             errors.append(f"{where}: rounds entries need an int "
                           "'round'")
             break
+    return errors
+
+
+def lint_occupancy_file(path: str) -> list:
+    """artifacts/telemetry/occupancy.json: the envelope plus numeric
+    frontier_fill / meets_target on every config row."""
+    where = os.path.basename(path)
+    try:
+        with open(path) as fh:
+            obj = json.load(fh)
+    except (OSError, ValueError) as e:
+        return [f"{where}: not JSON ({e})"]
+    if not isinstance(obj, dict):
+        return [f"{where}: not an object"]
+    errors = _check_fields(obj, OCCUPANCY_SCHEMA, where)
+    for name, row in (obj.get("configs") or {}).items():
+        if not isinstance(row, dict) \
+                or not isinstance(row.get("frontier_fill"), NUM) \
+                or not isinstance(row.get("meets_target"), bool):
+            errors.append(
+                f"{where}: configs[{name!r}] needs numeric "
+                "'frontier_fill' and bool 'meets_target'")
     return errors
 
 
@@ -275,6 +312,8 @@ def lint_path(path: str) -> list:
     gparent = os.path.basename(os.path.dirname(os.path.dirname(path)))
     if path.endswith("regressions.json"):
         return lint_regressions_file(path)
+    if path.endswith("occupancy.json"):
+        return lint_occupancy_file(path)
     if path.endswith("perfetto.json"):
         return lint_perfetto_file(path)
     # ledger/index.jsonl AND ledger/records/<id>.json — the record
@@ -316,7 +355,7 @@ def main(argv=None) -> int:
             continue
         errs = lint_path(p)
         if p.endswith((".jsonl", "regressions.json",
-                       "perfetto.json")) or \
+                       "occupancy.json", "perfetto.json")) or \
                 os.path.basename(os.path.dirname(p)) == "records":
             linted += 1
         errors += errs

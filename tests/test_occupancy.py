@@ -1,0 +1,478 @@
+"""Kernel-occupancy plane tests (doc/OBSERVABILITY.md "Occupancy &
+roofline"): ring-buffer drain round-trips, fill/rate math on known
+synthetic searches, the CompileGuard zero-new-recompile /
+zero-new-transfer proof for the instrumented hot loop, the
+/status.json occupancy schema, per-lane fill on the batched fan-out,
+the Elle closure's per-iteration frontier, heatmap/overlay rendering,
+and the telemetry_lint schemas for the new series."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from jepsen_tpu import fleet, metrics, occupancy, synth, trace, web
+from jepsen_tpu.models import cas_register
+from jepsen_tpu.ops import wgl
+from jepsen_tpu.ops.wgl32 import RING_COLS, RING_ROWS, SUMMARY_HEAD
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LINT = os.path.join(REPO, "scripts", "telemetry_lint.py")
+
+
+def _hist(n=300, seed=5):
+    return synth.cas_register_history(n, n_procs=4, seed=seed,
+                                      crash_p=0.005)
+
+
+def _checked(seed=5, reg=None, **kw):
+    reg = reg if reg is not None else metrics.Registry()
+    res = wgl.check(cas_register(), _hist(seed=seed), time_limit=60,
+                    metrics=reg, **kw)
+    assert res["valid?"] is True
+    return res, reg
+
+
+# --- ring drain round-trips -------------------------------------------------
+
+class TestRingDrain:
+    def test_occupancy_block_schema_and_counts(self):
+        res, reg = _checked()
+        occ = res["occupancy"]
+        assert occ["schema"] == 1
+        assert occ["kernel"] == "wgl32"
+        assert occ["rounds_total"] == res["util"]["rounds"]
+        assert occ["rounds_seen"] >= 1
+        rounds = occ["rounds"]
+        assert len(rounds) >= 1
+        for r in rounds[:5]:
+            assert {"round", "span", "frontier", "fill", "memo_hits",
+                    "memo_inserts", "frontier_after", "backlog",
+                    "max_base", "wall_s", "t"} <= set(r)
+        # round ids strictly increase; fills normalized by span*K
+        ids = [r["round"] for r in rounds]
+        assert ids == sorted(ids) and len(set(ids)) == len(ids)
+        for r in rounds:
+            assert 0.0 <= r["fill"] <= 1.0
+            assert r["frontier"] <= r["span"] * occ["K"]
+
+    def test_drained_counters_sum_to_search_totals(self):
+        """The per-round rows ARE the search: with nothing dropped,
+        per-round expansions sum to configs_explored and per-round
+        memo counters to the util totals."""
+        res, _ = _checked()
+        occ = res["occupancy"]
+        assert occ["rounds_dropped"] == 0
+        assert occ["rounds_truncated"] == 0
+        rounds = occ["rounds"]
+        assert sum(r["frontier"] for r in rounds) == \
+            res["configs_explored"]
+        assert sum(r["memo_hits"] for r in rounds) == \
+            occ["memo"]["hits"]
+        assert sum(r["memo_inserts"] for r in rounds) == \
+            occ["memo"]["inserts"]
+        # compaction survivors == memo inserts by construction
+        assert occ["expansion"]["survivors_seen"] == \
+            occ["memo"]["inserts"]
+        assert occ["memo"]["hit_rate"] == res["util"]["memo_hit_rate"]
+
+    def test_wgl_rounds_series_matches_result_rounds(self):
+        res, reg = _checked(seed=7)
+        pts = reg.series("wgl_rounds").points
+        occ = res["occupancy"]
+        assert len(pts) == occ["rounds_seen"]
+        assert pts[0]["kernel"] == "wgl32"
+        assert pts[0]["platform"] == "cpu"
+        assert pts[-1]["round"] == occ["rounds"][-1]["round"]
+
+    def test_wide_window_kernel_drains_too(self):
+        reg = metrics.Registry()
+        res = wgl.check(cas_register(), synth.long_tail_history(
+            60, seed=3), time_limit=120, metrics=reg)
+        assert res["valid?"] is True
+        occ = res["occupancy"]
+        assert occ["kernel"] == "wgln"
+        assert occ["rounds_seen"] >= 1
+        assert sum(r["frontier"] for r in occ["rounds"]) == \
+            res["configs_explored"]
+
+    def test_drain_chunk_synthetic(self):
+        """Known-input drain: hand-packed summary -> exact rows."""
+        s = np.zeros(SUMMARY_HEAD + RING_ROWS * RING_COLS,
+                     dtype=np.int32)
+        s[5] = 3                       # stats[1]: 3 rounds this chunk
+        s[9] = 13                      # stats[5]: cumulative rounds
+        ring = s[SUMMARY_HEAD:].reshape(RING_ROWS, RING_COLS)
+        # rounds 11..13, frontier 4/8/16 of K=16
+        for i, (rnd, fr) in enumerate([(11, 4), (12, 8), (13, 16)]):
+            ring[i] = [rnd, fr, i, i + 1, fr, 0, rnd]
+        rows, dropped = occupancy.drain_chunk(s, rounds_before=10,
+                                              K=16)
+        assert dropped == 0
+        assert [r["round"] for r in rows] == [11, 12, 13]
+        assert [r["fill"] for r in rows] == [0.25, 0.5, 1.0]
+        assert [r["span"] for r in rows] == [1, 1, 1]
+        assert rows[1]["memo_hits"] == 1
+        assert rows[1]["memo_inserts"] == 2
+
+    def test_drain_chunk_depth_fused_spans(self):
+        """A depth-fused super-round (one ring row covering several
+        levels) normalizes fill by span * K."""
+        s = np.zeros(SUMMARY_HEAD + RING_ROWS * RING_COLS,
+                     dtype=np.int32)
+        s[5] = 1
+        s[9] = 4
+        ring = s[SUMMARY_HEAD:].reshape(RING_ROWS, RING_COLS)
+        ring[0] = [4, 32, 0, 0, 16, 0, 4]   # 4 levels, 32 expansions
+        rows, dropped = occupancy.drain_chunk(s, rounds_before=0,
+                                              K=16)
+        assert dropped == 0
+        assert rows[0]["span"] == 4
+        assert rows[0]["fill"] == 0.5       # 32 / (4 * 16)
+        s[9] = 8                            # 4 more rounds never rang
+        rows, dropped = occupancy.drain_chunk(s, rounds_before=0,
+                                              K=16)
+        assert dropped == 4                 # visible, not silent
+
+    def test_drain_chunk_ringless_summary_is_empty(self):
+        rows, dropped = occupancy.drain_chunk(
+            np.zeros(SUMMARY_HEAD, dtype=np.int32), 0, 16)
+        assert rows == [] and dropped == 0
+
+    def test_memo_hit_rate_single_definition(self):
+        assert occupancy.memo_hit_rate(0, 0) == 0.0
+        assert occupancy.memo_hit_rate(1, 3) == 0.25
+        assert occupancy.memo_hit_rate(7, 0) == 1.0
+
+
+# --- fill math on a pinned-beam search --------------------------------------
+
+class TestFillMath:
+    def test_frontier_override_bounds_fill(self):
+        """With the beam pinned to K=32 every per-round frontier is
+        <= 32 and fill == frontier / 32 exactly."""
+        reg = metrics.Registry()
+        res = wgl.check(cas_register(), _hist(seed=9), time_limit=60,
+                        frontier=32, metrics=reg)
+        assert res["valid?"] is True
+        occ = res["occupancy"]
+        assert occ["K"] == 32
+        for r in occ["rounds"]:
+            assert r["frontier"] <= 32 * r["span"]
+            assert r["fill"] == round(
+                r["frontier"] / (32 * r["span"]), 4)
+        # whole-search fill (util) equals the mean of per-round fills
+        # when every span is 1 and nothing was dropped
+        if all(r["span"] == 1 for r in occ["rounds"]) \
+                and occ["rounds_dropped"] == 0:
+            assert res["util"]["frontier_fill"] == pytest.approx(
+                occ["fill"]["mean"], abs=2e-4)
+
+    def test_roofline_block(self):
+        res, _ = _checked(seed=11)
+        rf = res["occupancy"]["roofline"]
+        assert rf["bound"] in ("compute", "memory")
+        assert rf["source"] in ("compiler-cost-analysis", "analytic")
+        assert rf["flops_per_round"] > 0
+        assert rf["bytes_per_round"] > 0
+        assert 0.0 <= rf["achieved_frac"] <= 1.0
+        assert "peak_chip" in rf
+
+    def test_roofline_analytic_fallback(self):
+        rf = occupancy.roofline(K=16, row_cols=24, probes=4,
+                                rounds=100, wall_s=1.0, cost=None)
+        assert rf["source"] == "analytic"
+        assert rf["bytes_per_round"] == 16 * 24 * 4 * 16
+        rf2 = occupancy.roofline(K=16, row_cols=24, probes=4,
+                                 rounds=100, wall_s=1.0,
+                                 cost={"flops": 1e12,
+                                       "bytes_accessed": 8.0})
+        assert rf2["source"] == "compiler-cost-analysis"
+        assert rf2["bound"] == "compute"
+
+
+# --- the CompileGuard zero-new-recompile / zero-new-transfer proof ----------
+
+class TestGuardProof:
+    def test_instrumented_loop_adds_no_compiles_no_transfers(self):
+        """ISSUE 8 acceptance: the instrumented hot loop adds ZERO
+        recompiles and ZERO host<->device transfers versus the
+        uninstrumented run — the ring rides the existing poll summary
+        and the roofline's cost analysis lowers without a backend
+        compile."""
+        from jepsen_tpu.analysis import guards
+        m, h = cas_register(), _hist(seed=21)
+        wgl.check(m, h, time_limit=60)  # warm the shape bucket
+        with guards.CompileGuard(name="occ-off") as g_off:
+            r_off = wgl.check(m, h, time_limit=60,
+                              metrics=metrics.NULL)
+        reg = metrics.Registry()
+        with guards.CompileGuard(max_compiles=0, name="occ-on") as g_on:
+            r_on = wgl.check(m, h, time_limit=60, metrics=reg)
+        assert g_on.compiles == 0
+        assert g_on.d2h == g_off.d2h
+        assert g_on.h2d == g_off.h2d
+        # same search either way, plus a populated occupancy block
+        assert r_on["valid?"] == r_off["valid?"] is True
+        assert r_on["configs_explored"] == r_off["configs_explored"]
+        occ = r_on["occupancy"]
+        assert occ["rounds_seen"] >= 1
+        assert occ["memo"]["inserts"] > 0
+        assert "occupancy" not in r_off
+
+
+# --- /status.json occupancy schema ------------------------------------------
+
+OCC_STATUS_KEYS = {"active", "mode", "kernel", "platform", "K",
+                   "fill_last", "fill_mean", "rounds_seen",
+                   "rounds_dropped", "lanes", "recent"}
+
+
+class TestStatusSchema:
+    def test_snapshot_carries_occupancy_block(self, tmp_path):
+        st = fleet.RunStatus(enabled=True, test="occ")
+        with fleet.use(st):
+            _checked(seed=5)
+            snap = web.status_snapshot(str(tmp_path))
+        occ = snap["occupancy"]
+        assert OCC_STATUS_KEYS <= set(occ)
+        assert occ["active"] is True
+        assert occ["mode"] == "single"
+        assert occ["kernel"] == "wgl32"
+        assert occ["rounds_seen"] >= 1
+        assert 0.0 <= occ["fill_last"] <= 1.0
+        assert isinstance(occ["recent"], list) and occ["recent"]
+        assert {"round", "fill"} <= set(occ["recent"][-1])
+
+    def test_idle_stub_has_occupancy(self, tmp_path):
+        assert not fleet.get_default().enabled
+        snap = web.status_snapshot(str(tmp_path))
+        assert snap["occupancy"] == {"active": False}
+
+    def test_occupancy_panel_renders(self, tmp_path):
+        st = fleet.RunStatus(enabled=True, test="occ-panel")
+        st.occupancy_poll({"mode": "single", "kernel": "wgl32",
+                           "platform": "cpu", "K": 16,
+                           "fill_last": 0.9, "fill_mean": 0.5,
+                           "rounds_seen": 4,
+                           "recent_rounds": [{"round": i,
+                                              "fill": i / 4}
+                                             for i in range(1, 5)]})
+        with fleet.use(st):
+            doc = web.render_occupancy(str(tmp_path)).decode()
+        assert "kernel occupancy" in doc
+        assert "0.9" in doc
+        assert "fill target" in doc or "target" in doc
+        # and the no-data page never errors
+        prev = fleet.set_default(fleet.RunStatus(enabled=False))
+        try:
+            doc2 = web.render_occupancy(str(tmp_path)).decode()
+        finally:
+            fleet.set_default(prev)
+        assert "no occupancy data" in doc2
+
+
+# --- plots: heatmap + progress overlay --------------------------------------
+
+class TestPlots:
+    def test_heatmap_smoke(self, tmp_path):
+        from jepsen_tpu.checker import plots
+        test = {"name": "hm", "start_time": "t0",
+                "store_root": str(tmp_path)}
+        pts = [{"round": r, "lane": l, "fill": ((r * (l + 1)) % 10)
+                / 10.0}
+               for r in range(1, 40) for l in range(6)]
+        p = plots.occupancy_heatmap(test, pts)
+        assert p and os.path.exists(p)
+        assert p.endswith("occupancy-heatmap.png")
+        # malformed / empty input never raises
+        assert plots.occupancy_heatmap(test, []) is None
+        assert plots.occupancy_heatmap(test, [{"bogus": 1}]) is None
+        # explicit-path rendering (the bench artifact tree)
+        out = str(tmp_path / "art" / "hm.png")
+        assert plots.occupancy_heatmap(None, pts, out_path=out) == out
+        assert os.path.exists(out)
+
+    def test_progress_graph_fill_overlay(self, tmp_path):
+        from jepsen_tpu.checker import plots
+        test = {"name": "sp-occ", "start_time": "t0",
+                "store_root": str(tmp_path)}
+        chunks = [{"wall_s": 0.1 * i, "poll_s": 0.1, "frontier": 16,
+                   "backlog": 0, "K": 16, "explored": 100 * i,
+                   "explored_delta": 100, "memo_hit_rate": 0.5}
+                  for i in range(1, 5)]
+        rounds = [{"round": i, "fill": i / 20, "wall_s": 0.02 * i}
+                  for i in range(1, 21)]
+        p = plots.search_progress_graph(test, chunks, rounds=rounds)
+        assert p and os.path.exists(p)
+        # rounds=None keeps the pre-overlay behavior
+        assert plots.search_progress_graph(test, chunks) is not None
+
+    def test_checker_renders_heatmap_from_occupancy(self, tmp_path):
+        from jepsen_tpu import checker
+        test = {"name": "occ-check", "start_time": "t0",
+                "store_root": str(tmp_path)}
+        with metrics.use(metrics.Registry()):
+            res = checker.linearizable(
+                cas_register(), algorithm="tpu-wgl",
+                time_limit=60).check(test, _hist(seed=7), {})
+        assert res["valid?"] is True
+        assert os.path.exists(res["search-progress-png"])
+        p = res["occupancy-heatmap-png"]
+        assert p and os.path.exists(p)
+
+
+# --- batched fan-out: per-lane fill -----------------------------------------
+
+class TestBatchedLanes:
+    def test_vmap_batch_records_lane_fill(self):
+        from jepsen_tpu.parallel import check_batched
+        hs = [synth.cas_register_history(60, n_procs=3, seed=s)
+              for s in range(5)]
+        reg = metrics.Registry()
+        st = fleet.RunStatus(enabled=True, test="b")
+        with metrics.use(reg), fleet.use(st):
+            res = check_batched(cas_register(), hs, time_limit=60,
+                                strategy="vmap")
+        assert all(r["valid?"] is True for r in res)
+        lanes = reg.series("wgl_batched_lanes").points
+        assert lanes, "no per-lane fill points recorded"
+        for p in lanes:
+            assert len(p["fill"]) == 5
+            assert all(0.0 <= f <= 1.0 for f in p["fill"])
+            assert p["K"] >= 1
+        rp = [p for p in reg.series("wgl_batched_rounds").points
+              if p["round"] >= 0]
+        assert rp, "no per-round heatmap points recorded"
+        assert {p["lane"] for p in rp} == set(range(5))
+        # per-key results carry their lane's occupancy coordinates
+        occ = res[0]["occupancy"]
+        assert occ["lane"] == 0
+        assert 0.0 <= occ["fill_last"] <= 1.0
+        # the status panel saw the lane summary
+        lo = st.snapshot()["occupancy"]
+        assert lo["mode"] == "batched"
+        assert lo["lanes"]["n"] == 5
+
+
+# --- elle closure: per-iteration frontier -----------------------------------
+
+class TestElleIters:
+    def test_closure_reports_iteration_frontier(self):
+        from jepsen_tpu.elle import tpu as etpu
+        from jepsen_tpu.elle.graph import WR, WW, DepGraph
+        g = DepGraph()
+        for (s, d, t) in [(1, 2, WW), (2, 3, WW), (3, 1, WW),
+                          (3, 4, WR)]:
+            g.add_edge(s, d, t)
+        out = etpu.standard_cycle_search(g, backend="tpu")
+        assert out["G0"] is not None
+        u = out["util"]
+        assert len(u["iter_reach"]) == u["iters"]
+        assert all(len(row) == 3 for row in u["iter_reach"])
+        # reach is monotone under repeated squaring
+        widest = [row[-1] for row in u["iter_reach"]]
+        assert widest == sorted(widest)
+        assert 1 <= u["converged_at"] <= u["iters"]
+        assert 0.0 < u["reach_density"] <= 1.0
+
+
+# --- telemetry_lint schemas --------------------------------------------------
+
+class TestLintSchemas:
+    def _lint_lines(self, tmp_path, lines, name="m.jsonl"):
+        p = tmp_path / name
+        p.write_text("".join(json.dumps(x) + "\n" for x in lines))
+        return subprocess.run([sys.executable, LINT, str(p)],
+                              capture_output=True, text=True)
+
+    def good_round(self):
+        return {"type": "sample", "series": "wgl_rounds", "t": 1.0,
+                "round": 3, "span": 1, "frontier": 8, "fill": 0.5,
+                "memo_hits": 1, "memo_inserts": 2,
+                "frontier_after": 2, "backlog": 0, "K": 16,
+                "kernel": "wgl32", "platform": "cpu"}
+
+    def test_wgl_rounds_schema_good_and_drifted(self, tmp_path):
+        assert self._lint_lines(tmp_path, [self.good_round()]
+                                ).returncode == 0
+        bad = self.good_round()
+        bad["fill"] = "0.5"  # stringified number = drift
+        proc = self._lint_lines(tmp_path, [bad])
+        assert proc.returncode == 1
+        assert "fill" in proc.stderr
+        missing = self.good_round()
+        del missing["frontier"]
+        assert self._lint_lines(tmp_path, [missing]).returncode == 1
+
+    def test_batched_series_schemas(self, tmp_path):
+        good = [
+            {"type": "sample", "series": "wgl_batched_lanes", "t": 1.0,
+             "poll": 0, "wall_s": 0.1, "K": 64, "kernel": "wgl32",
+             "live": 3, "empty_lanes": 1, "fill": [0.1, 0.0, 0.5]},
+            {"type": "sample", "series": "wgl_batched_rounds",
+             "t": 1.0, "round": 2, "lane": 1, "fill": 0.25,
+             "frontier": 16},
+        ]
+        assert self._lint_lines(tmp_path, good).returncode == 0
+        bad = dict(good[0])
+        bad["fill"] = 0.5  # scalar where the lane vector belongs
+        assert self._lint_lines(tmp_path, [bad]).returncode == 1
+
+    def test_occupancy_report_schema(self, tmp_path):
+        rep = {"schema": 1, "target_fill": 0.8, "platform": "cpu",
+               "configs": {"mutex_1k": {"frontier_fill": 0.14,
+                                        "meets_target": False}},
+               "below_target": ["mutex_1k"], "fill_regressions": []}
+        p = tmp_path / "occupancy.json"
+        p.write_text(json.dumps(rep))
+        proc = subprocess.run([sys.executable, LINT, str(p)],
+                              capture_output=True, text=True)
+        assert proc.returncode == 0, proc.stderr
+        rep["configs"]["mutex_1k"]["frontier_fill"] = "0.14"
+        p.write_text(json.dumps(rep))
+        proc = subprocess.run([sys.executable, LINT, str(p)],
+                              capture_output=True, text=True)
+        assert proc.returncode == 1
+        assert "frontier_fill" in proc.stderr
+
+    def test_exported_run_lints_clean(self, tmp_path):
+        """An actual instrumented run's JSONL export passes the
+        linter — the schemas match what the code emits, not just the
+        synthetic fixtures above."""
+        _, reg = _checked(seed=5)
+        p = str(tmp_path / "occ_metrics.jsonl")
+        reg.export_jsonl(p)
+        proc = subprocess.run([sys.executable, LINT, p],
+                              capture_output=True, text=True)
+        assert proc.returncode == 0, proc.stderr
+
+
+# --- perfetto counter tracks -------------------------------------------------
+
+class TestPerfettoCounters:
+    def test_counter_tracks_from_registry(self, tmp_path):
+        _, reg = _checked(seed=5)
+        tracks = occupancy.perfetto_counter_tracks(reg)
+        assert "wgl fill" in tracks
+        assert "wgl frontier" in tracks
+        tr = trace.Tracer(sampled=True)
+        with tr.span("check"):
+            pass
+        p = str(tmp_path / "t.perfetto.json")
+        tr.export_perfetto(p, counters=tracks)
+        doc = json.load(open(p))
+        cev = [e for e in doc["traceEvents"] if e["ph"] == "C"]
+        assert cev
+        assert all(isinstance(e["args"]["value"], float)
+                   for e in cev)
+        # the exported doc passes the perfetto lint schema
+        proc = subprocess.run([sys.executable, LINT, p],
+                              capture_output=True, text=True)
+        assert proc.returncode == 0, proc.stderr
+
+    def test_null_registry_yields_no_tracks(self):
+        assert occupancy.perfetto_counter_tracks(metrics.NULL) == {}
