@@ -929,16 +929,23 @@ def load_bench_rounds(root: str = REPO_ROOT) -> list:
         if not isinstance(parsed, dict) or parsed.get("value") is None:
             continue
         configs = {}
+        fills = {}
         for name, c in (parsed.get("configs") or {}).items():
             if isinstance(c, dict) and isinstance(
                     c.get("wall_s"), (int, float)):
                 configs[name] = c["wall_s"]
+            # occupancy trajectory: compact-line entries carry
+            # frontier_fill from this round on (emit)
+            if isinstance(c, dict) and isinstance(
+                    c.get("frontier_fill"), (int, float)):
+                fills[name] = c["frontier_fill"]
         rounds.append({"round": int(m.group(1)),
                        "file": os.path.basename(path),
                        "value": parsed.get("value"),
                        "platform": parsed.get("platform"),
                        "verdict": parsed.get("verdict"),
                        "configs": configs,
+                       "fills": fills,
                        "source": "glob"})
     by_round = {r["round"]: r for r in rounds}
     try:
@@ -956,6 +963,9 @@ def load_bench_rounds(root: str = REPO_ROOT) -> list:
                 "configs": {k: v for k, v in
                             (rec.get("configs") or {}).items()
                             if isinstance(v, (int, float))},
+                "fills": {k: v for k, v in
+                          (rec.get("fills") or {}).items()
+                          if isinstance(v, (int, float))},
                 "source": "ledger"}
     except Exception:  # noqa: BLE001 — a torn ledger never hides
         pass  # the glob rounds
@@ -1020,6 +1030,24 @@ def compute_regressions(rounds: list, current=None,
         out["configs"][name] = row
         if row.get("regressed"):
             out["regressions"].append(name)
+    # occupancy trajectory (ROADMAP item 5): a config whose
+    # frontier_fill drops below 0.9x its best same-platform prior is
+    # flagged "<name>:fill" — a change that wins wall time by
+    # emptying the lanes still trips the tracker
+    out["occupancy"] = {}
+    for name in sorted({n for r in prior + [current]
+                        for n in (r.get("fills") or {})}):
+        latest = (current.get("fills") or {}).get(name)
+        priors = [r["fills"][name] for r in prior
+                  if name in (r.get("fills") or {})]
+        if latest is None or not priors:
+            continue
+        best = max(priors)
+        row = {"latest": latest, "best_prior": best,
+               "regressed": bool(best > 0 and latest < 0.9 * best)}
+        out["occupancy"][name] = row
+        if row["regressed"]:
+            out["regressions"].append(f"{name}:fill")
     return out
 
 
@@ -1042,7 +1070,14 @@ def _export_regressions(out: dict) -> None:
                 name: c["wall_s"]
                 for name, c in (out.get("configs") or {}).items()
                 if isinstance(c, dict) and isinstance(
-                    c.get("wall_s"), (int, float))}}
+                    c.get("wall_s"), (int, float))},
+            "fills": {
+                name: c["util"]["frontier_fill"]
+                for name, c in (out.get("configs") or {}).items()
+                if isinstance(c, dict)
+                and isinstance(c.get("util"), dict)
+                and isinstance(c["util"].get("frontier_fill"),
+                               (int, float))}}
         threshold = float(os.environ.get(
             "JEPSEN_TPU_BENCH_REGRESSION_X", "1.5"))
         report = compute_regressions(rounds, current,
@@ -1060,7 +1095,8 @@ def _export_regressions(out: dict) -> None:
                             "platform": current["platform"],
                             "verdict": current["verdict"],
                             "wall_s": current["value"],
-                            "configs": current["configs"]})
+                            "configs": current["configs"],
+                            "fills": current["fills"]})
         art = os.path.join(REPO_ROOT, "artifacts", "telemetry")
         os.makedirs(art, exist_ok=True)
         with open(os.path.join(art, "regressions.json"), "w") as fh:
@@ -1216,10 +1252,22 @@ def emit(out: dict) -> None:
             "evidence_wall_s": aot.get("evidence_wall_s")}
     cfgs = out.get("configs")
     if isinstance(cfgs, dict):
-        compact["configs"] = {
-            name: {k: v.get(k) for k in ("verdict", "wall_s", "engine")
-                   if isinstance(v, dict) and v.get(k) is not None}
-            for name, v in cfgs.items()}
+        compact["configs"] = {}
+        for name, v in cfgs.items():
+            if not isinstance(v, dict):
+                continue
+            row = {k: v.get(k) for k in ("verdict", "wall_s", "engine")
+                   if v.get(k) is not None}
+            # occupancy on the compact line: frontier_fill +
+            # memo_hit_rate ride every BENCH_r*.json config entry so
+            # the trajectory tracker flags occupancy regressions,
+            # not just wall-time ones (compute_regressions)
+            util = v.get("util")
+            if isinstance(util, dict):
+                for k in ("frontier_fill", "memo_hit_rate"):
+                    if util.get(k) is not None:
+                        row[k] = util[k]
+            compact["configs"][name] = row
     compact["details"] = "BENCH_DETAILS.json"
     print(json.dumps(compact), flush=True)
 

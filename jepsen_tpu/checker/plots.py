@@ -362,6 +362,12 @@ def bench_trajectory_graph(report: dict, out_path: str) -> Optional[str]:
         names = sorted({n for r in rounds
                         for n in (r.get("configs") or {})})
         flagged = set(report.get("regressions") or [])
+        # occupancy regressions ride the same flag list as
+        # "<name>:fill" (bench.compute_regressions) — the config's
+        # wall line marks them too, so an emptied-lanes regression is
+        # as loud as a wall-time one
+        fill_flagged = {f.rsplit(":", 1)[0] for f in flagged
+                        if f.endswith(":fill")}
         for i, name in enumerate(names):
             pts = [(x, (r.get("configs") or {}).get(name))
                    for x, r in zip(xs, rounds)]
@@ -369,12 +375,14 @@ def bench_trajectory_graph(report: dict, out_path: str) -> Optional[str]:
             if not pts:
                 continue
             px, py = zip(*pts)
-            color = (Q_COLORS[1.0] if name in flagged
-                     else f"C{i % 10}")
+            hot = name in flagged or name in fill_flagged
+            color = Q_COLORS[1.0] if hot else f"C{i % 10}"
+            suffix = (" (REGRESSED)" if name in flagged
+                      else " (FILL REGRESSED)" if name in fill_flagged
+                      else "")
             ax.plot(px, py, marker=MARKERS[i % len(MARKERS)],
                     markersize=4, lw=1, color=color,
-                    label=name + (" (REGRESSED)" if name in flagged
-                                  else ""))
+                    label=name + suffix)
         ax.set_yscale("log")
         ax.set_xlabel("BENCH round")
         ax.set_ylabel("config wall (s)")

@@ -98,17 +98,31 @@ def make_closure_kernel(n_pad: int, n_sub: int, iters: int, dtype):
         # doc/OBSERVABILITY.md "Occupancy & roofline")
         counts0 = jnp.zeros((iters, n_sub), jnp.int32)
 
-        def square(i, st):
-            r, cnt = st
+        # Convergence early-exit (ROADMAP item 2's reclaimable
+        # squarings, exposed by PR 8's converged_at counters): reach
+        # under repeated squaring is monotone and idempotent at the
+        # fixed point, so once the per-subset pair counts repeat the
+        # remaining scheduled squarings are pure wasted MXU work —
+        # stop there. Outputs are bit-identical to the fixed
+        # schedule; `iters_run` reports what actually executed.
+        def cond(st):
+            _, _, i, changed = st
+            return (i < iters) & changed
+
+        def square(st):
+            r, cnt, i, _ = st
             prod = jnp.einsum("sij,sjk->sik", r, r,
                               preferred_element_type=jnp.float32)
             r2 = (prod > 0).astype(dtype)
-            cnt = cnt.at[i].set(
-                jnp.sum((r2 > 0).astype(jnp.int32), axis=(1, 2)))
-            return r2, cnt
+            c = jnp.sum((r2 > 0).astype(jnp.int32), axis=(1, 2))
+            prev = jnp.where(i > 0, cnt[jnp.maximum(i - 1, 0)],
+                             jnp.full((n_sub,), -1, jnp.int32))
+            cnt = cnt.at[i].set(c)
+            return r2, cnt, i + 1, jnp.any(c != prev)
 
-        reach, counts = jax.lax.fori_loop(0, iters, square,
-                                          (reach, counts0))
+        reach, counts, iters_run, _ = jax.lax.while_loop(
+            cond, square, (reach, counts0, jnp.int32(0),
+                           jnp.asarray(True)))
         rb = reach > 0
         mutual = rb & jnp.swapaxes(rb, 1, 2)
         cols = jnp.arange(n_pad, dtype=jnp.int32)
@@ -116,7 +130,7 @@ def make_closure_kernel(n_pad: int, n_sub: int, iters: int, dtype):
                            n_pad).min(axis=2)
         # rw-closure queries: path q_dst -> q_src under each subset
         closed = rb[:, q_dst, q_src]
-        return labels.astype(jnp.int32), closed, counts
+        return labels.astype(jnp.int32), closed, counts, iters_run
 
     return kernel
 
@@ -226,26 +240,31 @@ def cycle_queries(g: DepGraph,
     with wd.watch("elle-closure", device="tpu",
                   stall_s=300.0) as hb:
         wd.beat(hb, edges=int(len(src)), n=n, n_pad=n_pad, iters=iters)
-        labels, closed, iter_counts = kernel(*ins)
-        jax.block_until_ready((labels, closed, iter_counts))
+        labels, closed, iter_counts, iters_run = kernel(*ins)
+        jax.block_until_ready((labels, closed, iter_counts, iters_run))
     kernel_s = _t.monotonic() - t0
+    # Convergence early-exit (make_closure_kernel): the device loop
+    # stopped after `iters_run` squarings; the rest of the fixed
+    # schedule is reclaimed MXU work, reported below.
+    iters_run = max(1, int(iters_run))
     # Achieved matmul throughput vs the flop model in the module
-    # docstring: iters squarings x n_sub batched (n_pad)^3 matmuls.
-    flops = 2.0 * n_sub * iters * float(n_pad) ** 3
+    # docstring: iters_run squarings x n_sub batched (n_pad)^3
+    # matmuls — the work that actually executed.
+    flops = 2.0 * n_sub * iters_run * float(n_pad) ** 3
     # per-iteration frontier (occupancy plane): reachable-pair counts
-    # per subset after each squaring, and the first iteration at
-    # which the widest subset's closure stopped growing — iterations
-    # past it are pure wasted MXU work an early-exit variant could
-    # reclaim (ROADMAP item 2)
-    iter_counts = np.asarray(iter_counts)         # (iters, n_sub)
+    # per subset after each executed squaring, and the first
+    # iteration at which the widest subset's closure stopped growing
+    iter_counts = np.asarray(iter_counts)[:iters_run]  # (run, n_sub)
     iter_reach = [[int(v) for v in row] for row in iter_counts]
     widest = iter_counts[:, -1]
-    converged_at = int(iters)
-    for i in range(1, iters):
+    converged_at = int(iters_run)
+    for i in range(1, iters_run):
         if widest[i] == widest[i - 1]:
             converged_at = i
             break
     util = {"n_pad": n_pad, "iters": iters,
+            "iters_run": iters_run,
+            "iters_reclaimed": int(iters) - iters_run,
             "kernel_s": round(kernel_s, 4),
             "compile_s": round(compile_s, 3),
             "achieved_tflops": round(flops / 1e12 / max(kernel_s, 1e-9),

@@ -1,0 +1,282 @@
+"""Occupancy-driven adaptive frontier scheduling for the WGL kernels.
+
+ROADMAP item 5: the PR-8 occupancy observatory showed the vmap lanes
+mostly empty on the bench configs (frontier_fill 0.14-0.44 at the
+fixed K=16 beam) — every round still pays the full K x (W + ic)
+successor expansion for a wavefront of 2-7 configs. Measured on the
+cpu backend (cas_register 10k, the headline shape): K=2 decides in
+0.39 s at fill 0.9999 where K=16 takes 1.61 s at fill 0.79 — a 4x
+wall win from *shrinking* the beam to the wavefront. The flip side is
+the exhaustive regime (invalid / adversarial histories must expand
+the whole reachable space): there rounds ~= total/K, so a narrow
+beam serializes and breadth wins — the old `wgl._ESCALATE_AT` jump
+to K=512 was exactly that observation, hard-coded.
+
+This module generalizes both into a **bucket ladder**: a small set of
+pre-compilable frontier capacities (one XLA executable per bucket,
+`functools.lru_cache`d by the kernel builders, so a warm ladder run
+stays inside a CompileGuard zero-compile budget) and a host-side
+hysteresis **policy** that picks the bucket BETWEEN device chunks
+from the same packed poll summary the host already reads — no extra
+transfers, no host syncs inside the hot loop, no retraces inside
+`lax.while_loop`.
+
+Policy signals (all host-side, per poll):
+
+  * **grow** when the search looks exhaustive: configs explored pass
+    an n_ok-relative threshold that quadruples per level (a valid
+    history explores ~2-3 x n_ok configs total and never trips it;
+    an exhaustive one blows through every level), or the backlog
+    nears capacity (overflow turns False into "unknown" — jump to
+    the top bucket before that);
+  * **shrink** when the beam runs persistently sparse: mean occupied
+    lanes fit inside HALF the next bucket down for `patience`
+    consecutive polls (hysteresis — a single sparse chunk on an
+    oscillating wavefront must not thrash the ladder, see
+    tests/test_adapt.py);
+  * a bucket abandoned by a shrink-then-regrow within the thrash
+    window is burned for the rest of the search.
+
+The policy is pure Python over integers — unit-testable with no
+device, no jax import.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Optional
+
+# The narrow-kernel (wgl32) ladder. Bottom bucket 2: the measured
+# sweet spot for valid histories (wavefront 2-4 configs on the
+# register/cas/mutex matrix — see module docstring); top bucket 512:
+# the proven exhaustion beam (`wgl._K_BIG`). Geometric x8 spacing
+# keeps the ladder at 4 executables.
+LADDER32 = (2, 16, 64, 512)
+
+# Explored-configs growth schedule: level i -> i+1 when
+# explored >= max(ESC_BASE, ESC_MULT * n_ok) * ESC_STEP**i.
+# Calibration: a valid history explores ~2.6 x n_ok configs, so
+# 6 x n_ok never fires on one; the 40k floor keeps tiny adversarial
+# histories (n_ok ~ 100, reachable space ~ millions) from crawling
+# at the bottom bucket for long.
+ESC_BASE = 40_000
+ESC_MULT = 6
+ESC_STEP = 4
+
+
+def enabled(default: bool = True) -> bool:
+    """The adaptive kill-switch: JEPSEN_TPU_ADAPTIVE=0 pins the old
+    fixed-K behavior (and the legacy one-shot escalation)."""
+    v = os.environ.get("JEPSEN_TPU_ADAPTIVE")
+    if v is None:
+        return default
+    return v not in ("0", "false", "no")
+
+
+def ladder_for(k_max: int, k_min: int = 2, step: int = 8) -> tuple:
+    """A geometric bucket ladder [k_min .. k_max] (k_max always
+    included), for kernels whose capacity ceiling is platform-derived
+    (the packed wide-window path). Powers of two, ascending."""
+    k_max = max(1, int(k_max))
+    k_min = max(1, min(int(k_min), k_max))
+    out = []
+    k = k_min
+    while k < k_max:
+        out.append(k)
+        k *= step
+    out.append(k_max)
+    return tuple(out)
+
+
+def recommend(ladder: tuple, occupied: float) -> int:
+    """The stateless per-lane hint: the smallest bucket that holds
+    ~2x the observed mean occupancy (the batched vmap path records
+    these per lane — it cannot re-bucket a single lane of a lockstep
+    batch, but the hint names the capacity each lane actually
+    needs)."""
+    want = max(1.0, 2.0 * float(occupied))
+    for k in ladder:
+        if k >= want:
+            return k
+    return ladder[-1]
+
+
+@dataclass
+class Decision:
+    """One policy verdict, recorded into the `wgl_adapt` series."""
+
+    switch: bool
+    to_k: int
+    reason: str
+
+
+@dataclass
+class Policy:
+    """Hysteresis bucket selection from per-poll occupancy inputs.
+
+    `observe()` is called once per device poll with cumulative
+    explored plus this chunk's round/expansion deltas and the
+    end-of-chunk frontier/backlog counts; it returns a `Decision`.
+    The caller owns the actual kernel swap + carry migration
+    (`wgl._search_loop` / `migrate_frontier`).
+    """
+
+    ladder: tuple
+    n_ok: int
+    backlog_cap: int            # B: jump to top before overflow
+    start_k: Optional[int] = None
+    esc_base: int = ESC_BASE
+    esc_mult: int = ESC_MULT
+    esc_step: int = ESC_STEP
+    shrink_frac: float = 0.5    # occupied <= frac * lower bucket
+    patience: int = 2           # consecutive sparse polls to shrink
+    level: int = field(init=False)
+    sparse_streak: int = field(default=0, init=False)
+    burned: set = field(default_factory=set, init=False)
+    switches: list = field(default_factory=list, init=False)
+
+    def __post_init__(self):
+        self.ladder = tuple(sorted(set(int(k) for k in self.ladder)))
+        if not self.ladder:
+            raise ValueError("empty ladder")
+        self.level = (self.ladder.index(self.start_k)
+                      if self.start_k in self.ladder else 0)
+
+    @property
+    def k(self) -> int:
+        return self.ladder[self.level]
+
+    def _esc_threshold(self) -> int:
+        base = max(self.esc_base, self.esc_mult * max(self.n_ok, 1))
+        return base * (self.esc_step ** self.level)
+
+    def observe(self, *, explored: int, rounds_delta: int,
+                explored_delta: int, frontier: int,
+                backlog: int) -> Decision:
+        k = self.k
+        top = len(self.ladder) - 1
+        # overflow prevention outranks everything: a backlog within
+        # 1/8 of capacity risks turning a False verdict into
+        # "backlog-overflow"/unknown — take the whole top beam now
+        if self.level < top and backlog >= max(1, self.backlog_cap // 8):
+            return self._switch(top, "backlog-pressure")
+        # exhaustion regime: explored blew through this level's
+        # threshold — the search is enumerating, breadth amortizes
+        if self.level < top and explored >= self._esc_threshold():
+            return self._switch(self.level + 1, "explored-threshold")
+        # sparse beam: mean occupied lanes fit well inside the next
+        # bucket down, for `patience` consecutive polls
+        if self.level > 0 and rounds_delta > 0:
+            occupied = explored_delta / rounds_delta
+            lower = self.ladder[self.level - 1]
+            fits = (occupied <= self.shrink_frac * lower
+                    and frontier <= lower
+                    and self.level - 1 not in self.burned)
+            self.sparse_streak = self.sparse_streak + 1 if fits else 0
+            if self.sparse_streak >= self.patience:
+                return self._switch(self.level - 1, "sparse-frontier")
+        else:
+            self.sparse_streak = 0
+        return Decision(False, k, "hold")
+
+    def _switch(self, new_level: int, reason: str) -> Decision:
+        # shrink-then-regrow inside the thrash window burns the
+        # abandoned lower bucket: oscillating wavefronts settle at
+        # the wider bucket instead of ping-ponging executables
+        if (new_level > self.level and self.switches
+                and self.switches[-1][1] < self.switches[-1][0]):
+            self.burned.add(self.level)
+        self.switches.append((self.level, new_level, reason))
+        self.level = new_level
+        self.sparse_streak = 0
+        return Decision(True, self.k, reason)
+
+    def summary(self) -> dict:
+        """The `util.adapt` block: what the ladder did this search."""
+        return {
+            "ladder": list(self.ladder),
+            "final_K": self.k,
+            "switches": len(self.switches),
+            "path": [[self.ladder[a], self.ladder[b], r]
+                     for a, b, r in self.switches],
+            "buckets_visited": sorted(
+                {self.ladder[0]} | {self.ladder[b]
+                                    for _, b, _ in self.switches}),
+        }
+
+
+def migrate_frontier(carry, k_new: int):
+    """Re-bucket a packed wgl32/wgln carry between chunks: the
+    frontier (K, C) grows by zero-padding (rows past fr_cnt are
+    inert) or shrinks by slicing. The caller must only shrink when
+    the polled fr_cnt <= k_new (the policy's sparse rule guarantees
+    it); backlog/memo/flags/stats/ring ride along untouched. A couple
+    of device ops per switch, outside the jitted loop — no retrace,
+    no host sync."""
+    import jax.numpy as jnp
+
+    fr = carry[0]
+    k_old = fr.shape[0]
+    if k_new == k_old:
+        return carry
+    if k_new > k_old:
+        fr = jnp.pad(fr, [(0, k_new - k_old), (0, 0)])
+    else:
+        fr = fr[:k_new]
+    return (fr, *carry[1:])
+
+
+def precompile_ladder(*, n_pad: int, ic_pad: int, S: int, O: int,
+                      H: int, B: int, chunk: int, probes: int,
+                      W: int, L: int = 0, accel: bool = False,
+                      depth: int = 1, ladder: tuple = LADDER32,
+                      pack: bool = False,
+                      compile_now: bool = False) -> dict:
+    """Warm every ladder bucket's kernel for one shape bucket.
+
+    By default this only populates the builders' lru caches (tracing
+    is deferred to first call); `compile_now=True` additionally runs
+    each bucket's kernel ONCE with a zero config budget — the
+    while-loop exits before its first round, so the call costs pure
+    trace + XLA compile and leaves the jit call cache (and, when
+    enabled, the persistent compilation cache) warm. A later real
+    search over this shape bucket then stays at zero recompiles no
+    matter which buckets the policy visits — the
+    checker-as-a-service warm-up path (`ops/aot.py
+    precompile_wgl_ladder`). Returns {K: compile_seconds | None}."""
+    import time as _t
+
+    out: dict = {}
+    for k in ladder:
+        if L:
+            from .wgln import compiled_searchN
+            init_fn, chunk_jit = compiled_searchN(
+                n_pad=n_pad, ic_pad=ic_pad, S=S, O=O, K=k, H=H, B=B,
+                chunk=chunk, probes=probes, W=W, L=L, accel=accel,
+                pack=pack)
+        else:
+            from .wgl32 import compiled_search32
+            init_fn, chunk_jit = compiled_search32(
+                n_pad=n_pad, ic_pad=ic_pad, S=S, O=O, K=k, H=H, B=B,
+                chunk=chunk, probes=probes, W=W, accel=accel,
+                depth=depth, pack=pack)
+        if not compile_now:
+            out[k] = None
+            continue
+        import jax
+        import jax.numpy as jnp
+
+        t0 = _t.monotonic()
+        z1 = jnp.zeros((n_pad,), jnp.int32)
+        consts = (z1, z1, z1, jnp.zeros((n_pad + 1,), jnp.int32),
+                  jnp.zeros((ic_pad,), jnp.int32),
+                  jnp.zeros((ic_pad,), jnp.int32),
+                  jnp.zeros((S, O), jnp.int32),
+                  jnp.int32(0), jnp.int32(0),
+                  jnp.int32(0))  # max_cfg 0: zero rounds run
+        carry, summary = chunk_jit(consts, init_fn(0))
+        jax.block_until_ready(summary)
+        del carry
+        out[k] = round(_t.monotonic() - t0, 3)
+    return out

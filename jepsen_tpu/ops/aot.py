@@ -77,6 +77,14 @@ def tpu_topology(name: str = _TOPOLOGY):
     compiler stack can't provide one (no libtpu in the image).  Pure
     host work: never initializes a backend, so it is safe on the
     wedged-axon machine (see util.backend_ready docs)."""
+    # libtpu init probes the GCE metadata server for a dozen tpu-env
+    # variables; off-GCE each probe can retry for ~30 s against a
+    # 403-ing endpoint (observed: 460 s before the first topology
+    # call returns — it single-handedly blew the tier-1 time budget).
+    # The topology here is named EXPLICITLY, so nothing from the
+    # metadata server is needed: tell libtpu to skip it. setdefault
+    # only — a real TPU VM that pre-set it stays authoritative.
+    os.environ.setdefault("TPU_SKIP_MDS_QUERY", "true")
     try:
         from jax.experimental import topologies
         return topologies.get_topology_desc(platform="tpu",
@@ -201,20 +209,45 @@ def _wgl_analytic(K: int, W: int, ic: int, probes: int = 4) -> dict:
 
 def wgl32_case(n_pad: int = 16384, ic_pad: int = 8, S: int = 1024,
                O: int = 16, K: int = 16, H: int = 1 << 23,
-               B: int = 1 << 18, chunk: int = 4096, W: int = 8) -> tuple:
+               B: int = 1 << 18, chunk: int = 4096, W: int = 8,
+               pack: bool = True) -> tuple:
     """The headline shape: a 10k-op cas-register history (n_pad 2^14,
     register state space, narrow window) through the bitmask kernel —
     compiled with the ACCEL layout and chunk size the chip actually
-    runs (accel=True; the host layout differs, see wgl32 docstring)."""
+    runs (accel=True; the host layout differs, see wgl32 docstring).
+    `pack` mirrors the runtime default: a 10k-op history's event
+    times fit int16, so the grand-table gather runs half-width."""
     import jax
+    from .adapt import LADDER32
     from .wgl32 import _build_search32
     init_fn, chunk_fn = _build_search32(n_pad, ic_pad, S, O, K, H, B,
                                         chunk, probes=4, W=W,
-                                        accel=True)
+                                        accel=True, pack=pack)
     carry_spec = jax.eval_shape(init_fn, 0)
     return chunk_fn, (_wgl_consts_spec(n_pad, ic_pad, S, O), carry_spec), \
-        {"K": K, "W": W, "chunk": chunk,
+        {"K": K, "W": W, "chunk": chunk, "packed_tables": pack,
+         "ladder": list(LADDER32),
          **_wgl_analytic(K, W, ic_pad)}
+
+
+def precompile_wgl_ladder(*, n_pad: int, ic_pad: int, S: int, O: int,
+                          H: int = 1 << 23, B: int = 1 << 18,
+                          chunk: int = 1024, probes: int = 4,
+                          W: int = 8, L: int = 0, accel: bool = False,
+                          depth: int = 1, pack: bool = False,
+                          ladder: Optional[tuple] = None) -> dict:
+    """Backend-compile every adaptive-ladder bucket for one shape
+    bucket, ahead of traffic — the checker-as-a-service warm-up
+    (ROADMAP item 1) and the CI ladder smoke both use it: after this
+    returns, a search over this shape stays at ZERO recompiles no
+    matter which buckets the occupancy policy visits (the
+    CompileGuard proof in tests/test_adapt.py). Returns {K:
+    compile_seconds}."""
+    from .adapt import LADDER32, precompile_ladder
+    return precompile_ladder(
+        n_pad=n_pad, ic_pad=ic_pad, S=S, O=O, H=H, B=B, chunk=chunk,
+        probes=probes, W=W, L=L, accel=accel, depth=depth, pack=pack,
+        ladder=ladder or LADDER32, compile_now=True)
 
 
 def wgln_case(n_pad: int = 4096, ic_pad: int = 8, S: int = 256,

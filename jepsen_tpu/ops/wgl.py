@@ -382,6 +382,8 @@ def _pick_capacities(W: int, ic_pad: int, n: int,
 # Beam escalation for the fast path: a valid history usually resolves
 # within ~depth rounds at the narrow K; past this many explored configs
 # the search is likely exhaustive, where breadth amortizes overhead.
+# (The legacy one-shot jump, used only when the adaptive ladder is
+# disabled — the ladder generalizes it, ops/adapt.py.)
 _ESCALATE_AT = 200_000
 _K_BIG = 512
 
@@ -396,13 +398,71 @@ def _widen_frontier(carry, k_new: int):
             *carry[1:])
 
 
+def _packable(enc: Encoded) -> bool:
+    """May this encoding run the int16/int8 packed lookup tables
+    (wgl32 `pack`)? Times are event indices — every real (non-INF)
+    inv/ret/sufminret entry must sit strictly under PACK_MAX, and
+    state indices must fit int16. Bit-exact when true."""
+    from .wgl32 import PACK_MAX
+    m = 0
+    for a in (enc.inv, enc.ret, enc.sufminret, enc.inv_info):
+        finite = a[a < INF]
+        if finite.size:
+            m = max(m, int(finite.max()))
+    return m < PACK_MAX and enc.table.shape[0] <= 32000
+
+
+def _apply_bucket(enc: Encoded, bucket: dict) -> Encoded:
+    """Pad an encoding into a shared shape bucket (host numpy only):
+    inv/ret/sufminret/inv_info pad with INF, opcodes with 0, the
+    transition table with -1. Padding ok-slots sit past n_ok and
+    padding info-slots past n_info, so the kernel never treats them
+    as candidates — verdicts are unchanged. This is what lets a
+    per-key fan-out share ONE compiled kernel across keys whose raw
+    shapes straddle several (n_pad, ic, S, O) buckets (the
+    independent_100x2k straggler fix — see parallel/batched.py)."""
+    import dataclasses
+
+    n_pad = max(int(bucket.get("n_pad", len(enc.inv))), len(enc.inv))
+    ic_pad = max(int(bucket.get("ic_pad", len(enc.inv_info))),
+                 len(enc.inv_info))
+    S = max(int(bucket.get("S", enc.table.shape[0])),
+            enc.table.shape[0])
+    O = max(int(bucket.get("O", enc.table.shape[1])),
+            enc.table.shape[1])
+
+    def pad1(a, size, fill):
+        if len(a) == size:
+            return a
+        out = np.full(size, fill, dtype=a.dtype)
+        out[:len(a)] = a
+        return out
+
+    table = enc.table
+    if table.shape != (S, O):
+        t = np.full((S, O), -1, dtype=np.int32)
+        t[:table.shape[0], :table.shape[1]] = table
+        table = t
+    return dataclasses.replace(
+        enc,
+        inv=pad1(enc.inv, n_pad, INF),
+        ret=pad1(enc.ret, n_pad, INF),
+        opcode=pad1(enc.opcode, n_pad, 0),
+        sufminret=pad1(enc.sufminret, n_pad + 1, INF),
+        inv_info=pad1(enc.inv_info, ic_pad, INF),
+        opcode_info=pad1(enc.opcode_info, ic_pad, 0),
+        table=table)
+
+
 def check(model: Model, history: History, time_limit: Optional[float] = None,
           max_configs: int = 200_000_000, frontier: Optional[int] = None,
           enc: Optional[Encoded] = None,
           stop: Optional[Callable[[], bool]] = None,
           platform: Optional[str] = None,
           metrics=None, tracer=None,
-          profile_dir: Optional[str] = None) -> dict:
+          profile_dir: Optional[str] = None,
+          shape_bucket: Optional[dict] = None,
+          adaptive: Optional[bool] = None) -> dict:
     """Decide linearizability on the accelerator.
 
     Returns {"valid?": True/False/"unknown", ...}. "unknown" (deadline,
@@ -435,6 +495,18 @@ def check(model: Model, history: History, time_limit: Optional[float] = None,
     JEPSEN_TPU_PROFILE_DIR) opt-in wraps the search in a
     `jax.profiler` capture whose Perfetto-ingestible trace lands in
     that directory; capture failures never block the verdict.
+
+    `shape_bucket` pads the encoding into a caller-shared shape
+    bucket ({n_pad, ic_pad, S, O, w_eff, ic_eff}) so a per-key
+    fan-out compiles ONE kernel for the whole key set
+    (`_apply_bucket`; parallel/batched.py builds it). `adaptive`
+    overrides the occupancy-driven bucket-ladder scheduling
+    (ops/adapt.py; default on unless JEPSEN_TPU_ADAPTIVE=0 or an
+    explicit `frontier` pins the beam): the beam starts at the
+    ladder's bottom bucket and the host grows/shrinks it between
+    chunks from the polled occupancy counters — no retraces inside
+    the device loop, one pre-compilable executable per bucket, and
+    the `util.adapt` block records the path taken.
     """
     from .. import metrics as _metrics_mod
     from .. import trace as _trace_mod
@@ -479,23 +551,50 @@ def check(model: Model, history: History, time_limit: Optional[float] = None,
     from ..util import safe_backend
     accel = (platform or safe_backend()) not in (None, "cpu")
 
+    if shape_bucket:
+        # shared-bucket fan-out: pad the encoding so every key in the
+        # caller's batch compiles (and warms) the SAME kernel
+        enc = _apply_bucket(enc, shape_bucket)
+
+    from . import adapt as _adapt
     W = enc.window
     ic_pad = len(enc.inv_info)
-    K, H, B = _pick_capacities(W, ic_pad, n, accel=accel)
+    # capacity sizing follows the bucket's biggest key, so every key
+    # of a shared-bucket fan-out lands on identical (K, H, B)
+    n_caps = max(n, int(shape_bucket.get("n_cap", 0))) \
+        if shape_bucket else n
+    K, H, B = _pick_capacities(W, ic_pad, n_caps, accel=accel)
+    # Occupancy-adaptive bucket ladder (ops/adapt.py): on unless the
+    # caller pinned the beam or flipped the kill-switch. The ladder
+    # replaces both the old fixed K=16 start and the one-shot
+    # escalation: start at the measured sweet spot (bottom bucket),
+    # grow between chunks when the search proves exhaustive.
+    use_adapt = (_adapt.enabled(True if adaptive is None else adaptive)
+                 and not frontier and adaptive is not False)
+    ladder: Optional[tuple] = None
     if enc.window_raw <= 32:
-        # Fast-path sweet spot (measured on the BASELINE model matrix):
-        # configs_explored scales ~linearly with K — the search
-        # finishes in ~depth rounds regardless of width, so a narrow
-        # beam does ~K/depth of the work (K=16 beats K=32 by ~30% and
-        # K=256 by ~10x across register/cas/mutex configs). Exhaustive
-        # searches (invalid or near-invalid histories) instead want
-        # breadth to amortize per-round overhead — the loop below
-        # escalates K when exploration passes _ESCALATE_AT, migrating
-        # the carry (the memo table survives, nothing is re-explored).
+        # Fast-path beam (measured on the BASELINE model matrix):
+        # narrow beams do less total work on valid histories — K=2
+        # decides the 10k headline 4x faster than K=16 at fill 0.9999
+        # (ops/adapt.py module docstring) — while exhaustive searches
+        # want breadth; the ladder covers both. Non-adaptive runs keep
+        # the old K=16 + _ESCALATE_AT jump.
         K = 16
+        if use_adapt:
+            ladder = _adapt.LADDER32
+            K = ladder[0]
     if frontier:
         K = frontier  # override breadth only; the memo table must still
         #               fit the config space (see _pick_capacities)
+    # Half-width packed lookup tables (wgl32 `pack`): bit-exact when
+    # every event time fits int16 — true for every history under ~16k
+    # events, including the 10k headline. Halves the per-round meta/
+    # grand-table gather bytes (the roofline block proves it via the
+    # compiler's own cost analysis). A shared bucket carries ONE
+    # bucket-wide bit so sibling keys never split into two variants.
+    pack = (bool(shape_bucket["pack"])
+            if shape_bucket and "pack" in shape_bucket
+            else _packable(enc))
     # Rounds per device call: the deadline/budget/stop signals are only
     # checked between calls — and each poll costs a full device->host
     # round-trip (~75 ms through the tunneled v5e), so the accelerator
@@ -512,6 +611,10 @@ def check(model: Model, history: History, time_limit: Optional[float] = None,
         W_eff = max(8, _pad_to_mult(enc.window_raw, 8))
         ic_eff = max(8, _pad_to_mult(enc.n_info, 8))
         ic_eff = min(ic_eff, ic_pad)
+        if shape_bucket:
+            W_eff = max(W_eff, int(shape_bucket.get("w_eff", 0)))
+            ic_eff = min(ic_pad, max(
+                ic_eff, int(shape_bucket.get("ic_eff", 0))))
         iinv, iopc = iinv[:ic_eff], iopc[:ic_eff]
         B = 1 << 18  # packed rows are cheap; escalation spills hard
         W = W_eff  # the width the kernel actually runs at
@@ -523,11 +626,15 @@ def check(model: Model, history: History, time_limit: Optional[float] = None,
         # (wgl32.round_body_deep). chunk counts super-rounds.
         depth = 4 if accel else 1
         chunk = max(1, chunk // depth)
-        init_fn, chunk_jit = compiled_search32(
-            n_pad=len(enc.inv), ic_pad=ic_eff,
-            S=enc.table.shape[0], O=enc.table.shape[1],
-            K=K, H=H, B=B, chunk=chunk, probes=4, W=W_eff, accel=accel,
-            depth=depth)
+
+        def rebuild(k):
+            return compiled_search32(
+                n_pad=len(enc.inv), ic_pad=ic_eff,
+                S=enc.table.shape[0], O=enc.table.shape[1],
+                K=k, H=H, B=B, chunk=chunk, probes=4, W=W_eff,
+                accel=accel, depth=depth, pack=pack)
+
+        init_fn, chunk_jit = rebuild(K)
     else:
         # Packed multi-lane kernel (wgln.py): window as L uint32
         # lanes. Successors are bit math + funnel shifts instead of
@@ -537,9 +644,13 @@ def check(model: Model, history: History, time_limit: Optional[float] = None,
         # driver, so the beam scales with a byte budget over it.
         from .wgln import compiled_searchN
         W_eff = _pad_to_mult(enc.window_raw, 32)
-        L = W_eff // 32
         ic_eff = max(8, _pad_to_mult(enc.n_info, 8))
         ic_eff = min(ic_eff, ic_pad)
+        if shape_bucket:
+            W_eff = max(W_eff, int(shape_bucket.get("w_eff", 0)))
+            ic_eff = min(ic_pad, max(
+                ic_eff, int(shape_bucket.get("ic_eff", 0))))
+        L = W_eff // 32
         iinv, iopc = iinv[:ic_eff], iopc[:ic_eff]
         budget_bytes = (1024 if accel else 128) * 1024 * 1024
         # cpu caps the beam at 1024: XLA:CPU compile scales with K and
@@ -573,11 +684,23 @@ def check(model: Model, history: History, time_limit: Optional[float] = None,
         # cpu polls a few times a second; the accelerator amortizes
         # its ~75 ms poll round-trip over bigger chunks
         chunk = 512 if accel else 128
-        init_fn, chunk_jit = compiled_searchN(
-            n_pad=len(enc.inv), ic_pad=ic_eff,
-            S=enc.table.shape[0], O=enc.table.shape[1],
-            K=K, H=H, B=B, chunk=chunk, probes=4, W=W_eff, L=L,
-            accel=accel)
+        if use_adapt:
+            # the wide-window ladder hangs off the platform-derived
+            # ceiling; valid wide histories ride the narrow buckets,
+            # exhaustive wavefronts climb (backlog pressure jumps
+            # straight to the top before the spill can overflow)
+            ladder = _adapt.ladder_for(K, k_min=max(32, K // 16),
+                                       step=8)
+            K = ladder[0]
+
+        def rebuild(k):
+            return compiled_searchN(
+                n_pad=len(enc.inv), ic_pad=ic_eff,
+                S=enc.table.shape[0], O=enc.table.shape[1],
+                K=k, H=H, B=B, chunk=chunk, probes=4, W=W_eff, L=L,
+                accel=accel, pack=pack)
+
+        init_fn, chunk_jit = rebuild(K)
 
     import contextlib
 
@@ -619,7 +742,8 @@ def check(model: Model, history: History, time_limit: Optional[float] = None,
                               ic_eff, chunk, probes_used, row_cols,
                               accel, t_enter, time_limit, stop,
                               depth=depth, mx=mx, tracer=tracer,
-                              plat=plat_label)
+                              plat=plat_label, ladder=ladder,
+                              rebuild=rebuild, pack=pack)
     finally:
         if profiled:
             try:
@@ -639,7 +763,8 @@ def check(model: Model, history: History, time_limit: Optional[float] = None,
 def _run_search(enc, init_fn, chunk_jit, iinv, iopc, n, max_configs,
                 frontier, K, H, B, W, W_eff, ic_eff, chunk, probes_used,
                 row_cols, accel, t_enter, time_limit, stop, depth=1,
-                mx=None, tracer=None, plat="cpu"):
+                mx=None, tracer=None, plat="cpu", ladder=None,
+                rebuild=None, pack=False):
     # Stall surveillance (watchdog.py): the loop below heartbeats once
     # per poll, so a device round that hangs INSIDE chunk_jit — which
     # the between-chunk deadline checks can never observe — stops
@@ -658,7 +783,8 @@ def _run_search(enc, init_fn, chunk_jit, iinv, iopc, n, max_configs,
                             ic_eff, chunk, probes_used, row_cols,
                             accel, t_enter, time_limit, stop,
                             depth=depth, mx=mx, tracer=tracer,
-                            plat=plat, wd=wd, hb=hb)
+                            plat=plat, wd=wd, hb=hb, ladder=ladder,
+                            rebuild=rebuild, pack=pack)
     finally:
         wd.unregister(hb)
 
@@ -667,7 +793,8 @@ def _search_loop(enc, init_fn, chunk_jit, iinv, iopc, n, max_configs,
                  frontier, K, H, B, W, W_eff, ic_eff, chunk,
                  probes_used, row_cols, accel, t_enter, time_limit,
                  stop, depth=1, mx=None, tracer=None, plat="cpu",
-                 wd=None, hb=None):
+                 wd=None, hb=None, ladder=None, rebuild=None,
+                 pack=False):
     import jax.numpy as jnp
 
     from .. import fleet as _fleet_mod
@@ -714,6 +841,20 @@ def _search_loop(enc, init_fn, chunk_jit, iinv, iopc, n, max_configs,
     occ_dropped = 0
     occ_seen = 0
     rounds_before = 0
+    # occupancy-adaptive bucket ladder (ops/adapt.py): decisions run
+    # host-side between chunks off the counters already polled — the
+    # device loop sees only a differently-shaped (pre-compiled)
+    # executable and a padded/sliced frontier
+    from . import adapt as _adapt_mod
+    policy = None
+    if ladder and rebuild is not None:
+        policy = _adapt_mod.Policy(ladder=ladder, n_ok=n,
+                                   backlog_cap=B, start_k=K)
+    # beam-area accounting: frontier_fill must normalize each round
+    # by the K it actually ran at, not the final K
+    beam_area = 0
+    prev_rounds_total = 0
+    prev_explored_total = 0
     # the compute/transfer split below costs one extra device sync per
     # poll — only pay it when someone is recording (the disabled run
     # must keep the original single-transfer poll, overhead-free)
@@ -817,6 +958,9 @@ def _search_loop(enc, init_fn, chunk_jit, iinv, iopc, n, max_configs,
             status.occupancy_poll({
                 "mode": "single", "kernel": kern, "platform": plat,
                 "K": K,
+                "adapt": ({"ladder": list(policy.ladder),
+                           "switches": len(policy.switches)}
+                          if policy is not None else None),
                 "fill_last": (fills[-1] if fills
                               else round(fr_cnt / max(K, 1), 4)),
                 "fill_mean": (round(sum(fills) / len(fills), 4)
@@ -902,18 +1046,45 @@ def _search_loop(enc, init_fn, chunk_jit, iinv, iopc, n, max_configs,
                          "host<->device chunk latency (device compute "
                          "+ packed-summary transfer)").observe(
                 poll_s, **lbl)
-        if (not found and fr_cnt > 0 and not frontier
+        rounds_now = int(stats[5])
+        rounds_delta = rounds_now - prev_rounds_total
+        explored_delta = total_explored - prev_explored_total
+        beam_area += rounds_delta * K
+        if policy is not None and not found and fr_cnt > 0:
+            d = policy.observe(explored=total_explored,
+                               rounds_delta=rounds_delta,
+                               explored_delta=explored_delta,
+                               frontier=fr_cnt, backlog=bk_cnt)
+            if d.switch:
+                k_old = K
+                _, chunk_jit = rebuild(d.to_k)
+                carry = _adapt_mod.migrate_frontier(carry, d.to_k)
+                K = d.to_k
+                if tl_points is not None:
+                    mx.series(
+                        "wgl_adapt",
+                        "bucket-ladder switch decisions of the "
+                        "occupancy-adaptive WGL scheduler").append({
+                            "chunk": n_chunks - 1,
+                            "from_K": k_old, "to_K": K,
+                            "reason": d.reason,
+                            "fill": round(explored_delta
+                                          / max(rounds_delta * k_old,
+                                                1), 4),
+                            "backlog": bk_cnt,
+                            "explored": total_explored,
+                            "kernel": kern, "platform": plat})
+        prev_rounds_total = rounds_now
+        prev_explored_total = total_explored
+        if (policy is None and not found and fr_cnt > 0
+                and not frontier
                 and enc.window_raw <= 32 and K < _K_BIG
                 and total_explored >= _ESCALATE_AT):
-            # Exhaustion regime: widen the beam so per-round overhead
-            # amortizes over more configs. The memo table rides along
-            # in the carry, so nothing is re-explored.
-            from .wgl32 import compiled_search32
-            _, chunk_jit = compiled_search32(
-                n_pad=len(enc.inv), ic_pad=ic_eff,
-                S=enc.table.shape[0], O=enc.table.shape[1],
-                K=_K_BIG, H=H, B=B, chunk=chunk, probes=4, W=W_eff,
-                accel=accel, depth=depth)
+            # Exhaustion regime (legacy non-adaptive path): widen the
+            # beam so per-round overhead amortizes over more configs.
+            # The memo table rides along in the carry, so nothing is
+            # re-explored.
+            _, chunk_jit = rebuild(_K_BIG)
             carry = _widen_frontier(carry, _K_BIG)
             K = _K_BIG
         # result assembly only when a stop condition holds — the
@@ -938,8 +1109,11 @@ def _search_loop(enc, init_fn, chunk_jit, iinv, iopc, n, max_configs,
         util = {
             "configs_per_s": int(total_explored / max(wall, 1e-9)),
             "rounds": rounds_total,
+            # beam-area weighted: each round normalized by the K it
+            # ran at (the ladder moves K mid-search)
             "frontier_fill": round(
-                total_explored / max(rounds_total * K, 1), 4),
+                total_explored / max(beam_area
+                                     or rounds_total * K, 1), 4),
             # the ONE hit-rate definition (occupancy.memo_hit_rate) —
             # shared with the per-chunk points so they can't drift
             "memo_hit_rate": _occ.memo_hit_rate(memo_hits, inserted),
@@ -949,7 +1123,10 @@ def _search_loop(enc, init_fn, chunk_jit, iinv, iopc, n, max_configs,
             "first_call_s": round(first_call_s, 3),
             "chunks": n_chunks,
             "backlog_peak": bk_peak,
+            "packed_tables": bool(pack),
         }
+        if policy is not None:
+            util["adapt"] = policy.summary()
         # W is the history's actual window; W_pad the kernel's padded
         # width (equal for the narrow path, 32-padded for wide lanes)
         detail = {"W": enc.window_raw, "W_pad": W, "K": K,
@@ -974,7 +1151,7 @@ def _search_loop(enc, init_fn, chunk_jit, iinv, iopc, n, max_configs,
 
             cost = _occ.cost_for(
                 (kern, len(enc.inv), ic_eff, W_eff, K, chunk, depth,
-                 accel), _lower)
+                 accel, pack), _lower)
             detail["occupancy"] = _occ.build_block(
                 occ_rounds, K=K, row_cols=row_cols,
                 probes=probes_used, kernel=kern, platform=plat,

@@ -56,10 +56,17 @@ reference reaches through `jepsen/src/jepsen/checker.clj:199-202`.
 from __future__ import annotations
 
 import functools
+from typing import Optional
 
 import numpy as np
 
 INF = np.int32(2**31 - 1)
+
+# Packed-table (int16) plane: the masked-ret sentinel and the largest
+# real event time a history may carry to qualify (strictly below the
+# sentinel, with head-room so the clamp can never collide with data).
+PACK_INF = np.int32(2**15 - 1)   # 32767
+PACK_MAX = int(PACK_INF) - 64    # caller-side eligibility bound
 
 # carry indices shared by wgl.py / parallel/batched.py
 FR, FR_CNT, BK, BK_CNT, TABLE, FLAGS, STATS, RING_BUF = range(8)
@@ -157,6 +164,40 @@ def probe_check(table, s0, s1, s2, probes: int, H: int):
     return seen, ins_idx, has_empty
 
 
+def make_compact_frontier(K: int, C: int):
+    """Compact-before-expand pre-pass, shared by wgl32 and wgln: sort-
+    dedup the (K, C) packed beam BEFORE the O(W)-way expansion. Rows
+    are exact packed configs, so equal neighbors after a
+    lexicographic sort ARE duplicate configs; survivors repack
+    densely. Liveness is its own leading sort key (same rationale as
+    round_body_deep's signature sort). The returned function maps
+    (fr, fr_cnt) -> (fr, fr_cnt, dups_dropped)."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    def compact(fr, fr_cnt):
+        dead = (jnp.arange(K, dtype=jnp.int32)
+                >= fr_cnt).astype(jnp.uint32)
+        rid = jnp.arange(K, dtype=jnp.int32)
+        cols = tuple(_u32(fr[:, c]) for c in range(C))
+        srt = lax.sort((dead,) + cols + (rid,), num_keys=1 + C)
+        dead_s, cols_s, perm = srt[0], srt[1:1 + C], srt[-1]
+        live_s = dead_s == 0
+        same = live_s & jnp.roll(live_s, 1)
+        for c in cols_s:
+            same = same & (c == jnp.roll(c, 1))
+        same = same.at[0].set(False)
+        keep = live_s & ~same
+        n_keep = jnp.sum(keep.astype(jnp.int32))
+        posk = jnp.cumsum(keep.astype(jnp.int32)) - 1
+        kidx = jnp.where(keep, posk, K)
+        nfr = jnp.zeros((K, C), dtype=jnp.int32).at[kidx].set(
+            fr[perm], mode="drop")
+        return nfr, n_keep, fr_cnt - n_keep
+
+    return compact
+
+
 def probe_insert(table, s0, s1, s2, explore, probes: int, H: int):
     """Memo-table dedup with one batched probe gather, one insert
     scatter, one verify gather (see module docstring). Returns
@@ -184,7 +225,9 @@ def probe_insert(table, s0, s1, s2, explore, probes: int, H: int):
 
 def _build_search32(n_pad: int, ic_pad: int, S: int, O: int,
                     K: int, H: int, B: int, chunk: int, probes: int,
-                    W: int = 32, accel: bool = False, depth: int = 1):
+                    W: int = 32, accel: bool = False, depth: int = 1,
+                    compact: Optional[bool] = None,
+                    pack: bool = False):
     """Build (init_fn, chunk_fn) for the W<=32 bitmask kernel. `W` is the
     window width actually materialized (pad the exact requirement to a
     small multiple — successor row count R = K*(W + ic_pad) drives the
@@ -195,11 +238,38 @@ def _build_search32(n_pad: int, ic_pad: int, S: int, O: int,
     cond-guarded backlog — each trades vector work (free on the VPU)
     for serialized ~30 µs scatter/gather latency. On a CPU core the
     same trades LOSE (caches make scatters cheap, top_k dear), so the
-    host build keeps the scatter-compaction layout."""
+    host build keeps the scatter-compaction layout.
+
+    `compact` reorders the round compact-before-expand: the beam is
+    sort-deduped (identical packed rows dropped, survivors repacked
+    densely) BEFORE the O(W)-way successor expansion, so a duplicate
+    config never pays its (W + ic) expansion + probe traffic again.
+    Duplicates only arise where insert-time dedup has blind spots —
+    twin-insert slot races, and the depth-fused accel path whose
+    check-only probes can't see uninserted sibling levels — so the
+    default is ON exactly there (depth > 1) and OFF for the
+    single-level host build, where beam rows are unique by
+    construction and the K-row sort would be pure overhead.
+
+    `pack` stores the per-round lookup tables half-width: the fused
+    grand table / meta rows (inv, ret, nst, suf) in int16 and the
+    transition rows in int16 (int8 when S*O allows), halving the
+    dominant gather stream's operand bytes. Only legal when every
+    real event time fits int16 (the caller checks against PACK_MAX
+    — times are event indices, < 2n+2, so every history under ~16k
+    events qualifies, including the 10k headline). Bit-exact: the
+    comparisons run in the packed dtype with PACK_INF as the masked
+    sentinel, and every real time is strictly below it."""
     import jax.numpy as jnp
     from jax import lax
 
     assert 1 <= W <= 32
+    if compact is None:
+        compact = depth > 1
+    pk_i = jnp.int16 if pack else jnp.int32
+    # int8 transition rows need every state index in [-1, 127]
+    pk_t = jnp.int8 if pack and S <= 127 else pk_i
+    pinf = jnp.asarray(PACK_INF if pack else INF, pk_i)
     Il = max(1, (ic_pad + 31) // 32)
     C = 3 + Il  # packed config row: [base, win, mst, info words...]
     # Grand-table fusion: when the (pos, mst) product is small enough,
@@ -279,15 +349,18 @@ def _build_search32(n_pad: int, ic_pad: int, S: int, O: int,
             invw, retw0, opw = (mrows[..., 0], mrows[..., 1],
                                 mrows[..., 2])
             tail = meta[tailp][:, 3]                          # gather
+            # index arithmetic in int32: packed meta rows may be
+            # int16 and opw * S overflows there for big state spaces
+            opw32 = opw.astype(jnp.int32)
             tidx = jnp.concatenate(
-                [(opw * S + fr_mst[:, None]).reshape(-1),
+                [(opw32 * S + fr_mst[:, None]).reshape(-1),
                  (iopc_c[None, :] * S + fr_mst[:, None]).reshape(-1)])
             nst_all = TK[tidx][:, 0]                          # gather
             nst_ok = nst_all[:K * W].reshape(K, W)
             nst_info = nst_all[K * W:].reshape(K, ic_pad)
             iinvw = jnp.broadcast_to(iinv[None, :], (K, ic_pad))
 
-        retw = jnp.where(linearized | (pos >= n_ok), INF, retw0)
+        retw = jnp.where(linearized | (pos >= n_ok), pinf, retw0)
         minret = jnp.min(retw, axis=1)
         minret = jnp.minimum(minret, tail)                    # (K,)
 
@@ -327,7 +400,8 @@ def _build_search32(n_pad: int, ic_pad: int, S: int, O: int,
         info_s = jnp.concatenate(
             [info_ok.reshape(-1, Il), info_new.reshape(-1, Il)])
         mst_s = jnp.concatenate(
-            [nst_ok.reshape(-1), nst_info.reshape(-1)])
+            [nst_ok.reshape(-1),
+             nst_info.reshape(-1)]).astype(jnp.int32)
         legal = jnp.concatenate(
             [legal_ok.reshape(-1), legal_info.reshape(-1)])   # (R,)
 
@@ -350,8 +424,13 @@ def _build_search32(n_pad: int, ic_pad: int, S: int, O: int,
         base_max = jnp.max(jnp.where(legal, base_s, 0))
         return succ, explore, found, s0, s1, s2, base_max
 
+    _compact_frontier = make_compact_frontier(K, C)
+
     def round_body(consts, carry):
         (fr, fr_cnt, bk, bk_cnt, table, flags, stats, ring) = carry
+        dups = jnp.int32(0)
+        if compact:
+            fr, fr_cnt, dups = _compact_frontier(fr, fr_cnt)
         succ, explore, found, s0, s1, s2, base_max = \
             _expand(consts, fr, fr_cnt)
 
@@ -415,7 +494,9 @@ def _build_search32(n_pad: int, ic_pad: int, S: int, O: int,
         nflags = jnp.stack([flags[0] | found,
                             flags[1] | overflow,
                             nfr_cnt == 0])
-        seen_n = jnp.sum(seen.astype(jnp.int32))
+        # beam duplicates dropped by compact-before-expand count as
+        # dedup hits: they are exactly the re-expansions saved
+        seen_n = jnp.sum(seen.astype(jnp.int32)) + dups
         nstats = jnp.stack([
             stats[0] + fr_cnt,
             stats[1] + 1,
@@ -443,11 +524,18 @@ def _build_search32(n_pad: int, ic_pad: int, S: int, O: int,
         sound, and irrelevant on the near-linear wavefronts this
         path exists for."""
         (fr, fr_cnt, bk, bk_cnt, table, flags, stats, ring) = carry
+        if compact:
+            # cross-level twins from the previous super-round (check-
+            # only probes can't see uninserted siblings) die here,
+            # before paying another full expansion
+            fr, fr_cnt, dups0 = _compact_frontier(fr, fr_cnt)
+        else:
+            dups0 = jnp.int32(0)
         found = flags[0]
         overflow = flags[1]
         base_max = stats[2]
         explored_add = jnp.int32(0)
-        hits_add = jnp.int32(0)
+        hits_add = dups0
         ins_add = jnp.int32(0)
         ins_widx = []
         ins_entry = []
@@ -561,37 +649,53 @@ def _build_search32(n_pad: int, ic_pad: int, S: int, O: int,
     def chunk_fn(consts, carry):
         (inv, ret, opc, suf, iinv, iopc, T, n_ok, n_info, max_cfg) = consts
         # Fused lookup tables, built once per chunk call (hoisted out
-        # of the round loop).
-        inv_p = jnp.concatenate([inv, jnp.full((1,), INF, jnp.int32)])
-        ret_p = jnp.concatenate([ret, jnp.full((1,), INF, jnp.int32)])
+        # of the round loop). Under `pack` every time column clamps
+        # its INF sentinel to PACK_INF and narrows to int16 — legal
+        # because the caller proved all real times < PACK_MAX — and
+        # the transition rows narrow to pk_t, halving (or quartering)
+        # the round's dominant gather stream.
+        def _pk(x):
+            if not pack:
+                return x
+            return jnp.minimum(x, jnp.asarray(PACK_INF,
+                                              x.dtype)).astype(pk_i)
+
+        inv_p = _pk(jnp.concatenate(
+            [inv, jnp.full((1,), INF, jnp.int32)]))
+        ret_p = _pk(jnp.concatenate(
+            [ret, jnp.full((1,), INF, jnp.int32)]))
         opc_p = jnp.concatenate([opc, jnp.zeros((1,), jnp.int32)])
+        suf_p = _pk(suf)
+        iinv_p = _pk(iinv)
         if fused:
             # Grand table GT: rows (pos, mst) -> [inv, ret, nst, suf]
             # for ok ops, then (m, mst) -> [iinv, 0, nst, 0] for info
             # ops — the round's whole lookup plane in one gather.
             np1 = n_pad + 1
-            nst_ok = T[:, opc_p].T                            # (np1, S)
+            nst_ok = T[:, opc_p].T.astype(pk_i)               # (np1, S)
             ok_rows = jnp.stack(
                 [jnp.broadcast_to(inv_p[:, None], (np1, S)),
                  jnp.broadcast_to(ret_p[:, None], (np1, S)),
                  nst_ok,
-                 jnp.broadcast_to(suf[:, None], (np1, S))],
+                 jnp.broadcast_to(suf_p[:, None], (np1, S))],
                 axis=2).reshape(np1 * S, 4)
-            nst_i = T[:, iopc].T                              # (ic, S)
+            nst_i = T[:, iopc].T.astype(pk_i)                 # (ic, S)
             info_rows = jnp.stack(
-                [jnp.broadcast_to(iinv[:, None], (ic_pad, S)),
-                 jnp.zeros((ic_pad, S), jnp.int32),
+                [jnp.broadcast_to(iinv_p[:, None], (ic_pad, S)),
+                 jnp.zeros((ic_pad, S), pk_i),
                  nst_i,
-                 jnp.zeros((ic_pad, S), jnp.int32)],
+                 jnp.zeros((ic_pad, S), pk_i)],
                 axis=2).reshape(ic_pad * S, 4)
             GT = jnp.concatenate([ok_rows, info_rows])
         else:
             # meta rows [inv, ret, opcode, sufminret] with a sentinel
             # row at n_pad; TK[o * S + s] = T[s, o] rows.
-            meta = jnp.stack([inv_p, ret_p, opc_p, suf], axis=1)
-            TK = jnp.broadcast_to(T.T.reshape(-1, 1), (S * O, 2))
+            meta = jnp.stack([inv_p, ret_p,
+                              opc_p.astype(pk_i), suf_p], axis=1)
+            TK = jnp.broadcast_to(
+                T.T.reshape(-1, 1).astype(pk_t), (S * O, 2))
             GT = (meta, TK)
-        rconsts = (GT, iinv, iopc, n_ok, n_info, max_cfg)
+        rconsts = (GT, iinv_p, iopc, n_ok, n_info, max_cfg)
 
         def cond(c):
             flags, stats = c[FLAGS], c[STATS]
@@ -624,13 +728,16 @@ def _build_search32(n_pad: int, ic_pad: int, S: int, O: int,
     return init_fn, chunk_fn
 
 
-@functools.lru_cache(maxsize=32)
+@functools.lru_cache(maxsize=48)
 def compiled_search32(n_pad: int, ic_pad: int, S: int, O: int,
                       K: int, H: int, B: int, chunk: int, probes: int,
-                      W: int = 32, accel: bool = False, depth: int = 1):
+                      W: int = 32, accel: bool = False, depth: int = 1,
+                      compact: Optional[bool] = None,
+                      pack: bool = False):
     import jax
 
     init_fn, chunk_fn = _build_search32(n_pad, ic_pad, S, O,
                                         K, H, B, chunk, probes, W=W,
-                                        accel=accel, depth=depth)
+                                        accel=accel, depth=depth,
+                                        compact=compact, pack=pack)
     return init_fn, jax.jit(chunk_fn, donate_argnums=(1,))

@@ -45,18 +45,24 @@ import functools
 
 import numpy as np
 
-from .wgl32 import BK_CNT, FLAGS, FR_CNT, RING_BUF, RING_COLS, \
-    RING_ROWS, STATS, _ctz32, _fnv_words, _i32, _u32, probe_insert
+from .wgl32 import BK_CNT, FLAGS, FR_CNT, PACK_INF, RING_BUF, \
+    RING_COLS, RING_ROWS, STATS, _ctz32, _fnv_words, _i32, _u32, \
+    make_compact_frontier, probe_insert
 
 INF = np.int32(2**31 - 1)
 
 
 def _build_searchN(n_pad: int, ic_pad: int, S: int, O: int,
                    K: int, H: int, B: int, chunk: int, probes: int,
-                   W: int, L: int, accel: bool = False):
+                   W: int, L: int, accel: bool = False,
+                   compact: bool = False, pack: bool = False):
     """Build (init_fn, chunk_fn) for the packed L-lane kernel.
     W == 32*L is the materialized window width. `accel` picks the
-    accelerator layout (see wgl32._build_search32)."""
+    accelerator layout (see wgl32._build_search32). `compact` and
+    `pack` are the compact-before-expand beam pre-pass and the
+    int16/int8 packed lookup tables — the wgl32 docstring has both
+    contracts; this kernel has no depth-fused path, so its beam is
+    duplicate-free by construction and `compact` defaults off."""
     import jax.numpy as jnp
     from jax import lax
 
@@ -65,6 +71,9 @@ def _build_searchN(n_pad: int, ic_pad: int, S: int, O: int,
     C = 2 + L + Il  # [base, win lanes..., mst, info words...]
     MST = 1 + L     # column index of the model state
     fused = accel and (n_pad + 1) * S + ic_pad * S <= (1 << 22)
+    pk_i = jnp.int16 if pack else jnp.int32
+    pk_t = jnp.int8 if pack and S <= 127 else pk_i
+    pinf = jnp.asarray(PACK_INF if pack else INF, pk_i)
 
     # host-precomputed tables
     j_arr = np.arange(W)
@@ -98,9 +107,14 @@ def _build_searchN(n_pad: int, ic_pad: int, S: int, O: int,
     jinfo_bit = jnp.asarray(info_bit)
     jinfo_set = jnp.asarray(info_set_mask)
 
+    _compact_frontier = make_compact_frontier(K, C)
+
     def round_body(consts, carry):
         (GT, iinv, iopc_c, n_ok, n_info, max_cfg) = consts
         (fr, fr_cnt, bk, bk_cnt, table, flags, stats, ring) = carry
+        dups = jnp.int32(0)
+        if compact:
+            fr, fr_cnt, dups = _compact_frontier(fr, fr_cnt)
 
         fr_base = fr[:, 0]
         fr_win = _u32(fr[:, 1:1 + L])                     # (K, L)
@@ -138,15 +152,17 @@ def _build_searchN(n_pad: int, ic_pad: int, S: int, O: int,
             invw, retw0, opw = (mrows[..., 0], mrows[..., 1],
                                 mrows[..., 2])
             tail = meta[tailp][:, 3]                      # gather
+            # int32 index math: packed meta may be int16 (wgl32 note)
+            opw32 = opw.astype(jnp.int32)
             tidx = jnp.concatenate(
-                [(opw * S + fr_mst[:, None]).reshape(-1),
+                [(opw32 * S + fr_mst[:, None]).reshape(-1),
                  (iopc_c[None, :] * S + fr_mst[:, None]).reshape(-1)])
             nst_all = TK[tidx][:, 0]                      # gather
             nst_ok = nst_all[:K * W].reshape(K, W)
             nst_info = nst_all[K * W:].reshape(K, ic_pad)
             iinvw = jnp.broadcast_to(iinv[None, :], (K, ic_pad))
 
-        retw = jnp.where(linearized | (pos >= n_ok), INF, retw0)
+        retw = jnp.where(linearized | (pos >= n_ok), pinf, retw0)
         minret = jnp.min(retw, axis=1)
         minret = jnp.minimum(minret, tail)                # (K,)
 
@@ -211,7 +227,8 @@ def _build_searchN(n_pad: int, ic_pad: int, S: int, O: int,
         info_s = jnp.concatenate(
             [info_ok.reshape(-1, Il), info_new.reshape(-1, Il)])
         mst_s = jnp.concatenate(
-            [nst_ok.reshape(-1), nst_info.reshape(-1)])
+            [nst_ok.reshape(-1),
+             nst_info.reshape(-1)]).astype(jnp.int32)
         legal = jnp.concatenate(
             [legal_ok.reshape(-1), legal_info.reshape(-1)])  # (R,)
 
@@ -284,7 +301,8 @@ def _build_searchN(n_pad: int, ic_pad: int, S: int, O: int,
         nflags = jnp.stack([flags[0] | found,
                             flags[1] | overflow,
                             nfr_cnt == 0])
-        seen_n = jnp.sum(seen.astype(jnp.int32))
+        # compact-before-expand drops count as dedup hits (wgl32 note)
+        seen_n = jnp.sum(seen.astype(jnp.int32)) + dups
         base_max = jnp.maximum(stats[2],
                                jnp.max(jnp.where(legal, base_s, 0)))
         nstats = jnp.stack([
@@ -303,32 +321,47 @@ def _build_searchN(n_pad: int, ic_pad: int, S: int, O: int,
 
     def chunk_fn(consts, carry):
         (inv, ret, opc, suf, iinv, iopc, T, n_ok, n_info, max_cfg) = consts
-        # fused lookup tables (see wgl32.chunk_fn)
-        inv_p = jnp.concatenate([inv, jnp.full((1,), INF, jnp.int32)])
-        ret_p = jnp.concatenate([ret, jnp.full((1,), INF, jnp.int32)])
+
+        # fused lookup tables (see wgl32.chunk_fn); `pack` narrows
+        # the time columns to int16 / transitions to pk_t exactly as
+        # the wgl32 build does
+        def _pk(x):
+            if not pack:
+                return x
+            return jnp.minimum(x, jnp.asarray(PACK_INF,
+                                              x.dtype)).astype(pk_i)
+
+        inv_p = _pk(jnp.concatenate(
+            [inv, jnp.full((1,), INF, jnp.int32)]))
+        ret_p = _pk(jnp.concatenate(
+            [ret, jnp.full((1,), INF, jnp.int32)]))
         opc_p = jnp.concatenate([opc, jnp.zeros((1,), jnp.int32)])
+        suf_p = _pk(suf)
+        iinv_p = _pk(iinv)
         if fused:
             np1 = n_pad + 1
-            nst_ok = T[:, opc_p].T                        # (np1, S)
+            nst_ok = T[:, opc_p].T.astype(pk_i)           # (np1, S)
             ok_rows = jnp.stack(
                 [jnp.broadcast_to(inv_p[:, None], (np1, S)),
                  jnp.broadcast_to(ret_p[:, None], (np1, S)),
                  nst_ok,
-                 jnp.broadcast_to(suf[:, None], (np1, S))],
+                 jnp.broadcast_to(suf_p[:, None], (np1, S))],
                 axis=2).reshape(np1 * S, 4)
-            nst_i = T[:, iopc].T                          # (ic, S)
+            nst_i = T[:, iopc].T.astype(pk_i)             # (ic, S)
             info_rows = jnp.stack(
-                [jnp.broadcast_to(iinv[:, None], (ic_pad, S)),
-                 jnp.zeros((ic_pad, S), jnp.int32),
+                [jnp.broadcast_to(iinv_p[:, None], (ic_pad, S)),
+                 jnp.zeros((ic_pad, S), pk_i),
                  nst_i,
-                 jnp.zeros((ic_pad, S), jnp.int32)],
+                 jnp.zeros((ic_pad, S), pk_i)],
                 axis=2).reshape(ic_pad * S, 4)
             GT = jnp.concatenate([ok_rows, info_rows])
         else:
-            meta = jnp.stack([inv_p, ret_p, opc_p, suf], axis=1)
-            TK = jnp.broadcast_to(T.T.reshape(-1, 1), (S * O, 2))
+            meta = jnp.stack([inv_p, ret_p,
+                              opc_p.astype(pk_i), suf_p], axis=1)
+            TK = jnp.broadcast_to(
+                T.T.reshape(-1, 1).astype(pk_t), (S * O, 2))
             GT = (meta, TK)
-        rconsts = (GT, iinv, iopc, n_ok, n_info, max_cfg)
+        rconsts = (GT, iinv_p, iopc, n_ok, n_info, max_cfg)
 
         def cond(c):
             flags, stats = c[FLAGS], c[STATS]
@@ -353,13 +386,15 @@ def _build_searchN(n_pad: int, ic_pad: int, S: int, O: int,
     return init_fn, chunk_fn
 
 
-@functools.lru_cache(maxsize=32)
+@functools.lru_cache(maxsize=48)
 def compiled_searchN(n_pad: int, ic_pad: int, S: int, O: int,
                      K: int, H: int, B: int, chunk: int, probes: int,
-                     W: int, L: int, accel: bool = False):
+                     W: int, L: int, accel: bool = False,
+                     compact: bool = False, pack: bool = False):
     import jax
 
     init_fn, chunk_fn = _build_searchN(n_pad, ic_pad, S, O,
                                        K, H, B, chunk, probes, W, L,
-                                       accel=accel)
+                                       accel=accel, compact=compact,
+                                       pack=pack)
     return init_fn, jax.jit(chunk_fn, donate_argnums=(1,))

@@ -29,8 +29,56 @@ from .. import occupancy as _occ
 from .. import watchdog as _watchdog
 from ..history import History
 from ..models.core import Model
+from ..ops import adapt as _adapt
 from ..ops import wgl_ref
 from ..ops.encode import INF, Encoded, EncodingUnsupported, _pad_to, encode
+
+
+def shared_shape_bucket(encs: Sequence[Encoded]) -> Optional[dict]:
+    """One (n_pad, ic, S, O, w_eff) shape bucket covering every key
+    of a streamed fan-out — `wgl.check(shape_bucket=...)` pads each
+    encoding into it, so the whole key set compiles ONE kernel per
+    ladder bucket instead of one per raw shape.
+
+    Root cause of the r05 `independent_100x2k` regression (+8 s over
+    r04 on the same code): the 100 keys' raw encodings straddle
+    several (n_pad, W_eff) buckets — n_pad buckets at 64-op
+    granularity, W_eff at 8 — so a handful of keys each paid a fresh
+    XLA compile + python-dispatch warm-up INSIDE the measured window
+    (shard walls: p50 0.23 s vs max 1.3 s on this machine — the
+    stragglers in `fleet.summarize()` are exactly the first key of
+    each bucket), and whether those compiles hit the persistent
+    compile cache varies round to round. One shared bucket makes the
+    cost one compile, paid once, cache-state-independent.
+
+    Only meaningful when every key takes the same kernel branch —
+    callers split keys at window_raw 32 (narrow/wide) and bucket
+    each group separately. Returns None for empty input."""
+    if not encs:
+        return None
+    from ..ops.wgl import _packable
+    wide = encs[0].window_raw > 32
+    w_eff = 0
+    ic_eff = 8
+    for e in encs:
+        if wide:
+            w_eff = max(w_eff, _pad_to(e.window_raw, 32))
+        else:
+            w_eff = max(w_eff, max(8, _pad_to(e.window_raw, 8)))
+        ic_eff = max(ic_eff, _pad_to(max(e.n_info, 1), 8))
+    return {
+        "n_pad": max(len(e.inv) for e in encs),
+        "ic_pad": max(len(e.inv_info) for e in encs),
+        "S": max(e.table.shape[0] for e in encs),
+        "O": max(e.table.shape[1] for e in encs),
+        "w_eff": w_eff,
+        "ic_eff": min(ic_eff, max(len(e.inv_info) for e in encs)),
+        "n_cap": max(e.n_ok for e in encs),
+        # bucket-wide packed-table bit: one unpackable key must not
+        # split the bucket into two kernel variants (the whole point
+        # is ONE executable per ladder bucket)
+        "pack": all(_packable(e) for e in encs),
+    }
 
 
 def default_mesh(axis: str = "keys"):
@@ -312,6 +360,16 @@ def check_streamed(model: Model, histories: Sequence[History],
     if status.enabled and register_keys and len(histories) > 1:
         status.begin_keys(len(histories))
 
+    # One shared shape bucket per kernel branch: every key compiles
+    # the same executable (see shared_shape_bucket — the
+    # independent_100x2k straggler fix)
+    bucket_n = bucket_w = None
+    if encs is not None and len(histories) > 1:
+        bucket_n = shared_shape_bucket(
+            [e for e in encs if e.window_raw <= 32])
+        bucket_w = shared_shape_bucket(
+            [e for e in encs if e.window_raw > 32])
+
     def one(dev, i_hist):
         label = _fleet.device_label(dev)
         di = devices.index(dev) if dev in devices else None
@@ -342,10 +400,15 @@ def check_streamed(model: Model, histories: Sequence[History],
                         enc=encs[i_hist] if encs else None)
                     engine = str(res.get("engine") or "device")
                 else:
+                    enc_i = encs[i_hist] if encs else None
+                    sb = None
+                    if enc_i is not None:
+                        sb = (bucket_n if enc_i.window_raw <= 32
+                              else bucket_w)
                     res = wgl.check(model, histories[i_hist],
                                     time_limit=remaining,
                                     max_configs=max_configs,
-                                    enc=encs[i_hist] if encs else None)
+                                    enc=enc_i, shape_bucket=sb)
                     engine = "device"
                     if res.get("valid?") == "unknown" and oracle_fallback:
                         status.device_state(label, "fallback",
@@ -642,6 +705,14 @@ def check_batched(model: Model, histories: Sequence[History],
     # points — silent caps read as full coverage, so exhaustion is
     # recorded on the series itself
     prev_rounds = np.zeros(bk, dtype=np.int64)
+    prev_expl = np.zeros(bk, dtype=np.int64)
+    # per-lane adaptive hints: a lockstep vmap batch shares ONE K, so
+    # the ladder cannot re-bucket a single lane — but the policy's
+    # recommendation is recorded per lane per poll, naming the
+    # capacity each lane actually needs (the mesh-sharding rework of
+    # ROADMAP item 3 consumes these)
+    hint_ladder = (_adapt.ladder_for(K, k_min=max(32, K // 16), step=8)
+                   if L else _adapt.LADDER32)
     occ_budget = 8192
     try:
         while True:
@@ -668,6 +739,21 @@ def check_batched(model: Model, histories: Sequence[History],
             fr_real = fr_cnt[:batch.n_keys]
             fills = np.round(fr_real / max(K, 1), 4)
             if mx.enabled:
+                # per-lane adaptive hints ride the lanes series only
+                # — the metrics-off poll loop stays overhead-free
+                # (PR-2's zero-cost contract)
+                r_delta = np.maximum(stats[:, 5].astype(np.int64)
+                                     - prev_rounds, 0)
+                e_delta = np.maximum(stats[:, 0].astype(np.int64)
+                                     - prev_expl, 0)
+                occupied = np.where(r_delta > 0, e_delta
+                                    / np.maximum(r_delta, 1), 0.0)
+                hints = [_adapt.recommend(hint_ladder,
+                                          float(occupied[lane]))
+                         for lane in range(batch.n_keys)]
+            prev_expl = stats[:, 0].astype(np.int64)
+            prev_rounds_next = stats[:, 5].astype(np.int64)
+            if mx.enabled:
                 mx.series(
                     "wgl_batched_chunks",
                     "per-poll state of the mesh-sharded batched search"
@@ -693,7 +779,10 @@ def check_batched(model: Model, histories: Sequence[History],
                         "K": K, "kernel": kern,
                         "live": int(live.sum()),
                         "empty_lanes": int((fr_real == 0).sum()),
-                        "fill": [float(f) for f in fills]})
+                        "fill": [float(f) for f in fills],
+                        # the per-lane adaptive recommendation (the
+                        # bucket a solo search of this lane would run)
+                        "hints": [int(h) for h in hints]})
                 # per-lane per-ROUND drain for the round x lane
                 # heatmap, bounded; exhaustion is recorded, not silent
                 rounds_series = mx.series(
@@ -718,7 +807,7 @@ def check_batched(model: Model, histories: Sequence[History],
                             "note": "point budget exhausted; later "
                                     "rounds not drained"})
                         occ_budget = -1  # emit the marker once
-                prev_rounds = stats[:, 5].astype(np.int64)
+            prev_rounds = prev_rounds_next
             if status.enabled:
                 status.batched_poll(
                     live=int(live.sum()),
@@ -784,7 +873,12 @@ def check_batched(model: Model, histories: Sequence[History],
                       "lane": lane, "K": K,
                       "fill_last": round(
                           int(fr_cnt[lane]) / max(K, 1), 4),
-                      "rounds": rounds}}
+                      "rounds": rounds,
+                      # whole-run adaptive hint: the ladder bucket a
+                      # solo search of this key would have settled at
+                      "hint": _adapt.recommend(
+                          hint_ladder,
+                          int(stats[lane, 0]) / max(rounds, 1))}}
         engine = "device-vmap"
         if found[lane]:
             res = {"valid?": True, "op_count": n_total, **detail}

@@ -340,6 +340,12 @@ def render_occupancy(store_root: str) -> bytes:
                  + "</tbody></table>"
                  f"<p>target: mean fill &ge; "
                  f"{occupancy_mod.TARGET_FILL} (ROADMAP item 5)</p>")
+    ad = occ.get("adapt") or {}
+    if ad:
+        parts.append(
+            f"<p>adaptive ladder {_esc(ad.get('ladder'))} &middot; "
+            f"{_esc(ad.get('switches'))} switch(es) this search — "
+            f"K above is the live bucket</p>")
     lanes = occ.get("lanes") or {}
     if lanes:
         parts.append(

@@ -1,0 +1,78 @@
+#!/usr/bin/env python
+"""Fast CI gate for the occupancy-adaptive WGL ladder (ops/adapt.py).
+
+Drives one small valid config and one small exhaustive config through
+the bucket ladder on the cpu backend and fails loudly when a policy
+regression lands:
+
+  * the valid config must decide at the ladder's bottom bucket with
+    frontier_fill >= the 0.8 target (the whole point of ISSUE 9);
+  * the exhaustive config must climb the ladder (>= 1 growth switch)
+    and still match the `wgl_ref` oracle verdict;
+  * a warm re-run over the already-visited buckets must stay at ZERO
+    XLA recompiles under CompileGuard (the ladder is pre-compiled
+    state, not a retrace hazard).
+
+~20 s on a CI cpu. Exit 0 clean, 1 on any violation.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    from jepsen_tpu import synth
+    from jepsen_tpu.analysis import guards
+    from jepsen_tpu.models import cas_register, mutex
+    from jepsen_tpu.ops import adapt, wgl, wgl_ref
+
+    failures = []
+
+    def check(cond, msg):
+        print(("ok   " if cond else "FAIL ") + msg)
+        if not cond:
+            failures.append(msg)
+
+    # -- valid config: bottom bucket, high fill ---------------------
+    m, h = mutex(), synth.mutex_history(1000, n_procs=4, seed=7)
+    res = wgl.check(m, h, time_limit=60)
+    util = res["util"]
+    check(res["valid?"] is True, "mutex_1k verdict True")
+    check(res["K"] == adapt.LADDER32[0],
+          f"mutex_1k stays at bottom bucket (K={res['K']})")
+    check(util["frontier_fill"] >= 0.8,
+          f"mutex_1k frontier_fill {util['frontier_fill']} >= 0.8")
+    ref = wgl_ref.check(m, h, time_limit=60)
+    check(res["valid?"] == ref["valid?"], "mutex_1k oracle parity")
+
+    # -- exhaustive config: ladder climbs, verdict parity -----------
+    ma = cas_register()
+    ha = synth.adversarial_wave_history(8, width=10, span=4, seed=7)
+    ra = wgl.check(ma, ha, time_limit=120)
+    path = (ra["util"].get("adapt") or {}).get("path") or []
+    grew = any(b > a for a, b, _ in path)
+    check(ra["valid?"] != "unknown", "adversarial decided")
+    check(grew, f"adversarial climbed the ladder (path={path})")
+    rra = wgl_ref.check(ma, ha, time_limit=120)
+    check(ra["valid?"] == rra["valid?"], "adversarial oracle parity")
+
+    # -- warm ladder run: zero recompiles ---------------------------
+    with guards.CompileGuard(max_compiles=0, name="adapt-smoke") as g:
+        res2 = wgl.check(m, h, time_limit=60)
+    check(g.compiles == 0,
+          f"warm ladder run recompiles == 0 (got {g.compiles})")
+    check(res2["valid?"] == res["valid?"], "warm verdict stable")
+
+    print(f"adaptive smoke: {len(failures)} failure(s)")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -13,6 +13,9 @@ python scripts/jax_lint.py
 echo "== telemetry_lint =="
 python scripts/telemetry_lint.py
 
+echo "== adaptive ladder smoke =="
+JAX_PLATFORMS=cpu python scripts/adaptive_smoke.py
+
 echo "== tier-1 pytest =="
 JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider

@@ -53,9 +53,13 @@ SERIES_SCHEMAS = {
                            "live_keys": int, "decided_keys": int,
                            "frontier_total": int, "backlog_total": int,
                            "explored_total": int},
+    "wgl_adapt": {"chunk": int, "from_K": int, "to_K": int,
+                  "reason": str, "fill": NUM, "backlog": int,
+                  "explored": int, "kernel": str, "platform": str},
     "wgl_batched_lanes": {"poll": int, "wall_s": NUM, "K": int,
                           "kernel": str, "live": int,
-                          "empty_lanes": int, "fill": list},
+                          "empty_lanes": int, "fill": list,
+                          "hints": list},
     "wgl_batched_rounds": {"round": int, "lane": int, "fill": NUM,
                            "frontier": int},
     "fleet_shards": {"key_index": int, "device": str, "engine": str,

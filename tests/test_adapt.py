@@ -1,0 +1,482 @@
+"""Occupancy-adaptive WGL scheduling (ops/adapt.py + the wgl.check
+ladder integration): hysteresis policy unit tests (no device), carry
+migration, verdict equivalence vs the fixed-window kernels and the
+`wgl_ref` oracle across valid/invalid/adversarial corpora, the
+shared-shape-bucket fan-out, the packed lookup tables, the
+CompileGuard warm-ladder proof, and the `wgl_adapt` series schema."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from jepsen_tpu import metrics, synth
+from jepsen_tpu.models import cas_register, mutex, register
+from jepsen_tpu.ops import adapt, wgl, wgl_ref
+from jepsen_tpu.ops.encode import encode
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LINT = os.path.join(REPO, "scripts", "telemetry_lint.py")
+
+
+# --- pure-python policy unit tests (no jax import needed) -------------------
+
+class TestPolicy:
+    def _policy(self, ladder=(2, 16, 64, 512), n_ok=1000, **kw):
+        return adapt.Policy(ladder=ladder, n_ok=n_ok,
+                            backlog_cap=1 << 18, **kw)
+
+    def test_starts_at_bottom(self):
+        p = self._policy()
+        assert p.k == 2
+
+    def test_explored_threshold_grows_one_level(self):
+        p = self._policy()
+        d = p.observe(explored=p._esc_threshold(), rounds_delta=100,
+                      explored_delta=200, frontier=2, backlog=10)
+        assert d.switch and d.to_k == 16
+        assert d.reason == "explored-threshold"
+        # thresholds quadruple per level: the same explored count
+        # does NOT immediately trigger the next level
+        d2 = p.observe(explored=p._esc_threshold() - 1,
+                       rounds_delta=100, explored_delta=200,
+                       frontier=2, backlog=10)
+        assert not d2.switch
+
+    def test_valid_history_never_escalates(self):
+        # a valid history explores ~2.6 x n_ok configs — below the
+        # 6 x n_ok escalation floor by design
+        p = self._policy(n_ok=10_000)
+        for i in range(10):
+            d = p.observe(explored=2600 * (i + 1), rounds_delta=1000,
+                          explored_delta=2600, frontier=2,
+                          backlog=5000)
+            assert not d.switch
+        assert p.k == 2
+
+    def test_backlog_pressure_jumps_to_top(self):
+        p = self._policy()
+        d = p.observe(explored=100, rounds_delta=10,
+                      explored_delta=100, frontier=2,
+                      backlog=(1 << 18) // 8)
+        assert d.switch and d.to_k == 512
+        assert d.reason == "backlog-pressure"
+
+    def test_shrink_needs_patience(self):
+        p = self._policy(start_k=64)
+        # one sparse poll is not enough (hysteresis)
+        d = p.observe(explored=100, rounds_delta=100,
+                      explored_delta=300, frontier=3, backlog=0)
+        assert not d.switch
+        d = p.observe(explored=200, rounds_delta=100,
+                      explored_delta=300, frontier=3, backlog=0)
+        assert d.switch and d.to_k == 16
+        assert d.reason == "sparse-frontier"
+
+    def test_oscillating_fill_does_not_thrash(self):
+        """A wavefront oscillating around a bucket boundary settles
+        instead of ping-ponging executables: after a shrink, a
+        regrow burns the abandoned bucket, so later sparse polls
+        hold."""
+        p = self._policy(ladder=(2, 16, 64), start_k=64, n_ok=10)
+        sparse = dict(rounds_delta=100, explored_delta=300,
+                      frontier=3, backlog=0)
+        full = dict(rounds_delta=100, explored_delta=6400,
+                    frontier=64, backlog=0)
+        p.observe(explored=50, **sparse)
+        d = p.observe(explored=60, **sparse)
+        assert d.switch and d.to_k == 16          # shrink
+        # demand returns: the explored threshold regrows and burns 16
+        d = p.observe(explored=10 ** 6, **full)
+        assert d.switch and d.to_k == 64
+        # sparse again — the burned bucket is never re-entered
+        for i in range(6):
+            d = p.observe(explored=10 ** 6 + i, **sparse)
+            assert not d.switch
+        assert p.k == 64
+        assert len(p.switches) == 2               # no thrash
+
+    def test_summary_shape(self):
+        p = self._policy()
+        p.observe(explored=10 ** 7, rounds_delta=10,
+                  explored_delta=10, frontier=2, backlog=0)
+        s = p.summary()
+        assert s["ladder"] == [2, 16, 64, 512]
+        assert s["switches"] == 1
+        assert s["path"] == [[2, 16, "explored-threshold"]]
+        assert 16 in s["buckets_visited"]
+
+    def test_ladder_for(self):
+        assert adapt.ladder_for(1024, k_min=64, step=8) == \
+            (64, 512, 1024)
+        assert adapt.ladder_for(64, k_min=64) == (64,)
+        assert adapt.ladder_for(512, k_min=2, step=8)[-1] == 512
+
+    def test_recommend(self):
+        ladder = (2, 16, 64, 512)
+        assert adapt.recommend(ladder, 0.5) == 2
+        assert adapt.recommend(ladder, 5.0) == 16
+        assert adapt.recommend(ladder, 400.0) == 512
+
+
+class TestMigrate:
+    def test_grow_and_shrink_roundtrip(self):
+        import jax.numpy as jnp
+        fr = jnp.arange(4 * 3, dtype=jnp.int32).reshape(4, 3)
+        carry = (fr, jnp.int32(2), "rest")
+        grown = adapt.migrate_frontier(carry, 16)
+        assert grown[0].shape == (16, 3)
+        assert (grown[0][:4] == fr).all()
+        assert (grown[0][4:] == 0).all()
+        back = adapt.migrate_frontier(grown, 4)
+        assert back[0].shape == (4, 3)
+        assert (back[0] == fr).all()
+        assert adapt.migrate_frontier(carry, 4) is carry
+
+
+# --- verdict equivalence: adaptive vs fixed vs oracle -----------------------
+
+class TestParity:
+    def _verdicts(self, model, h, **kw):
+        ad = wgl.check(model, h, time_limit=120, **kw)
+        fixed = wgl.check(model, h, time_limit=120, adaptive=False,
+                          **kw)
+        ora = wgl_ref.check(model, h, time_limit=120)
+        return ad, fixed, ora
+
+    def test_valid_matrix(self):
+        cases = [
+            (cas_register(), synth.cas_register_history(
+                600, n_procs=5, seed=1, crash_p=0.005)),
+            (mutex(), synth.mutex_history(400, n_procs=4, seed=7)),
+            (register(), synth.cas_register_history(
+                300, n_procs=5, seed=9, fs=("read", "write"))),
+        ]
+        for model, h in cases:
+            ad, fixed, ora = self._verdicts(model, h)
+            assert ad["valid?"] is True
+            assert ad["valid?"] == fixed["valid?"] == ora["valid?"]
+
+    def test_adversarial_corpus(self):
+        import random
+        rng = random.Random(4242)
+        for _ in range(3):
+            invalid = rng.random() < 0.5
+            h = synth.adversarial_wave_history(
+                3, width=rng.choice([8, 10]), span=3,
+                seed=rng.randrange(10 ** 6), invalid=invalid)
+            ad, fixed, ora = self._verdicts(cas_register(), h)
+            assert ad["valid?"] == fixed["valid?"] == ora["valid?"] \
+                == (not invalid)
+
+    def test_invalid_narrow_exhaustive(self):
+        # a tiny impossible history: exhaustion at the bottom bucket
+        from jepsen_tpu.history import History
+        ev = [
+            {"index": 0, "time": 0, "type": "invoke", "process": 0,
+             "f": "write", "value": 1},
+            {"index": 1, "time": 1, "type": "ok", "process": 0,
+             "f": "write", "value": 1},
+            {"index": 2, "time": 2, "type": "invoke", "process": 1,
+             "f": "read", "value": None},
+            {"index": 3, "time": 3, "type": "ok", "process": 1,
+             "f": "read", "value": 2},
+        ]
+        ad, fixed, ora = self._verdicts(register(), History(ev))
+        assert ad["valid?"] is False
+        assert fixed["valid?"] is False and ora["valid?"] is False
+
+    def test_adapt_block_on_result(self):
+        h = synth.cas_register_history(300, n_procs=4, seed=3)
+        res = wgl.check(cas_register(), h, time_limit=60)
+        a = res["util"]["adapt"]
+        assert a["ladder"] == list(adapt.LADDER32)
+        assert a["final_K"] == res["K"]
+        assert res["util"]["packed_tables"] is True
+
+    @pytest.mark.parametrize("kern", ["wgl32", "wgln"])
+    def test_compact_before_expand_parity(self, kern):
+        """The compact-before-expand pre-pass (shared
+        wgl32.make_compact_frontier) must not change verdicts or
+        exhaustive explored counts on either kernel — built
+        explicitly with compact=True, since the host builds default
+        it off (insert-time dedup keeps their beams unique)."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        from jepsen_tpu.ops.encode import INF
+        from jepsen_tpu.ops.wgl32 import _build_search32
+        from jepsen_tpu.ops.wgln import _build_searchN
+
+        width = 6 if kern == "wgl32" else 12   # 12x3 spans W=37 > 32
+        h = synth.adversarial_wave_history(3, width=width, span=3,
+                                           seed=11)
+        enc = encode(cas_register(), h)
+        n_pad = len(enc.inv)
+        S, O = enc.table.shape
+
+        def pad1(a, size, fill):
+            out = np.full(size, fill, dtype=a.dtype)
+            out[:len(a)] = a
+            return out
+
+        consts = (jnp.asarray(enc.inv), jnp.asarray(enc.ret),
+                  jnp.asarray(enc.opcode), jnp.asarray(enc.sufminret),
+                  jnp.asarray(pad1(enc.inv_info[:8], 8, INF)),
+                  jnp.asarray(pad1(enc.opcode_info[:8], 8, 0)),
+                  jnp.asarray(enc.table), jnp.int32(enc.n_ok),
+                  jnp.int32(enc.n_info), jnp.int32(10 ** 8))
+
+        def run(compact):
+            if kern == "wgl32":
+                assert enc.window_raw <= 32
+                init_fn, chunk_fn = _build_search32(
+                    n_pad, 8, S, O, K=16, H=1 << 16, B=1 << 12,
+                    chunk=64, probes=4, W=8, compact=compact)
+            else:
+                W = ((enc.window_raw + 31) // 32) * 32
+                init_fn, chunk_fn = _build_searchN(
+                    n_pad, 8, S, O, K=64, H=1 << 18, B=1 << 14,
+                    chunk=64, probes=4, W=W, L=W // 32,
+                    compact=compact)
+            chunk = jax.jit(chunk_fn, donate_argnums=(1,))
+            carry = init_fn(0)
+            for _ in range(256):
+                carry, s = chunk(consts, carry)
+                s = np.asarray(s)
+                if s[1] or s[0] == 0:   # found or exhausted
+                    break
+            return bool(s[1]), int(s[0] == 0), int(s[4])
+
+        found_a, empty_a, explored_a = run(False)
+        found_b, empty_b, explored_b = run(True)
+        assert (found_a, empty_a) == (found_b, empty_b)
+        # exhaustive explored counts agree up to sound re-exploration
+        # from probe-slot races (the relative bound the adversarial
+        # differential tests use — compaction shifts insert ordering)
+        assert abs(explored_a - explored_b) \
+            <= max(64, int(explored_a * 1e-3))
+
+    def test_frontier_override_disables_ladder(self):
+        h = synth.cas_register_history(300, n_procs=4, seed=3)
+        res = wgl.check(cas_register(), h, time_limit=60, frontier=32)
+        assert res["K"] == 32
+        assert "adapt" not in res["util"]
+
+
+# --- packed lookup tables ----------------------------------------------------
+
+class TestPackedTables:
+    def test_packable_decision(self):
+        enc = encode(cas_register(), synth.cas_register_history(
+            500, n_procs=4, seed=1))
+        assert wgl._packable(enc) is True
+
+    def test_unpacked_parity(self, monkeypatch):
+        h = synth.cas_register_history(500, n_procs=5, seed=11,
+                                       crash_p=0.005)
+        res_p = wgl.check(cas_register(), h, time_limit=60)
+        monkeypatch.setattr(wgl, "_packable", lambda e: False)
+        res_u = wgl.check(cas_register(), h, time_limit=60)
+        assert res_p["valid?"] == res_u["valid?"] is True
+        assert res_p["util"]["packed_tables"] is True
+        assert res_u["util"]["packed_tables"] is False
+        # bit-exact: the packed comparisons run in int16 with the
+        # clamped sentinel, so the explored mass is identical
+        assert res_p["configs_explored"] == res_u["configs_explored"]
+
+    def test_packed_tables_shrink_gather_bytes(self):
+        """The win, proven by the compiler's own cost analysis on the
+        lowered kernel (no backend compile): int16 tables cut the
+        per-round bytes accessed."""
+        import jax
+        from jepsen_tpu.ops.wgl32 import _build_search32
+
+        def lowered_bytes(pack):
+            init_fn, chunk_fn = _build_search32(
+                512, 8, 64, 16, K=4, H=1 << 16, B=1 << 12, chunk=64,
+                probes=4, W=8, pack=pack)
+            import jax.numpy as jnp
+            v = lambda s: jax.ShapeDtypeStruct(s, jnp.int32)  # noqa: E731
+            consts = (v((512,)), v((512,)), v((512,)), v((513,)),
+                      v((8,)), v((8,)), v((64, 16)), v(()), v(()),
+                      v(()))
+            carry = jax.eval_shape(init_fn, 0)
+            ca = jax.jit(chunk_fn).lower(consts, carry).cost_analysis()
+            if isinstance(ca, (list, tuple)):
+                ca = ca[0] if ca else {}
+            return float(ca.get("bytes accessed", 0.0))
+
+        b_packed, b_full = lowered_bytes(True), lowered_bytes(False)
+        assert b_packed > 0 and b_full > 0
+        assert b_packed < b_full
+
+
+# --- shared shape bucket (the independent_100x2k straggler fix) -------------
+
+class TestSharedBucket:
+    def test_bucket_covers_all_keys(self):
+        m = cas_register()
+        encs = [encode(m, synth.cas_register_history(
+            n, n_procs=4, seed=n)) for n in (420, 500, 610)]
+        from jepsen_tpu.parallel.batched import shared_shape_bucket
+        b = shared_shape_bucket(encs)
+        assert b["n_pad"] == max(len(e.inv) for e in encs)
+        assert b["w_eff"] % 8 == 0
+        assert b["n_cap"] == max(e.n_ok for e in encs)
+        assert shared_shape_bucket([]) is None
+
+    def test_apply_bucket_preserves_verdict(self):
+        m = cas_register()
+        h = synth.cas_register_history(300, n_procs=4, seed=5)
+        enc = encode(m, h)
+        bucket = {"n_pad": len(enc.inv) + 192,
+                  "ic_pad": len(enc.inv_info) + 32,
+                  "S": enc.table.shape[0] + 5,
+                  "O": enc.table.shape[1] + 3,
+                  "w_eff": 24, "ic_eff": 16, "n_cap": enc.n_ok}
+        res_b = wgl.check(m, h, time_limit=60, shape_bucket=bucket)
+        res = wgl.check(m, h, time_limit=60)
+        assert res_b["valid?"] == res["valid?"] is True
+        assert res_b["configs_explored"] == res["configs_explored"]
+
+    def test_bucketed_keys_share_one_kernel(self):
+        """Keys whose raw encodings straddle several (n_pad, W_eff)
+        shape buckets share ONE compiled kernel once padded into the
+        shared bucket: after the first key compiles it, every later
+        key checks at zero recompiles under CompileGuard. (Driven
+        through wgl.check directly on one device — the threaded
+        fan-out runs on the conftest's 8-device virtual mesh, where
+        each device necessarily owns its own executable.)"""
+        from jepsen_tpu.analysis import guards
+        from jepsen_tpu.parallel.batched import shared_shape_bucket
+        m = cas_register()
+        # lengths straddle the 64-op n_pad granularity: raw shapes
+        # would compile 3+ distinct kernels
+        hists = [synth.cas_register_history(n, n_procs=4, seed=n)
+                 for n in (800, 900, 1000, 1100)]
+        encs = [encode(m, h) for h in hists]
+        assert len({len(e.inv) for e in encs}) > 1  # really straddles
+        bucket = shared_shape_bucket(encs)
+        first = wgl.check(m, hists[0], time_limit=120, enc=encs[0],
+                          shape_bucket=bucket)
+        assert first["valid?"] is True
+        with guards.CompileGuard(max_compiles=0, name="bucket-warm"):
+            rest = [wgl.check(m, h, time_limit=120, enc=e,
+                              shape_bucket=bucket)
+                    for h, e in zip(hists[1:], encs[1:])]
+        assert all(r["valid?"] is True for r in rest)
+
+    def test_streamed_fanout_uses_bucket(self):
+        """End-to-end: the streamed auto path (n_ok > 512 on cpu)
+        decides every key, and every per-key result reports the
+        SAME bucket-padded n_pad capacity (the bucket was applied)."""
+        from jepsen_tpu.parallel import check_batched
+        m = cas_register()
+        hists = [synth.cas_register_history(n, n_procs=4, seed=n)
+                 for n in (800, 1100)]
+        res = check_batched(m, hists, time_limit=120)
+        assert all(r["valid?"] is True for r in res)
+        assert res[0]["shard"]["engine"] == "device"  # streamed
+
+
+# --- CompileGuard: warm ladder stays within the compile budget --------------
+
+class TestWarmLadder:
+    def test_warm_ladder_zero_recompiles(self):
+        from jepsen_tpu.analysis import guards
+        m, h = mutex(), synth.mutex_history(400, n_procs=4, seed=3)
+        wgl.check(m, h, time_limit=60)        # cold: compiles buckets
+        with guards.CompileGuard(max_compiles=0, name="ladder-warm") \
+                as g:
+            res = wgl.check(m, h, time_limit=60)
+        assert g.compiles == 0
+        assert res["valid?"] is True
+
+    def test_precompile_ladder_covers_adaptive_run(self):
+        """ops/aot.py precompile_wgl_ladder: after the warm-up, a
+        fresh search over that shape bucket never compiles, whatever
+        buckets the policy visits."""
+        from jepsen_tpu.analysis import guards
+        from jepsen_tpu.ops import aot
+        m = cas_register()
+        h = synth.cas_register_history(200, n_procs=4, seed=21)
+        enc = encode(m, h)
+        n_pad, ic = len(enc.inv), 8
+        W_eff = max(8, ((enc.window_raw + 7) // 8) * 8)
+        timings = aot.precompile_wgl_ladder(
+            n_pad=n_pad, ic_pad=ic, S=enc.table.shape[0],
+            O=enc.table.shape[1], H=1 << 19, B=1 << 18, chunk=1024,
+            W=W_eff, pack=wgl._packable(enc))
+        assert set(timings) == set(adapt.LADDER32)
+        with guards.CompileGuard(max_compiles=0,
+                                 name="precompiled-ladder"):
+            res = wgl.check(m, h, time_limit=60, enc=enc)
+        assert res["valid?"] is True
+
+
+# --- wgl_adapt series schema -------------------------------------------------
+
+class TestAdaptSeries:
+    def test_switch_points_recorded_and_lint_clean(self, tmp_path):
+        reg = metrics.Registry()
+        h = synth.adversarial_wave_history(8, width=10, span=4,
+                                           seed=7)
+        res = wgl.check(cas_register(), h, time_limit=120,
+                        metrics=reg)
+        assert res["valid?"] is not None
+        pts = reg.series("wgl_adapt").points
+        assert pts, "exhaustive search must switch buckets"
+        for p in pts:
+            assert p["to_K"] > p["from_K"]
+            assert p["reason"] in ("explored-threshold",
+                                   "backlog-pressure")
+        path = res["util"]["adapt"]["path"]
+        assert len(path) == len(pts)
+        p = str(tmp_path / "adapt.jsonl")
+        reg.export_jsonl(p)
+        proc = subprocess.run([sys.executable, LINT, p],
+                              capture_output=True, text=True)
+        assert proc.returncode == 0, proc.stderr
+
+    def test_synthetic_point_lints(self, tmp_path):
+        pt = {"type": "sample", "series": "wgl_adapt", "t": 1.0,
+              "chunk": 3, "from_K": 2, "to_K": 16,
+              "reason": "explored-threshold", "fill": 0.9,
+              "backlog": 12, "explored": 50000, "kernel": "wgl32",
+              "platform": "cpu"}
+        p = tmp_path / "m.jsonl"
+        p.write_text(json.dumps(pt) + "\n")
+        proc = subprocess.run([sys.executable, LINT, str(p)],
+                              capture_output=True, text=True)
+        assert proc.returncode == 0, proc.stderr
+        bad = dict(pt)
+        bad["to_K"] = "16"
+        p.write_text(json.dumps(bad) + "\n")
+        proc = subprocess.run([sys.executable, LINT, str(p)],
+                              capture_output=True, text=True)
+        assert proc.returncode == 1
+        assert "to_K" in proc.stderr
+
+
+# --- batched per-lane hints --------------------------------------------------
+
+class TestBatchedHints:
+    def test_vmap_lanes_carry_hints(self):
+        from jepsen_tpu.parallel import check_batched
+        hs = [synth.cas_register_history(60, n_procs=3, seed=s)
+              for s in range(5)]
+        reg = metrics.Registry()
+        with metrics.use(reg):
+            res = check_batched(cas_register(), hs, time_limit=60,
+                                strategy="vmap")
+        assert all(r["valid?"] is True for r in res)
+        lanes = reg.series("wgl_batched_lanes").points
+        assert lanes
+        for p in lanes:
+            assert len(p["hints"]) == 5
+            assert all(h in adapt.LADDER32 for h in p["hints"])
+        occ = res[0]["occupancy"]
+        assert occ["hint"] in adapt.LADDER32

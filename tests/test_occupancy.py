@@ -371,12 +371,15 @@ class TestElleIters:
         out = etpu.standard_cycle_search(g, backend="tpu")
         assert out["G0"] is not None
         u = out["util"]
-        assert len(u["iter_reach"]) == u["iters"]
+        # convergence early-exit: only the executed squarings report
+        assert len(u["iter_reach"]) == u["iters_run"]
+        assert 1 <= u["iters_run"] <= u["iters"]
+        assert u["iters_reclaimed"] == u["iters"] - u["iters_run"]
         assert all(len(row) == 3 for row in u["iter_reach"])
         # reach is monotone under repeated squaring
         widest = [row[-1] for row in u["iter_reach"]]
         assert widest == sorted(widest)
-        assert 1 <= u["converged_at"] <= u["iters"]
+        assert 1 <= u["converged_at"] <= u["iters_run"]
         assert 0.0 < u["reach_density"] <= 1.0
 
 
@@ -412,7 +415,8 @@ class TestLintSchemas:
         good = [
             {"type": "sample", "series": "wgl_batched_lanes", "t": 1.0,
              "poll": 0, "wall_s": 0.1, "K": 64, "kernel": "wgl32",
-             "live": 3, "empty_lanes": 1, "fill": [0.1, 0.0, 0.5]},
+             "live": 3, "empty_lanes": 1, "fill": [0.1, 0.0, 0.5],
+             "hints": [2, 2, 16]},
             {"type": "sample", "series": "wgl_batched_rounds",
              "t": 1.0, "round": 2, "lane": 1, "fill": 0.25,
              "frontier": 16},

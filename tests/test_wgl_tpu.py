@@ -253,14 +253,17 @@ class TestWideWindow:
 
 def test_beam_escalation(monkeypatch):
     """Past the exploration threshold the beam widens to _K_BIG and the
-    carry (incl. memo table) migrates — verdict unchanged."""
+    carry (incl. memo table) migrates — verdict unchanged. This is the
+    legacy non-adaptive path (adaptive=False pins it); the
+    occupancy-driven ladder that replaces it is covered by
+    tests/test_adapt.py."""
     from jepsen_tpu.ops import wgl
     from jepsen_tpu.synth import cas_register_history
     monkeypatch.setattr(wgl, "_ESCALATE_AT", 1000)
     # must span >1 chunk (1024 rounds) so the between-chunks escalation
     # check actually runs mid-search
     h = cas_register_history(3000, n_procs=5, seed=0)
-    res = wgl.check(models.cas_register(), h)
+    res = wgl.check(models.cas_register(), h, adaptive=False)
     assert res["valid?"] is True
     assert res["K"] == wgl._K_BIG  # escalated mid-search
 
