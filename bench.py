@@ -312,92 +312,107 @@ def run_extras(budget: float, deadline: float) -> dict:
 
     run("long_tail_900", None, None, checker=long_tail)
 
-    # Elle plane: list-append txn anomaly search, graph cycle queries
-    # as batched closure matmuls on device (elle/tpu.py). On an
-    # accelerator the device backend is FORCED (not auto) so the MXU
-    # plane is always exercised and its TFLOP/s recorded.
-    import jax as _jax
-    cycle_backend = ("tpu" if _jax.default_backend() != "cpu"
-                     else "auto")
+    # Elle plane: list-append txn anomaly search. The whole pipeline
+    # is device-first now (ISSUE 10): elle/build.py tensorizes graph
+    # construction and cycle_backend="auto" shape-routes the query
+    # battery onto the elle/tpu.py kernel family (trim on cpu-XLA,
+    # bf16/packed squaring on an accelerator, picked per shape by
+    # Lowered.cost_analysis). Each config warms its closure shape
+    # bucket through aot.precompile_elle_closure BEFORE the measured
+    # window — the same zero-recompile warm path the service
+    # direction uses (and the PR-9 lesson: compile warm-up inside the
+    # measured window is a measurement bug, not a result).
+    from jepsen_tpu.elle import build as elle_build_mod
+    from jepsen_tpu.elle import tpu as elle_tpu_mod
+    from jepsen_tpu.ops import aot as aot_mod
+
+    def _warm_elle(hist, build_fn, **build_kw):
+        # split ops the same way the checkers do, build the tensors,
+        # and backend-compile their shape bucket — ONE helper so the
+        # warm bucket can never drift from the measured shape
+        try:
+            oks = [op for op in hist
+                   if op.is_ok and op.f in ("txn", None) and op.value]
+            infos = [op for op in hist
+                     if op.is_info and op.f in ("txn", None)
+                     and op.value]
+            tensors = build_fn(hist, oks, infos, **build_kw).tensors
+            aot_mod.precompile_elle_closure(
+                elle_tpu_mod.shape_bucket_for(tensors))
+        except Exception:  # noqa: BLE001 — warm-up is best-effort;
+            pass           # the measured run still decides correctly
+
+    def _elle_entry(res, hist):
+        return {"valid?": res["valid?"],
+                "op_count": len(hist) // 2,
+                "engine": res.get("cycle-engine"),
+                "route_reason": res.get("cycle-route-reason"),
+                "util": res.get("cycle-util"),
+                "cause": ",".join(res["anomaly-types"]) or None}
+
+    hist_a3 = synth.list_append_history(3000, n_procs=5, seed=7)
 
     def elle_append():
         from jepsen_tpu.elle import append as elle_append_mod
-        hist_a = synth.list_append_history(3000, n_procs=5, seed=7)
-        res = elle_append_mod.check(hist_a,
+        res = elle_append_mod.check(hist_a3,
                                     additional_graphs=("realtime",),
-                                    cycle_backend=cycle_backend)
-        return {"valid?": res["valid?"],
-                "op_count": len(hist_a) // 2,
-                "engine": res.get("cycle-engine"),
-                "util": res.get("cycle-util"),
-                "cause": ",".join(res["anomaly-types"]) or None}
+                                    cycle_backend="auto")
+        return _elle_entry(res, hist_a3)
 
+    _warm_elle(hist_a3, elle_build_mod.build_append,
+               additional_graphs=("realtime",))
     run("elle_append_3k", None, None, checker=elle_append, need=45)
+
+    hist_w3 = synth.wr_register_history(3000, n_procs=5, seed=7)
 
     def elle_wr():
         from jepsen_tpu.elle import wr as elle_wr_mod
-        hist_w = synth.wr_register_history(3000, n_procs=5, seed=7)
-        res = elle_wr_mod.check(hist_w, linearizable_keys=True,
+        res = elle_wr_mod.check(hist_w3, linearizable_keys=True,
                                 additional_graphs=("realtime",),
-                                cycle_backend=cycle_backend)
-        return {"valid?": res["valid?"],
-                "op_count": len(hist_w) // 2,
-                "engine": res.get("cycle-engine"),
-                "util": res.get("cycle-util"),
-                "cause": ",".join(res["anomaly-types"]) or None}
+                                cycle_backend="auto")
+        return _elle_entry(res, hist_w3)
 
+    _warm_elle(hist_w3, elle_build_mod.build_wr,
+               linearizable_keys=True,
+               additional_graphs=("realtime",))
     run("elle_wr_3k", None, None, checker=elle_wr, need=45)
 
-    # The closure kernel AT CAPACITY (elle/tpu.py sizes itself for
-    # 4-8k txns): on an accelerator the backend is FORCED to the
-    # closure kernel so the bench records the MXU plane's wall +
-    # achieved TFLOP/s at a production shape next to the host-BFS row
-    # (VERDICT r3 #7). On cpu the forced row is a KNOWN-slow ~57 s of
-    # dense f32 matmuls (~0.1 TFLOP/s, measured and banked in
-    # BENCH_r04) — re-measuring it every cpu round bought nothing
-    # (round-4 VERDICT weak #5), so cpu runs keep the host row only
-    # and record the skip with the documented number.
+    # The capacity config (elle/tpu.py sizes the dense closures for
+    # 4-8k txns; packed lifts the cap to 32k): the auto route MUST
+    # pick the device engine here on every platform — the r05 rows
+    # that sat on `engine: host` at the kernel's own capacity are the
+    # bug this config now guards against. The host row runs alongside
+    # for verdict parity + the speedup ratio.
+    hist_a8 = synth.list_append_history(4000, n_procs=5, seed=7)
+
     def elle_append_8k():
         from jepsen_tpu.elle import append as elle_append_mod
-        hist_a = synth.list_append_history(4000, n_procs=5, seed=7)
-        on_accel = _jax.default_backend() != "cpu"
-        out = {"op_count": len(hist_a) // 2}
-        if on_accel:
-            t0 = time.monotonic()
-            res = elle_append_mod.check(hist_a,
-                                        additional_graphs=("realtime",),
-                                        cycle_backend="tpu")
-            closure_wall = time.monotonic() - t0
-            out["closure_row"] = {
-                "verdict": res["valid?"],
-                "wall_s": round(closure_wall, 2),
-                "util": res.get("cycle-util")}
-        else:
-            out["closure_row"] = {
-                "verdict": "skipped",
-                "cause": "cpu platform: documented known-slow row "
-                         "(BENCH_r04: 56.9 s at ~0.1 TFLOP/s f32)",
-                "documented_cpu_wall_s": 56.9}
         t0 = time.monotonic()
-        res_h = elle_append_mod.check(hist_a,
+        res = elle_append_mod.check(hist_a8,
+                                    additional_graphs=("realtime",),
+                                    cycle_backend="auto")
+        dev_wall = time.monotonic() - t0
+        out = _elle_entry(res, hist_a8)
+        out["closure_row"] = {"verdict": res["valid?"],
+                              "wall_s": round(dev_wall, 2),
+                              "engine": res.get("cycle-engine"),
+                              "util": res.get("cycle-util")}
+        t0 = time.monotonic()
+        res_h = elle_append_mod.check(hist_a8,
                                       additional_graphs=("realtime",),
                                       cycle_backend="host")
         host_wall = time.monotonic() - t0
-        ref = res if on_accel else res_h
-        out.update({
-            "valid?": ref["valid?"],
-            "engine": ("closure" if on_accel
-                       and ref.get("cycle-engine") == "tpu"
-                       else ref.get("cycle-engine")),
-            "util": ref.get("cycle-util"),
-            "cause": ",".join(ref["anomaly-types"]) or None,
-            "host_row": {"verdict": res_h["valid?"],
-                         "wall_s": round(host_wall, 2)}})
-        if on_accel and res["valid?"] != res_h["valid?"]:
-            out["cause"] = (f"ENGINE DISAGREEMENT: closure="
+        out["host_row"] = {"verdict": res_h["valid?"],
+                           "wall_s": round(host_wall, 2)}
+        out["speedup_vs_host"] = round(host_wall / max(dev_wall, 1e-9),
+                                       1)
+        if res["valid?"] != res_h["valid?"]:
+            out["cause"] = (f"ENGINE DISAGREEMENT: device="
                             f"{res['valid?']} host={res_h['valid?']}")
         return out
 
+    _warm_elle(hist_a8, elle_build_mod.build_append,
+               additional_graphs=("realtime",))
     run("elle_append_8k", None, None, checker=elle_append_8k, need=60)
 
     # independent 100 keys x 2k ops, batch-checked over the device mesh

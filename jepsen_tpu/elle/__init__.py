@@ -18,7 +18,14 @@ re-implemented from its published semantics rather than ported:
                                 incompatible-order anomalies;
   * `jepsen_tpu.elle.wr`      — write/read registers with unique writes:
                                 version orders inferred under the
-                                sequential/linearizable/wfr assumptions.
+                                sequential/linearizable/wfr assumptions;
+  * `jepsen_tpu.elle.build`   — tensorized graph construction: flat
+                                micro-op columns in, (E, 3) edge columns
+                                + interval-jump metadata out, no
+                                DepGraph on the hot path;
+  * `jepsen_tpu.elle.tpu`     — the device cycle-query battery (bf16 /
+                                bitset-packed squaring, peel-to-core
+                                trim) behind shape-aware auto-routing.
 
 Anomaly taxonomy (naming follows Adya, as the reference documents in
 tests/cycle/wr.clj:30-46):

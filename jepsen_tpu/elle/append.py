@@ -64,8 +64,11 @@ def check(history: History, anomalies: Iterable[str] = DEFAULT_ANOMALIES,
     {"valid?": bool, "anomaly-types": [...], "anomalies": {...},
     "not": [violated models]}.
 
-    cycle_backend: "host" (Tarjan oracle), "tpu" (batched
-    closure-matmul kernel, elle/tpu.py), or "auto"."""
+    cycle_backend: "host" (Tarjan oracle), "tpu" / "packed" / "prop"
+    / "device" (the elle/tpu.py kernel family), or "auto"
+    (shape-routed via ops/route.elle_cycle_route)."""
+    import time as _time
+
     from ..analysis import history_lint
     bad = history_lint.gate(history, where="elle.append",
                             rules=history_lint.ELLE_GATE_RULES)
@@ -76,19 +79,39 @@ def check(history: History, anomalies: Iterable[str] = DEFAULT_ANOMALIES,
                 "anomaly-types": ["malformed-history"],
                 "anomalies": {"malformed-history": bad["anomalies"]},
                 "not": [], "analyzer": bad["analyzer"]}
+    t_start = _time.monotonic()
     anomalies = set(anomalies)
     found: dict[str, list] = {}
+    for name in additional_graphs:
+        if name not in ("realtime", "process"):
+            raise ValueError(f"unknown additional graph {name!r}")
 
     completed = [op for op in history
                  if op.type in ("ok", "info") and op.f in ("txn", None)
                  and op.value]
     oks = [op for op in completed if op.is_ok]
+    infos = [op for op in completed if op.is_info]
     failed = [op for op in history if op.is_fail and op.value]
 
-    # -- 1. version orders ------------------------------------------------
-    writer, dup_anoms = _writer_index(oks, [op for op in completed
-                                            if op.is_info])
-    orders, order_anoms = _version_orders(oks)
+    # -- 1. tensorized construction (elle/build.py): writer index,
+    #    version orders, and the ww/wr/rw(+rt/proc) edge columns come
+    #    out of one vectorized pass; dirty histories fall back to the
+    #    exact host loops inside the builder ---------------------------
+    from . import build as build_mod
+    try:
+        bt = build_mod.build_append(history, oks, infos,
+                                    additional_graphs=additional_graphs)
+        writer, orders = bt.writer, bt.orders
+        dup_anoms, order_anoms = bt.dup_anomalies, bt.order_anomalies
+        gt = bt.tensors
+        gt._explain = lambda: _legacy_graph(history, orders, writer,
+                                            oks, additional_graphs)
+        _record_build("append", bt)
+    except build_mod.BuildUnsupported:
+        writer, dup_anoms = _writer_index(oks, infos)
+        orders, order_anoms = _version_orders(oks)
+        gt = _legacy_graph(history, orders, writer, oks,
+                           additional_graphs)
     if dup_anoms:
         found["duplicate-elements"] = dup_anoms
     if order_anoms:
@@ -108,19 +131,12 @@ def check(history: History, anomalies: Iterable[str] = DEFAULT_ANOMALIES,
     if dirty:
         found["dirty-update"] = dirty
 
-    # -- 3. dependency graph ---------------------------------------------
-    g = graph(history, orders=orders, writer=writer, oks=oks)
-    for name in additional_graphs:
-        if name == "realtime":
-            g.merge(realtime_graph(history))
-        elif name == "process":
-            g.merge(process_graph(history))
-        else:
-            raise ValueError(f"unknown additional graph {name!r}")
-
-    # -- 4. cycles --------------------------------------------------------
+    # -- 3+4. cycles over the edge columns -------------------------------
     from .tpu import standard_cycle_search
-    cycles = standard_cycle_search(g, backend=cycle_backend)
+    cycles = standard_cycle_search(gt, backend=cycle_backend)
+    g = None  # the labeled DepGraph materializes only to EXPLAIN
+    if any(cycles[q] for q in ("G0", "G1c", "G-single", "G2")):
+        g = gt.to_depgraph() if hasattr(gt, "to_depgraph") else gt
     if cycles["G0"]:
         found["G0"] = [_cycle_case(g, cycles["G0"], history)]
     if cycles["G1c"] and "G0" not in found:
@@ -144,9 +160,58 @@ def check(history: History, anomalies: Iterable[str] = DEFAULT_ANOMALIES,
                           if a in MODEL_VIOLATIONS})}
     if cycles.get("util"):
         out["cycle-util"] = cycles["util"]
+    if cycles.get("route_reason"):
+        out["cycle-route-reason"] = cycles["route_reason"]
     if silent:
         out["unchecked-anomaly-types"] = sorted(silent)
+    _record_elle("elle.append", out, len(oks),
+                 _time.monotonic() - t_start)
     return out
+
+
+def _legacy_graph(history, orders, writer, oks, additional_graphs):
+    """The host-builder graph: the oracle/explanation side of the
+    tensorized pass, and the whole pipeline when tensorization is
+    unsupported."""
+    g = graph(history, orders=orders, writer=writer, oks=oks)
+    for name in additional_graphs:
+        if name == "realtime":
+            g.merge(realtime_graph(history))
+        elif name == "process":
+            g.merge(process_graph(history))
+    return g
+
+
+def _record_build(checker: str, bt) -> None:
+    """elle_build series: one point per tensorized construction."""
+    from .. import metrics as _metrics
+    mx = _metrics.get_default()
+    if not mx.enabled:
+        return
+    mx.series("elle_build",
+              "tensorized elle graph construction").append(
+        {"checker": checker, "txns": int(len(bt.tensors.nodes)),
+         "mops": int(bt.micro_ops), "edges": len(bt.tensors),
+         "edge_counts": bt.tensors.counts(),
+         "build_s": round(bt.tensors.build_s, 4),
+         "builder": bt.builder})
+
+
+def _record_elle(name: str, out: dict, op_count: int,
+                 wall_s: float) -> None:
+    """Run-ledger record (kind="elle") — device-seconds ride
+    util.kernel_s via ledger.device_seconds, so /runs aggregates and
+    regressions() cover the elle family next to WGL."""
+    from .. import ledger as _ledger
+    from ..util import safe_backend
+    res = {"valid?": out.get("valid?"),
+           "cause": ",".join(out.get("anomaly-types") or []) or None,
+           "op_count": op_count,
+           "engine": out.get("cycle-engine"),
+           "util": out.get("cycle-util")}
+    _ledger.record_result("elle", name, res, wall_s=wall_s,
+                          engine=out.get("cycle-engine"),
+                          platform=safe_backend())
 
 
 def graph(history: History, orders: Optional[dict] = None,

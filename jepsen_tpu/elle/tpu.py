@@ -1,5 +1,30 @@
 """The TPU Elle plane: cycle detection as dense boolean linear algebra.
 
+Three device kernels now share the query battery (routing below picks
+per shape, ops/route.elle_cycle_route):
+
+  bf16    dense (S, N, N) closure by repeated squaring on the MXU —
+          the original kernel, validated on the 8-device mesh
+          (MULTICHIP_r05), capacity 8k txns.
+  packed  the same closure over uint32 bitset words: (S, N, N/32)
+          storage (16x less than bf16), AND/OR-reduce squaring over
+          32-column blocks, popcount occupancy counters. Bit-identical
+          outputs to bf16 (tests/test_elle_tpu.py pins it), lifts the
+          dense capacity cap to 32k txns; per shape bucket the
+          bf16-vs-packed choice is made from Lowered.cost_analysis
+          bytes (the ops/adapt.py packed-table pattern).
+  trim    peel-to-core cycle detection: per subset, iteratively trim
+          every node with no live predecessor or successor, where
+          pred/succ come from the sparse ww/wr/rw edge columns plus
+          ANALYTIC realtime/process interval bounds (builder metadata
+          from elle/build.py) instead of materialized O(N^2) edges.
+          Nonempty fixpoint core <=> cycle. O((E + N) x S) per round,
+          no N^2 anywhere — the shape that wins when the graph is
+          sparse relative to N^3/32, which includes every elle bench
+          config on a plain CPU backend; valid histories decide
+          entirely on device (empty cores), anomalies hand a tiny
+          core to the host explainer.
+
 The reference's Elle (dependency-graph cycle search over txn histories,
 wrapped at jepsen/src/jepsen/tests/cycle/append.clj:11-22 and wr.clj:
 14-53) walks graphs with DFS on the JVM. SURVEY.md flags it as the
@@ -54,7 +79,8 @@ from typing import Optional, Sequence
 
 import numpy as np
 
-from .graph import PROCESS, REALTIME, RW, WR, WW, DepGraph
+from .graph import (PROCESS, REALTIME, RW, WR, WW, DepGraph,
+                    _bfs_path)
 
 # The standard Elle query battery (append.clj / wr.clj semantics).
 # Subsets are cumulative: S0 (G0) < S1 (G1c, and the G-single closure)
@@ -273,19 +299,9 @@ def cycle_queries(g: DepGraph,
             "converged_at": converged_at,
             "reach_density": round(
                 float(widest[-1]) / float(n_pad) ** 2, 6)}
-    from .. import metrics as _metrics
-    mx = _metrics.get_default()
-    if mx.enabled:
-        # the MXU plane's telemetry rides the same registry as the
-        # search kernels' (doc/OBSERVABILITY.md)
-        mx.series("elle_closure",
-                  "per-call Elle closure-kernel telemetry").append(
-            {"edges": int(len(src)), "n": n, **util})
-        mx.counter("elle_closure_calls_total",
-                   "batched closure kernel invocations").inc()
-        mx.histogram("elle_closure_seconds",
-                     "closure kernel wall (post-compile)").observe(
-            kernel_s)
+    # the MXU plane's telemetry rides the same registry as the
+    # search kernels' (doc/OBSERVABILITY.md)
+    _record_closure(util, len(src), n)
     labels = np.asarray(labels)[:, :n]
     closed = np.asarray(closed)[:, :len(rw_edges)]
     _guards.note_transfer("d2h",
@@ -306,23 +322,646 @@ def cycle_queries(g: DepGraph,
             "util": util}
 
 
+PACKED_MAX_N = 32768
+
+
+def _record_closure(util: dict, edges: int, n: int) -> None:
+    """elle_closure series + counters — every device kernel variant
+    feeds the same registry — plus an `elle` strip on the live
+    occupancy block, so /occupancy and /status.json cover the Elle
+    plane next to the WGL kernels (doc/OBSERVABILITY.md)."""
+    from .. import fleet as _fleet
+    from .. import metrics as _metrics
+    mx = _metrics.get_default()
+    if mx.enabled:
+        mx.series("elle_closure",
+                  "per-call Elle closure-kernel telemetry").append(
+            {"edges": int(edges), "n": int(n), **util})
+        mx.counter("elle_closure_calls_total",
+                   "batched closure kernel invocations").inc()
+        mx.histogram("elle_closure_seconds",
+                     "closure kernel wall (post-compile)").observe(
+            float(util.get("kernel_s") or 0.0))
+    st = _fleet.get_default()
+    if st.enabled:
+        st.occupancy_poll({"elle": {
+            "kernel": util.get("kernel", "bf16"), "n": int(n),
+            "edges": int(edges),
+            "iters_run": util.get("iters_run"),
+            "kernel_s": util.get("kernel_s"),
+            "reach_density": util.get("reach_density")}},
+            search_id="elle")
+
+
+# -- packed closure: uint32 bitset squaring ---------------------------------
+
+def make_packed_closure_kernel(n_pad: int, n_sub: int, iters: int):
+    """The closure-by-squaring kernel over uint32 bitset words:
+    (S, N, N/32) storage, 16x less than bf16, capacity lifted to
+    PACKED_MAX_N. The squaring R2[i] = OR_{j : R[i] bit j} R[j] scans
+    32-column blocks: extract the block's i->j bits from one word
+    column, AND/OR-reduce the block's 32 packed rows into the
+    accumulator. Outputs (labels, closed, counts, iters_run) are
+    BIT-IDENTICAL to make_closure_kernel's — same convergence
+    schedule, counts by popcount — which tests/test_elle_tpu.py and
+    the CI elle smoke gate pin."""
+    import jax
+    import jax.numpy as jnp
+
+    W = n_pad // 32
+    word_idx = np.arange(n_pad, dtype=np.int32) // 32
+    bit_idx = (np.arange(n_pad, dtype=np.int32) % 32).astype(np.uint32)
+
+    def kernel(r0, q_src, q_dst):
+        counts0 = jnp.zeros((iters, n_sub), jnp.int32)
+
+        def square(r):
+            def blk(acc, jb):
+                rows_j = jax.lax.dynamic_slice(
+                    r, (0, jb * 32, 0), (n_sub, 32, W))
+                word_i = jax.lax.dynamic_slice(
+                    r, (0, 0, jb), (n_sub, n_pad, 1))[..., 0]
+                # intentional bounded unroll: exactly the 32 bits
+                # of one packed word per block
+                for k in range(32):  # jaxlint: ok(J006)
+                    bit = (word_i >> jnp.uint32(k)) & jnp.uint32(1)
+                    acc = acc | (bit[:, :, None]
+                                 * rows_j[:, k][:, None, :])
+                return acc, None
+            out, _ = jax.lax.scan(blk, jnp.zeros_like(r),
+                                  jnp.arange(W))
+            return out
+
+        def cond(st):
+            _, _, i, changed = st
+            return (i < iters) & changed
+
+        def step(st):
+            r, cnt, i, _ = st
+            r2 = square(r)
+            c = jnp.sum(jax.lax.population_count(r2).astype(jnp.int32),
+                        axis=(1, 2))
+            prev = jnp.where(i > 0, cnt[jnp.maximum(i - 1, 0)],
+                             jnp.full((n_sub,), -1, jnp.int32))
+            cnt = cnt.at[i].set(c)
+            return r2, cnt, i + 1, jnp.any(c != prev)
+
+        reach, counts, iters_run, _ = jax.lax.while_loop(
+            cond, step, (r0, counts0, jnp.int32(0), jnp.asarray(True)))
+
+        # labels[i] = min{j : reach[i,j] & reach[j,i]}, scanned over
+        # 32-column blocks of the packed closure
+        cols32 = jnp.arange(32, dtype=jnp.int32)
+
+        def lab_blk(lab, jb):
+            bits_ij = (jax.lax.dynamic_slice(
+                reach, (0, 0, jb), (n_sub, n_pad, 1))[..., 0][:, :, None]
+                >> cols32[None, None, :].astype(jnp.uint32)) \
+                & jnp.uint32(1)                          # (S, N, 32)
+            rows_j = jax.lax.dynamic_slice(
+                reach, (0, jb * 32, 0), (n_sub, 32, W))  # (S, 32, W)
+            bits_ji = (jnp.take(rows_j, jnp.asarray(word_idx), axis=2)
+                       >> bit_idx[None, None, :]) & jnp.uint32(1)
+            mutual = (bits_ij & jnp.moveaxis(bits_ji, 1, 2)) > 0
+            jcol = jb * 32 + cols32
+            cand = jnp.min(jnp.where(mutual, jcol[None, None, :],
+                                     n_pad), axis=2)
+            return jnp.minimum(lab, cand), None
+
+        labels, _ = jax.lax.scan(
+            lab_blk, jnp.full((n_sub, n_pad), n_pad, jnp.int32),
+            jnp.arange(W))
+
+        words = reach[:, q_dst, q_src // 32]             # (S, Q)
+        closed = ((words >> (q_src % 32).astype(jnp.uint32))
+                  & jnp.uint32(1)) > 0
+        return labels, closed, counts, iters_run
+
+    return kernel
+
+
+@lru_cache(maxsize=32)
+def _compiled_packed(n_pad: int, q_pad: int, n_sub: int, iters: int):
+    import time as _t
+
+    import jax
+    import jax.numpy as jnp
+
+    kernel = make_packed_closure_kernel(n_pad, n_sub, iters)
+    specs = (jax.ShapeDtypeStruct((n_sub, n_pad, n_pad // 32),
+                                  jnp.uint32),
+             jax.ShapeDtypeStruct((q_pad,), jnp.int32),
+             jax.ShapeDtypeStruct((q_pad,), jnp.int32))
+    t0 = _t.monotonic()
+    compiled = jax.jit(kernel).lower(*specs).compile()
+    return compiled, _t.monotonic() - t0
+
+
+def _graph_arrays(g, subsets, rw_type):
+    """Shared edge-column prep for the squaring kernels: local ids,
+    per-subset weights, rw query endpoints."""
+    nodes = g.nodes
+    n = int(nodes.shape[0])
+    edges = np.asarray(g.edges)
+    id_of = {int(v): i for i, v in enumerate(nodes)}
+    src = np.array([id_of[int(s)] for s in edges[:, 0]], np.int32)
+    dst = np.array([id_of[int(d)] for d in edges[:, 1]], np.int32)
+    typ = edges[:, 2]
+    n_sub = len(subsets)
+    w = np.zeros((n_sub, len(src)), np.float32)
+    for si, sub in enumerate(subsets):
+        w[si] = np.isin(typ, list(sub)).astype(np.float32)
+    rw_mask = typ == rw_type
+    q_src, q_dst = src[rw_mask], dst[rw_mask]
+    rw_edges = [(int(edges[i, 0]), int(edges[i, 1]))
+                for i in np.flatnonzero(rw_mask)]
+    return nodes, n, src, dst, w, q_src, q_dst, rw_edges
+
+
+def _sccs_from_labels(labels, nodes, n, n_sub):
+    sccs: list = []
+    for si in range(n_sub):
+        comps: dict = {}
+        for i in range(n):
+            lab = int(labels[si, i])
+            if lab != i:
+                comps.setdefault(lab, [int(nodes[lab])]).append(
+                    int(nodes[i]))
+        sccs.append([sorted(c) for c in comps.values()])
+    return sccs
+
+
+def cycle_queries_packed(g, subsets: Sequence[frozenset] = SUBSETS,
+                         rw_type: int = RW,
+                         max_n: int = PACKED_MAX_N) -> Optional[dict]:
+    """cycle_queries on the uint32 bitset kernel: same result
+    envelope, 16x less closure memory, capacity to PACKED_MAX_N.
+    The packed adjacency (plus identity) is assembled host-side with
+    one bitwise_or scatter per subset — E word-ops, negligible."""
+    nodes, n, src, dst, w, q_src, q_dst, rw_edges = \
+        _graph_arrays(g, subsets, rw_type)
+    if n > max_n:
+        return None
+    n_sub = len(subsets)
+    n_pad = _round_up(max(_bucket(n), n + 2), 128)
+    Wn = n_pad // 32
+
+    r0 = np.zeros((n_sub, n_pad, Wn), np.uint32)
+    eye = np.arange(n_pad)
+    np.bitwise_or.at(r0, (slice(None), eye, eye // 32),
+                     np.uint32(1) << (eye % 32).astype(np.uint32))
+    for si in range(n_sub):
+        m = w[si] > 0
+        if m.any():
+            np.bitwise_or.at(
+                r0[si], (src[m], dst[m] // 32),
+                np.uint32(1) << (dst[m] % 32).astype(np.uint32))
+
+    q_pad = _bucket(max(len(q_src), 1))
+
+    def pad(a, size, fill):
+        out = np.full(size, fill, np.int32)
+        out[:len(a)] = a
+        return out
+
+    q_src_p = pad(q_src, q_pad, n_pad - 1)
+    q_dst_p = pad(q_dst, q_pad, n_pad - 2)
+    iters = max(1, math.ceil(math.log2(n_pad)))
+    kernel, compile_s = _compiled_packed(n_pad, q_pad, n_sub, iters)
+
+    import time as _t
+
+    import jax
+
+    from ..analysis import guards as _guards
+    from .. import watchdog as _watchdog
+    t0 = _t.monotonic()
+    _guards.note_transfer("h2d", r0.nbytes + q_src_p.nbytes
+                          + q_dst_p.nbytes,
+                          what="elle-closure-inputs")
+    wd = _watchdog.get_default()
+    with wd.watch("elle-closure", device="tpu", stall_s=300.0) as hb:
+        wd.beat(hb, edges=int(len(src)), n=n, n_pad=n_pad,
+                iters=iters, kernel="packed")
+        labels, closed, iter_counts, iters_run = kernel(
+            r0, q_src_p, q_dst_p)
+        jax.block_until_ready((labels, closed, iter_counts, iters_run))
+    kernel_s = _t.monotonic() - t0
+    iters_run = max(1, int(iters_run))
+    iter_counts = np.asarray(iter_counts)[:iters_run]
+    iter_reach = [[int(v) for v in row] for row in iter_counts]
+    widest = iter_counts[:, -1]
+    converged_at = int(iters_run)
+    for i in range(1, iters_run):
+        if widest[i] == widest[i - 1]:
+            converged_at = i
+            break
+    # word-ops model: one squaring ANDs/ORs n_pad^2 * W words/subset
+    gops = 2.0 * n_sub * iters_run * float(n_pad) ** 2 * Wn / 1e9
+    util = {"kernel": "packed", "n_pad": n_pad, "iters": iters,
+            "iters_run": iters_run,
+            "iters_reclaimed": int(iters) - iters_run,
+            "kernel_s": round(kernel_s, 4),
+            "compile_s": round(compile_s, 3),
+            "achieved_gops": round(gops / max(kernel_s, 1e-9), 2),
+            "closure_bytes": int(r0.nbytes),
+            "iter_reach": iter_reach,
+            "converged_at": converged_at,
+            "reach_density": round(
+                float(widest[-1]) / float(n_pad) ** 2, 6)}
+    _record_closure(util, len(src), n)
+    labels = np.asarray(labels)[:, :n]
+    closed = np.asarray(closed)[:, :len(rw_edges)]
+    _guards.note_transfer("d2h", labels.nbytes + closed.nbytes
+                          + iter_counts.nbytes,
+                          what="elle-closure-outputs")
+    return {"sccs": _sccs_from_labels(labels, nodes, n, len(subsets)),
+            "rw_edges": rw_edges, "rw_closed": closed, "util": util}
+
+
+# -- trim closure: peel-to-core cycle detection + interval jumps ------------
+
+def make_trim_kernel(n_pad: int, d_in: int, d_out: int, n_sub: int,
+                     p_pad: int, use_rt: bool, use_proc: bool,
+                     counts_rows: int = 64):
+    """Cycle EXISTENCE for the query battery by trimming: per subset,
+    iteratively peel every node with no live predecessor or no live
+    successor; the fixpoint ("core") is nonempty iff the subset has a
+    cycle (every core node keeps an out-neighbor in the core, so a
+    walk must revisit). Predecessors/successors come from
+
+      * the sparse ww/wr/rw edges, as PADDED NEIGHBOR GATHERS
+        (in/out adjacency lists padded to the degree bucket) — pure
+        gather+reduce, because XLA's cpu scatter lowering makes a
+        segment-max formulation ~25x slower per round (measured);
+      * analytic realtime interval bounds in builder mode: a node has
+        a realtime predecessor iff some live node's comp_evt lies
+        below its inv_evt — per-subset min/argmin plus masked
+        second-min scalars (second-min so a zero-duration op whose
+        completion event precedes its own invocation cannot keep
+        itself alive);
+      * process chains via per-process segment-min/max positions
+        (strict compares, so self never qualifies).
+
+    Work per round is O((E + N) x S) elementwise — no O(N^2)
+    anywhere — and rounds are bounded by the peel depth (~N /
+    concurrency width for real histories; the safety bound is n_pad).
+    Valid histories end with EMPTY cores: the device verdict alone
+    answers all four queries and the host never builds a DepGraph; a
+    nonempty core hands the (tiny) cyclic neighborhood to the host
+    oracle for the concrete cycle ("device decides, host explains")."""
+    import jax
+    import jax.numpy as jnp
+
+    def kernel(in_neigh, in_mask, out_neigh, out_mask,
+               inv_e, comp_e, proc, ppos, live0):
+        counts0 = jnp.zeros((counts_rows, n_sub), jnp.int32)
+        BIGI = jnp.int32(2 ** 30)
+        rows = jnp.arange(n_pad, dtype=jnp.int32)[:, None]
+
+        def peel(live):
+            has_in = jnp.any(live[in_neigh, :] & in_mask, axis=1)
+            has_out = jnp.any(live[out_neigh, :] & out_mask, axis=1)
+            if use_rt:
+                comp_live = jnp.where(live, comp_e[:, None], BIGI)
+                minc1 = jnp.min(comp_live, axis=0)
+                minc_at = jnp.argmin(comp_live, axis=0)
+                minc2 = jnp.min(
+                    jnp.where(rows == minc_at[None, :], BIGI,
+                              comp_live), axis=0)
+                inv_live = jnp.where(live, inv_e[:, None], -BIGI)
+                maxi1 = jnp.max(inv_live, axis=0)
+                maxi_at = jnp.argmax(inv_live, axis=0)
+                maxi2 = jnp.max(
+                    jnp.where(rows == maxi_at[None, :], -BIGI,
+                              inv_live), axis=0)
+                in_thr = jnp.where(rows == minc_at[None, :],
+                                   minc2[None, :], minc1[None, :])
+                out_thr = jnp.where(rows == maxi_at[None, :],
+                                    maxi2[None, :], maxi1[None, :])
+                has_in = has_in | (inv_e[:, None] > in_thr)
+                has_out = has_out | (comp_e[:, None] < out_thr)
+            if use_proc:
+                pp_in = jnp.where(live, ppos[:, None], BIGI)
+                minpp = jax.ops.segment_min(pp_in, proc,
+                                            num_segments=p_pad)
+                pp_out = jnp.where(live, ppos[:, None], -BIGI)
+                maxpp = jax.ops.segment_max(pp_out, proc,
+                                            num_segments=p_pad)
+                has_in = has_in | (ppos[:, None] > minpp[proc, :])
+                has_out = has_out | ((ppos[:, None] < maxpp[proc, :])
+                                     & (ppos[:, None] >= 0))
+            return live & has_in & has_out
+
+        def cond(st):
+            _l, _c, i, changed = st
+            return changed & (i < n_pad)
+
+        def body(st):
+            live, cnt, i, _ = st
+            live = peel(peel(live))
+            c = jnp.sum(live, axis=0, dtype=jnp.int32)
+            prev = jnp.where(
+                i > 0,
+                cnt[jnp.minimum(jnp.maximum(i - 1, 0),
+                                counts_rows - 1)],
+                jnp.full((n_sub,), -1, jnp.int32))
+            cnt = cnt.at[jnp.minimum(i, counts_rows - 1)].set(c)
+            return live, cnt, i + 1, jnp.any(c != prev)
+
+        live, counts, iters_run, _ = jax.lax.while_loop(
+            cond, body, (live0, counts0, jnp.int32(0),
+                         jnp.asarray(True)))
+        # iters_run counts while-loop BODIES (= counts rows); each
+        # body runs two peel rounds — the wrapper reports both
+        return live, counts, iters_run
+
+    return kernel
+
+
+# degree buckets past this fall back to the dense kernels: a padded
+# neighbor gather at that width would cost more than it saves
+TRIM_MAX_DEGREE = 256
+
+
+@lru_cache(maxsize=32)
+def _compiled_trim(n_pad: int, d_in: int, d_out: int, n_sub: int,
+                   p_pad: int, use_rt: bool, use_proc: bool):
+    import time as _t
+
+    import jax
+    import jax.numpy as jnp
+
+    kernel = make_trim_kernel(n_pad, d_in, d_out, n_sub, p_pad,
+                              use_rt, use_proc)
+    i32 = jnp.int32
+    specs = (jax.ShapeDtypeStruct((n_pad, d_in), i32),
+             jax.ShapeDtypeStruct((n_pad, d_in, n_sub), jnp.bool_),
+             jax.ShapeDtypeStruct((n_pad, d_out), i32),
+             jax.ShapeDtypeStruct((n_pad, d_out, n_sub), jnp.bool_),
+             jax.ShapeDtypeStruct((n_pad,), i32),
+             jax.ShapeDtypeStruct((n_pad,), i32),
+             jax.ShapeDtypeStruct((n_pad,), i32),
+             jax.ShapeDtypeStruct((n_pad,), i32),
+             jax.ShapeDtypeStruct((n_pad, n_sub), jnp.bool_))
+    t0 = _t.monotonic()
+    compiled = jax.jit(kernel).lower(*specs).compile()
+    return compiled, _t.monotonic() - t0
+
+
+def _neighbor_pads(n_pad, e_from, e_to, w):
+    """(neigh, mask) padded adjacency-list arrays: slot d of row j =
+    d-th edge endpoint, mask carries the per-subset membership."""
+    n_sub = w.shape[1]
+    counts = np.bincount(e_to, minlength=n_pad)
+    deg = int(counts.max()) if len(e_to) else 0
+    d_pad = _bucket(max(deg, 4))
+    if d_pad > TRIM_MAX_DEGREE:
+        return None, None, d_pad
+    order = np.argsort(e_to, kind="stable")
+    to_s, from_s, w_s = e_to[order], e_from[order], w[order]
+    starts = np.zeros(n_pad + 1, np.int64)
+    starts[1:] = np.cumsum(counts)
+    slot = np.arange(len(to_s)) - starts[to_s]
+    neigh = np.zeros((n_pad, d_pad), np.int32)
+    mask = np.zeros((n_pad, d_pad, n_sub), bool)
+    neigh[to_s, slot] = from_s
+    mask[to_s, slot, :] = w_s
+    return neigh, mask, d_pad
+
+
+def trim_shapes(n: int, d_in: int, d_out: int, p: int, use_rt: bool,
+                use_proc: bool) -> tuple:
+    """The compile bucket a trim run of these sizes lands in — shared
+    by the runtime path and aot.precompile_elle_closure."""
+    return (_round_up(_bucket(max(n, 2)), 128),
+            _bucket(max(d_in, 4)), _bucket(max(d_out, 4)),
+            max(8, _bucket(p + 1)), bool(use_rt), bool(use_proc))
+
+
+def _cycle_from_core(dep: DepGraph, sub: frozenset) -> Optional[list]:
+    """Host explanation once the device core is nonempty: the full
+    oracle over explicit edges (the core guarantees a cycle exists, so
+    this never runs on the valid-history hot path)."""
+    return dep.find_cycle(types=set(sub))
+
+
+def shape_bucket_for(g) -> dict:
+    """The exact compile buckets a cycle search over `g` lands in, for
+    every kernel the router might pick — the aot.precompile_elle_closure
+    input. Mirrors the bucket derivation in trim_cycle_search /
+    cycle_queries / cycle_queries_packed, so a warm call through the
+    same lru caches leaves the real search at ZERO recompiles."""
+    nodes = np.asarray(g.nodes)
+    n = int(nodes.shape[0])
+    edges = np.asarray(g.edges)
+    typ = edges[:, 2] if len(edges) else np.zeros(0, np.int32)
+    analytic = bool(getattr(g, "analytic", False))
+    sm = np.isin(typ, [WW, WR, RW]) if analytic \
+        else np.ones(len(typ), bool)
+    n_pad = _round_up(max(_bucket(max(n, 2)), n + 2), 128)
+    n_pad_trim = _round_up(_bucket(max(n, 2)), 128)
+    e_to = edges[sm, 1] if len(edges) else np.zeros(0, np.int64)
+    e_from = edges[sm, 0] if len(edges) else np.zeros(0, np.int64)
+    d_in = int(np.bincount(
+        np.searchsorted(nodes, e_to)).max()) if len(e_to) else 0
+    d_out = int(np.bincount(
+        np.searchsorted(nodes, e_from)).max()) if len(e_from) else 0
+    use_rt = use_proc = False
+    n_procs = 0
+    if analytic:
+        use_rt = bool((np.asarray(g.comp_evt) < 2 ** 60).any())
+        proc = np.asarray(g.proc)
+        use_proc = bool((proc >= 0).any())
+        n_procs = int(proc.max()) + 1 if use_proc else 0
+    n_rw = int(np.sum(typ == RW)) if len(typ) else 0
+    trim = trim_shapes(n, _bucket(max(d_in, 4)),
+                       _bucket(max(d_out, 4)), n_procs, use_rt,
+                       use_proc)
+    return {"n": n,
+            "trim": trim,
+            "dense": {"n_pad": n_pad,
+                      "e_pad": _bucket(max(len(edges), 1)),
+                      "q_pad": _bucket(max(n_rw, 1)),
+                      "iters": max(1, math.ceil(math.log2(n_pad)))}}
+
+
+def trim_cycle_search(g, max_n: int = PACKED_MAX_N) -> Optional[dict]:
+    """The full query battery on the trim kernel. `g` is a
+    GraphTensors (builder mode: analytic interval jumps, only
+    ww/wr/rw columns scatter) or a DepGraph (generic mode: every edge
+    scatters). Returns the standard_cycle_search dict, or None over
+    capacity.
+
+    G0/G1c fire iff their subset core is nonempty. G-single/G2 anchor
+    on rw edges; a cycle's nodes all survive trimming, so only rw
+    edges with BOTH endpoints in the S2 core are candidates — zero
+    for valid histories — and each candidate is settled by one host
+    BFS over the allowed path types."""
+    nodes = np.asarray(g.nodes)
+    n = int(nodes.shape[0])
+    if n > max_n:
+        return None
+    edges = np.asarray(g.edges)
+    s0, s1, s2 = SUBSETS
+    analytic = bool(getattr(g, "analytic", False))
+    battery = {"G0": None, "G1c": None, "G-single": None, "G2": None}
+    if n == 0 or not len(edges):
+        return {**battery, "engine": "device",
+                "util": {"kernel": "trim", "skipped": "empty-graph",
+                         "kernel_s": 0.0}}
+
+    id_of = {int(v): i for i, v in enumerate(nodes)}
+    src = np.array([id_of[int(s)] for s in edges[:, 0]], np.int32)
+    dst = np.array([id_of[int(d)] for d in edges[:, 1]], np.int32)
+    typ = edges[:, 2]
+
+    scatter_types = {WW, WR, RW} if analytic else None
+    sm = np.isin(typ, list(scatter_types)) \
+        if scatter_types is not None else np.ones(len(typ), bool)
+    e_src, e_dst, e_typ = src[sm], dst[sm], typ[sm]
+    n_sub = len(SUBSETS)
+    w = np.zeros((len(e_src), n_sub), bool)
+    for si, sub in enumerate(SUBSETS):
+        w[:, si] = np.isin(e_typ, list(sub))
+
+    use_rt = use_proc = False
+    if analytic:
+        inv_e = np.asarray(g.inv_evt)
+        comp_e = np.asarray(g.comp_evt)
+        proc = np.asarray(g.proc)
+        ppos = np.asarray(g.proc_pos)
+        use_rt = bool((comp_e < 2 ** 60).any())
+        use_proc = bool((proc >= 0).any())
+        n_procs = int(proc.max()) + 1 if use_proc else 0
+    else:
+        inv_e = comp_e = proc = ppos = None
+        n_procs = 0
+
+    n_pad = _round_up(_bucket(max(n, 2)), 128)
+    in_neigh, in_mask, d_in_raw = _neighbor_pads(n_pad, e_src, e_dst, w)
+    out_neigh, out_mask, d_out_raw = _neighbor_pads(n_pad, e_dst,
+                                                    e_src, w)
+    if in_neigh is None or out_neigh is None:
+        return None  # degree past the gather bucket: dense kernels
+    shapes = trim_shapes(n, d_in_raw, d_out_raw, n_procs, use_rt,
+                         use_proc)
+    n_pad, d_in, d_out, p_pad, _, _ = shapes
+    BIGI = np.int32(2 ** 30)
+
+    def pad(a, size, fill, dtype=np.int32):
+        out = np.full(size, fill, dtype)
+        out[:len(a)] = a
+        return out
+
+    if use_rt or use_proc:
+        inv_p = pad(np.clip(inv_e, -BIGI, BIGI), n_pad, -BIGI)
+        comp_p = pad(np.clip(comp_e, -BIGI, BIGI), n_pad, BIGI)
+        proc_p = pad(np.where(proc < 0, p_pad - 1, proc), n_pad,
+                     p_pad - 1)
+        ppos_p = pad(ppos, n_pad, -1)
+    else:
+        inv_p = np.full(n_pad, -BIGI, np.int32)
+        comp_p = np.full(n_pad, BIGI, np.int32)
+        proc_p = np.full(n_pad, p_pad - 1, np.int32)
+        ppos_p = np.full(n_pad, -1, np.int32)
+    live0 = np.zeros((n_pad, n_sub), bool)
+    live0[:n] = True
+
+    kernel, compile_s = _compiled_trim(n_pad, d_in, d_out, n_sub,
+                                       p_pad, use_rt, use_proc)
+
+    import time as _t
+
+    import jax
+
+    from ..analysis import guards as _guards
+    from .. import watchdog as _watchdog
+    ins = (in_neigh, in_mask, out_neigh, out_mask,
+           inv_p.astype(np.int32), comp_p.astype(np.int32), proc_p,
+           ppos_p, live0)
+    t0 = _t.monotonic()
+    _guards.note_transfer("h2d",
+                          sum(np.asarray(a).nbytes for a in ins),
+                          what="elle-closure-inputs")
+    wd = _watchdog.get_default()
+    with wd.watch("elle-closure", device="tpu", stall_s=300.0) as hb:
+        wd.beat(hb, edges=int(len(e_src)), n=n, n_pad=n_pad,
+                kernel="trim")
+        live, counts, iters_run = kernel(*ins)
+        jax.block_until_ready((live, counts, iters_run))
+    kernel_s = _t.monotonic() - t0
+    bodies = max(1, int(iters_run))
+    iters_run = 2 * bodies  # two peel rounds per loop body
+    counts = np.asarray(counts)[:min(bodies, 64)]
+    live = np.asarray(live)[:n]
+    _guards.note_transfer("d2h", live.nbytes + counts.nbytes,
+                          what="elle-closure-outputs")
+    core_sizes = [int(live[:, si].sum()) for si in range(n_sub)]
+    util = {"kernel": "trim", "n_pad": n_pad,
+            "d_in": d_in, "d_out": d_out,
+            "edges": int(len(e_src)),
+            "iters_run": iters_run,
+            "kernel_s": round(kernel_s, 4),
+            "compile_s": round(compile_s, 3),
+            "iter_reach": [[int(v) for v in row] for row in counts],
+            "converged_at": iters_run,
+            "core_sizes": core_sizes,
+            "reach_density": round(max(core_sizes) / max(n, 1), 6),
+            "jumps": {"rt": use_rt, "proc": use_proc}}
+    _record_closure(util, len(e_src), n)
+
+    out: dict = {**battery, "engine": "device", "util": util}
+    if not any(core_sizes):
+        return out  # valid: the device core IS the verdict
+    dep = g.to_depgraph() if hasattr(g, "to_depgraph") else g
+    if core_sizes[0]:
+        out["G0"] = _cycle_from_core(dep, s0)
+    if core_sizes[1]:
+        out["G1c"] = _cycle_from_core(dep, s1)
+    if core_sizes[2]:
+        # rw anchors with both endpoints in the S2 core
+        core2 = {int(nodes[i]) for i in np.flatnonzero(live[:, 2])}
+        adj1 = dep.adjacency(set(s1))
+        adj2 = dep.adjacency(set(s2))
+        for ei in np.flatnonzero(typ == RW):
+            u, v = int(edges[ei, 0]), int(edges[ei, 1])
+            if u not in core2 or v not in core2:
+                continue
+            if out["G-single"] is None:
+                path = _bfs_path(adj1, v, u)
+                if path is not None:
+                    out["G-single"] = [u] + path
+            if out["G2"] is None:
+                path = _bfs_path(adj2, v, u)
+                if path is not None:
+                    out["G2"] = [u] + path
+            if out["G-single"] is not None \
+                    and out["G2"] is not None:
+                break
+    return out
+
+
 # auto-routing's once-per-process device decision: a platform can be
 # *configured* as an accelerator yet hang at init (this environment's
 # site pin), so configuration alone must never route device-ward
 _AUTO_DECISION: dict = {}
 
 
-def _device_available() -> bool:
+def _device_available(require_accel: bool = True) -> bool:
     """Can the auto path safely use the device backend? Requires a
-    non-cpu platform AND a backend that PROVES it can initialize
-    within a short bounded wait (util.backend_ready's shared daemon
-    probe — a wedged init would otherwise hang this main-thread hot
-    path). Only the POSITIVE verdict is cached: the first call pays
-    the bounded wait, later calls re-check the probe's zero-cost fast
-    path — so an init that completes after the first timeout upgrades
-    auto-routing mid-process instead of pinning host forever.
-    bench/dryrun force backend="tpu" explicitly where the device
-    plane must run."""
+    backend that PROVES it can initialize within a short bounded wait
+    (util.backend_ready's shared daemon probe — a wedged init would
+    otherwise hang this main-thread hot path). Only the POSITIVE
+    verdict is cached: the first call pays the bounded wait, later
+    calls re-check the probe's zero-cost fast path — so an init that
+    completes after the first timeout upgrades auto-routing
+    mid-process instead of pinning host forever. bench/dryrun force a
+    device backend explicitly where the device plane must run.
+
+    With require_accel=False (the trim kernel runs fine on the
+    XLA cpu backend) a cpu platform qualifies too — only a missing
+    jax or a wedged init rules the device plane out."""
     if _AUTO_DECISION.get("ok"):
         return True
     import importlib.util
@@ -330,8 +969,9 @@ def _device_available() -> bool:
 
     from ..util import backend_ready, safe_backend
     plat = safe_backend()
-    if plat is None or plat == "cpu" \
-            or importlib.util.find_spec("jax") is None:
+    if importlib.util.find_spec("jax") is None:
+        return False
+    if require_accel and (plat is None or plat == "cpu"):
         return False
     if _AUTO_DECISION.get("waited"):
         timeout = 0.05  # probe already running: just peek at it
@@ -345,57 +985,226 @@ def _device_available() -> bool:
     return ok
 
 
-def standard_cycle_search(g: DepGraph, backend: str = "host",
+def _squaring_select(n: int) -> tuple:
+    """bf16 vs packed for one shape bucket, decided from the
+    compiler's Lowered.cost_analysis bytes (the ops/adapt.py
+    packed-table pattern: tracing+lowering only, no backend compile,
+    cached per bucket by occupancy.cost_for). Past the bf16 capacity
+    cap, packed is the only dense option; below it, packed wins when
+    the bf16 closure's live working set stops fitting the HBM-comfort
+    budget."""
+    import jax
+    import jax.numpy as jnp
+
+    from .. import occupancy as occupancy_mod
+    from ..util import safe_backend
+
+    if n > DEFAULT_MAX_N:
+        return "packed", {"why": f"n {n} > bf16 cap {DEFAULT_MAX_N}"}
+    n_pad = _round_up(max(_bucket(n), n + 2), 128)
+    iters = max(1, math.ceil(math.log2(n_pad)))
+
+    def lower_bf16():
+        dtype = jnp.bfloat16 if safe_backend() == "tpu" \
+            else jnp.float32
+        k = make_closure_kernel(n_pad, len(SUBSETS), iters, dtype)
+        specs = (jax.ShapeDtypeStruct((128,), jnp.int32),
+                 jax.ShapeDtypeStruct((128,), jnp.int32),
+                 jax.ShapeDtypeStruct((len(SUBSETS), 128),
+                                      jnp.float32),
+                 jax.ShapeDtypeStruct((128,), jnp.int32),
+                 jax.ShapeDtypeStruct((128,), jnp.int32))
+        # lowering only (no backend compile), and occupancy.cost_for
+        # caches the result per shape bucket
+        return jax.jit(k).lower(*specs)  # jaxlint: ok(J003)
+
+    def lower_packed():
+        k = make_packed_closure_kernel(n_pad, len(SUBSETS), iters)
+        specs = (jax.ShapeDtypeStruct(
+            (len(SUBSETS), n_pad, n_pad // 32), jnp.uint32),
+            jax.ShapeDtypeStruct((128,), jnp.int32),
+            jax.ShapeDtypeStruct((128,), jnp.int32))
+        return jax.jit(k).lower(*specs)  # jaxlint: ok(J003)
+
+    c_bf = occupancy_mod.cost_for(("elle-bf16", n_pad), lower_bf16)
+    c_pk = occupancy_mod.cost_for(("elle-packed", n_pad), lower_packed)
+    sel = {"bytes_bf16": (c_bf or {}).get("bytes_accessed"),
+           "bytes_packed": (c_pk or {}).get("bytes_accessed")}
+    if c_bf and c_pk and c_bf["bytes_accessed"] > 0:
+        # the MXU prefers bf16 until its working set stops fitting
+        # comfortably: S live (N, N) bf16 planes + f32 product vs HBM
+        from ..ops import aot as aot_mod
+        budget = 0.25 * getattr(aot_mod, "V5E_PEAK_HBM_BYTES", 1.6e10)
+        live = 3 * len(SUBSETS) * float(n_pad) ** 2 * 2
+        if live > budget:
+            sel["why"] = (f"bf16 live bytes {live:.2e} over "
+                          f"{budget:.2e} budget")
+            return "packed", sel
+        sel["why"] = "bf16 working set fits; MXU squaring wins"
+        return "bf16", sel
+    sel["why"] = "cost analysis unavailable; bf16 under cap"
+    return "bf16", sel
+
+
+def device_cycle_search(g, max_n: int = PACKED_MAX_N,
+                        kernel: Optional[str] = None) -> Optional[dict]:
+    """The query battery on the device kernel family. Kernel choice
+    per shape: `trim` wherever a dense squaring cannot pay for
+    itself — always on a cpu/XLA backend (measured here: ONE squaring
+    at n_pad 3072 costs ~0.5 s on one core; the whole trim fixpoint
+    runs in tens of ms) — while an accelerator keeps the dense
+    closures on the MXU/VPU with bf16-vs-packed decided by
+    Lowered.cost_analysis (`_squaring_select`). Returns None over
+    capacity."""
+    from ..util import safe_backend
+
+    n = int(np.asarray(g.nodes).shape[0])
+    accel = safe_backend() not in (None, "cpu")
+    if kernel is None:
+        if accel:
+            kernel, sel = _squaring_select(n)
+        else:
+            kernel = "trim"
+            sel = {"why": "cpu backend: dense squaring is "
+                          "compute-prohibitive; trim kernel"}
+    else:
+        sel = {"why": f"forced {kernel}"}
+
+    if kernel == "trim":
+        res = trim_cycle_search(g, max_n=max_n)
+        if res is not None:
+            res["util"]["select"] = sel
+            return res
+        if not accel:
+            # never fall through to a dense squaring on a cpu
+            # backend: at trim-refusing sizes (degree past the gather
+            # bucket, or n past capacity) the squaring costs minutes
+            # per subset there — the host oracle is the right engine
+            return None
+        kernel, sel = "packed", {"why": "over trim capacity"}
+
+    s0, s1, s2 = SUBSETS
+    # the dense kernels read only .nodes/.edges, which GraphTensors
+    # provides directly — the labeled DepGraph materializes lazily
+    # below, and only when something actually needs explaining
+    qres = (cycle_queries(g, max_n=min(max_n, DEFAULT_MAX_N))
+            if kernel == "bf16"
+            else cycle_queries_packed(g, max_n=max_n))
+    if qres is None:
+        return None
+    out = {"engine": "device", "util": dict(qres["util"])}
+    out["util"].setdefault("kernel", kernel)
+    out["util"]["select"] = sel
+    hits = (any(qres["sccs"][si] for si in range(len(SUBSETS)))
+            or bool(np.asarray(qres["rw_closed"]).any()))
+    dep = (g.to_depgraph() if hits and hasattr(g, "to_depgraph")
+           else g)
+    for name, si, sub in (("G0", 0, s0), ("G1c", 1, s1)):
+        cyc = None
+        if hits:
+            for comp in qres["sccs"][si]:
+                cyc = dep._cycle_in(set(comp), set(sub))
+                if cyc:
+                    break
+        out[name] = cyc
+    out["G-single"] = _first_closed(dep, qres, 1, set(s1)) \
+        if hits else None
+    out["G2"] = _first_closed(dep, qres, 2, set(s2)) if hits else None
+    return out
+
+
+def standard_cycle_search(g, backend: str = "host",
                           max_n: int = DEFAULT_MAX_N) -> dict:
-    """The four-query battery both elle checkers run, on either
-    backend. Returns {"G0": cycle|None, "G1c": ..., "G-single": ...,
-    "G2": ...} where each cycle is a node list [a, ..., a]. Device
-    verdicts are re-derived into concrete cycles host-side, restricted
-    to the flagged component/edge.
+    """The four-query battery both elle checkers run, on any engine.
+    `g` is a DepGraph or an elle/build.py GraphTensors. Returns
+    {"G0": cycle|None, "G1c": ..., "G-single": ..., "G2": ...} where
+    each cycle is a node list [a, ..., a]; device verdicts are
+    re-derived into concrete cycles host-side, restricted to the
+    flagged component/edge ("device decides, host explains").
 
-    backend: "host" (Tarjan + per-edge BFS oracle), "tpu" (batched
-    closure kernel), or "auto" (tpu when the graph is big enough that
-    the O(rw_edges * E) host queries hurt, else host).
+    backend:
+      "host"    Tarjan + per-edge BFS oracle (and the explainer).
+      "tpu"     the original bf16 dense closure, engine "tpu" —
+                kept verbatim as the MULTICHIP evidence path.
+      "packed"  the uint32 bitset closure (capacity PACKED_MAX_N).
+      "trim"    the peel-to-core trim kernel.
+      "device"  kernel picked per shape (device_cycle_search).
+      "auto"    ops/route.elle_cycle_route decides host vs device
+                from (n, e, rw) shape stats; the decision is
+                recorded as `route_reason`.
 
-    The "engine" key records which backend actually ran ("tpu",
-    "host", or "host-fallback" when a tpu request exceeded max_n)."""
+    The "engine" key records what actually ran ("tpu", "device",
+    "trim", "packed", "host", or "host-fallback" when a device
+    request exceeded capacity); device results carry util.kernel."""
     s0, s1, s2 = SUBSETS
     engine = backend
+    route_reason = None
     if backend == "auto":
-        # The dense closure only pays off on a real accelerator: 12
-        # squarings of (4096)^3 matmuls are milliseconds on the MXU but
-        # minutes on a CPU host, where Tarjan wins at any size.
-        backend = "tpu" if (_device_available()
-                            and len(g.nodes) >= 512
-                            and len(g) >= 512) else "host"
+        from ..ops.route import elle_cycle_route
+        from ..util import safe_backend
+        edges = np.asarray(g.edges)
+        rw = int(np.sum(edges[:, 2] == RW)) if len(edges) else 0
+        plat = safe_backend()
+        accel = plat not in (None, "cpu")
+        backend, route_reason = elle_cycle_route(
+            n=int(np.asarray(g.nodes).shape[0]), e=int(len(edges)),
+            rw_edges=rw, accel=accel,
+            device_ok=_device_available(require_accel=accel),
+            packed_cap=PACKED_MAX_N)
         engine = backend
-    if backend == "tpu":
-        res = cycle_queries(g, max_n=max_n)
+    if backend == "device":
+        res = device_cycle_search(g, max_n=max(max_n, PACKED_MAX_N))
         if res is None:
             backend = engine = "host-fallback"  # over capacity
         else:
-            out: dict = {"engine": "tpu", "util": res["util"]}
+            if route_reason:
+                res["route_reason"] = route_reason
+            return res
+    if backend in ("trim", "packed"):
+        res = device_cycle_search(g, max_n=max(max_n, PACKED_MAX_N),
+                                  kernel=backend)
+        if res is None:
+            backend = engine = "host-fallback"
+        else:
+            # a forced trim request can still fall through to packed
+            # (degree past the gather bucket on an accelerator) —
+            # only claim the forced engine when it actually ran
+            if res["util"].get("kernel", backend) == backend:
+                res["engine"] = backend
+            return res
+    if backend == "tpu":
+        dep = g.to_depgraph() if hasattr(g, "to_depgraph") else g
+        res = cycle_queries(dep, max_n=max_n)
+        if res is None:
+            backend = engine = "host-fallback"  # over capacity
+        else:
+            out = {"engine": "tpu", "util": res["util"]}
             for name, si, sub in (("G0", 0, s0), ("G1c", 1, s1)):
                 cyc = None
                 for comp in res["sccs"][si]:
-                    cyc = g._cycle_in(set(comp), set(sub))
+                    cyc = dep._cycle_in(set(comp), set(sub))
                     if cyc:
                         break
                 out[name] = cyc
             # G-single: rw edge closed by a NON-rw path (subset 1);
             # G2: closed by any path (subset 2)
-            out["G-single"] = _first_closed(g, res, 1, set(s1))
-            out["G2"] = _first_closed(g, res, 2, set(s2))
+            out["G-single"] = _first_closed(dep, res, 1, set(s1))
+            out["G2"] = _first_closed(dep, res, 2, set(s2))
             return out
     if backend not in ("host", "host-fallback"):
         raise ValueError(f"unknown backend {backend!r}")
-    return {
+    dep = g.to_depgraph() if hasattr(g, "to_depgraph") else g
+    out = {
         "engine": engine,
-        "G0": g.find_cycle(types=set(s0)),
-        "G1c": g.find_cycle(types=set(s1)),
-        "G-single": g.find_cycle_with(RW, set(s1), exactly_one=True),
-        "G2": g.find_cycle_with(RW, set(s1), exactly_one=False),
+        "G0": dep.find_cycle(types=set(s0)),
+        "G1c": dep.find_cycle(types=set(s1)),
+        "G-single": dep.find_cycle_with(RW, set(s1),
+                                        exactly_one=True),
+        "G2": dep.find_cycle_with(RW, set(s1), exactly_one=False),
     }
+    if route_reason:
+        out["route_reason"] = route_reason
+    return out
 
 
 def _first_closed(g: DepGraph, res: dict, subset_idx: int,

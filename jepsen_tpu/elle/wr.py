@@ -48,7 +48,10 @@ def check(history: History, anomalies: Iterable[str] = DEFAULT_ANOMALIES,
           wfr_keys: bool = False,
           cycle_backend: str = "auto") -> dict:
     """Analyze a write/read register history. cycle_backend as in
-    append.check: "host" | "tpu" | "auto"."""
+    append.check: "host" | "tpu" | "packed" | "prop" | "device" |
+    "auto"."""
+    import time as _time
+
     from ..analysis import history_lint
     bad = history_lint.gate(history, where="elle.wr",
                             rules=history_lint.ELLE_GATE_RULES)
@@ -57,8 +60,12 @@ def check(history: History, anomalies: Iterable[str] = DEFAULT_ANOMALIES,
                 "anomaly-types": ["malformed-history"],
                 "anomalies": {"malformed-history": bad["anomalies"]},
                 "not": [], "analyzer": bad["analyzer"]}
+    t_start = _time.monotonic()
     anomalies = set(anomalies)
     found: dict[str, list] = {}
+    for name in additional_graphs:
+        if name not in ("realtime", "process"):
+            raise ValueError(f"unknown additional graph {name!r}")
 
     oks = [op for op in history
            if op.is_ok and op.f in ("txn", None) and op.value]
@@ -66,7 +73,29 @@ def check(history: History, anomalies: Iterable[str] = DEFAULT_ANOMALIES,
              if op.is_info and op.f in ("txn", None) and op.value]
     failed = [op for op in history if op.is_fail and op.value]
 
-    writer = _writer_index(oks + infos)
+    # tensorized construction (elle/build.py): writer index, version
+    # evidence, and the edge columns in one vectorized pass
+    from . import build as build_mod
+    from .append import _record_build, _record_elle
+    try:
+        bt = build_mod.build_wr(history, oks, infos,
+                                sequential_keys=sequential_keys,
+                                linearizable_keys=linearizable_keys,
+                                wfr_keys=wfr_keys,
+                                additional_graphs=additional_graphs)
+        writer, orders, cyclic = bt.writer, bt.orders, \
+            bt.cyclic_anomalies
+        gt = bt.tensors
+        gt._explain = lambda: _legacy_graph(history, oks, writer,
+                                            orders, additional_graphs)
+        _record_build("wr", bt)
+    except build_mod.BuildUnsupported:
+        writer = _writer_index(oks + infos)
+        orders, cyclic = _version_orders(
+            history, oks, writer, sequential_keys=sequential_keys,
+            linearizable_keys=linearizable_keys, wfr_keys=wfr_keys)
+        gt = _legacy_graph(history, oks, writer, orders,
+                           additional_graphs)
 
     internal = _internal_cases(oks)
     if internal:
@@ -77,24 +106,14 @@ def check(history: History, anomalies: Iterable[str] = DEFAULT_ANOMALIES,
     g1b = _g1b_cases(oks)
     if g1b:
         found["G1b"] = g1b
-
-    orders, cyclic = _version_orders(
-        history, oks, writer, sequential_keys=sequential_keys,
-        linearizable_keys=linearizable_keys, wfr_keys=wfr_keys)
     if cyclic:
         found["cyclic-versions"] = cyclic
 
-    g = _txn_graph(oks, writer, orders)
-    for name in additional_graphs:
-        if name == "realtime":
-            g.merge(realtime_graph(history))
-        elif name == "process":
-            g.merge(process_graph(history))
-        else:
-            raise ValueError(f"unknown additional graph {name!r}")
-
     from .tpu import standard_cycle_search
-    cycles = standard_cycle_search(g, backend=cycle_backend)
+    cycles = standard_cycle_search(gt, backend=cycle_backend)
+    g = None  # the labeled DepGraph materializes only to EXPLAIN
+    if any(cycles[q] for q in ("G0", "G1c", "G-single", "G2")):
+        g = gt.to_depgraph() if hasattr(gt, "to_depgraph") else gt
     if cycles["G0"]:
         found["G0"] = [_cycle_case(g, cycles["G0"])]
     if cycles["G1c"] and "G0" not in found:
@@ -117,9 +136,24 @@ def check(history: History, anomalies: Iterable[str] = DEFAULT_ANOMALIES,
                           if a in MODEL_VIOLATIONS})}
     if cycles.get("util"):
         out["cycle-util"] = cycles["util"]
+    if cycles.get("route_reason"):
+        out["cycle-route-reason"] = cycles["route_reason"]
     if silent:
         out["unchecked-anomaly-types"] = sorted(silent)
+    _record_elle("elle.wr", out, len(oks), _time.monotonic() - t_start)
     return out
+
+
+def _legacy_graph(history, oks, writer, orders, additional_graphs):
+    """The host-builder graph: the oracle/explanation side of the
+    tensorized pass."""
+    g = _txn_graph(oks, writer, orders)
+    for name in additional_graphs:
+        if name == "realtime":
+            g.merge(realtime_graph(history))
+        elif name == "process":
+            g.merge(process_graph(history))
+    return g
 
 
 # -- internals ---------------------------------------------------------------

@@ -250,6 +250,54 @@ def precompile_wgl_ladder(*, n_pad: int, ic_pad: int, S: int, O: int,
         ladder=ladder or LADDER32, compile_now=True)
 
 
+def precompile_elle_closure(shape_bucket: dict,
+                            kernels: Optional[tuple] = None) -> dict:
+    """precompile_wgl_ladder's sibling for the Elle cycle engines:
+    backend-compile every closure kernel the router might pick for one
+    shape bucket, ahead of traffic — the checker-as-a-service warm
+    path (ROADMAP item 1) and bench's elle configs both use it. After
+    this returns, an elle check over the same shape stays at ZERO
+    recompiles no matter which kernel the shape router lands on (the
+    CompileGuard proof in tests/test_elle_build.py).
+
+    `shape_bucket` is elle/tpu.shape_bucket_for(tensors) — or any dict
+    with the same {"trim": ..., "dense": ...} layout. `kernels`
+    defaults to the platform's plausible picks: ("trim",) plus, on an
+    accelerator, the cost-analysis squaring choice. Returns
+    {kernel: compile_seconds}."""
+    from ..elle import tpu as elle_tpu
+    from ..util import safe_backend
+
+    if kernels is None:
+        kernels = ("trim",)
+        if safe_backend() not in (None, "cpu"):
+            pick, _sel = elle_tpu._squaring_select(
+                int(shape_bucket.get("n") or 0))
+            kernels = ("trim", pick)
+    out: dict = {}
+    for k in kernels:
+        if k == "trim":
+            n_pad, d_in, d_out, p_pad, use_rt, use_proc = \
+                shape_bucket["trim"]
+            _fn, compile_s = elle_tpu._compiled_trim(
+                n_pad, d_in, d_out, len(elle_tpu.SUBSETS), p_pad,
+                use_rt, use_proc)
+        elif k == "packed":
+            d = shape_bucket["dense"]
+            _fn, compile_s = elle_tpu._compiled_packed(
+                d["n_pad"], d["q_pad"], len(elle_tpu.SUBSETS),
+                d["iters"])
+        elif k == "bf16":
+            d = shape_bucket["dense"]
+            _fn, compile_s = elle_tpu._compiled(
+                d["n_pad"], d["e_pad"], d["q_pad"],
+                len(elle_tpu.SUBSETS), d["iters"])
+        else:
+            raise ValueError(f"unknown elle kernel {k!r}")
+        out[k] = round(compile_s, 3)
+    return out
+
+
 def wgln_case(n_pad: int = 4096, ic_pad: int = 8, S: int = 256,
               O: int = 16, K: int = 1024, H: int = 1 << 23,
               B: int = 1 << 20, chunk: int = 512, W: int = 96,

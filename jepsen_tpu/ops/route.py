@@ -133,3 +133,56 @@ def check_routed(model: Model, history: History,
     r["route_reason"] = "no engine decided within budget"
     r["shape"] = shape
     return r
+
+
+# -- Elle cycle-engine routing ----------------------------------------------
+
+def elle_cycle_route(*, n: int, e: int, rw_edges: int,
+                     accel: bool, device_ok: bool,
+                     packed_cap: int = 32768,
+                     cpu_cap: int = 16384,
+                     min_n: int = 384,
+                     min_host_work: int = 2_000_000) -> tuple:
+    """The elle extension of this module's shape-aware routing: decide
+    host vs device for the cycle-query battery from static graph
+    stats, and say why (`route_reason` on results, exactly like the
+    WGL router above).
+
+    The host engine's hot spot is the per-rw-edge BFS in
+    DepGraph.find_cycle_with — O(rw_edges x E) when the history is
+    valid (every BFS exhausts the reachable set; measured here: 5300
+    rw edges x 25k edges =~ 9 s of the elle_append_3k host wall). The
+    device battery answers every query from one closure, so routing
+    is a host-work model against a capacity check:
+
+      * no usable jax backend           -> host
+      * n > packed closure capacity     -> host (dense closure cannot
+                                           hold the graph; Tarjan can)
+      * small graph AND small BFS bill  -> host (kernel dispatch +
+                                           compile-cache lookup costs
+                                           more than it saves)
+      * otherwise                       -> device; elle/tpu.py picks
+                                           the kernel per shape
+                                           (bf16 / packed / prop).
+
+    Returns (backend, reason) with backend in {"host", "device"}."""
+    host_work = rw_edges * max(e, 1)
+    if not device_ok:
+        return ("host", "no usable jax backend (missing or init "
+                        "timed out); host Tarjan/BFS")
+    if n > packed_cap:
+        return ("host", f"n {n} over packed closure capacity "
+                        f"{packed_cap}; host Tarjan/BFS")
+    if not accel and n > cpu_cap:
+        # past this the trim kernel's peel rounds (bounded by n_pad)
+        # stop paying for themselves on a single XLA-cpu core, and
+        # the dense squarings were never an option there
+        return ("host", f"n {n} over cpu device cap {cpu_cap}; "
+                        "host Tarjan/BFS")
+    if n < min_n and host_work < min_host_work:
+        return ("host", f"small graph (n {n}, rw*E {host_work}): "
+                        "host BFS beats a kernel dispatch")
+    plat = "accelerator" if accel else "cpu-XLA"
+    return ("device", f"n {n}, E {e}, rw {rw_edges} "
+                      f"(host BFS model ~{host_work} node-visits) "
+                      f"-> device closure battery on {plat}")

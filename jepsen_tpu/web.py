@@ -353,6 +353,16 @@ def render_occupancy(store_root: str) -> bytes:
             f"fill min {_esc(lanes.get('fill_min'))} / max "
             f"{_esc(lanes.get('fill_max'))} &middot; "
             f"<b>{_esc(lanes.get('empty'))}</b> empty</p>")
+    elle = occ.get("elle") or {}
+    if elle:
+        parts.append(
+            f"<h2>elle closure</h2><p>kernel "
+            f"<b>{_esc(elle.get('kernel'))}</b> &middot; n "
+            f"{_esc(elle.get('n'))} / {_esc(elle.get('edges'))} edges"
+            f" &middot; {_esc(elle.get('iters_run'))} iters in "
+            f"{_esc(elle.get('kernel_s'))}s &middot; reach density "
+            f"{_esc(elle.get('reach_density'))} "
+            f"(doc/OBSERVABILITY.md \"Elle device plane\")</p>")
     recent = occ.get("recent") or []
     if recent:
         bars = "".join(

@@ -16,6 +16,9 @@ python scripts/telemetry_lint.py
 echo "== adaptive ladder smoke =="
 JAX_PLATFORMS=cpu python scripts/adaptive_smoke.py
 
+echo "== elle device-plane smoke =="
+JAX_PLATFORMS=cpu python scripts/elle_smoke.py
+
 echo "== tier-1 pytest =="
 JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider

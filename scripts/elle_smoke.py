@@ -1,0 +1,141 @@
+#!/usr/bin/env python
+"""Fast CI gate for the Elle device plane (elle/build.py +
+elle/tpu.py, ISSUE 10).
+
+Drives a small corpus through the full pipeline on the cpu backend
+and fails loudly when a routing or kernel regression lands:
+
+  * device-route parity: valid AND anomalous append/wr histories must
+    produce identical verdicts + anomaly sets on cycle_backend="auto"
+    (which must land on the device engine at routed sizes) and
+    cycle_backend="host";
+  * the tensorized builder's edge columns must equal the host
+    builders' edge set exactly;
+  * packed-vs-bf16 closure bit-equality: SCC partitions, rw-closure
+    bits, and per-iteration reach counts must match word-for-word on
+    a random-graph battery;
+  * the auto route must pick the device engine at the capacity
+    config's shape (the r05 `elle_append_8k: engine host` bug);
+  * a warmed shape bucket must re-check at ZERO XLA recompiles
+    (aot.precompile_elle_closure, the service warm path).
+
+~60 s on a CI cpu. Exit 0 clean, 1 on any violation.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import random
+
+    import numpy as np
+
+    from jepsen_tpu import synth
+    from jepsen_tpu.analysis import guards
+    from jepsen_tpu.elle import append, build, wr
+    from jepsen_tpu.elle import tpu as elle_tpu
+    from jepsen_tpu.elle.graph import (PROCESS, REALTIME, RW, WR, WW,
+                                       DepGraph)
+    from jepsen_tpu.ops import aot
+
+    failures = []
+
+    def check(cond, msg):
+        print(("ok   " if cond else "FAIL ") + msg)
+        if not cond:
+            failures.append(msg)
+
+    # -- device-route parity on a small corpus ----------------------
+    for name, hist, kw in (
+            ("append-valid", synth.list_append_history(600, seed=7),
+             {}),
+            ("append-corrupt",
+             synth.list_append_history(600, seed=7, corrupt_p=0.05),
+             {}),
+            ("wr-valid", synth.wr_register_history(600, seed=7),
+             {"linearizable_keys": True}),
+            ("wr-stale",
+             synth.wr_register_history(600, seed=7, stale_p=0.1),
+             {"linearizable_keys": True})):
+        mod = append if name.startswith("append") else wr
+        res_a = mod.check(hist, additional_graphs=("realtime",),
+                          cycle_backend="auto", **kw)
+        res_h = mod.check(hist, additional_graphs=("realtime",),
+                          cycle_backend="host", **kw)
+        check(res_a["cycle-engine"] == "device",
+              f"{name}: auto routed to device "
+              f"(got {res_a['cycle-engine']})")
+        check(res_a["valid?"] == res_h["valid?"],
+              f"{name}: verdict parity ({res_a['valid?']} vs "
+              f"{res_h['valid?']})")
+        check(set(res_a["anomaly-types"]) == set(res_h["anomaly-types"]),
+              f"{name}: anomaly-set parity")
+
+    # -- builder edge-column parity ----------------------------------
+    hist = synth.list_append_history(400, seed=11)
+    oks = [op for op in hist
+           if op.is_ok and op.f in ("txn", None) and op.value]
+    infos = [op for op in hist
+             if op.is_info and op.f in ("txn", None) and op.value]
+    bt = build.build_append(hist, oks, infos,
+                            additional_graphs=("realtime", "process"))
+    host_g = bt.tensors.to_depgraph()
+    b_edges = set(map(tuple, bt.tensors.edges.tolist()))
+    h_edges = set(map(tuple, np.asarray(host_g.edges).tolist()))
+    check(b_edges == h_edges,
+          f"builder edge columns == host edge set "
+          f"({len(b_edges)} edges)")
+
+    # -- packed vs bf16 bit-equality ---------------------------------
+    bit_ok = True
+    for seed in range(4):
+        rng = random.Random(seed)
+        g = DepGraph()
+        n = rng.randrange(8, 64)
+        for i in range(n):
+            g.add_node(i)
+        for _ in range(rng.randrange(8, 4 * n)):
+            g.add_edge(rng.randrange(n), rng.randrange(n),
+                       rng.choice([WW, WR, RW, REALTIME, PROCESS]))
+        r_bf = elle_tpu.cycle_queries(g)
+        r_pk = elle_tpu.cycle_queries_packed(g)
+        bit_ok &= all(
+            set(map(tuple, r_bf["sccs"][i]))
+            == set(map(tuple, r_pk["sccs"][i])) for i in range(3))
+        bit_ok &= np.array_equal(np.asarray(r_bf["rw_closed"]),
+                                 np.asarray(r_pk["rw_closed"]))
+        bit_ok &= (r_bf["util"]["iter_reach"]
+                   == r_pk["util"]["iter_reach"])
+    check(bit_ok, "packed closure bit-identical to bf16 "
+                  "(sccs + rw_closed + iter_reach)")
+
+    # -- capacity-shape routing + zero-recompile warm path -----------
+    hist8 = synth.list_append_history(900, seed=3)
+    oks8 = [op for op in hist8
+            if op.is_ok and op.f in ("txn", None) and op.value]
+    infos8 = [op for op in hist8
+              if op.is_info and op.f in ("txn", None) and op.value]
+    bt8 = build.build_append(hist8, oks8, infos8,
+                             additional_graphs=("realtime",))
+    rep = aot.precompile_elle_closure(
+        elle_tpu.shape_bucket_for(bt8.tensors))
+    check(bool(rep), f"precompile_elle_closure compiled {rep}")
+    with guards.CompileGuard(max_compiles=0):
+        res8 = append.check(hist8, additional_graphs=("realtime",),
+                            cycle_backend="auto")
+    check(res8["cycle-engine"] == "device",
+          "warmed capacity-shape auto-routes to device at zero "
+          "recompiles")
+
+    print("elle_smoke:", "PASS" if not failures
+          else f"{len(failures)} FAILURES")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

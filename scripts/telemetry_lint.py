@@ -70,6 +70,16 @@ SERIES_SCHEMAS = {
     "watchdog_heartbeats": {"source": str, "beats": int},
     "watchdog_stalls": {"source": str, "age_s": NUM, "beats": int,
                         "escalation": str},
+    # the Elle device plane (elle/build.py + elle/tpu.py):
+    # construction stats per tensorized build, and one point per
+    # closure-kernel call — `kernel` says which engine variant ran
+    # (bf16 legacy points predate the field, hence optional there)
+    "elle_build": {"checker": str, "txns": int, "mops": int,
+                   "edges": int, "edge_counts": dict, "build_s": NUM,
+                   "builder": str},
+    "elle_closure": {"edges": int, "n": int, "iters_run": int,
+                     "kernel_s": NUM, "compile_s": NUM,
+                     "iter_reach": list},
 }
 
 REGRESSIONS_SCHEMA = {"schema": int, "threshold_x": NUM,

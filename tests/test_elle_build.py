@@ -1,0 +1,363 @@
+"""The tensorized Elle pipeline (ISSUE 10): elle/build.py edge-column
+parity against the host builders, the trim/packed device kernels
+against the host oracle, shape-aware auto-routing, the
+precompile_elle_closure warm path, and the kind="elle" run ledger."""
+
+import random
+
+import numpy as np
+import pytest
+
+from jepsen_tpu import ledger, synth
+from jepsen_tpu.analysis import guards
+from jepsen_tpu.elle import append, build, wr
+from jepsen_tpu.elle import tpu as elle_tpu
+from jepsen_tpu.elle.graph import (PROCESS, REALTIME, RW, WR, WW,
+                                   DepGraph, process_graph,
+                                   realtime_graph)
+from jepsen_tpu.history import History, Op
+from jepsen_tpu.ops import aot
+from jepsen_tpu.ops.route import elle_cycle_route
+
+
+def edge_set(edges):
+    return set(map(tuple, np.asarray(edges).reshape(-1, 3).tolist()))
+
+
+def split_ops(h):
+    oks = [op for op in h
+           if op.is_ok and op.f in ("txn", None) and op.value]
+    infos = [op for op in h
+             if op.is_info and op.f in ("txn", None) and op.value]
+    return oks, infos
+
+
+def host_append_graph(h, additional=()):
+    oks, infos = split_ops(h)
+    writer, _ = append._writer_index(oks, infos)
+    orders, _ = append._version_orders(oks)
+    g = append.graph(h, orders=orders, writer=writer, oks=oks)
+    if "realtime" in additional:
+        g.merge(realtime_graph(h))
+    if "process" in additional:
+        g.merge(process_graph(h))
+    return g, writer, orders
+
+
+# -- builder parity corpus ---------------------------------------------------
+
+@pytest.mark.parametrize("seed", range(3))
+@pytest.mark.parametrize("corrupt", [0.0, 0.15])
+@pytest.mark.parametrize("additional",
+                         [(), ("realtime",), ("realtime", "process")])
+def test_append_builder_edge_parity(seed, corrupt, additional):
+    """Tensorized append construction produces EXACTLY the host
+    builders' edge set, writer index, and version orders — clean and
+    corrupted histories (with aborted/info txns: crash_p is on), all
+    additional-graph combinations."""
+    h = synth.list_append_history(250, seed=seed, corrupt_p=corrupt,
+                                  crash_p=0.02)
+    g, writer, orders = host_append_graph(h, additional)
+    oks, infos = split_ops(h)
+    b = build.build_append(h, oks, infos, additional_graphs=additional)
+    assert edge_set(b.tensors.edges) == edge_set(g.edges)
+    assert b.writer == writer
+    assert b.orders == orders
+    # corrupted reads break prefix-compatibility -> the exact host
+    # loop re-derives the order-dependent anomaly payloads
+    if corrupt and b.builder == "host-fallback":
+        _, anoms = append._version_orders(oks)
+        assert [a["key"] for a in b.order_anomalies] == \
+            [a["key"] for a in anoms]
+
+
+@pytest.mark.parametrize("seed", range(2))
+@pytest.mark.parametrize("stale", [0.0, 0.2])
+@pytest.mark.parametrize("kw", [
+    {}, {"sequential_keys": True}, {"linearizable_keys": True},
+    {"wfr_keys": True},
+    {"sequential_keys": True, "linearizable_keys": True,
+     "wfr_keys": True}])
+def test_wr_builder_edge_parity(seed, stale, kw):
+    """Tensorized wr construction matches the host evidence builders
+    across every version-order evidence source."""
+    h = synth.wr_register_history(220, seed=seed, stale_p=stale,
+                                  crash_p=0.02)
+    oks, infos = split_ops(h)
+    writer = wr._writer_index(oks + infos)
+    orders, cyclic = wr._version_orders(h, oks, writer, **kw)
+    g = wr._txn_graph(oks, writer, orders)
+    g.merge(realtime_graph(h))
+    b = build.build_wr(h, oks, infos, additional_graphs=("realtime",),
+                       **kw)
+    assert edge_set(b.tensors.edges) == edge_set(g.edges)
+    assert b.writer == writer
+    assert sorted(c["key"] for c in b.cyclic_anomalies) == \
+        sorted(c["key"] for c in cyclic)
+
+
+def test_builder_handles_g1a_g1b_fixtures():
+    """Hand-built aborted-read / intermediate-read histories flow
+    through the tensor path with full verdict parity."""
+    def op(i, typ, mops, t):
+        return Op(type=typ, f="txn", process=0, value=mops, time=t,
+                  index=i)
+
+    ops = [op(0, "invoke", [["append", "x", 1]], 0),
+           op(1, "fail", [["append", "x", 1]], 1),
+           op(2, "invoke", [["append", "x", 2], ["append", "x", 3]], 2),
+           op(3, "ok", [["append", "x", 2], ["append", "x", 3]], 3),
+           op(4, "invoke", [["r", "x", None]], 4),
+           op(5, "ok", [["r", "x", [1, 2]]], 5)]
+    h = History()
+    for o in ops:
+        h.append(o)
+    h = h.index()
+    res_d = append.check(h, additional_graphs=("realtime",),
+                         cycle_backend="device")
+    res_h = append.check(h, additional_graphs=("realtime",),
+                         cycle_backend="host")
+    assert res_d["valid?"] == res_h["valid?"] is False
+    assert set(res_d["anomaly-types"]) == set(res_h["anomaly-types"])
+    assert "G1a" in res_d["anomaly-types"]
+    assert "G1b" in res_d["anomaly-types"]
+
+
+def test_realtime_arrays_match_sweep_under_ties():
+    """The vectorized reduced realtime graph equals the host sweep on
+    histories dense with equal timestamps and zero-duration ops."""
+    for seed in range(12):
+        rng = random.Random(seed)
+        h = History()
+        pend = {}
+        t = 0
+        evs = []
+        for _ in range(70):
+            p = rng.randrange(4)
+            if p in pend:
+                inv = pend.pop(p)
+                evs.append(Op(type=rng.choice(["ok", "ok", "info",
+                                               "fail"]),
+                              f="txn", process=p, value=inv.value,
+                              time=inv.time + rng.choice([0, 0, 1, 3])))
+            else:
+                o = Op(type="invoke", f="txn", process=p,
+                       value=[["append", "x", rng.randrange(999)]],
+                       time=t)
+                pend[p] = o
+                evs.append(o)
+            t += rng.choice([0, 1])
+        for i, o in enumerate(evs):
+            h.append(o.with_(index=i))
+        hg = realtime_graph(h)
+        _idx, _inv, _comp, redges = build.realtime_arrays(h)
+        assert set(map(tuple, redges.tolist())) == \
+            set(map(tuple, np.asarray(hg.edges)[:, :2].tolist())), seed
+
+
+# -- device-vs-host verdict parity (full pipeline) --------------------------
+
+@pytest.mark.parametrize("corrupt", [0.0, 0.2])
+def test_append_device_parity(corrupt):
+    h = synth.list_append_history(400, seed=5, corrupt_p=corrupt,
+                                  crash_p=0.02)
+    res_d = append.check(h, additional_graphs=("realtime",),
+                         cycle_backend="device")
+    res_h = append.check(h, additional_graphs=("realtime",),
+                         cycle_backend="host")
+    assert res_d["valid?"] == res_h["valid?"]
+    assert set(res_d["anomaly-types"]) == set(res_h["anomaly-types"])
+    assert res_d["cycle-engine"] == "device"
+    assert res_d["cycle-util"]["kernel"] in ("trim", "bf16", "packed")
+
+
+@pytest.mark.parametrize("stale", [0.0, 0.15])
+def test_wr_device_parity(stale):
+    h = synth.wr_register_history(400, seed=5, stale_p=stale,
+                                  crash_p=0.02)
+    kw = dict(linearizable_keys=True, additional_graphs=("realtime",))
+    res_d = wr.check(h, cycle_backend="device", **kw)
+    res_h = wr.check(h, cycle_backend="host", **kw)
+    assert res_d["valid?"] == res_h["valid?"]
+    assert set(res_d["anomaly-types"]) == set(res_h["anomaly-types"])
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_trim_generic_graph_parity(seed):
+    """The trim kernel agrees with the host oracle on arbitrary
+    DepGraphs (no builder metadata: every edge scatters)."""
+    rng = random.Random(seed)
+    g = DepGraph()
+    n = rng.randrange(3, 70)
+    for i in range(n):
+        g.add_node(i)
+    for _ in range(rng.randrange(0, 4 * n)):
+        g.add_edge(rng.randrange(n), rng.randrange(n),
+                   rng.choice([WW, WR, RW, REALTIME, PROCESS]))
+    host = elle_tpu.standard_cycle_search(g, backend="host")
+    trim = elle_tpu.standard_cycle_search(g, backend="trim")
+    for q in ("G0", "G1c", "G-single", "G2"):
+        assert (host[q] is None) == (trim[q] is None), q
+
+
+# -- packed closure ----------------------------------------------------------
+
+@pytest.mark.parametrize("seed", range(5))
+def test_packed_bit_identical_to_bf16(seed):
+    """The uint32 bitset closure is bit-identical to the bf16 kernel:
+    same SCC partitions, same rw-closure bits, same per-iteration
+    reach counts and executed-squaring count."""
+    rng = random.Random(seed)
+    g = DepGraph()
+    n = rng.randrange(4, 90)
+    for i in range(n):
+        g.add_node(i)
+    for _ in range(rng.randrange(0, 5 * n)):
+        g.add_edge(rng.randrange(n), rng.randrange(n),
+                   rng.choice([WW, WR, RW, REALTIME, PROCESS]))
+    r_bf = elle_tpu.cycle_queries(g)
+    r_pk = elle_tpu.cycle_queries_packed(g)
+    for si in range(3):
+        assert set(map(tuple, r_bf["sccs"][si])) == \
+            set(map(tuple, r_pk["sccs"][si])), si
+    assert np.array_equal(np.asarray(r_bf["rw_closed"]),
+                          np.asarray(r_pk["rw_closed"]))
+    assert r_bf["util"]["iter_reach"] == r_pk["util"]["iter_reach"]
+    assert r_bf["util"]["iters_run"] == r_pk["util"]["iters_run"]
+
+
+def test_packed_lifts_capacity_past_bf16_cap():
+    """cycle_queries refuses graphs past DEFAULT_MAX_N; the packed
+    kernel's cap is 4x higher (the 16x memory cut is what buys it)."""
+    assert elle_tpu.PACKED_MAX_N == 4 * elle_tpu.DEFAULT_MAX_N
+    g = DepGraph()
+    for i in range(30):
+        g.add_edge(i, (i + 1) % 30, WW)
+    assert elle_tpu.cycle_queries(g, max_n=10) is None
+    res = elle_tpu.cycle_queries_packed(g, max_n=64)
+    assert res is not None
+    assert res["util"]["kernel"] == "packed"
+    assert res["util"]["closure_bytes"] < 3 * 128 * 128 * 2  # < bf16
+
+
+# -- routing -----------------------------------------------------------------
+
+def test_route_small_graph_stays_host():
+    backend, reason = elle_cycle_route(n=40, e=120, rw_edges=10,
+                                       accel=False, device_ok=True)
+    assert backend == "host"
+    assert "small graph" in reason
+
+
+def test_route_no_backend_stays_host():
+    backend, reason = elle_cycle_route(n=5000, e=20000, rw_edges=4000,
+                                       accel=False, device_ok=False)
+    assert backend == "host"
+
+
+def test_route_big_graph_goes_device():
+    backend, reason = elle_cycle_route(n=3000, e=15000, rw_edges=2600,
+                                       accel=False, device_ok=True)
+    assert backend == "device"
+    assert "device closure battery" in reason
+
+
+def test_route_over_packed_capacity_falls_host():
+    backend, reason = elle_cycle_route(n=40000, e=100000,
+                                       rw_edges=9000, accel=True,
+                                       device_ok=True)
+    assert backend == "host"
+    assert "capacity" in reason
+
+
+def test_capacity_shape_routes_device():
+    """The elle_append_8k regression (ISSUE 10 satellite): at the
+    kernel's own capacity config the auto route must pick the device
+    engine — r05 sat on `engine: host` for every elle config."""
+    h = synth.list_append_history(900, n_procs=5, seed=7)
+    res = append.check(h, additional_graphs=("realtime",),
+                       cycle_backend="auto")
+    assert res["cycle-engine"] == "device", res.get("cycle-route-reason")
+    assert "device closure battery" in res["cycle-route-reason"]
+    res_h = append.check(h, additional_graphs=("realtime",),
+                         cycle_backend="host")
+    assert res["valid?"] == res_h["valid?"] is True
+
+
+# -- warm path ---------------------------------------------------------------
+
+def test_precompile_elle_closure_zero_recompiles():
+    """aot.precompile_elle_closure warms every kernel the router can
+    pick for a shape bucket; the subsequent auto-routed check stays at
+    ZERO XLA compiles under CompileGuard (the service warm path)."""
+    h = synth.list_append_history(700, n_procs=5, seed=9)
+    oks, infos = split_ops(h)
+    bt = build.build_append(h, oks, infos,
+                            additional_graphs=("realtime",))
+    rep = aot.precompile_elle_closure(
+        elle_tpu.shape_bucket_for(bt.tensors))
+    assert "trim" in rep
+    with guards.CompileGuard(max_compiles=0):
+        res = append.check(h, additional_graphs=("realtime",),
+                           cycle_backend="auto")
+    assert res["cycle-engine"] == "device"
+    assert res["valid?"] is True
+
+
+# -- ledger ------------------------------------------------------------------
+
+def test_elle_analyses_land_in_ledger(tmp_path):
+    """Every elle analysis records a kind="elle" ledger entry with
+    engine + device-seconds, so /runs aggregates and regressions()
+    cover both checker families."""
+    led = ledger.Ledger(str(tmp_path))
+    h = synth.list_append_history(600, n_procs=5, seed=2)
+    hw = synth.wr_register_history(600, n_procs=5, seed=2)
+    with ledger.use(led):
+        append.check(h, additional_graphs=("realtime",),
+                     cycle_backend="auto")
+        wr.check(hw, linearizable_keys=True,
+                 additional_graphs=("realtime",),
+                 cycle_backend="auto")
+    recs = led.query(kind="elle")
+    assert len(recs) == 2
+    names = {r["name"] for r in recs}
+    assert names == {"elle.append", "elle.wr"}
+    for r in recs:
+        assert r["engine"] == "device"
+        assert r["verdict"] is True
+        assert r.get("device_s") is not None  # util.kernel_s rode in
+        assert r["wall_s"] > 0
+    agg = led.aggregate(recs)
+    assert agg["runs"] == 2
+
+
+# -- telemetry ---------------------------------------------------------------
+
+def test_elle_series_lint_clean(tmp_path):
+    """elle_build / elle_closure points pass the telemetry linter —
+    and a drifted point fails it."""
+    import json
+    import sys
+
+    sys.path.insert(0, "scripts")
+    import telemetry_lint
+
+    from jepsen_tpu import metrics
+    reg = metrics.Registry(enabled=True)
+    h = synth.list_append_history(600, n_procs=5, seed=4)
+    with metrics.use(reg):
+        append.check(h, additional_graphs=("realtime",),
+                     cycle_backend="device")
+    p = tmp_path / "m.jsonl"
+    reg.export_jsonl(str(p))
+    lines = [json.loads(ln) for ln in open(p) if ln.strip()]
+    assert any(ln.get("series") == "elle_build" for ln in lines)
+    assert any(ln.get("series") == "elle_closure" for ln in lines)
+    assert telemetry_lint.lint_jsonl_file(str(p)) == []
+    bad = dict(next(ln for ln in lines
+                    if ln.get("series") == "elle_build"))
+    bad.pop("builder")
+    with open(p, "a") as fh:
+        fh.write(json.dumps(bad) + "\n")
+    assert telemetry_lint.lint_jsonl_file(str(p)) != []
