@@ -198,6 +198,34 @@ def _config_entry(res: dict, wall: float) -> dict:
     return out
 
 
+def _preflight_block(model, hist, res) -> Optional[dict]:
+    """The compact-line `preflight` block: the static plan the
+    admission analyzer (analysis/preflight) predicted for this config
+    next to what the executed check actually did — so
+    prediction-vs-measured drift is tracked per round. lower="warm"
+    reads predicted cost straight from the cost_for cache the executed
+    check just populated (same keys): no re-encode, no tracing, zero
+    backend compiles added to the round."""
+    from jepsen_tpu.analysis import preflight
+    try:
+        rep = preflight.plan_wgl(model, hist, lower="warm")
+        blk = {"verdict": rep["verdict"],
+               "kernel": rep.get("kernel"),
+               "buckets": rep.get("buckets"),
+               "hbm_peak_bytes": (rep.get("hbm") or {}).get(
+                   "peak_bytes"),
+               "rules": [r["rule"] for r in rep["rules"]]}
+        par = preflight._parity(rep, res)
+        for k in ("buckets_visited", "buckets_subset", "pack_match",
+                  "bytes_per_round_predicted",
+                  "bytes_per_round_measured", "drift_x"):
+            if par.get(k) is not None:
+                blk[k] = par[k]
+        return blk
+    except Exception:  # noqa: BLE001 — the admission model must
+        return None    # never cost a measured number
+
+
 def run_extras(budget: float, deadline: float) -> dict:
     """The non-headline BASELINE configs; each failure is contained.
     Configs that would start with < 10 s left before `deadline`
@@ -231,6 +259,11 @@ def run_extras(budget: float, deadline: float) -> dict:
                 res = checker()
             wall = time.monotonic() - t0
             configs[name] = _config_entry(res, wall)
+            if model is not None and hist is not None:
+                # prediction-vs-measured drift per config
+                pf = _preflight_block(model, hist, res)
+                if pf:
+                    configs[name]["preflight"] = pf
             _ledger_record_config(name, res, wall)
         except Exception as e:  # noqa: BLE001
             traceback.print_exc(file=sys.stderr)
@@ -343,12 +376,31 @@ def run_extras(budget: float, deadline: float) -> dict:
             pass           # the measured run still decides correctly
 
     def _elle_entry(res, hist):
-        return {"valid?": res["valid?"],
-                "op_count": len(hist) // 2,
-                "engine": res.get("cycle-engine"),
-                "route_reason": res.get("cycle-route-reason"),
-                "util": res.get("cycle-util"),
-                "cause": ",".join(res["anomaly-types"]) or None}
+        out = {"valid?": res["valid?"],
+               "op_count": len(hist) // 2,
+               "engine": res.get("cycle-engine"),
+               "route_reason": res.get("cycle-route-reason"),
+               "util": res.get("cycle-util"),
+               "cause": ",".join(res["anomaly-types"]) or None}
+        try:
+            # the elle preflight block: planned route vs executed
+            from jepsen_tpu.analysis import preflight
+            n = len([op for op in hist
+                     if op.type in ("ok", "info")
+                     and op.f in ("txn", None) and op.value])
+            rep = preflight.plan_elle(n_txns=n, backend="auto")
+            ran = res.get("cycle-engine")
+            out["preflight"] = {
+                "verdict": rep["verdict"],
+                "engine": rep["engine"],
+                "kernel": rep.get("kernel"),
+                "rules": [r["rule"] for r in rep["rules"]],
+                "engine_match": ((rep["engine"] == "host")
+                                 == (ran in ("host",
+                                             "host-fallback")))}
+        except Exception:  # noqa: BLE001 — advisory block only
+            pass
+        return out
 
     hist_a3 = synth.list_append_history(3000, n_procs=5, seed=7)
 
@@ -759,6 +811,10 @@ def run_bench() -> tuple[dict, int]:
            "occupancy": res.get("occupancy"),
            "telemetry": res.get("telemetry"),
            "probe_diagnostics": probe_diags}
+    pf = _preflight_block(model, hist, res)
+    if pf:
+        # admission-model drift on the headline, tracked per round
+        out["preflight"] = pf
     if guard_reports:
         # warm-run compile/transfer accounting; the adopted platform's
         # report is last. JEPSEN_TPU_BENCH_COMPILE_BUDGET (int) turns
@@ -1256,7 +1312,7 @@ def emit(out: dict) -> None:
                ("metric", "value", "unit", "vs_baseline", "verdict",
                 "platform", "cold_s", "terminated", "error", "cause",
                 "tpu_measured", "regressions", "occupancy_report",
-                "compile_budget_exceeded")
+                "compile_budget_exceeded", "preflight")
                if out.get(k) is not None}
     aot = out.get("tpu_aot")
     if isinstance(aot, dict):
@@ -1271,7 +1327,8 @@ def emit(out: dict) -> None:
         for name, v in cfgs.items():
             if not isinstance(v, dict):
                 continue
-            row = {k: v.get(k) for k in ("verdict", "wall_s", "engine")
+            row = {k: v.get(k) for k in ("verdict", "wall_s", "engine",
+                                         "preflight")
                    if v.get(k) is not None}
             # occupancy on the compact line: frontier_fill +
             # memo_hit_rate ride every BENCH_r*.json config entry so

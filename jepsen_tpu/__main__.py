@@ -75,10 +75,41 @@ DEMO_OPTS = [
         help="Approximate ops/sec per worker"),
 ]
 
+def preflight_cmd() -> dict:
+    """`python -m jepsen_tpu preflight` — the static admission
+    analyzer (analysis/preflight): emit the plan report a check WOULD
+    run (ladder buckets, kernel variants, Elle route, per-node
+    cost_analysis, HBM peak) plus the feasible/degrade/infeasible
+    verdict, without executing anything on a device."""
+    spec = [
+        Opt("help", short="-h", help="Print out this message and exit"),
+        Opt("config", metavar="NAME", default="all",
+            help="headline | elle_append_8k | dense_100k | all"),
+        Opt("ops", metavar="N", default=10_000, parse=cli.pos_int,
+            help="Headline history size (invocations)"),
+        Opt("txns", metavar="N", default=4_000, parse=cli.pos_int,
+            help="elle_append_8k history size (txns)"),
+        Opt("execute", default=False,
+            help="Also run the planned check and print the "
+                 "planned-vs-executed parity block"),
+        Opt("json", default=False,
+            help="Emit the full plan reports as JSON"),
+    ]
+
+    def run(parsed):
+        from .analysis import preflight as preflight_mod
+        return preflight_mod.cli_main(parsed.options)
+
+    return {"preflight": {"opt_spec": spec, "run": run,
+                          "usage": "Usage: python -m jepsen_tpu "
+                                   "preflight [OPTIONS ...]"}}
+
+
 COMMANDS = {
     **cli.single_test_cmd({"test_fn": demo_test, "opt_spec": DEMO_OPTS}),
     **cli.test_all_cmd({"tests_fn": demo_tests, "opt_spec": DEMO_OPTS}),
     **cli.serve_cmd(),
+    **preflight_cmd(),
 }
 
 

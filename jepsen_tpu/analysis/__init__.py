@@ -26,10 +26,22 @@ Two planes, both cheap enough to run always:
                       during a checker run, and asserts budgets (e.g.
                       re-checking a same-shape history must not
                       recompile). Used by tests and `bench.py`.
+  * `preflight`     — the static kernel-plan & capacity analyzer
+                      (admission control): enumerates, WITHOUT
+                      executing, the ladder buckets / kernel variants
+                      / Elle route a check would take, costs each
+                      plan node via tracing+lowering-only
+                      `Lowered.cost_analysis`, and returns a
+                      `feasible | degrade | infeasible` verdict
+                      (rules P001-P006). Infeasible requests
+                      fast-fail as `{"valid?": "unknown", "cause":
+                      "preflight"}` before any backend compile or
+                      device byte. CLI: `python -m jepsen_tpu
+                      preflight`.
 
 Rule catalogs and allowlist syntax: doc/STATIC_ANALYSIS.md.
 """
 
-from . import guards, history_lint, jaxlint  # noqa: F401
+from . import guards, history_lint, jaxlint, preflight  # noqa: F401
 
-__all__ = ["history_lint", "jaxlint", "guards"]
+__all__ = ["history_lint", "jaxlint", "guards", "preflight"]

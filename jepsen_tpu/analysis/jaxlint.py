@@ -33,6 +33,23 @@ per invocation. This linter encodes them as static rules:
                           `for ... in range(...)` statement in a jit
                           region — unrolls into the trace; belongs in
                           `lax.scan`/`lax.fori_loop`
+  J007 transfer-in-loop   a host transfer/sync (`np.asarray`/
+                          `np.array` on a device result,
+                          `jax.device_get`, `.block_until_ready()`)
+                          inside a HOST-side poll loop — each
+                          iteration pays a device->host round-trip
+                          (~75 ms on a tunneled v5e); the static twin
+                          of the transfer budget `guards.CompileGuard`
+                          enforces at runtime. While-loops check all
+                          four forms; for-loops only the unambiguous
+                          syncs (`device_get`/`block_until_ready`),
+                          since `np.asarray` over a host iterable is
+                          idiomatic numpy
+  J008 missing-donation   `jax.jit(fn)` where `fn` is a chunked
+                          kernel (a parameter named `carry`/`state` —
+                          the re-fed search carry) without
+                          `donate_argnums` — every call copies the
+                          multi-MB carry instead of donating it
 
 Jit regions are resolved per module: functions passed to `jax.jit`
 (call or decorator, incl. `functools.partial(jax.jit, ...)`),
@@ -45,8 +62,12 @@ traced expressions (one forward pass).
 
 Allowlist: a `# jaxlint: ok(J001)` (or `ok(J001,J006)`, or a bare
 `# jaxlint: ok`) comment on the flagged line — or on the line
-directly above it — suppresses the finding. Every allowlist in the
-tree is an explicit, reviewable decision; CI keeps the tree clean
+directly above it — suppresses the finding. A file-level
+`# jaxlint: ok-file(J003,J006)` within the first 20 lines suppresses
+the named rules for the whole module (for benchmark-style scripts
+whose one-shot compiles and timing loops ARE the point; never a bare
+form — file-wide suppression must name its rules). Every allowlist in
+the tree is an explicit, reviewable decision; CI keeps the tree clean
 (`scripts/jax_lint.py`, wired as a tier-1 test).
 """
 
@@ -65,7 +86,13 @@ RULES = {
     "J004": "scalar-closure",
     "J005": "dtype-promotion",
     "J006": "python-loop-jnp",
+    "J007": "transfer-in-loop",
+    "J008": "missing-donation",
 }
+
+# jitted-kernel carry parameter names J008 keys on: the re-fed search
+# carry is the multi-MB buffer donation exists for.
+_CARRY_PARAMS = {"carry", "state"}
 
 _LAX_HOFS = {"while_loop", "fori_loop", "scan", "cond", "switch", "map"}
 _CACHE_DECORATORS = {"lru_cache", "cache", "cached_property"}
@@ -75,6 +102,9 @@ _HOST_SYNC_NP_FUNCS = {"asarray", "array"}
 _INT_DTYPES = {"int8", "int16", "int32", "int64",
                "uint8", "uint16", "uint32", "uint64"}
 _ALLOW_RE = re.compile(r"#\s*jaxlint:\s*ok(?:\(([^)]*)\))?")
+_ALLOW_FILE_RE = re.compile(r"#\s*jaxlint:\s*ok-file\(([^)]*)\)")
+# ok-file must sit in the module header, a visible reviewable banner
+_ALLOW_FILE_SCAN_LINES = 20
 
 
 @dataclass
@@ -308,6 +338,33 @@ def _traced_names(fn_node) -> set:
     return traced
 
 
+def _walk_skip_defs(node):
+    """Walk a subtree without descending into nested function defs or
+    lambdas (separate scopes, analyzed on their own)."""
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        sub = stack.pop()
+        if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.Lambda)):
+            continue
+        yield sub
+        stack.extend(ast.iter_child_nodes(sub))
+
+
+def _loop_call_targets(loop) -> set:
+    """Names assigned from a call expression inside the loop body —
+    the values that can hold device results in a host poll loop."""
+    out: set = set()
+    for sub in _walk_skip_defs(loop):
+        if isinstance(sub, ast.Assign) and any(
+                isinstance(x, ast.Call) for x in ast.walk(sub.value)):
+            for tgt in sub.targets:
+                for nm in ast.walk(tgt):
+                    if isinstance(nm, ast.Name):
+                        out.add(nm.id)
+    return out
+
+
 def _dtype_markers(node) -> set:
     """Explicit integer-dtype markers in an expression subtree:
     jnp.int32(x) casts, dtype=jnp.uint32 kwargs, .astype(jnp.int32),
@@ -347,6 +404,92 @@ def lint_source(src: str, path: str = "<string>") -> list:
         findings.append(Finding(path, getattr(node, "lineno", 0),
                                 getattr(node, "col_offset", 0),
                                 rule, msg))
+
+    # -- J007: host transfers/syncs inside host-side poll loops --------
+    def in_region(node) -> bool:
+        p = node
+        while p is not None:
+            if p in regions:
+                return True
+            p = parent_map.get(p)
+        return False
+
+    for loop in ast.walk(tree):
+        if not isinstance(loop, (ast.While, ast.For)) \
+                or in_region(loop):
+            continue
+        targets = _loop_call_targets(loop)
+        is_while = isinstance(loop, ast.While)
+        for sub in _walk_skip_defs(loop):
+            if not isinstance(sub, ast.Call):
+                continue
+            f = sub.func
+            if not isinstance(f, ast.Attribute):
+                continue
+            arg_names = set()
+            for a in sub.args:
+                arg_names |= _names_in(a)
+            if isinstance(f.value, ast.Name):
+                arg_names.add(f.value.id)  # method receiver
+            if not (arg_names & targets):
+                continue  # not a device result produced in this loop
+            if f.attr in ("block_until_ready", "device_get"):
+                add(sub, "J007",
+                    f"{f.attr} on a device result inside a host poll "
+                    "loop — each iteration pays a device->host "
+                    "round-trip; batch the fetch into the packed "
+                    "poll summary (allowlist the ONE designed poll)")
+            elif is_while and f.attr in _HOST_SYNC_NP_FUNCS \
+                    and isinstance(f.value, ast.Name) \
+                    and f.value.id in _NUMPY_NAMES:
+                add(sub, "J007",
+                    f"np.{f.attr} on a device result inside a "
+                    "while/poll loop transfers per iteration — "
+                    "batch the fetch (allowlist the ONE designed "
+                    "poll)")
+
+    # -- J008: carry-style jitted kernels must donate the carry --------
+    for call, _chain in idx.jit_calls:
+        target = call.args[0] if call.args else None
+        if not isinstance(target, ast.Name):
+            continue
+        if {kw.arg for kw in call.keywords} \
+                & {"donate_argnums", "donate_argnames"}:
+            continue
+        for fi in idx.by_name.get(target.id, []):
+            if isinstance(fi.node, ast.Lambda):
+                continue
+            carry = _param_names(fi.node) & _CARRY_PARAMS
+            if carry:
+                add(call, "J008",
+                    f"jax.jit({target.id}) re-feeds its "
+                    f"{sorted(carry)} parameter without "
+                    "donate_argnums — every chunk call copies the "
+                    "multi-MB carry instead of donating it")
+                break
+    # decorator spellings of the same footgun: @jax.jit / @jit bare,
+    # or @functools.partial(jax.jit, ...) without donation
+    for fi in idx.funcs:
+        carry = fi.params & _CARRY_PARAMS
+        if not carry:
+            continue
+        for dec in getattr(fi.node, "decorator_list", []):
+            if _is_jit_ref(dec):
+                donated = False
+            elif isinstance(dec, ast.Call) and (
+                    _is_jit_ref(dec.func)
+                    or any(_is_jit_ref(a) for a in dec.args)):
+                donated = bool({kw.arg for kw in dec.keywords}
+                               & {"donate_argnums", "donate_argnames"})
+            else:
+                continue
+            if not donated:
+                add(dec, "J008",
+                    f"@jit on {fi.name} re-feeds its "
+                    f"{sorted(carry)} parameter without "
+                    "donate_argnums — every chunk call copies the "
+                    "multi-MB carry instead of donating it")
+            break
 
     # -- J003 / J004: jit construction + closure captures -------------
     for call, chain in idx.jit_calls:
@@ -466,7 +609,16 @@ def lint_source(src: str, path: str = "<string>") -> list:
 def _apply_allowlist(findings: list, src: str) -> list:
     lines = src.splitlines()
 
+    file_rules: set = set()
+    for ln in lines[:_ALLOW_FILE_SCAN_LINES]:
+        m = _ALLOW_FILE_RE.search(ln)
+        if m:
+            file_rules |= {w.strip() for w in m.group(1).split(",")
+                           if w.strip()}
+
     def allowed(f: Finding) -> bool:
+        if f.rule in file_rules:
+            return True
         for ln in (f.line, f.line - 1):
             if 1 <= ln <= len(lines):
                 m = _ALLOW_RE.search(lines[ln - 1])

@@ -1,0 +1,1018 @@
+"""Preflight: static kernel-plan & capacity analyzer with admission verdicts.
+
+The JVM checkers the reference wraps discover infeasibility by timing
+out ("some tests are expensive to check… which requires we verify only
+short histories" — jepsen.independent); our device engine used to
+discover it the same way, by OOMing or burning device-seconds on a
+plan that could never fit (a 100k-txn dense closure is ~3.75 GB of
+bitset words before the squaring temporaries, ROADMAP item 3). This
+module is the admission-control front door: given (model, encoded
+history shapes, backend) it *enumerates without executing* the full
+plan a check would take —
+
+  * the adaptive ladder buckets `ops/adapt.LADDER32` / `ladder_for`
+    would climb (`wgl.check`'s exact derivation, mirrored here),
+  * the wgl32/wgln variant flags (`pack` via `wgl._packable`,
+    `compact` via the depth-fused default) the kernel builders would
+    pick,
+  * the Elle route (host / bf16 / packed / trim) that
+    `ops/route.elle_cycle_route` + `elle/tpu._squaring_select` would
+    choose —
+
+then costs each plan node via tracing+lowering-only
+`jax.stages.Lowered.cost_analysis` (`occupancy.cost_for`, cached per
+shape bucket, ZERO backend compiles — the cache keys match the ones
+`ops/wgl.py` uses at result time, so the prediction and the executed
+check read the same numbers) into a machine-readable plan report with
+a verdict:
+
+    feasible              admit as planned
+    degrade               admit, but the report's `suggestion` names a
+                          cheaper/safer shape (host oracle, adaptive
+                          ladder, precompiled warm path, …)
+    infeasible            reject statically — no backend compile, no
+                          device byte is ever spent
+
+Rule catalog (doc/STATIC_ANALYSIS.md "Plane 3"):
+
+  P001 plan-exceeds-hbm          peak live bytes of a plan node blow
+                                 the device memory budget
+  P002 closure-over-capacity     a dense Elle closure (bf16/packed/
+                                 trim) over its kernel capacity cap
+  P003 compile-budget-blown      cold executables exceed the caller's
+                                 CompileGuard-style compile budget —
+                                 precompile (ops/aot) first
+  P004 encoding-overflow-predicted   the WGL encoding would trip an
+                                 `EncodingUnsupported` limit (window /
+                                 info-cap / state-space) — route to
+                                 the host oracle
+  P005 padded-waste              predicted frontier/window fill under
+                                 the occupancy target — the plan pays
+                                 for lanes the wavefront can't use
+  P006 route-cost-disagreement   the shape router's engine pick and
+                                 the cost model disagree — trust the
+                                 cost side and degrade
+
+P001/P002 are *infeasible* (gating); P003-P006 are *degrade*
+(advisory). Gates are wired into `checker.Linearizable`, elle
+append/wr auto-routing, and both `parallel/batched.py` fan-out paths:
+an infeasible request fast-fails as `{"valid?": "unknown", "cause":
+"preflight", ...}` exactly like `history_lint`, is recorded as a
+`preflight` series point + a `kind="preflight"` ledger record, and
+surfaces on `/status.json`'s `preflight` block. The CLI is
+`python -m jepsen_tpu preflight`.
+
+This is the feasibility oracle the checker-as-a-service admission
+queue (ROADMAP item 1) fronts requests with, and the one the
+100k-Elle sharding work (item 3) queries before picking a plan.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+from typing import Optional
+
+import numpy as np
+
+RULES = {
+    "P001": "plan-exceeds-hbm",
+    "P002": "closure-over-capacity",
+    "P003": "compile-budget-blown",
+    "P004": "encoding-overflow-predicted",
+    "P005": "padded-waste",
+    "P006": "route-cost-disagreement",
+}
+
+# Rules that reject (verdict "infeasible"); the rest only degrade.
+INFEASIBLE_RULES = ("P001", "P002")
+
+# TPU v5e HBM capacity (single chip, spec sheet) — the default device
+# memory budget an admitted plan must fit. The cpu tier-1 runs use the
+# same figure as a conservative host budget unless overridden: the
+# dense-closure blowups this rule exists for are 6-100 GB, far past
+# any sane budget either way.
+V5E_HBM_CAPACITY_BYTES = 16 * 2 ** 30
+
+# Live-copy multiplier for the dense closure squaring: the reach
+# matrix, the einsum product, and the re-binarized result are live at
+# once inside the while_loop body (elle/tpu.make_closure_kernel).
+CLOSURE_LIVE_FACTOR = 3
+
+
+def device_memory_budget(platform: Optional[str] = None) -> int:
+    """The byte budget a plan's peak live bytes must fit
+    (JEPSEN_TPU_PREFLIGHT_MEM_BUDGET overrides; default: v5e HBM)."""
+    env = os.environ.get("JEPSEN_TPU_PREFLIGHT_MEM_BUDGET")
+    if env:
+        return int(float(env))
+    return V5E_HBM_CAPACITY_BYTES
+
+
+def _compile_budget(explicit: Optional[int]) -> Optional[int]:
+    if explicit is not None:
+        return int(explicit)
+    env = os.environ.get("JEPSEN_TPU_PREFLIGHT_COMPILE_BUDGET")
+    return int(env) if env not in (None, "") else None
+
+
+def _rule(rule: str, message: str, suggestion: Optional[str] = None,
+          severity: Optional[str] = None) -> dict:
+    return {"rule": rule, "name": RULES[rule],
+            "severity": severity or ("infeasible"
+                                     if rule in INFEASIBLE_RULES
+                                     else "degrade"),
+            "message": message, "suggestion": suggestion}
+
+
+def _verdict(rules: list) -> tuple:
+    """(verdict, suggestion) from the fired rules."""
+    infeasible = [r for r in rules if r["severity"] == "infeasible"]
+    if infeasible:
+        return "infeasible", (infeasible[0].get("suggestion")
+                              or infeasible[0]["message"])
+    degrade = [r for r in rules if r["severity"] == "degrade"]
+    if degrade:
+        return "degrade", (degrade[0].get("suggestion")
+                           or degrade[0]["message"])
+    return "feasible", None
+
+
+def _safe_platform(platform: Optional[str]) -> Optional[str]:
+    if platform is not None:
+        return platform
+    try:
+        from ..util import safe_backend
+        return safe_backend()
+    except Exception:  # noqa: BLE001 — no jax at all: host plans only
+        return None
+
+
+# ---------------------------------------------------------------------------
+# WGL: shape probe + plan enumeration
+# ---------------------------------------------------------------------------
+
+def _probe_shapes(history) -> dict:
+    """The encoding-relevant shapes of a history WITHOUT enumerating
+    the model state space (`encode.build_table` is the expensive half
+    of `encode`; everything the planner needs — window requirement,
+    op/info counts, concurrency depth — comes from the prepared op
+    intervals alone). The window math and pad buckets ARE encode's
+    (`encode.window_requirement` / `encode._pad_to`), so probe and
+    encoder cannot disagree."""
+    from ..ops.encode import _pad_to, window_requirement
+    from ..ops.linprep import prepare
+
+    ops = prepare(history)
+    ok = [o for o in ops if o.ok]
+    info = [o for o in ops if not o.ok]
+    n, ni = len(ok), len(info)
+    inv = np.asarray([o.inv for o in ok], dtype=np.int64)
+    ret = np.asarray([min(o.ret, 2 ** 31 - 1) for o in ok],
+                     dtype=np.int64)
+    w_needed, W = window_requirement(inv, ret)
+    return {"n_ok": n, "n_info": ni, "W_raw": w_needed, "W": W,
+            "n_pad": _pad_to(n, 64), "ic_pad": _pad_to(ni, 32),
+            "S": None, "O": None,
+            # every time class `wgl._packable` checks that is knowable
+            # without the table: ok inv/ret AND info invocation times
+            # (sufminret is bounded by max ret); the table-rows cap is
+            # the one residual the `pack_estimated` flag covers
+            "times_max": int(max(inv.max() if n else 0,
+                                 ret.max() if n else 0,
+                                 max((o.inv for o in info),
+                                     default=0))),
+            "inv": inv, "ret": ret}
+
+
+def _shapes_from_enc(enc) -> dict:
+    n = int(enc.n_ok)
+    inv = enc.inv[:n].astype(np.int64)
+    ret = enc.ret[:n].astype(np.int64)
+    m = 0
+    from ..ops.wgl import INF
+    for a in (enc.inv, enc.ret, enc.sufminret, enc.inv_info):
+        finite = a[a < INF]
+        if finite.size:
+            m = max(m, int(finite.max()))
+    return {"n_ok": n, "n_info": int(enc.n_info),
+            "W_raw": int(enc.window_raw), "W": int(enc.window),
+            "n_pad": len(enc.inv), "ic_pad": len(enc.inv_info),
+            "S": int(enc.table.shape[0]), "O": int(enc.table.shape[1]),
+            "times_max": m, "inv": inv, "ret": ret}
+
+
+def _depth_stats(shapes: dict) -> dict:
+    """Mean/p95 pending-op depth — the static wavefront predictor the
+    router uses (`ops/route.shape_stats`); the planner reuses it for
+    the predicted-fill model behind P005."""
+    inv, ret = shapes.get("inv"), shapes.get("ret")
+    if inv is None or not len(inv):
+        return {"mean_depth": 0.0, "p95_depth": 0}
+    order_i = np.sort(inv)
+    order_r = np.sort(ret)
+    depth = (np.searchsorted(order_i, inv, side="right")
+             - np.searchsorted(order_r, inv, side="right"))
+    return {"mean_depth": round(float(depth.mean()), 2),
+            "p95_depth": int(np.percentile(depth, 95))}
+
+
+def _node_bytes(K, W_eff, ic_eff, window_lanes, H, B, n_pad) -> int:
+    """Peak-live-bytes model for one kernel bucket: memo table (16 B /
+    slot) + packed backlog rows + the per-round successor
+    intermediates (R rows x packed lanes x ~3 temporaries) + consts.
+    One model for both variants — `window_lanes` is the packed window
+    word count (1 for wgl32, which always carries exactly one uint32
+    window lane; L for wgln). An upper-bound-flavored model, like the
+    util-block accounting."""
+    lanes = window_lanes + max(1, ic_eff // 32) + 4
+    rows = K * (W_eff + ic_eff)
+    return int(H * 16 + B * lanes * 4
+               + 3 * rows * lanes * 4 + 6 * n_pad * 4)
+
+
+def _lower_wgl_node(enc, kern: str, *, K, H, B, chunk, probes, W_eff,
+                    ic_eff, L, accel, depth, pack):
+    """A `jax.stages.Lowered` for one plan node — tracing + lowering
+    only, NO backend compile (`occupancy.cost_for`'s contract; the
+    CompileGuard proof in tests/test_preflight.py). Uses the SAME
+    builders (and their lru caches) the runtime search uses, so a
+    later real check over this shape stays warm."""
+    import jax
+
+    from ..ops.aot import _wgl_consts_spec
+
+    n_pad = len(enc.inv)
+    S, O = enc.table.shape
+    if kern == "wgl32":
+        from ..ops.wgl32 import compiled_search32
+        init_fn, chunk_jit = compiled_search32(
+            n_pad=n_pad, ic_pad=ic_eff, S=S, O=O, K=K, H=H, B=B,
+            chunk=chunk, probes=probes, W=W_eff, accel=accel,
+            depth=depth, pack=pack)
+    else:
+        from ..ops.wgln import compiled_searchN
+        init_fn, chunk_jit = compiled_searchN(
+            n_pad=n_pad, ic_pad=ic_eff, S=S, O=O, K=K, H=H, B=B,
+            chunk=chunk, probes=probes, W=W_eff, L=L, accel=accel,
+            pack=pack)
+    consts_spec = _wgl_consts_spec(n_pad, ic_eff, S, O)
+    carry_spec = jax.eval_shape(init_fn, 0)
+    return chunk_jit.lower(consts_spec, carry_spec)
+
+
+def plan_wgl(model=None, history=None, *, enc=None,
+             platform: Optional[str] = None,
+             frontier: Optional[int] = None,
+             adaptive: Optional[bool] = None,
+             shape_bucket: Optional[dict] = None,
+             lower: bool = False,
+             lanes: int = 1,
+             compile_budget: Optional[int] = None) -> dict:
+    """Enumerate the exact plan `ops/wgl.check` would run for this
+    history — kernel variant, ladder buckets, capacities, pack bit —
+    without executing any of it, and attach the admission rules that
+    fire. With `lower=True` each bucket additionally carries the
+    compiler's own per-round cost analysis (`cost_for`, cached under
+    the runtime's keys; requires a real `enc` or (model, history) to
+    encode one); `lower="warm"` attaches cost ONLY from that shared
+    cache — no encode, no tracing — for callers (bench) that just ran
+    the check whose kernels populated it. `lanes` > 1 bills each
+    bucket for a vmapped lockstep batch (lanes-per-device x the lane
+    bytes). Returns the plan report dict (module docstring)."""
+    from ..ops import wgl as wgl_mod
+
+    plat = _safe_platform(platform)
+    accel = plat not in (None, "cpu")
+    rules: list = []
+
+    # -- shapes ---------------------------------------------------------
+    if enc is None and lower is True and model is not None \
+            and history is not None:
+        from ..ops.encode import EncodingUnsupported, encode
+        try:
+            enc = encode(model, history)
+        except EncodingUnsupported as e:
+            rules.append(_rule(
+                "P004", f"encoding unsupported: {e}",
+                suggestion="route to the host oracle (wgl_ref)"))
+            verdict, suggestion = _verdict(rules)
+            return {"schema": 1, "kind": "wgl", "platform": plat,
+                    "engine": "oracle", "shapes": {},
+                    "encoding": e.to_dict(), "plan": [], "rules": rules,
+                    "verdict": verdict, "suggestion": suggestion}
+    if enc is not None:
+        shapes = _shapes_from_enc(enc)
+    elif history is not None:
+        shapes = _probe_shapes(history)
+    else:
+        raise ValueError("plan_wgl needs enc or history")
+    shapes.update(_depth_stats(shapes))
+    if shape_bucket:
+        # the bucket maxima are the compiled shape — a representative
+        # enc smaller than the bucket must not shrink the byte model
+        shapes["n_pad"] = max(shapes["n_pad"],
+                              int(shape_bucket.get("n_pad", 0)))
+        shapes["ic_pad"] = max(shapes["ic_pad"],
+                               int(shape_bucket.get("ic_pad", 0)))
+    n, ni = shapes["n_ok"], shapes["n_info"]
+    w_raw, W = shapes["W_raw"], shapes["W"]
+    ic_pad = shapes["ic_pad"]
+
+    # -- predictive encoding limits (P004) — encode.py's own caps ------
+    from ..ops.encode import MAX_INFO, MAX_WINDOW
+    if W > MAX_WINDOW:
+        rules.append(_rule(
+            "P004", f"window {w_raw} would exceed the encode cap "
+                    f"{MAX_WINDOW} (rule=window)",
+            suggestion="route to the host oracle (wgl_ref)"))
+    if ni > MAX_INFO:
+        rules.append(_rule(
+            "P004", f"{ni} crashed ops would exceed the encode cap "
+                    f"{MAX_INFO} (rule=info-cap)",
+            suggestion="route to the host oracle (wgl_ref)"))
+    if any(r["rule"] == "P004" for r in rules):
+        verdict, suggestion = _verdict(rules)
+        shapes.pop("inv", None), shapes.pop("ret", None)
+        return {"schema": 1, "kind": "wgl", "platform": plat,
+                "engine": "oracle", "shapes": shapes, "plan": [],
+                "rules": rules, "verdict": verdict,
+                "suggestion": suggestion}
+
+    # -- the SAME derivation wgl.check executes (single source of
+    #    truth: ops/wgl.derive_plan — the planner cannot drift from
+    #    the kernel it models) -----------------------------------------
+    plan_p = wgl_mod.derive_plan(
+        window_raw=w_raw, W=W, ic_pad=ic_pad, n=n, n_info=ni,
+        accel=accel, frontier=frontier, adaptive=adaptive,
+        shape_bucket=shape_bucket)
+    kern = plan_p["kern"]
+    H, B = plan_p["H"], plan_p["B"]
+    W_eff, ic_eff, L = plan_p["W_eff"], plan_p["ic_eff"], plan_p["L"]
+    chunk, depth, probes = (plan_p["chunk"], plan_p["depth"],
+                            plan_p["probes"])
+    use_adapt, buckets = plan_p["use_adapt"], plan_p["buckets"]
+    compact = depth > 1  # wgl32's compact-before-expand default
+    if enc is not None:
+        pack = (bool(shape_bucket["pack"])
+                if shape_bucket and "pack" in shape_bucket
+                else wgl_mod._packable(enc))
+        pack_estimated = False
+    else:
+        # probe mode: times + a typical table fit; labeled an estimate
+        from ..ops.wgl32 import PACK_MAX
+        pack = shapes["times_max"] < PACK_MAX
+        pack_estimated = True
+
+    # -- plan nodes -----------------------------------------------------
+    budget = device_memory_budget(plat)
+    nodes: list = []
+    for k in buckets:
+        hbm = _node_bytes(k, W_eff, ic_eff,
+                          1 if kern == "wgl32" else L, H, B,
+                          shapes["n_pad"])
+        if lanes > 1:
+            # a vmapped lockstep batch keeps every lane's buffers
+            # resident at once (parallel/batched.encode_batch): the
+            # per-device bill is lanes-per-device x the lane bytes
+            hbm *= lanes
+        node = {"kernel": kern, "K": k, "H": H, "B": B,
+                "W_eff": W_eff, "ic_eff": ic_eff, "chunk": chunk,
+                "depth": depth, "pack": pack, "compact": compact,
+                "succ_rows": k * (W_eff + ic_eff),
+                "hbm_bytes": hbm}
+        if lanes > 1:
+            node["lanes"] = lanes
+        if lower:
+            from .. import occupancy as occ_mod
+            # the SAME cache key ops/wgl.py uses at result time (the
+            # bucket-padded n_pad IS len(enc.inv) there), so the
+            # executed check's roofline and this prediction can't drift
+            key = (kern, shapes["n_pad"], ic_eff, W_eff, k, chunk,
+                   depth, accel, pack)
+            if lower is True and enc is not None:
+                node["cost"] = occ_mod.cost_for(
+                    key, lambda k_=k: _lower_wgl_node(
+                        enc, kern, K=k_, H=H, B=B, chunk=chunk,
+                        probes=probes, W_eff=W_eff, ic_eff=ic_eff,
+                        L=L, accel=accel, depth=depth, pack=pack))
+            else:
+                # lower="warm" (with or without an enc): cost only
+                # when the executed check already lowered this exact
+                # kernel — no encode, no tracing, just the shared
+                # cache. lower=True without an enc lands here too.
+                cost = occ_mod.cost_cached(key)
+                if cost is not None:
+                    node["cost"] = cost
+        nodes.append(node)
+    peak = max(nd["hbm_bytes"] for nd in nodes)
+    if peak > budget:
+        rules.append(_rule(
+            "P001", f"plan peak {peak / 1e9:.2f} GB exceeds the "
+                    f"{budget / 1e9:.2f} GB device budget",
+            suggestion="shard the history (parallel/batched) or cap "
+                       "the frontier"))
+
+    # -- P003: cold executables vs the caller's compile budget ----------
+    cbudget = _compile_budget(compile_budget)
+    if cbudget is not None and len(nodes) > cbudget:
+        rules.append(_rule(
+            "P003", f"{len(nodes)} cold executables exceed the "
+                    f"compile budget {cbudget}",
+            suggestion="warm the ladder first: "
+                       "aot.precompile_wgl_ladder(...)"))
+
+    # -- P005: predicted fill at the starting bucket --------------------
+    wavefront = max(shapes.get("mean_depth") or 0.0, 1.0)
+    k_start = buckets[0]
+    fill_pred = round(min(1.0, wavefront / max(k_start, 1)), 4)
+    from ..occupancy import TARGET_FILL
+    if fill_pred < TARGET_FILL:
+        why = (f"predicted fill {fill_pred} at start bucket "
+               f"K={k_start} (wavefront ~{wavefront}) under target "
+               f"{TARGET_FILL}")
+        sugg = ("enable the adaptive ladder (ops/adapt.py)"
+                if not use_adapt else
+                "near-serial shape: the jitlin probe route "
+                "(ops/route.check_routed) decides it cheaper")
+        if shape_bucket and shape_bucket.get("w_eff", 0) > 2 * W:
+            sugg = ("shared bucket pads W to "
+                    f"{shape_bucket['w_eff']} vs raw {w_raw}: split "
+                    "the bucket")
+        rules.append(_rule("P005", why, suggestion=sugg))
+
+    verdict, suggestion = _verdict(rules)
+    shapes.pop("inv", None), shapes.pop("ret", None)
+    return {
+        "schema": 1, "kind": "wgl", "platform": plat,
+        "engine": "device", "shapes": shapes, "kernel": kern,
+        "pack": pack, "pack_estimated": pack_estimated,
+        "adaptive": bool(use_adapt), "buckets": buckets,
+        "plan": nodes,
+        "hbm": {"peak_bytes": peak, "budget_bytes": budget},
+        "compiles": {"cold_max": len(nodes), "budget": cbudget},
+        "fill": {"predicted": fill_pred, "target": TARGET_FILL,
+                 "start_K": k_start},
+        "rules": rules, "verdict": verdict, "suggestion": suggestion,
+    }
+
+
+# ---------------------------------------------------------------------------
+# Elle: route + closure capacity plan
+# ---------------------------------------------------------------------------
+
+def plan_elle(*, n_txns: int, edges: Optional[int] = None,
+              rw_edges: Optional[int] = None, backend: str = "auto",
+              platform: Optional[str] = None,
+              lower: bool = False) -> dict:
+    """Enumerate the cycle-engine plan an Elle check over `n_txns`
+    graph nodes would take: the `ops/route.elle_cycle_route` decision
+    (when `backend="auto"`), the kernel the shape selector would pick
+    (trim on cpu-XLA, bf16-vs-packed by cost on an accelerator), the
+    closure's padded shapes and peak live bytes, and the capacity
+    rules that fire. Edge counts default to the append-builder's
+    typical density (~4 edges and ~1 rw edge per txn), labeled as
+    estimates. Pure host arithmetic: no graph build, no backend
+    compile, no device byte."""
+    import importlib.util
+    import math
+
+    from ..ops.route import elle_cycle_route
+
+    plat = _safe_platform(platform)
+    accel = plat not in (None, "cpu")
+    n = int(n_txns)
+    e = int(edges) if edges is not None else 4 * n
+    rw = int(rw_edges) if rw_edges is not None else n
+    estimated = edges is None or rw_edges is None
+    rules: list = []
+
+    # lazy: PACKED_MAX_N / DEFAULT_MAX_N are the kernels' own caps
+    from ..elle import tpu as elle_tpu
+    packed_cap = elle_tpu.PACKED_MAX_N
+    bf16_cap = elle_tpu.DEFAULT_MAX_N
+
+    engine = backend
+    route_reason = None
+    if backend == "auto":
+        device_ok = importlib.util.find_spec("jax") is not None
+        engine, route_reason = elle_cycle_route(
+            n=n, e=e, rw_edges=rw, accel=accel, device_ok=device_ok,
+            packed_cap=packed_cap)
+
+    if engine in ("host", "host-fallback"):
+        verdict, suggestion = _verdict(rules)
+        return {"schema": 1, "kind": "elle", "platform": plat,
+                "engine": "host", "backend": backend,
+                "route": {"engine": "host", "reason": route_reason},
+                "shapes": {"n": n, "e": e, "rw": rw,
+                           "estimated": estimated},
+                "plan": [{"kernel": "host-tarjan",
+                          "host_work": rw * max(e, 1)}],
+                "rules": rules, "verdict": verdict,
+                "suggestion": suggestion}
+
+    # -- kernel selection (mirror device_cycle_search) ------------------
+    forced = backend in ("tpu", "packed", "trim")
+    if forced:
+        kernel = "bf16" if backend == "tpu" else backend
+        sel = {"why": f"forced {kernel}"}
+    elif accel:
+        if lower:
+            kernel, sel = elle_tpu._squaring_select(n)
+        elif n > bf16_cap:
+            kernel, sel = "packed", {
+                "why": f"n {n} > bf16 cap {bf16_cap}"}
+        else:
+            kernel, sel = "bf16", {"why": "bf16 under cap (static)"}
+    else:
+        kernel, sel = "trim", {
+            "why": "cpu backend: dense squaring is "
+                   "compute-prohibitive; trim kernel"}
+
+    # -- padded shapes + capacity + bytes -------------------------------
+    n_sub = len(elle_tpu.SUBSETS)
+    n_pad = elle_tpu._round_up(
+        max(elle_tpu._bucket(max(n, 2)), n + 2), 128)
+    iters = max(1, math.ceil(math.log2(max(n_pad, 2))))
+    cap = bf16_cap if kernel == "bf16" else packed_cap
+    if n > cap:
+        rules.append(_rule(
+            "P002", f"n {n} over the {kernel} closure capacity {cap}",
+            suggestion="host Tarjan/BFS, or shard the bitset words "
+                       "across the mesh (ROADMAP item 3)"))
+    if kernel == "bf16":
+        cell = 2.0            # bf16
+    elif kernel == "packed":
+        cell = 1.0 / 8.0      # one bit per pair, uint32 words
+    else:
+        cell = 0.0            # trim never materializes N^2
+    if cell:
+        hbm = int(CLOSURE_LIVE_FACTOR * n_sub * n_pad * n_pad * cell)
+    else:
+        # trim: padded neighbor gathers, O((E + N) x S)
+        n_pad_t = elle_tpu._round_up(elle_tpu._bucket(max(n, 2)), 128)
+        d_est = elle_tpu._bucket(max(4, (2 * e) // max(n, 1)))
+        hbm = int(3 * n_pad_t * d_est * n_sub * 4)
+    budget = device_memory_budget(plat)
+    if hbm > budget:
+        if backend == "auto":
+            # the router said device but the cost side disagrees —
+            # auto still holds the host engine in hand, so degrade
+            # rather than reject (the route downstream stays free to
+            # fall back; an explicit device request below does not)
+            rules.append(_rule(
+                "P006", "route picked the device closure but its "
+                        f"cost model blows HBM ({hbm / 1e9:.2f} GB): "
+                        "trust the cost side",
+                suggestion="host Tarjan/BFS"))
+        else:
+            # backend= explicitly pins the device plane ("device"
+            # included: device_cycle_search runs whatever kernel the
+            # shape selector picks) — an over-budget closure would
+            # OOM, so reject it statically
+            rules.append(_rule(
+                "P001", f"{kernel} closure peak {hbm / 1e9:.2f} GB "
+                        f"exceeds the {budget / 1e9:.2f} GB device "
+                        "budget",
+                suggestion="host Tarjan/BFS, or shard/chunk the "
+                           "closure through HBM (ROADMAP item 3)"))
+
+    verdict, suggestion = _verdict(rules)
+    return {
+        "schema": 1, "kind": "elle", "platform": plat,
+        "engine": "device", "backend": backend,
+        "route": {"engine": "device", "reason": route_reason},
+        "shapes": {"n": n, "e": e, "rw": rw, "n_pad": n_pad,
+                   "iters": iters, "estimated": estimated},
+        "kernel": kernel, "select": sel,
+        "plan": [{"kernel": kernel, "n_pad": n_pad, "iters": iters,
+                  "hbm_bytes": hbm, "capacity": cap}],
+        "hbm": {"peak_bytes": hbm, "budget_bytes": budget},
+        "rules": rules, "verdict": verdict, "suggestion": suggestion,
+    }
+
+
+def elle_closure_feasible(n_txns: int,
+                          platform: Optional[str] = None) -> tuple:
+    """(feasible?, report) for a dense device closure over `n_txns` —
+    the feasibility oracle the 100k-Elle sharding plan queries before
+    choosing whole-closure vs column-blocked execution."""
+    rep = plan_elle(n_txns=n_txns, backend="device",
+                    platform=platform)
+    return rep["verdict"] != "infeasible", rep
+
+
+# ---------------------------------------------------------------------------
+# recording + gates
+# ---------------------------------------------------------------------------
+
+_LOCK = threading.Lock()
+_RECENT: deque = deque(maxlen=32)
+_COUNTS: dict = {}
+
+
+def compact(report: dict) -> dict:
+    """The bounded projection of a plan report that rides gate
+    results, ledger records, and /status.json (the full plan nodes
+    stay with the CLI/report path)."""
+    out = {k: report.get(k) for k in
+           ("schema", "kind", "platform", "engine", "kernel",
+            "buckets", "verdict", "suggestion")
+           if report.get(k) is not None}
+    out["rules"] = [{"rule": r["rule"], "name": r["name"],
+                     "severity": r["severity"],
+                     "message": r["message"]}
+                    for r in report.get("rules", [])]
+    hbm = report.get("hbm") or {}
+    if hbm.get("peak_bytes") is not None:
+        out["hbm_peak_bytes"] = hbm["peak_bytes"]
+        out["hbm_budget_bytes"] = hbm.get("budget_bytes")
+    return out
+
+
+def _register(report: dict, where: str,
+              ledger_name: Optional[str] = None) -> None:
+    """Record one preflight verdict into the ambient observability
+    planes: the in-process recent window (/status.json's `preflight`
+    block), the `preflight` metrics series, and — when `ledger_name`
+    names a top-level analysis — a `kind="preflight"` ledger record.
+    Never raises; accounting must not void an admission decision."""
+    entry = {"where": where, "kind": report.get("kind"),
+             "verdict": report.get("verdict"),
+             "engine": report.get("engine"),
+             "rules": [r["rule"] for r in report.get("rules", [])],
+             "t": round(time.time(), 3)}
+    with _LOCK:
+        _RECENT.append(entry)
+        _COUNTS[entry["verdict"]] = _COUNTS.get(entry["verdict"],
+                                                0) + 1
+    try:
+        from .. import metrics as metrics_mod
+        mx = metrics_mod.get_default()
+        if mx.enabled:
+            mx.series("preflight",
+                      "admission-control preflight verdicts"
+                      ).append(dict(entry))
+            mx.counter("preflight_checks_total",
+                       "preflight admission decisions").inc(
+                where=where, verdict=str(entry["verdict"]))
+    except Exception:  # noqa: BLE001
+        pass
+    if ledger_name:
+        try:
+            from .. import ledger as ledger_mod
+            ledger_mod.record({
+                "kind": "preflight", "name": ledger_name,
+                "verdict": str(report.get("verdict")),
+                "engine": report.get("engine"),
+                "where": where,
+                "rules": entry["rules"],
+                "preflight": compact(report)})
+        except Exception:  # noqa: BLE001
+            pass
+
+
+def snapshot() -> dict:
+    """The `/status.json` `preflight` block: how many admission
+    decisions this process made, their verdict mix, and a bounded
+    recent window."""
+    with _LOCK:
+        recent = list(_RECENT)[-8:]
+        counts = dict(_COUNTS)
+    return {"checked": sum(counts.values()), "verdicts": counts,
+            "recent": recent}
+
+
+def _reject(report: dict, op_count: Optional[int] = None) -> dict:
+    out = {"valid?": "unknown", "cause": "preflight",
+           "preflight": compact(report),
+           "rules": [r["rule"] for r in report.get("rules", [])
+                     if r["severity"] == "infeasible"]}
+    if op_count is not None:
+        out["op_count"] = op_count
+    return out
+
+
+def gate_wgl(model, history, *, where: str, enc=None,
+             platform: Optional[str] = None,
+             ledger_name: Optional[str] = None) -> Optional[dict]:
+    """The WGL admission gate (history_lint.gate's sibling): None when
+    the plan is admissible (feasible or degrade), else a checker-style
+    `{"valid?": "unknown", "cause": "preflight", ...}` fast-fail.
+    Cheap — a shape probe plus integer plan math, no encode table, no
+    jax."""
+    try:
+        rep = plan_wgl(model, history, enc=enc, platform=platform)
+    except Exception:  # noqa: BLE001 — an unplannable history is the
+        return None    # search engines' problem, not the gate's
+    _register(rep, where, ledger_name=ledger_name)
+    if rep["verdict"] != "infeasible":
+        return None
+    return _reject(rep, op_count=len(history))
+
+
+def gate_elle(n_txns: int, *, backend: str, where: str,
+              edges: Optional[int] = None,
+              rw_edges: Optional[int] = None,
+              platform: Optional[str] = None,
+              ledger_name: Optional[str] = None) -> Optional[dict]:
+    """The Elle admission gate: rejects a device-backend cycle search
+    whose closure can never fit (P001/P002) BEFORE any graph build,
+    backend compile, or device execution. None when admissible."""
+    try:
+        rep = plan_elle(n_txns=n_txns, edges=edges, rw_edges=rw_edges,
+                        backend=backend, platform=platform)
+    except Exception:  # noqa: BLE001
+        return None
+    _register(rep, where, ledger_name=ledger_name)
+    if rep["verdict"] != "infeasible":
+        return None
+    return _reject(rep)
+
+
+def gate_fanout(model, histories, *, encs=None, where: str,
+                platform: Optional[str] = None,
+                mode: str = "group",
+                n_devices: int = 1,
+                on_infeasible: str = "reject") -> Optional[dict]:
+    """Admission gate for the parallel fan-out paths: plan the SHARED
+    shape bucket each kernel branch will actually compile (the same
+    `shared_shape_bucket` maxima `parallel/batched.py` pads every
+    lane to — keys split at window_raw 32 exactly like the runtime),
+    so the admitted plan is the kernel that runs.
+
+    mode="group" (the streamed path): the narrow and wide groups
+    compile SEPARATE kernels and each lane runs alone on a device, so
+    an infeasible bucket rejects only within its own group — and only
+    the keys whose OWN plan is infeasible, with the survivors' bucket
+    re-planned (the runtime re-buckets without rejected lanes); the
+    whole group rejects only in the mixed-maxima edge where every key
+    fits alone but the combined maxima do not.
+    mode="batch" (the lockstep vmap path): `encode_batch` pads EVERY
+    lane to the batch maxima and one kernel keeps ceil(lanes /
+    n_devices) lanes' buffers resident per device — the plan is that
+    single batch kernel, and an infeasible plan rejects every key.
+    `on_infeasible="degrade"` (batch mode) records the decision as a
+    degrade instead of an infeasible rejection, for callers that
+    answer an infeasible batch by streaming per-key kernels.
+
+    Returns `{key_index: rejection}` for the rejected keys (indices
+    into the encs/histories as passed), or None when admissible.
+    Without encs there is no shared bucket yet: each key is probed
+    and gated on its own plan."""
+    rejected: dict = {}
+    try:
+        if encs:
+            from ..parallel.batched import shared_shape_bucket
+            if mode == "batch":
+                bucket = shared_shape_bucket(list(encs))
+                # the rep must take the kernel branch encode_batch
+                # takes (wgln iff ANY lane is wide); the bucket's
+                # n_pad/ic_pad maxima override its smaller dims
+                rep_enc = max(encs,
+                              key=lambda e: (e.window_raw > 32,
+                                             len(e.inv)))
+                per_dev = -(-len(encs) // max(n_devices, 1))
+                rep = plan_wgl(enc=rep_enc, platform=platform,
+                               shape_bucket=bucket, lanes=per_dev)
+                if rep["verdict"] == "infeasible" \
+                        and on_infeasible == "degrade":
+                    # the caller's declared policy: an infeasible
+                    # lockstep batch is served by per-key kernels
+                    # instead — the admission decision actually made
+                    # for this request is a degrade, not a rejection
+                    _register(dict(rep, verdict="degrade",
+                                   suggestion="stream per-key kernels "
+                                              "(check_streamed)"),
+                              where)
+                else:
+                    _register(rep, where)
+                if rep["verdict"] == "infeasible":
+                    rej = _reject(rep)
+                    rejected = {i: rej for i in range(len(encs))}
+                return rejected or None
+            def _bucket_plan(idxs):
+                grp = [encs[i] for i in idxs]
+                bucket = shared_shape_bucket(grp)
+                # the representative carries the bucket's n_pad (the
+                # byte model reads it off the enc); W_eff/ic_eff/
+                # n_cap/pack come from the bucket dict itself
+                rep_enc = max(grp, key=lambda e: (len(e.inv),
+                                                  e.window_raw))
+                rep = plan_wgl(enc=rep_enc, platform=platform,
+                               shape_bucket=bucket)
+                _register(rep, where)
+                return rep
+
+            idx_groups = (
+                [i for i, e in enumerate(encs) if e.window_raw <= 32],
+                [i for i, e in enumerate(encs) if e.window_raw > 32])
+            for idxs in idx_groups:
+                if not idxs:
+                    continue
+                rep = _bucket_plan(idxs)
+                if rep["verdict"] != "infeasible":
+                    continue
+                # the shared bucket is blown — but the bucket is the
+                # group MAXIMA, so first reject only the keys whose
+                # OWN single-key plan is infeasible, then re-try the
+                # survivors' re-computed bucket (the runtime streams
+                # re-bucket without the rejected lanes)
+                survivors = []
+                for i in idxs:
+                    own = plan_wgl(enc=encs[i], platform=platform)
+                    if own["verdict"] == "infeasible":
+                        # this plan IS the decision delivered to the
+                        # caller — it must land in the series/status
+                        # like every other admission verdict
+                        _register(own, where)
+                        rejected[i] = _reject(own)
+                    else:
+                        survivors.append(i)
+                if not survivors:
+                    continue
+                if len(survivors) == len(idxs):
+                    # mixed-maxima edge: every key fits alone, the
+                    # combined maxima do not — the group compiles ONE
+                    # kernel, so it rejects as a group
+                    rej = _reject(rep)
+                    for i in survivors:
+                        rejected[i] = rej
+                    continue
+                rep2 = _bucket_plan(survivors)
+                if rep2["verdict"] == "infeasible":
+                    rej = _reject(rep2)
+                    for i in survivors:
+                        rejected[i] = rej
+        elif histories:
+            # no encodings yet: no shared bucket exists either, so
+            # each key runs (and is gated) on its own probe plan — a
+            # feasible key must not lose its verdict to an oversized
+            # neighbor
+            for i, h in enumerate(histories):
+                rep = plan_wgl(model, h, platform=platform)
+                _register(rep, where)
+                if rep["verdict"] == "infeasible":
+                    rejected[i] = _reject(rep)
+    except Exception:  # noqa: BLE001 — an unplannable batch is the
+        return None    # engines' problem, not the gate's
+    return rejected or None
+
+
+# ---------------------------------------------------------------------------
+# CLI (`python -m jepsen_tpu preflight`)
+# ---------------------------------------------------------------------------
+
+CLI_CONFIGS = ("headline", "elle_append_8k", "dense_100k")
+
+
+def _cli_headline(n_ops: int, execute: bool) -> dict:
+    from .. import synth
+    from ..models import cas_register
+
+    model = cas_register()
+    hist = synth.cas_register_history(n_ops, n_procs=5, seed=42,
+                                      crash_p=0.002)
+    rep = plan_wgl(model, hist, lower=True)
+    _register(rep, "cli.headline", ledger_name="preflight-headline")
+    out = {"report": rep}
+    if execute:
+        from ..ops import wgl
+        from .. import metrics as metrics_mod
+        with metrics_mod.use(metrics_mod.Registry()):
+            res = wgl.check(model, hist)
+        out["executed"] = _parity(rep, res)
+    return out
+
+
+def _cli_elle(n_txns: int, execute: bool) -> dict:
+    from .. import synth
+    from ..elle import build as build_mod
+    from ..elle import tpu as elle_tpu
+
+    hist = synth.list_append_history(n_txns, n_procs=5, seed=7)
+    oks = [op for op in hist
+           if op.is_ok and op.f in ("txn", None) and op.value]
+    infos = [op for op in hist
+             if op.is_info and op.f in ("txn", None) and op.value]
+    bt = build_mod.build_append(hist, oks, infos,
+                                additional_graphs=("realtime",))
+    gt = bt.tensors
+    edges = np.asarray(gt.edges)
+    from ..elle.graph import RW
+    rw = int(np.sum(edges[:, 2] == RW)) if len(edges) else 0
+    rep = plan_elle(n_txns=int(np.asarray(gt.nodes).shape[0]),
+                    edges=int(len(edges)), rw_edges=rw,
+                    backend="auto", lower=True)
+    _register(rep, "cli.elle_append_8k",
+              ledger_name="preflight-elle-append-8k")
+    out = {"report": rep}
+    if execute:
+        res = elle_tpu.standard_cycle_search(gt, backend="auto")
+        out["executed"] = {
+            "engine": res.get("engine"),
+            "kernel": (res.get("util") or {}).get("kernel"),
+            "engine_match": _engines_match(rep, res),
+        }
+    return out
+
+
+def _cli_dense_100k() -> dict:
+    """The synthetic oversized request: a 100k-txn dense closure,
+    rejected statically — zero graph build, zero backend compiles,
+    zero device execution (the smoke proves it under a CompileGuard
+    zero-compile budget)."""
+    rep = plan_elle(n_txns=100_000, backend="packed")
+    _register(rep, "cli.dense_100k", ledger_name="preflight-dense-100k")
+    return {"report": rep}
+
+
+def _engines_match(rep: dict, res: dict) -> bool:
+    planned = rep.get("engine")
+    ran = res.get("engine")
+    if planned == "host":
+        return ran in ("host", "host-fallback")
+    kernel = (res.get("util") or {}).get("kernel")
+    return ran in ("device", "tpu", "trim", "packed") \
+        and (rep.get("kernel") in (None, kernel))
+
+
+def _parity(rep: dict, res: dict) -> dict:
+    """Planned-vs-executed comparison for the WGL path: did the
+    executed check stay inside the planned buckets, on the planned
+    kernel/variant, and how far is the measured per-round byte stream
+    from the plan's prediction for the bucket it ended on."""
+    util = res.get("util") or {}
+    adapt = util.get("adapt") or {}
+    visited = adapt.get("buckets_visited") or [res.get("K")]
+    planned = rep.get("buckets") or []
+    occ = res.get("occupancy") or {}
+    measured = ((occ.get("roofline") or {}).get("bytes_per_round"))
+    pred = None
+    for node in rep.get("plan", []):
+        if node.get("K") == res.get("K") and node.get("cost"):
+            pred = node["cost"].get("bytes_accessed")
+    out = {
+        "verdict": res.get("valid?"),
+        "kernel_match": (occ.get("kernel") or
+                         ("wgl32" if res.get("W", 33) <= 32
+                          else "wgln")) == rep.get("kernel"),
+        "buckets_planned": planned,
+        "buckets_visited": visited,
+        "buckets_subset": all(k in planned for k in visited if k),
+        "pack_match": (util.get("packed_tables") is None
+                       or bool(util.get("packed_tables"))
+                       == bool(rep.get("pack"))),
+        "bytes_per_round_predicted": pred,
+        "bytes_per_round_measured": measured,
+    }
+    if pred and measured:
+        out["drift_x"] = round(measured / pred, 4)
+    return out
+
+
+def cli_main(options: dict) -> int:
+    """`python -m jepsen_tpu preflight` — emit plan reports for the
+    named config(s); `--execute` additionally runs the check and
+    prints the planned-vs-executed parity block."""
+    import json as json_mod
+
+    which = options.get("config") or "all"
+    execute = bool(options.get("execute"))
+    as_json = bool(options.get("json"))
+    names = list(CLI_CONFIGS) if which == "all" else [which]
+    out: dict = {}
+    for name in names:
+        if name == "headline":
+            out[name] = _cli_headline(
+                int(options.get("ops") or 10_000), execute)
+        elif name == "elle_append_8k":
+            out[name] = _cli_elle(
+                int(options.get("txns") or 4_000), execute)
+        elif name == "dense_100k":
+            out[name] = _cli_dense_100k()
+        else:
+            print(f"unknown preflight config {name!r} "
+                  f"(known: {', '.join(CLI_CONFIGS)} | all)")
+            return 254
+    if as_json:
+        print(json_mod.dumps(out, indent=2, default=str))
+    else:
+        for name, blk in out.items():
+            rep = blk["report"]
+            rules = ", ".join(r["rule"] for r in rep["rules"]) or "-"
+            line = (f"{name:18s} verdict={rep['verdict']:10s} "
+                    f"engine={rep.get('engine')} "
+                    f"kernel={rep.get('kernel', '-')} "
+                    f"buckets={rep.get('buckets', '-')} "
+                    f"hbm={((rep.get('hbm') or {}).get('peak_bytes') or 0) / 1e9:.3f}GB "
+                    f"rules=[{rules}]")
+            print(line)
+            if rep.get("suggestion"):
+                print(f"{'':18s} -> {rep['suggestion']}")
+            if "executed" in blk:
+                print(f"{'':18s} executed: {blk['executed']}")
+    return 0

@@ -290,6 +290,31 @@ class Linearizable(Checker):
             return {"valid?": UNKNOWN, "algorithm": algo,
                     "cause": "queue-poly requires a FIFOQueue model, "
                              f"got {type(self.model).__name__}"}
+        pf_bad = None
+        if algo in ("tpu-wgl", "competition"):
+            # Admission preflight (analysis/preflight): enumerate the
+            # device plan statically and reject a request the device
+            # engine could only discover infeasible by OOMing —
+            # before any encode table, backend compile, or device
+            # byte. Sits AFTER the queue fast-path so a 100k-op FIFO
+            # history decided by the polynomial checker never pays the
+            # probe. Feasible/degrade plans pass through untouched
+            # (the verdict + plan land in the preflight series and,
+            # for top-level analyses, a kind="preflight" ledger
+            # record). Only "tpu-wgl" (device-only) rejects outright:
+            # competition races device vs host, and an infeasible
+            # DEVICE plan merely scratches the device racer — the
+            # host oracle (no HBM budget) still decides the history.
+            from ..analysis import preflight
+            with tracer.span("preflight", attrs={"ops": len(h)}):
+                pf_bad = preflight.gate_wgl(
+                    self.model, h, where="checker.linearizable",
+                    ledger_name=((test or {}).get("name")
+                                 if "history_key" not in (opts or {})
+                                 else None))
+            if pf_bad is not None and algo != "competition":
+                pf_bad["algorithm"] = algo
+                return pf_bad
         if algo == "wgl":
             res = wgl_ref.check(self.model, h, time_limit=self.time_limit)
         elif algo == "linear":
@@ -302,8 +327,15 @@ class Linearizable(Checker):
                 self.model, h, time_limit=self.time_limit,
                 tracer=tracer)
         elif algo == "competition":
-            res = _race_competition(self.model, h, self.time_limit,
-                                    tracer=tracer)
+            if pf_bad is not None:
+                # device racer statically scratched: host-only heat
+                res = wgl_ref.check(self.model, h,
+                                    time_limit=self.time_limit)
+                res["device_cause"] = "preflight"
+                res["preflight"] = pf_bad.get("preflight")
+            else:
+                res = _race_competition(self.model, h, self.time_limit,
+                                        tracer=tracer)
         else:
             raise ValueError(f"unknown linearizability algorithm {algo!r}")
         # Truncate expensive diagnostics (checker.clj:213-216).

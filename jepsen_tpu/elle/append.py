@@ -93,6 +93,23 @@ def check(history: History, anomalies: Iterable[str] = DEFAULT_ANOMALIES,
     infos = [op for op in completed if op.is_info]
     failed = [op for op in history if op.is_fail and op.value]
 
+    # Admission preflight (analysis/preflight): a dense-closure
+    # request whose graph can never fit the device (P001/P002 —
+    # e.g. a forced cycle_backend="packed" at 100k txns) is rejected
+    # HERE, before the graph build, any backend compile, or any
+    # device byte — the static twin of the capacity checks the
+    # kernels only discover by refusing at runtime.
+    if cycle_backend != "host":
+        from ..analysis import preflight
+        bad_pf = preflight.gate_elle(len(completed),
+                                     backend=cycle_backend,
+                                     where="elle.append")
+        if bad_pf is not None:
+            return {"valid?": "unknown",
+                    "anomaly-types": ["preflight"],
+                    "anomalies": {"preflight": [bad_pf["preflight"]]},
+                    "not": [], "preflight": bad_pf["preflight"]}
+
     # -- 1. tensorized construction (elle/build.py): writer index,
     #    version orders, and the ww/wr/rw(+rt/proc) edge columns come
     #    out of one vectorized pass; dirty histories fall back to the

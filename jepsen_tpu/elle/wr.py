@@ -73,6 +73,20 @@ def check(history: History, anomalies: Iterable[str] = DEFAULT_ANOMALIES,
              if op.is_info and op.f in ("txn", None) and op.value]
     failed = [op for op in history if op.is_fail and op.value]
 
+    # Admission preflight (analysis/preflight): reject a device
+    # closure request over kernel capacity / HBM budget (P001/P002)
+    # before the graph build — see elle/append.py.
+    if cycle_backend != "host":
+        from ..analysis import preflight
+        bad_pf = preflight.gate_elle(len(oks) + len(infos),
+                                     backend=cycle_backend,
+                                     where="elle.wr")
+        if bad_pf is not None:
+            return {"valid?": "unknown",
+                    "anomaly-types": ["preflight"],
+                    "anomalies": {"preflight": [bad_pf["preflight"]]},
+                    "not": [], "preflight": bad_pf["preflight"]}
+
     # tensorized construction (elle/build.py): writer index, version
     # evidence, and the edge columns in one vectorized pass
     from . import build as build_mod

@@ -139,6 +139,17 @@ def cost_for(key: tuple, lower_fn) -> Optional[dict]:
     so these are per-ROUND numbers and an upper bound on traffic."""
     if key in _COST_CACHE:
         return _COST_CACHE[key]
+    return _cost_fill(key, lower_fn)
+
+
+def cost_cached(key: tuple) -> Optional[dict]:
+    """The cached per-round cost for `key`, or None when the kernel
+    was never lowered in this process — lets a probe-only preflight
+    plan reuse the executed check's numbers without re-encoding."""
+    return _COST_CACHE.get(key)
+
+
+def _cost_fill(key: tuple, lower_fn) -> Optional[dict]:
     out: Optional[dict] = None
     try:
         ca = lower_fn().cost_analysis()

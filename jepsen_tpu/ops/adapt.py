@@ -276,7 +276,8 @@ def precompile_ladder(*, n_pad: int, ic_pad: int, S: int, O: int,
                   jnp.int32(0), jnp.int32(0),
                   jnp.int32(0))  # max_cfg 0: zero rounds run
         carry, summary = chunk_jit(consts, init_fn(0))
-        jax.block_until_ready(summary)
+        # per-bucket warm compile: one sync per executable IS the job
+        jax.block_until_ready(summary)  # jaxlint: ok(J007)
         del carry
         out[k] = round(_t.monotonic() - t0, 3)
     return out

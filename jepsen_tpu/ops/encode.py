@@ -36,6 +36,30 @@ from .linprep import LinOp, prepare
 
 INF = np.int32(2**31 - 1)  # event indices are small; x64 stays off
 
+# Kernel limits (the `encode()` defaults). Single source of truth:
+# the preflight admission analyzer (analysis/preflight) predicts
+# EncodingUnsupported against these same constants, so a cap change
+# here moves the P004 rule with it.
+MAX_WINDOW = 1024
+MAX_INFO = 256
+
+
+def window_requirement(inv_ok: np.ndarray,
+                       ret_ok: np.ndarray) -> tuple[int, int]:
+    """(w_needed, W_padded) for inv-sorted ok-op intervals — the
+    window-width theory in the module docstring, shared by `encode()`
+    and the preflight shape probe so the two can never disagree."""
+    n = len(inv_ok)
+    if n:
+        hi = np.searchsorted(inv_ok, ret_ok)
+        w_needed = int(np.max(hi - np.arange(n)))
+    else:
+        w_needed = 1
+    # Narrow windows bucket at 32 (few shapes, cheap); wide ones at
+    # 128 so adversarial long-tail runs don't compile a fresh kernel
+    # per history length.
+    return w_needed, _pad_to(w_needed, 32 if w_needed <= 256 else 128)
+
 
 class EncodingUnsupported(Exception):
     """The history/model cannot be encoded within kernel limits; callers
@@ -131,8 +155,8 @@ def _pad_to(n: int, multiple: int) -> int:
     return max(multiple, ((n + multiple - 1) // multiple) * multiple)
 
 
-def encode(model: Model, history: History, max_window: int = 1024,
-           max_states: int = 1 << 16, max_info: int = 256) -> Encoded:
+def encode(model: Model, history: History, max_window: int = MAX_WINDOW,
+           max_states: int = 1 << 16, max_info: int = MAX_INFO) -> Encoded:
     """History + model -> Encoded tensors, or raise EncodingUnsupported."""
     ops = prepare(history)
     ok_ops = [o for o in ops if o.ok]
@@ -174,18 +198,12 @@ def encode(model: Model, history: History, max_window: int = 1024,
     if n > 1:
         assert np.all(np.diff(inv_ok) > 0)
 
-    # Exact window requirement (see module docstring).
-    if n:
-        hi = np.searchsorted(inv_ok, ret_ok)  # first j with inv[j] > ret[i]
-        w_needed = int(np.max(hi - np.arange(n)))
-    else:
-        w_needed = 1
-    # Narrow windows bucket at 32 (few shapes, cheap); wide ones at 128
-    # so adversarial long-tail runs don't compile a fresh kernel per
-    # history length.
-    W = _pad_to(w_needed, 32 if w_needed <= 256 else 128)
+    # Exact window requirement (see module docstring; shared with the
+    # preflight shape probe).
+    w_needed, W = window_requirement(inv_ok, ret_ok)
     if W > max_window:
         # the op whose open window drives the requirement
+        hi = np.searchsorted(inv_ok, ret_ok)
         widest = ok_ops[int(np.argmax(hi - np.arange(n)))] if n else None
         raise EncodingUnsupported(
             f"window {w_needed} exceeds max {max_window} "

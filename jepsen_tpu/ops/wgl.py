@@ -388,6 +388,85 @@ _ESCALATE_AT = 200_000
 _K_BIG = 512
 
 
+def derive_plan(*, window_raw: int, W: int, ic_pad: int, n: int,
+                n_info: int, accel: bool,
+                frontier: Optional[int] = None,
+                adaptive: Optional[bool] = None,
+                shape_bucket: Optional[dict] = None) -> dict:
+    """The static kernel-plan derivation: variant, capacities, ladder,
+    effective widths. Pure scalar math — no arrays, no jax.
+
+    This is the SINGLE source of truth for what `check()` below will
+    run AND what `analysis/preflight.plan_wgl` admits against; keeping
+    it one function is what stops the admission analyzer silently
+    drifting from the kernel it models. Returns {kern, K, H, B, W_eff,
+    ic_eff, L, chunk, depth, probes, ladder, use_adapt, buckets} —
+    `buckets` is every frontier capacity the search may visit (the
+    adaptive ladder, the legacy [K, 512] escalation, or a pinned
+    frontier)."""
+    from . import adapt as _adapt
+
+    n_caps = max(n, int(shape_bucket.get("n_cap", 0))) \
+        if shape_bucket else n
+    K, H, B = _pick_capacities(W, ic_pad, max(n_caps, 1), accel=accel)
+    use_adapt = (_adapt.enabled(True if adaptive is None else adaptive)
+                 and not frontier and adaptive is not False)
+    ladder: Optional[tuple] = None
+    L = 0
+    chunk = 4096 if accel else 1024
+    depth = 1
+    if window_raw <= 32:
+        kern = "wgl32"
+        K = 16
+        if use_adapt:
+            ladder = _adapt.LADDER32
+            K = ladder[0]
+        W_eff = max(8, _pad_to_mult(window_raw, 8))
+        ic_eff = min(max(8, _pad_to_mult(n_info, 8)), ic_pad)
+        if shape_bucket:
+            W_eff = max(W_eff, int(shape_bucket.get("w_eff", 0)))
+            ic_eff = min(ic_pad, max(
+                ic_eff, int(shape_bucket.get("ic_eff", 0))))
+        B = 1 << 18
+        depth = 4 if accel else 1
+        chunk = max(1, chunk // depth)
+    else:
+        kern = "wgln"
+        W_eff = _pad_to_mult(window_raw, 32)
+        ic_eff = min(max(8, _pad_to_mult(n_info, 8)), ic_pad)
+        if shape_bucket:
+            W_eff = max(W_eff, int(shape_bucket.get("w_eff", 0)))
+            ic_eff = min(ic_pad, max(
+                ic_eff, int(shape_bucket.get("ic_eff", 0))))
+        L = W_eff // 32
+        budget_bytes = (1024 if accel else 128) * 1024 * 1024
+        K = max(64, min(4096 if accel else 1024,
+                        budget_bytes // (W_eff * L * 4 * 3)))
+        cap = int(os.environ.get("JEPSEN_TPU_MAX_FRONTIER", "0"))
+        if cap:
+            K = min(K, cap)
+        K = 1 << (K.bit_length() - 1)
+        B = min(1 << 20, max(1 << 18, (32 << 20) // (L * 4)))
+        B = 1 << (B.bit_length() - 1)
+        chunk = 512 if accel else 128
+        if use_adapt:
+            ladder = _adapt.ladder_for(K, k_min=max(32, K // 16),
+                                       step=8)
+            K = ladder[0]
+    if frontier:
+        K = frontier
+    if ladder:
+        buckets = list(ladder)
+    elif kern == "wgl32" and not frontier and K < _K_BIG:
+        buckets = [K, _K_BIG]  # legacy one-shot escalation
+    else:
+        buckets = [K]
+    return {"kern": kern, "K": K, "H": H, "B": B, "W_eff": W_eff,
+            "ic_eff": ic_eff, "L": L, "chunk": chunk, "depth": depth,
+            "probes": 4, "ladder": ladder, "use_adapt": use_adapt,
+            "buckets": buckets}
+
+
 def _widen_frontier(carry, k_new: int):
     """Pad the packed frontier (K, C) of a wgl32 carry to k_new rows
     (zeros beyond fr_cnt are inert); backlog/memo/flags ride along."""
@@ -556,36 +635,22 @@ def check(model: Model, history: History, time_limit: Optional[float] = None,
         # caller's batch compiles (and warms) the SAME kernel
         enc = _apply_bucket(enc, shape_bucket)
 
-    from . import adapt as _adapt
     W = enc.window
     ic_pad = len(enc.inv_info)
-    # capacity sizing follows the bucket's biggest key, so every key
-    # of a shared-bucket fan-out lands on identical (K, H, B)
-    n_caps = max(n, int(shape_bucket.get("n_cap", 0))) \
-        if shape_bucket else n
-    K, H, B = _pick_capacities(W, ic_pad, n_caps, accel=accel)
-    # Occupancy-adaptive bucket ladder (ops/adapt.py): on unless the
-    # caller pinned the beam or flipped the kill-switch. The ladder
-    # replaces both the old fixed K=16 start and the one-shot
-    # escalation: start at the measured sweet spot (bottom bucket),
-    # grow between chunks when the search proves exhaustive.
-    use_adapt = (_adapt.enabled(True if adaptive is None else adaptive)
-                 and not frontier and adaptive is not False)
-    ladder: Optional[tuple] = None
-    if enc.window_raw <= 32:
-        # Fast-path beam (measured on the BASELINE model matrix):
-        # narrow beams do less total work on valid histories — K=2
-        # decides the 10k headline 4x faster than K=16 at fill 0.9999
-        # (ops/adapt.py module docstring) — while exhaustive searches
-        # want breadth; the ladder covers both. Non-adaptive runs keep
-        # the old K=16 + _ESCALATE_AT jump.
-        K = 16
-        if use_adapt:
-            ladder = _adapt.LADDER32
-            K = ladder[0]
-    if frontier:
-        K = frontier  # override breadth only; the memo table must still
-        #               fit the config space (see _pick_capacities)
+    # The whole static plan — kernel variant, K/H/B capacities,
+    # adaptive ladder, effective widths, chunk/depth — comes from ONE
+    # derivation shared with the admission analyzer
+    # (analysis/preflight.plan_wgl), so what preflight admits against
+    # is exactly what runs here. The measured rationale for every
+    # branch lives on derive_plan.
+    plan = derive_plan(window_raw=enc.window_raw, W=W, ic_pad=ic_pad,
+                       n=n, n_info=enc.n_info, accel=accel,
+                       frontier=frontier, adaptive=adaptive,
+                       shape_bucket=shape_bucket)
+    K, H, B = plan["K"], plan["H"], plan["B"]
+    ladder = plan["ladder"]
+    W_eff, ic_eff = plan["W_eff"], plan["ic_eff"]
+    chunk, depth = plan["chunk"], plan["depth"]
     # Half-width packed lookup tables (wgl32 `pack`): bit-exact when
     # every event time fits int16 — true for every history under ~16k
     # events, including the 10k headline. Halves the per-round meta/
@@ -595,37 +660,14 @@ def check(model: Model, history: History, time_limit: Optional[float] = None,
     pack = (bool(shape_bucket["pack"])
             if shape_bucket and "pack" in shape_bucket
             else _packable(enc))
-    # Rounds per device call: the deadline/budget/stop signals are only
-    # checked between calls — and each poll costs a full device->host
-    # round-trip (~75 ms through the tunneled v5e), so the accelerator
-    # build runs big chunks. 1024 keeps CPU fast-path poll granularity
-    # a few seconds; the packed wide-window branch below sets its own.
-    chunk = 4096 if accel else 1024
-    depth = 1  # the fast path raises this on accel (depth-fused rounds)
-    iinv, iopc = enc.inv_info, enc.opcode_info
-    if enc.window_raw <= 32:
-        # Bitmask fast path: window in one uint32 lane, sort-free dedup.
-        # Successor-row count R = K*(W_eff + ic_eff) drives probe traffic
-        # (the dominant cost), so materialize only what the history needs.
+    iinv, iopc = enc.inv_info[:ic_eff], enc.opcode_info[:ic_eff]
+    W = W_eff  # the width the kernel actually runs at
+    probes_used, row_cols = plan["probes"], W_eff + ic_eff
+    if plan["kern"] == "wgl32":
+        # Bitmask fast path: window in one uint32 lane, sort-free
+        # dedup. Successor-row count R = K*(W_eff + ic_eff) drives
+        # probe traffic, so only what the history needs materializes.
         from .wgl32 import compiled_search32
-        W_eff = max(8, _pad_to_mult(enc.window_raw, 8))
-        ic_eff = max(8, _pad_to_mult(enc.n_info, 8))
-        ic_eff = min(ic_eff, ic_pad)
-        if shape_bucket:
-            W_eff = max(W_eff, int(shape_bucket.get("w_eff", 0)))
-            ic_eff = min(ic_pad, max(
-                ic_eff, int(shape_bucket.get("ic_eff", 0))))
-        iinv, iopc = iinv[:ic_eff], iopc[:ic_eff]
-        B = 1 << 18  # packed rows are cheap; escalation spills hard
-        W = W_eff  # the width the kernel actually runs at
-        probes_used, row_cols = 4, W_eff + ic_eff
-        # Depth-fused accel rounds: the search is DEPTH-bound (valid
-        # histories need ~n_ok sequential linearization levels) and
-        # accel rounds are latency-bound, so fusing several levels per
-        # memo/backlog commit divides the serialized round count
-        # (wgl32.round_body_deep). chunk counts super-rounds.
-        depth = 4 if accel else 1
-        chunk = max(1, chunk // depth)
 
         def rebuild(k):
             return compiled_search32(
@@ -633,65 +675,14 @@ def check(model: Model, history: History, time_limit: Optional[float] = None,
                 S=enc.table.shape[0], O=enc.table.shape[1],
                 K=k, H=H, B=B, chunk=chunk, probes=4, W=W_eff,
                 accel=accel, depth=depth, pack=pack)
-
-        init_fn, chunk_jit = rebuild(K)
     else:
         # Packed multi-lane kernel (wgln.py): window as L uint32
         # lanes. Successors are bit math + funnel shifts instead of
         # (K, W, 2W) bool gathers, dedup is probe-only instead of a
         # 3-key sort — measured ~11x over the bool kernel at W=71 on
-        # cpu. The (K, W, L) u32 successor tensor is the memory
-        # driver, so the beam scales with a byte budget over it.
+        # cpu.
         from .wgln import compiled_searchN
-        W_eff = _pad_to_mult(enc.window_raw, 32)
-        ic_eff = max(8, _pad_to_mult(enc.n_info, 8))
-        ic_eff = min(ic_eff, ic_pad)
-        if shape_bucket:
-            W_eff = max(W_eff, int(shape_bucket.get("w_eff", 0)))
-            ic_eff = min(ic_pad, max(
-                ic_eff, int(shape_bucket.get("ic_eff", 0))))
-        L = W_eff // 32
-        iinv, iopc = iinv[:ic_eff], iopc[:ic_eff]
-        budget_bytes = (1024 if accel else 128) * 1024 * 1024
-        # cpu caps the beam at 1024: XLA:CPU compile scales with K and
-        # the post-compile search rate is flat across K=1024..4096 on
-        # the adversarial shape (measured: 50.4 s total at K=1024 w/
-        # 5.1 s compile vs 53.6 s at K=4096 w/ 13.9 s), so the bigger
-        # beam only buys compile latency there; accelerators keep the
-        # full width (compile is fast, rounds scale with K)
-        K = max(64, min(4096 if accel else 1024,
-                        budget_bytes // (W_eff * L * 4 * 3)))
-        # XLA:CPU compile time scales with K (~3 s at 512, ~14 s at
-        # 4096); JEPSEN_TPU_MAX_FRONTIER lets CI cap the beam so its
-        # many small shape buckets don't pay production-size compiles
-        cap = int(os.environ.get("JEPSEN_TPU_MAX_FRONTIER", "0"))
-        if cap:
-            K = min(K, cap)
-        K = 1 << (K.bit_length() - 1)
-        if frontier:
-            K = frontier
-        # packed backlog rows are (L + Il) u32s: a 2^20-row backlog at
-        # L=3 is ~12 MB; scale down as lanes widen (measured: 2^18
-        # overflowed the 16-wave adversarial shape's ~1.5M-config
-        # wavefront where the byte-budget backlog did not)
-        B = min(1 << 20, max(1 << 18, (32 << 20) // (L * 4)))
-        B = 1 << (B.bit_length() - 1)
-        W = W_eff
-        # probes=4 like the fast path: the H=2^23 table stays under
-        # ~30% load at the encode cap, and fewer probe rounds measured
-        # ~1.5x on search time (failed inserts re-explore soundly)
-        probes_used, row_cols = 4, W_eff + ic_eff
-        # cpu polls a few times a second; the accelerator amortizes
-        # its ~75 ms poll round-trip over bigger chunks
-        chunk = 512 if accel else 128
-        if use_adapt:
-            # the wide-window ladder hangs off the platform-derived
-            # ceiling; valid wide histories ride the narrow buckets,
-            # exhaustive wavefronts climb (backlog pressure jumps
-            # straight to the top before the spill can overflow)
-            ladder = _adapt.ladder_for(K, k_min=max(32, K // 16),
-                                       step=8)
-            K = ladder[0]
+        L = plan["L"]
 
         def rebuild(k):
             return compiled_searchN(
@@ -700,7 +691,7 @@ def check(model: Model, history: History, time_limit: Optional[float] = None,
                 K=k, H=H, B=B, chunk=chunk, probes=4, W=W_eff, L=L,
                 accel=accel, pack=pack)
 
-        init_fn, chunk_jit = rebuild(K)
+    init_fn, chunk_jit = rebuild(K)
 
     import contextlib
 
@@ -885,10 +876,14 @@ def _search_loop(enc, init_fn, chunk_jit, iinv, iopc, n, max_configs,
             # (11,) summary [fr_cnt, found, overflow, exhausted,
             # stats x6, bk_cnt] (~75 ms round-trip, tunneled v5e)
             if instrumented:
-                summary.block_until_ready()
+                # the ONE designed poll sync: splits device compute
+                # from the packed-summary transfer for the phase spans
+                summary.block_until_ready()  # jaxlint: ok(J007)
             with tracer.span("host-poll"):
                 t_xfer = _time.monotonic()
-                s = np.asarray(summary)
+                # the ONE designed per-chunk drain: a single packed
+                # (11,)+ring summary per poll, budgeted by CompileGuard
+                s = np.asarray(summary)  # jaxlint: ok(J007)
                 xfer_s = _time.monotonic() - t_xfer
                 # one packed (11,) poll per chunk — the ONLY
                 # device->host transfer in the loop by design; the

@@ -57,7 +57,10 @@ def shared_shape_bucket(encs: Sequence[Encoded]) -> Optional[dict]:
     if not encs:
         return None
     from ..ops.wgl import _packable
-    wide = encs[0].window_raw > 32
+    # max-based so a MIXED batch (preflight's vmap batch-kernel plan)
+    # gets the branch encode_batch would take; uniform groups —
+    # the streamed callers — are unaffected
+    wide = max(e.window_raw for e in encs) > 32
     w_eff = 0
     ic_eff = 8
     for e in encs:
@@ -338,13 +341,52 @@ def check_streamed(model: Model, histories: Sequence[History],
     if not _backend_ready_or_fallback(time_limit):
         return _all_host(model, histories, deadline, oracle_fallback,
                          key_indices=key_indices)
-    devices = jax.devices()
-    results: list[Optional[dict]] = [None] * len(histories)
     if race and not oracle_fallback:
         raise ValueError(
             "race=True requires oracle_fallback (racing IS the oracle "
             "running concurrently); pass race=False to see raw device "
             "verdicts")
+    status = _fleet.get_default()
+    # register_keys=False: check_batched already registered the whole
+    # key set (host-decided keys included) with the run status.
+    # Registered BEFORE the admission gate: begin_keys resets the
+    # decided counter, and rejected keys close via key_done below.
+    if status.enabled and register_keys and len(histories) > 1:
+        status.begin_keys(len(histories))
+    # Admission preflight (analysis/preflight): each kernel branch's
+    # shared shape bucket sizes every lane of its group by the group
+    # maxima, so one key whose plan blows the device budget makes the
+    # shared kernel infeasible for its WHOLE group — those keys are
+    # rejected statically, before any compile or device byte, exactly
+    # like the history_lint gate; keys in an admissible group proceed.
+    # Device path only: a host fallback has no HBM budget, so nothing
+    # is planned (or recorded) for it.
+    from ..analysis import preflight
+    rejected = preflight.gate_fanout(model, histories, encs=encs,
+                                     where="parallel.streamed") or {}
+
+    def _rejected_result(i: int) -> dict:
+        # annotated like any other shard so fleet key accounting
+        # (keys.decided, /status.json) still closes the key
+        ki = key_indices[i] if key_indices is not None else i
+        return _annotate_shard(
+            dict(rejected[i], op_count=len(histories[i])),
+            key_index=ki, device="none", engine="preflight",
+            t0=_time.monotonic(), wall_s=0.0)
+
+    # With oracle_fallback the rejection is not terminal: the device
+    # attempt is skipped statically, but the host oracle (no HBM
+    # budget) still decides the key inside the deadline — the same
+    # competition semantics as a device "unknown" (see one() below).
+    # Without it, the structured rejection IS the verdict.
+    if not oracle_fallback:
+        if len(rejected) == len(histories):
+            return [_rejected_result(i) for i in range(len(histories))]
+    devices = jax.devices()
+    results: list[Optional[dict]] = [None] * len(histories)
+    if not oracle_fallback:
+        for i in rejected:
+            results[i] = _rejected_result(i)
     if race is None:
         # On a real accelerator the host CPU is otherwise idle, so
         # racing the per-key device search against the host oracle
@@ -354,21 +396,19 @@ def check_streamed(model: Model, histories: Sequence[History],
         race = oracle_fallback and \
             jax.default_backend() not in ("cpu",)
 
-    status = _fleet.get_default()
-    # register_keys=False: check_batched already registered the whole
-    # key set (host-decided keys included) with the run status
-    if status.enabled and register_keys and len(histories) > 1:
-        status.begin_keys(len(histories))
-
     # One shared shape bucket per kernel branch: every key compiles
     # the same executable (see shared_shape_bucket — the
     # independent_100x2k straggler fix)
     bucket_n = bucket_w = None
     if encs is not None and len(histories) > 1:
+        # rejected keys must not size the shared bucket: the whole
+        # point of the per-group rejection is that the admitted
+        # group's kernel is NOT padded to the infeasible key's shape
+        admitted = [e for j, e in enumerate(encs) if j not in rejected]
         bucket_n = shared_shape_bucket(
-            [e for e in encs if e.window_raw <= 32])
+            [e for e in admitted if e.window_raw <= 32])
         bucket_w = shared_shape_bucket(
-            [e for e in encs if e.window_raw > 32])
+            [e for e in admitted if e.window_raw > 32])
 
     def one(dev, i_hist):
         label = _fleet.device_label(dev)
@@ -379,6 +419,23 @@ def check_streamed(model: Model, histories: Sequence[History],
               else i_hist)
         t0 = _time.monotonic()
         retries = 0
+        rej = rejected.get(i_hist)
+        if rej is not None:
+            # preflight-rejected: the device attempt is skipped
+            # statically, but the host oracle (no HBM budget) still
+            # decides the key — competition semantics, same as a
+            # device "unknown" (oracle_fallback is True here; the
+            # False case pre-filled the structured rejection above)
+            status.device_state(label, "fallback", key_index=ki)
+            res = _oracle_fallback(
+                model, histories[i_hist], deadline,
+                dict(rej, op_count=len(histories[i_hist])))
+            if "preflight" in rej:   # keep the plan that scratched
+                res.setdefault("preflight", rej["preflight"])
+            return _annotate_shard(
+                res, key_index=ki, device=label, device_index=di,
+                engine=str(res.get("engine") or "preflight"),
+                t0=t0, wall_s=_time.monotonic() - t0)
         status.device_state(label, "searching", key_index=ki)
         remaining = None
         if deadline is not None:
@@ -450,6 +507,8 @@ def check_streamed(model: Model, histories: Sequence[History],
     wd = _watchdog.get_default()
     if len(devices) == 1 or len(histories) == 1:
         for i in range(len(histories)):
+            if results[i] is not None:  # preflight-rejected key
+                continue
             if wd.cancelled():
                 # run-wide soft-cancel (an escalated stall): the
                 # remaining keys report partial progress, not silence
@@ -470,6 +529,8 @@ def check_streamed(model: Model, histories: Sequence[History],
             i = next(counter)
             if i >= len(histories) or wd.cancelled():
                 return
+            if results[i] is not None:  # preflight-rejected key
+                continue
             results[i] = one(dev, i)
 
     # daemon only under cancel-escalation: that is the one mode where
@@ -636,6 +697,30 @@ def check_batched(model: Model, histories: Sequence[History],
     axis = tuple(mesh.axis_names) if len(mesh.axis_names) > 1 \
         else mesh.axis_names[0]
     nd = mesh.devices.size
+
+    # Admission preflight for the lockstep vmap batch (the streamed
+    # branch gates inside check_streamed): encode_batch pads EVERY
+    # lane to the batch maxima and the one kernel keeps ceil(lanes/nd)
+    # lanes' buffers resident per device, so the admitted plan is THAT
+    # batch kernel (mode="batch"), not the per-key kernels. An
+    # infeasible batch is not necessarily dead — per-key kernels are
+    # the memory-minimal execution — so degrade to the streamed path,
+    # whose own per-group gate rejects what even a lone kernel cannot
+    # fit; either way nothing compiles or touches the device first.
+    from ..analysis import preflight
+    bad_pf = preflight.gate_fanout(model, histories, encs=encs,
+                                   where="parallel.batched",
+                                   mode="batch", n_devices=nd,
+                                   on_infeasible="degrade")
+    if bad_pf:
+        streamed = check_streamed(
+            model, [histories[i] for i in lanes],
+            time_limit=time_limit, max_configs=max_configs,
+            oracle_fallback=oracle_fallback,
+            encs=encs, register_keys=False, key_indices=lanes)
+        for i, res in zip(lanes, streamed):
+            results[i] = res
+        return results  # type: ignore[return-value]
 
     batch = encode_batch(encs, batch_pad=nd)
     bk = batch.inv.shape[0]

@@ -160,6 +160,19 @@ def status_snapshot(store_root: str) -> dict:
     # pre-occupancy mirrors (an older run's current-status.json) still
     # answer the documented schema
     snap.setdefault("occupancy", {"active": False})
+    # admission-control verdicts this process has issued (the
+    # checker-as-a-service front door, analysis/preflight): verdict
+    # mix + a bounded recent window
+    try:
+        from .analysis import preflight
+        pf = preflight.snapshot()
+        # a mirror from another process may already carry its own
+        # preflight block; only an in-process decision overrides it
+        if pf["checked"] or "preflight" not in snap:
+            snap["preflight"] = pf
+    except Exception:  # noqa: BLE001 — the status answer must not
+        snap.setdefault("preflight",  # depend on the analysis plane
+                        {"checked": 0, "verdicts": {}, "recent": []})
     # history, not just the live run: the last N ledger entries ride
     # every status answer so the fleet dashboard shows what the fleet
     # has DONE, not only what it is doing

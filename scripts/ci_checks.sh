@@ -13,6 +13,9 @@ python scripts/jax_lint.py
 echo "== telemetry_lint =="
 python scripts/telemetry_lint.py
 
+echo "== preflight admission smoke =="
+JAX_PLATFORMS=cpu python scripts/preflight_smoke.py
+
 echo "== adaptive ladder smoke =="
 JAX_PLATFORMS=cpu python scripts/adaptive_smoke.py
 

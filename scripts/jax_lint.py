@@ -1,16 +1,24 @@
 #!/usr/bin/env python
 """jit-safety lint over the kernel modules (CLI for analysis.jaxlint).
 
-Flags the classic JAX footguns in `jepsen_tpu/ops/` and
-`jepsen_tpu/elle/` — host syncs inside jitted regions, per-call
+Flags the classic JAX footguns in `jepsen_tpu/ops/`, `jepsen_tpu/elle/`,
+`scripts/`, and `bench.py` — host syncs inside jitted regions, per-call
 `jax.jit` construction, Python branches on tracers, closure captures
-that force retraces, implicit integer dtype promotion, and Python
-loops that belong in `lax` control flow. Rule catalog + allowlist
-syntax: doc/STATIC_ANALYSIS.md.
+that force retraces, implicit integer dtype promotion, Python loops
+that belong in `lax` control flow, host transfers inside poll loops
+(J007), and carry-style kernels missing `donate_argnums` (J008). Rule
+catalog + allowlist syntax: doc/STATIC_ANALYSIS.md.
 
 Usage:
-    python scripts/jax_lint.py [--check] [--list-rules] [paths...]
-    # no paths: lints jepsen_tpu/ops and jepsen_tpu/elle
+    python scripts/jax_lint.py [--check] [--list-rules]
+                               [--rules J001,J007] [--changed-only]
+                               [paths...]
+    # no paths: lints jepsen_tpu/ops, jepsen_tpu/elle, scripts/,
+    #           and bench.py
+    # --rules        keep only the named rules' findings
+    # --changed-only lint only files changed vs git HEAD (plus
+    #                untracked), intersected with the lint paths —
+    #                the fast pre-commit loop
     # exit 1 when findings remain after the inline allowlist
     # (`# jaxlint: ok(<rule>)`); --check only changes verbosity
 
@@ -22,6 +30,7 @@ that way.
 from __future__ import annotations
 
 import os
+import subprocess
 import sys
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -32,19 +41,89 @@ from jepsen_tpu.analysis import jaxlint  # noqa: E402
 DEFAULT_PATHS = (
     os.path.join(REPO_ROOT, "jepsen_tpu", "ops"),
     os.path.join(REPO_ROOT, "jepsen_tpu", "elle"),
+    os.path.join(REPO_ROOT, "scripts"),
+    os.path.join(REPO_ROOT, "bench.py"),
 )
+
+
+def changed_files():
+    """Python files changed vs HEAD (staged, unstaged, untracked),
+    absolute paths. Returns None when git is unavailable/failing —
+    the caller must then lint the full paths rather than silently
+    passing an unknowable working tree."""
+    out: list = []
+    try:
+        diff = subprocess.run(
+            ["git", "diff", "--name-only", "HEAD"],
+            cwd=REPO_ROOT, capture_output=True, text=True, timeout=30)
+        untracked = subprocess.run(
+            ["git", "ls-files", "--others", "--exclude-standard"],
+            cwd=REPO_ROOT, capture_output=True, text=True, timeout=30)
+        if diff.returncode != 0 or untracked.returncode != 0:
+            return None
+        names = diff.stdout.splitlines() + untracked.stdout.splitlines()
+    except Exception:  # noqa: BLE001 — no git: signal the caller
+        return None
+    for name in names:
+        path = os.path.join(REPO_ROOT, name)
+        # a deleted tracked file still shows in the diff — nothing to
+        # lint there
+        if name.endswith(".py") and os.path.isfile(path):
+            out.append(path)
+    return out
+
+
+def _under(path: str, roots) -> bool:
+    path = os.path.abspath(path)
+    for r in roots:
+        r = os.path.abspath(r)
+        if path == r or path.startswith(r + os.sep):
+            return True
+    return False
 
 
 def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     quiet = "--check" in argv
-    argv = [a for a in argv if a != "--check"]
+    changed_only = "--changed-only" in argv
+    argv = [a for a in argv if a not in ("--check", "--changed-only")]
+    rules = None
+    if "--rules" in argv:
+        i = argv.index("--rules")
+        if i + 1 >= len(argv):
+            print("--rules needs a comma-separated rule list "
+                  "(e.g. --rules J001,J007)", file=sys.stderr)
+            return 254
+        rules = {r.strip() for r in argv[i + 1].split(",") if r.strip()}
+        unknown = rules - set(jaxlint.RULES)
+        if unknown:
+            print(f"unknown rule(s): {sorted(unknown)} "
+                  f"(known: {sorted(jaxlint.RULES)})", file=sys.stderr)
+            return 254
+        del argv[i:i + 2]
     if "--list-rules" in argv:
         for rule, name in sorted(jaxlint.RULES.items()):
             print(f"{rule}  {name}")
         return 0
     paths = argv or list(DEFAULT_PATHS)
+    if changed_only:
+        scope = paths
+        changed = changed_files()
+        if changed is None:
+            # no usable git: a silent pass here would green-light an
+            # unknowable tree — lint the full scope instead
+            print("jax lint: git unavailable; --changed-only falls "
+                  "back to the full lint paths", file=sys.stderr)
+        else:
+            paths = [p for p in changed if _under(p, scope)]
+            if not paths:
+                if not quiet:
+                    print("jax lint: no changed files under the lint "
+                          "paths")
+                return 0
     findings = jaxlint.lint_paths(paths)
+    if rules is not None:
+        findings = [f for f in findings if f.rule in rules]
     for f in findings:
         print(f, file=sys.stderr)
     n_files = sum(

@@ -1,0 +1,93 @@
+#!/usr/bin/env python
+"""CI gate for the preflight admission analyzer (analysis/preflight).
+
+Three invariants, each cheap enough for every CI run:
+
+  1. **feasible headline passes** — the bench headline shape plans
+     feasible, on the right kernel, with the adaptive ladder's
+     buckets, and the executed check stays inside the plan (buckets
+     visited are a subset; pack bit matches; the per-round byte
+     prediction matches the executed check's cost_analysis within
+     10%).
+  2. **oversized closure rejected statically** — a synthetic 100k-txn
+     dense-closure request is rejected (P001 + P002) with ZERO
+     backend compiles and zero device execution, proven under a
+     CompileGuard zero-compile budget.
+  3. **warm path zero-recompile** — after one real check has warmed
+     the shape bucket, running the preflight gate + a re-check stays
+     at zero compiles: the analyzer's cost lowering must never cost a
+     backend compile.
+
+Wired into scripts/ci_checks.sh. Run standalone:
+    JAX_PLATFORMS=cpu python scripts/preflight_smoke.py
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+# CI-sized headline: same shape family as the bench headline (narrow
+# window cas-register), small enough for the smoke budget.
+N_OPS = int(os.environ.get("JEPSEN_TPU_SMOKE_OPS", "2000"))
+
+
+def main() -> int:
+    from jepsen_tpu import metrics as metrics_mod
+    from jepsen_tpu import synth
+    from jepsen_tpu.analysis import guards, preflight
+    from jepsen_tpu.models import cas_register
+    from jepsen_tpu.ops import adapt, wgl
+
+    model = cas_register()
+    hist = synth.cas_register_history(N_OPS, n_procs=5, seed=42,
+                                      crash_p=0.002)
+
+    # -- 1. feasible headline plans + executes inside the plan --------
+    rep = preflight.plan_wgl(model, hist, lower=True)
+    assert rep["verdict"] == "feasible", rep["rules"]
+    assert rep["kernel"] == "wgl32", rep["kernel"]
+    assert rep["buckets"] == list(adapt.LADDER32), rep["buckets"]
+    with metrics_mod.use(metrics_mod.Registry()):
+        res = wgl.check(model, hist)
+    assert res["valid?"] is True, res
+    par = preflight._parity(rep, res)
+    assert par["kernel_match"] and par["buckets_subset"] \
+        and par["pack_match"], par
+    assert par.get("drift_x") is not None \
+        and 0.9 <= par["drift_x"] <= 1.1, par
+    print(f"1. headline feasible: buckets {rep['buckets']}, "
+          f"visited {par['buckets_visited']}, "
+          f"bytes drift {par['drift_x']}x")
+
+    # -- 2. oversized closure rejected statically, zero compiles ------
+    with guards.CompileGuard(max_compiles=0,
+                             name="preflight-static-reject"):
+        dense = preflight.plan_elle(n_txns=100_000, backend="packed")
+        gate = preflight.gate_elle(100_000, backend="packed",
+                                   where="smoke")
+    fired = [r["rule"] for r in dense["rules"]]
+    assert dense["verdict"] == "infeasible", dense
+    assert "P001" in fired and "P002" in fired, fired
+    assert gate is not None and gate["cause"] == "preflight", gate
+    print(f"2. 100k dense closure rejected statically: {fired}, "
+          f"peak {dense['hbm']['peak_bytes'] / 1e9:.1f} GB, "
+          "0 compiles (CompileGuard-proven)")
+
+    # -- 3. warm path: gate + re-check at zero recompiles -------------
+    with guards.CompileGuard(max_compiles=0, name="preflight-warm"):
+        bad = preflight.gate_wgl(model, hist, where="smoke")
+        assert bad is None, bad
+        rep2 = preflight.plan_wgl(model, hist, lower=True)
+        res2 = wgl.check(model, hist)
+    assert res2["valid?"] is True, res2
+    assert rep2["verdict"] == "feasible"
+    print("3. warm gate + re-check: 0 compiles")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -80,6 +80,11 @@ SERIES_SCHEMAS = {
     "elle_closure": {"edges": int, "n": int, "iters_run": int,
                      "kernel_s": NUM, "compile_s": NUM,
                      "iter_reach": list},
+    # admission-control verdicts (analysis/preflight): one point per
+    # gate/CLI decision — verdict in {feasible, degrade, infeasible},
+    # rules the P-rule ids that fired
+    "preflight": {"where": str, "kind": str, "verdict": str,
+                  "rules": list},
 }
 
 REGRESSIONS_SCHEMA = {"schema": int, "threshold_x": NUM,
@@ -231,6 +236,22 @@ def lint_ledger_file(path: str) -> list:
             if obj.get(f) is not None and not isinstance(obj[f], NUM):
                 errs.append(f"{where}: {f!r} should be numeric, got "
                             f"{type(obj[f]).__name__}")
+        if obj.get("kind") == "preflight":
+            # admission records (analysis/preflight): the verdict is
+            # one of the admission strings, the fired rules ride as a
+            # list, and the compact plan report is an object
+            if obj.get("verdict") not in ("feasible", "degrade",
+                                          "infeasible"):
+                errs.append(
+                    f"{where}: preflight 'verdict' should be "
+                    f"feasible/degrade/infeasible, got "
+                    f"{obj.get('verdict')!r}")
+            if not isinstance(obj.get("rules"), list):
+                errs.append(f"{where}: preflight 'rules' should be "
+                            "a list")
+            if not isinstance(obj.get("preflight"), dict):
+                errs.append(f"{where}: preflight record needs the "
+                            "compact 'preflight' report object")
         return errs
 
     if path.endswith(".jsonl"):

@@ -6,6 +6,10 @@ probe chain, scatter, or plain per-op launch overhead inside
 lax.while_loop.
 
 Usage: JAX_PLATFORMS=tpu python scripts/tpu_microbench.py
+
+One-shot jits, bounded unrolls, and per-iteration syncs are this
+script's measurement method, not footguns:
+# jaxlint: ok-file(J003,J006,J007)
 """
 import json
 import sys

@@ -1,0 +1,17 @@
+"""J007 fixture: host transfers inside a host-side poll loop."""
+import jax
+import numpy as np
+
+
+def poll(chunk_jit, consts, carry):
+    while True:
+        carry, summary = chunk_jit(consts, carry)
+        s = np.asarray(summary)        # J007: per-iteration transfer
+        if s[0]:
+            return carry, s
+
+
+def drain(fetch):
+    for i in range(8):
+        out = fetch(i)
+        jax.device_get(out)            # J007: per-iteration transfer

@@ -1,0 +1,35 @@
+"""J008 fixture: a carry-style chunk kernel jitted without donation."""
+import functools
+
+import jax
+
+
+@functools.lru_cache(maxsize=8)
+def compiled_chunk(n: int):
+    def chunk_fn(consts, carry):
+        return carry
+
+    return jax.jit(chunk_fn)           # J008: carry not donated
+
+
+@functools.lru_cache(maxsize=8)
+def compiled_chunk_ok(n: int):
+    def chunk_fn(consts, carry):
+        return carry
+
+    return jax.jit(chunk_fn, donate_argnums=(1,))   # clean
+
+
+@jax.jit                               # J008: decorated, carry not donated
+def decorated_chunk(consts, carry):
+    return carry
+
+
+@functools.partial(jax.jit, static_argnums=(0,))   # J008: partial, no donation
+def partial_chunk(n, state):
+    return state
+
+
+@functools.partial(jax.jit, donate_argnums=(1,))   # clean: donated
+def partial_chunk_ok(consts, carry):
+    return carry

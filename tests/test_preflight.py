@@ -1,0 +1,713 @@
+"""Preflight: the static kernel-plan & capacity analyzer.
+
+What's under test (doc/STATIC_ANALYSIS.md "Plane 3 — admission
+control"):
+
+  * plan enumeration parity — the statically enumerated plan (ladder
+    buckets, kernel variant, pack bit, Elle route) matches what
+    wgl/elle actually execute on the same shapes, and the HBM-byte
+    prediction lands within 10% of the executed plan's own
+    cost_analysis (it shares the runtime's cost_for cache keys, so
+    the match is exact by construction);
+  * the admission rules P001-P006, each from a shape that trips it;
+  * the static rejection: a synthetic 100k-txn dense-closure request
+    flagged P001/P002 with zero device execution and zero backend
+    compiles, CompileGuard-proven — including end-to-end through
+    elle append.check;
+  * the gates in checker/parallel, the preflight telemetry series +
+    kind="preflight" ledger records (good + drifted), /status.json's
+    preflight block, and the CLI;
+  * jaxlint J007 (transfer-in-loop) / J008 (missing-donation)
+    fixtures and the extended scripts/jax_lint.py flags.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from jepsen_tpu import metrics, synth
+from jepsen_tpu import ledger as ledger_mod
+from jepsen_tpu.analysis import guards, jaxlint, preflight
+from jepsen_tpu.history import History, info, invoke, ok
+from jepsen_tpu.models import cas_register
+from jepsen_tpu.ops import adapt
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LINT_CLI = os.path.join(REPO, "scripts", "jax_lint.py")
+FIXTURES = os.path.join(REPO, "tests", "jaxlint_fixtures")
+
+sys.path.insert(0, os.path.join(REPO, "scripts"))
+
+
+def H(ops):
+    return History(ops).index()
+
+
+@pytest.fixture()
+def hist_2k():
+    return synth.cas_register_history(2000, n_procs=5, seed=42,
+                                      crash_p=0.002)
+
+
+# ---------------------------------------------------------------------------
+# plan enumeration (WGL)
+# ---------------------------------------------------------------------------
+
+class TestWglPlan:
+    def test_headline_shape_feasible_on_ladder(self, hist_2k):
+        rep = preflight.plan_wgl(cas_register(), hist_2k)
+        assert rep["verdict"] == "feasible"
+        assert rep["kernel"] == "wgl32"
+        assert rep["buckets"] == list(adapt.LADDER32)
+        assert rep["pack"] is True
+        assert rep["engine"] == "device"
+        assert rep["rules"] == []
+
+    def test_non_adaptive_plans_legacy_escalation(self, hist_2k):
+        rep = preflight.plan_wgl(cas_register(), hist_2k,
+                                 adaptive=False)
+        assert rep["buckets"] == [16, 512]
+
+    def test_pinned_frontier_plans_one_bucket(self, hist_2k):
+        rep = preflight.plan_wgl(cas_register(), hist_2k, frontier=8)
+        assert rep["buckets"] == [8]
+
+    def test_wide_window_plans_wgln_ladder(self):
+        h = synth.adversarial_wave_history(8, width=14, span=5, seed=7)
+        rep = preflight.plan_wgl(cas_register(), h)
+        assert rep["kernel"] == "wgln"
+        assert rep["shapes"]["W_raw"] > 32
+        assert len(rep["buckets"]) >= 2
+        assert rep["buckets"] == sorted(rep["buckets"])
+
+    def test_probe_matches_encoded_shapes(self, hist_2k):
+        from jepsen_tpu.ops.encode import encode
+        model = cas_register()
+        cheap = preflight.plan_wgl(model, hist_2k)
+        enc = encode(model, hist_2k)
+        full = preflight.plan_wgl(enc=enc)
+        for k in ("n_ok", "n_info", "W_raw", "n_pad", "ic_pad"):
+            assert cheap["shapes"][k] == full["shapes"][k], k
+        assert cheap["buckets"] == full["buckets"]
+        assert cheap["pack"] == full["pack"]
+
+
+class TestRules:
+    def test_p004_window_overflow_degrades_to_oracle(self):
+        # one op holds its interval open across 1100 short ops: the
+        # window requirement blows the encode cap (1024)
+        ops = [invoke(99, "read", None, time=0)]
+        t = 1
+        for i in range(1100):
+            p = i % 4
+            ops.append(invoke(p, "write", 1, time=t)); t += 1
+            ops.append(ok(p, "write", 1, time=t)); t += 1
+        ops.append(ok(99, "read", None, time=t))
+        rep = preflight.plan_wgl(cas_register(), H(ops))
+        assert rep["verdict"] == "degrade"
+        assert rep["engine"] == "oracle"
+        assert [r["rule"] for r in rep["rules"]] == ["P004"]
+        # degrade admits: the gate stays open
+        assert preflight.gate_wgl(cas_register(), H(ops),
+                                  where="test") is None
+
+    def test_p004_info_cap(self):
+        ops = []
+        t = 0
+        for i in range(300):
+            ops.append(invoke(i, "write", 1, time=t)); t += 1
+            ops.append(info(i, "write", 1, time=t)); t += 1
+        rep = preflight.plan_wgl(cas_register(), H(ops))
+        assert any(r["rule"] == "P004" and "info-cap" in r["message"]
+                   for r in rep["rules"])
+
+    def test_p003_compile_budget(self, hist_2k):
+        rep = preflight.plan_wgl(cas_register(), hist_2k,
+                                 compile_budget=0)
+        fired = [r["rule"] for r in rep["rules"]]
+        assert "P003" in fired
+        assert rep["verdict"] == "degrade"
+        assert "precompile" in rep["suggestion"]
+
+    def test_p005_sparse_beam_without_ladder(self):
+        # serial history (wavefront 1) at the fixed K=16 start
+        ops = []
+        t = 0
+        for i in range(100):
+            ops.append(invoke(0, "write", i % 5, time=t)); t += 1
+            ops.append(ok(0, "write", i % 5, time=t)); t += 1
+        rep = preflight.plan_wgl(cas_register(), H(ops),
+                                 adaptive=False)
+        assert any(r["rule"] == "P005" for r in rep["rules"])
+        assert rep["verdict"] == "degrade"
+
+    def test_p001_tiny_budget_rejects(self, hist_2k, monkeypatch):
+        monkeypatch.setenv("JEPSEN_TPU_PREFLIGHT_MEM_BUDGET", "1000")
+        rep = preflight.plan_wgl(cas_register(), hist_2k)
+        assert rep["verdict"] == "infeasible"
+        assert any(r["rule"] == "P001" for r in rep["rules"])
+        bad = preflight.gate_wgl(cas_register(), hist_2k, where="test")
+        assert bad is not None
+        assert bad["valid?"] == "unknown" and bad["cause"] == "preflight"
+        assert "P001" in bad["rules"]
+
+    def test_verdict_precedence(self):
+        inf = preflight._rule("P001", "x")
+        deg = preflight._rule("P005", "y", suggestion="z")
+        assert preflight._verdict([deg, inf])[0] == "infeasible"
+        assert preflight._verdict([deg]) == ("degrade", "z")
+        assert preflight._verdict([]) == ("feasible", None)
+
+
+# ---------------------------------------------------------------------------
+# elle plans + the static 100k rejection
+# ---------------------------------------------------------------------------
+
+class TestEllePlan:
+    def test_auto_routes_host_over_capacity(self):
+        rep = preflight.plan_elle(n_txns=40_000, backend="auto")
+        assert rep["engine"] == "host"
+        assert rep["verdict"] == "feasible"
+
+    def test_dense_100k_rejected_with_zero_compiles(self):
+        with guards.CompileGuard(max_compiles=0, name="pf-100k"):
+            rep = preflight.plan_elle(n_txns=100_000, backend="packed")
+            gate = preflight.gate_elle(100_000, backend="packed",
+                                       where="test")
+        fired = [r["rule"] for r in rep["rules"]]
+        assert rep["verdict"] == "infeasible"
+        assert "P001" in fired and "P002" in fired
+        assert rep["hbm"]["peak_bytes"] > rep["hbm"]["budget_bytes"]
+        assert gate is not None and gate["cause"] == "preflight"
+
+    def test_bf16_forced_over_cap(self):
+        rep = preflight.plan_elle(n_txns=10_000, backend="tpu")
+        assert any(r["rule"] == "P002" for r in rep["rules"])
+        assert rep["verdict"] == "infeasible"
+
+    def test_p006_auto_route_degrades_on_cost_disagreement(self,
+                                                           monkeypatch):
+        # auto still holds the host engine in hand: an over-budget
+        # device pick degrades (P006) instead of rejecting
+        monkeypatch.setenv("JEPSEN_TPU_PREFLIGHT_MEM_BUDGET", "1e6")
+        # platform="tpu": the selector statically picks the dense
+        # bf16 squaring, whose byte model blows the tiny budget
+        rep = preflight.plan_elle(n_txns=2000, edges=8000,
+                                  rw_edges=2000, backend="auto",
+                                  platform="tpu")
+        assert rep["engine"] == "device"
+        assert any(r["rule"] == "P006" for r in rep["rules"])
+        assert rep["verdict"] == "degrade"
+
+    def test_p001_explicit_device_backend_rejects(self, monkeypatch):
+        # backend="device" explicitly pins the device plane — an
+        # over-budget closure is rejected, not degraded
+        monkeypatch.setenv("JEPSEN_TPU_PREFLIGHT_MEM_BUDGET", "1e6")
+        rep = preflight.plan_elle(n_txns=2000, backend="packed")
+        assert any(r["rule"] == "P001" for r in rep["rules"])
+        assert rep["verdict"] == "infeasible"
+
+    def test_closure_feasibility_oracle(self):
+        ok_small, _ = preflight.elle_closure_feasible(2000)
+        ok_huge, rep = preflight.elle_closure_feasible(500_000)
+        assert ok_small is True
+        assert ok_huge is False
+        assert rep["verdict"] == "infeasible"
+
+    def test_append_check_rejects_oversized_dense_request(self):
+        # a completed-only txn history past PACKED_MAX_N, forced onto
+        # the packed closure: rejected BEFORE the graph build, with
+        # zero backend compiles and zero device execution
+        from jepsen_tpu.elle import append as elle_append
+        from jepsen_tpu.elle.tpu import PACKED_MAX_N
+        n = PACKED_MAX_N + 8
+        h = History([{"type": "ok", "f": "txn", "process": 0,
+                      "time": i, "index": i,
+                      "value": [["append", 0, i]]}
+                     for i in range(n)])
+        with guards.CompileGuard(max_compiles=0, name="pf-append"):
+            res = elle_append.check(h, cycle_backend="packed")
+        assert res["valid?"] == "unknown"
+        assert res["anomaly-types"] == ["preflight"]
+        assert res["preflight"]["verdict"] == "infeasible"
+        assert any(r["rule"] == "P002"
+                   for r in res["preflight"]["rules"])
+
+    def test_append_check_small_device_request_admitted(self):
+        h = synth.list_append_history(120, n_procs=3, seed=7)
+        from jepsen_tpu.elle import append as elle_append
+        res = elle_append.check(h, cycle_backend="trim")
+        assert res["valid?"] in (True, False)  # decided, not rejected
+
+
+# ---------------------------------------------------------------------------
+# executed-plan parity (the acceptance shape, CI-sized)
+# ---------------------------------------------------------------------------
+
+class TestExecutedParity:
+    def test_elle_route_parity_vs_executed(self):
+        # the plan's route/kernel must match what the cycle search
+        # actually runs on the same tensors (real edge counts, not
+        # the gate-time estimates)
+        import numpy as np
+
+        from jepsen_tpu.elle import build as build_mod
+        from jepsen_tpu.elle import tpu as elle_tpu
+        from jepsen_tpu.elle.graph import RW
+        h = synth.list_append_history(1500, n_procs=5, seed=7)
+        oks = [op for op in h
+               if op.is_ok and op.f in ("txn", None) and op.value]
+        infos = [op for op in h
+                 if op.is_info and op.f in ("txn", None) and op.value]
+        bt = build_mod.build_append(h, oks, infos,
+                                   additional_graphs=("realtime",))
+        gt = bt.tensors
+        edges = np.asarray(gt.edges)
+        rw = int(np.sum(edges[:, 2] == RW)) if len(edges) else 0
+        rep = preflight.plan_elle(
+            n_txns=int(np.asarray(gt.nodes).shape[0]),
+            edges=int(len(edges)), rw_edges=rw, backend="auto")
+        res = elle_tpu.standard_cycle_search(gt, backend="auto")
+        ran_host = res.get("engine") in ("host", "host-fallback")
+        assert (rep["engine"] == "host") == ran_host, (rep, res)
+        if not ran_host:
+            assert rep.get("kernel") == (res.get("util")
+                                         or {}).get("kernel")
+
+    @pytest.mark.slow
+    def test_headline_10k_parity(self):
+        # the acceptance-criterion shape, verbatim (the CI-sized
+        # variant below runs in tier-1)
+        from jepsen_tpu.ops import wgl
+        model = cas_register()
+        hist = synth.cas_register_history(10_000, n_procs=5, seed=42,
+                                          crash_p=0.002)
+        rep = preflight.plan_wgl(model, hist, lower=True)
+        assert rep["verdict"] == "feasible"
+        assert rep["buckets"] == list(adapt.LADDER32)
+        with metrics.use(metrics.Registry()):
+            res = wgl.check(model, hist)
+        par = preflight._parity(rep, res)
+        assert par["kernel_match"] and par["buckets_subset"] \
+            and par["pack_match"], par
+        assert 0.9 <= par["drift_x"] <= 1.1, par
+
+    def test_plan_matches_executed_check(self, hist_2k):
+        from jepsen_tpu.ops import wgl
+        model = cas_register()
+        rep = preflight.plan_wgl(model, hist_2k, lower=True)
+        assert rep["verdict"] == "feasible"
+        with metrics.use(metrics.Registry()):
+            res = wgl.check(model, hist_2k)
+        assert res["valid?"] is True
+        par = preflight._parity(rep, res)
+        assert par["kernel_match"], par
+        assert par["buckets_subset"], par
+        assert par["pack_match"], par
+        assert par["bytes_per_round_predicted"] is not None
+        assert par["bytes_per_round_measured"] is not None
+        # within 10% of the executed plan's cost_analysis (exact by
+        # construction: shared cost_for cache keys)
+        assert 0.9 <= par["drift_x"] <= 1.1, par
+
+    def test_lower_warm_reuses_executed_cost(self, hist_2k):
+        # probe-only plan (no encode) still carries predicted bytes
+        # when the executed check already lowered the same kernels —
+        # the bench per-config block's zero-re-encode path
+        from jepsen_tpu.ops import wgl
+        model = cas_register()
+        with metrics.use(metrics.Registry()):
+            res = wgl.check(model, hist_2k)
+        rep = preflight.plan_wgl(model, hist_2k, lower="warm")
+        assert any(n.get("cost") for n in rep["plan"])
+        par = preflight._parity(rep, res)
+        assert par["bytes_per_round_predicted"] is not None
+        assert 0.9 <= par["drift_x"] <= 1.1, par
+
+    def test_warm_gate_is_zero_compile(self, hist_2k):
+        from jepsen_tpu.ops import wgl
+        model = cas_register()
+        wgl.check(model, hist_2k)  # warm the shape bucket
+        with guards.CompileGuard(max_compiles=0, name="pf-warm"):
+            assert preflight.gate_wgl(model, hist_2k,
+                                      where="test") is None
+            rep = preflight.plan_wgl(model, hist_2k, lower=True)
+            res = wgl.check(model, hist_2k)
+        assert rep["verdict"] == "feasible"
+        assert res["valid?"] is True
+
+
+# ---------------------------------------------------------------------------
+# fan-out gates
+# ---------------------------------------------------------------------------
+
+class TestFanoutGate:
+    def test_feasible_batch_passes(self):
+        from jepsen_tpu.ops.encode import encode
+        model = cas_register()
+        hists = [synth.cas_register_history(60, n_procs=3, seed=s)
+                 for s in range(3)]
+        encs = [encode(model, h) for h in hists]
+        assert preflight.gate_fanout(model, hists, encs=encs,
+                                     where="test") is None
+
+    def test_infeasible_bucket_rejects_whole_fanout(self, monkeypatch):
+        monkeypatch.setenv("JEPSEN_TPU_PREFLIGHT_MEM_BUDGET", "1000")
+        model = cas_register()
+        hists = [synth.cas_register_history(60, n_procs=3, seed=s)
+                 for s in range(2)]
+        bad = preflight.gate_fanout(model, hists, where="test")
+        assert bad is not None and set(bad) == {0, 1}
+        assert all(r["cause"] == "preflight" for r in bad.values())
+
+    def test_rejection_scoped_to_infeasible_group(self, monkeypatch):
+        # narrow (W<=32) and wide (W>32) groups compile SEPARATE
+        # kernels: a budget only the wide bucket blows must reject the
+        # wide keys alone, not the whole fan-out
+        from jepsen_tpu.ops.encode import encode
+        model = cas_register()
+        hists = [synth.cas_register_history(60, n_procs=3, seed=s)
+                 for s in range(2)]
+        hists.append(synth.cas_register_history(400, n_procs=40,
+                                                seed=9))
+        encs = [encode(model, h) for h in hists]
+        assert encs[2].window_raw > 32, "wide key must be wide"
+        narrow_pk = preflight.plan_wgl(
+            enc=encs[0])["hbm"]["peak_bytes"]
+        wide_pk = preflight.plan_wgl(
+            enc=encs[2])["hbm"]["peak_bytes"]
+        assert wide_pk > 2 * narrow_pk
+        monkeypatch.setenv("JEPSEN_TPU_PREFLIGHT_MEM_BUDGET",
+                           str((narrow_pk + wide_pk) // 2))
+        bad = preflight.gate_fanout(model, hists, encs=encs,
+                                    where="test")
+        assert bad is not None and set(bad) == {2}
+        assert bad[2]["cause"] == "preflight"
+        assert "P001" in bad[2]["rules"]
+
+    def test_histories_only_gate_is_per_key(self, monkeypatch):
+        # without encodings there is no shared bucket: each key is
+        # gated on its own probe plan, so a feasible key must not
+        # lose its verdict to an oversized neighbor
+        model = cas_register()
+        small = synth.cas_register_history(60, n_procs=3, seed=1)
+        big = synth.cas_register_history(400, n_procs=40, seed=9)
+        spk = preflight.plan_wgl(model, small)["hbm"]["peak_bytes"]
+        bpk = preflight.plan_wgl(model, big)["hbm"]["peak_bytes"]
+        assert bpk > spk
+        monkeypatch.setenv("JEPSEN_TPU_PREFLIGHT_MEM_BUDGET",
+                           str((spk + bpk) // 2))
+        bad = preflight.gate_fanout(model, [small, big], where="test")
+        assert bad is not None and set(bad) == {1}
+
+    def test_rejected_keys_close_fleet_accounting(self, monkeypatch):
+        # a preflight-rejected key must still count as decided in the
+        # run status — /status.json's keys block would otherwise show
+        # the fan-out as permanently in-flight
+        from jepsen_tpu import fleet
+        from jepsen_tpu.ops.encode import encode
+        from jepsen_tpu.parallel.batched import check_streamed
+        model = cas_register()
+        hists = [synth.cas_register_history(60, n_procs=3, seed=s)
+                 for s in range(2)]
+        hists.append(synth.cas_register_history(400, n_procs=40,
+                                                seed=9))
+        encs = [encode(model, h) for h in hists]
+        npk = preflight.plan_wgl(enc=encs[0])["hbm"]["peak_bytes"]
+        wpk = preflight.plan_wgl(enc=encs[2])["hbm"]["peak_bytes"]
+        monkeypatch.setenv("JEPSEN_TPU_PREFLIGHT_MEM_BUDGET",
+                           str((npk + wpk) // 2))
+        st = fleet.RunStatus(progress=False)
+        with fleet.use(st):
+            res = check_streamed(model, hists, time_limit=30,
+                                 encs=encs, oracle_fallback=False)
+        assert res[2]["cause"] == "preflight"
+        assert res[2]["shard"]["engine"] == "preflight"
+        keys = st.snapshot()["keys"]
+        assert keys["decided"] == keys["total"] == 3
+
+    def test_group_rejection_scoped_to_oversized_key(self, monkeypatch):
+        # within ONE kernel-branch group, only the key whose own plan
+        # is infeasible is rejected; the survivors' re-computed bucket
+        # admits the rest
+        from jepsen_tpu.ops.encode import encode
+        model = cas_register()
+        hists = [synth.cas_register_history(60, n_procs=3, seed=s)
+                 for s in range(2)]
+        hists.append(synth.cas_register_history(3000, n_procs=3,
+                                                seed=9))
+        encs = [encode(model, h) for h in hists]
+        assert all(e.window_raw <= 32 for e in encs)
+        spk = preflight.plan_wgl(enc=encs[0])["hbm"]["peak_bytes"]
+        bpk = preflight.plan_wgl(enc=encs[2])["hbm"]["peak_bytes"]
+        assert bpk > 2 * spk
+        monkeypatch.setenv("JEPSEN_TPU_PREFLIGHT_MEM_BUDGET",
+                           str((spk + bpk) // 2))
+        bad = preflight.gate_fanout(model, hists, encs=encs,
+                                    where="test")
+        assert bad is not None and set(bad) == {2}
+
+    def test_rejected_key_decided_by_oracle_fallback(self, monkeypatch):
+        # with oracle_fallback the rejection only scratches the DEVICE
+        # attempt — the host oracle still decides the key
+        from jepsen_tpu.parallel.batched import check_streamed
+        model = cas_register()
+        hists = [synth.cas_register_history(60, n_procs=3, seed=s)
+                 for s in range(2)]
+        monkeypatch.setenv("JEPSEN_TPU_PREFLIGHT_MEM_BUDGET", "1000")
+        res = check_streamed(model, hists, time_limit=30)
+        assert all(r["valid?"] is True for r in res)
+        assert all(r.get("device_cause") == "preflight" for r in res)
+
+    def test_competition_decides_despite_infeasible_plan(
+            self, monkeypatch):
+        # competition races device vs host: an infeasible DEVICE plan
+        # must not cost the request its verdict
+        from jepsen_tpu import checker as jchecker
+        model = cas_register()
+        h = synth.cas_register_history(60, n_procs=3, seed=3)
+        monkeypatch.setenv("JEPSEN_TPU_PREFLIGHT_MEM_BUDGET", "1000")
+        c = jchecker.linearizable(model, algorithm="competition",
+                                  time_limit=30)
+        res = c.check({}, h, {})
+        assert res["valid?"] is True
+        assert res["device_cause"] == "preflight"
+        bad = jchecker.linearizable(model, algorithm="tpu-wgl",
+                                    time_limit=30).check({}, h, {})
+        assert bad["valid?"] == "unknown"
+        assert bad["cause"] == "preflight"
+
+    def test_batch_mode_bills_lanes_per_device(self, monkeypatch):
+        # the lockstep vmap batch keeps every lane's buffers resident:
+        # 8 lanes on one device blow a 4x-one-lane budget even though
+        # each per-key kernel (mode="group") fits alone
+        from jepsen_tpu.ops.encode import encode
+        model = cas_register()
+        h = synth.cas_register_history(60, n_procs=3, seed=1)
+        enc = encode(model, h)
+        one = preflight.plan_wgl(enc=enc)["hbm"]["peak_bytes"]
+        monkeypatch.setenv("JEPSEN_TPU_PREFLIGHT_MEM_BUDGET",
+                           str(one * 4))
+        hs, es = [h] * 8, [enc] * 8
+        assert preflight.gate_fanout(model, hs, encs=es, where="test",
+                                     mode="group") is None
+        bad = preflight.gate_fanout(model, hs, encs=es, where="test",
+                                    mode="batch", n_devices=1)
+        assert bad is not None and set(bad) == set(range(8))
+        # sharded over 8 devices it is one lane per device again
+        assert preflight.gate_fanout(model, hs, encs=es, where="test",
+                                     mode="batch", n_devices=8) is None
+
+    def test_vmap_batch_degrades_to_streamed_scoped(self, monkeypatch):
+        # an infeasible BATCH kernel must not reject keys a per-key
+        # kernel can run: check_batched degrades to the streamed path,
+        # whose group gate rejects only the wide key
+        from jepsen_tpu.ops.encode import encode
+        from jepsen_tpu.parallel import check_batched
+        model = cas_register()
+        hists = [synth.cas_register_history(60, n_procs=3, seed=s)
+                 for s in range(2)]
+        hists.append(synth.cas_register_history(400, n_procs=40,
+                                                seed=9))
+        encs = [encode(model, h) for h in hists]
+        npk = preflight.plan_wgl(enc=encs[0])["hbm"]["peak_bytes"]
+        wpk = preflight.plan_wgl(enc=encs[2])["hbm"]["peak_bytes"]
+        monkeypatch.setenv("JEPSEN_TPU_PREFLIGHT_MEM_BUDGET",
+                           str((npk + wpk) // 2))
+        res = check_batched(model, hists, time_limit=30,
+                            oracle_fallback=False)
+        assert [r["valid?"] for r in res[:2]] == [True, True]
+        assert res[2]["valid?"] == "unknown"
+        assert res[2]["cause"] == "preflight"
+        assert res[2]["op_count"] == len(hists[2])
+
+    def test_check_batched_rejects_statically(self, monkeypatch):
+        monkeypatch.setenv("JEPSEN_TPU_PREFLIGHT_MEM_BUDGET", "1000")
+        from jepsen_tpu.parallel import check_batched
+        model = cas_register()
+        hists = [synth.cas_register_history(40, n_procs=3, seed=s)
+                 for s in range(2)]
+        res = check_batched(model, hists, time_limit=10,
+                            oracle_fallback=False)
+        assert all(r["valid?"] == "unknown" for r in res)
+        assert all(r["cause"] == "preflight" for r in res)
+        assert all(r["op_count"] == len(h)
+                   for r, h in zip(res, hists))
+
+
+# ---------------------------------------------------------------------------
+# telemetry: series + ledger schemas (good + drifted), status block
+# ---------------------------------------------------------------------------
+
+class TestTelemetry:
+    def test_series_point_lints_clean(self, tmp_path, hist_2k):
+        import telemetry_lint
+        reg = metrics.Registry()
+        with metrics.use(reg):
+            preflight.gate_wgl(cas_register(), hist_2k, where="test")
+        path = tmp_path / "pf_metrics.jsonl"
+        reg.export_jsonl(str(path))
+        assert telemetry_lint.lint_jsonl_file(str(path)) == []
+        pts = reg.series("preflight").points
+        assert pts and pts[-1]["verdict"] == "feasible"
+        assert pts[-1]["where"] == "test"
+
+    def test_drifted_series_point_flagged(self, tmp_path):
+        import telemetry_lint
+        bad = {"type": "sample", "series": "preflight", "t": 1.0,
+               "where": "x", "kind": "wgl", "verdict": 7,
+               "rules": "P001"}
+        p = tmp_path / "drift.jsonl"
+        p.write_text(json.dumps(bad) + "\n")
+        errs = telemetry_lint.lint_jsonl_file(str(p))
+        assert any("verdict" in e for e in errs)
+        assert any("rules" in e for e in errs)
+
+    def test_ledger_record_written_and_lints(self, tmp_path, hist_2k):
+        import telemetry_lint
+        led = ledger_mod.Ledger(str(tmp_path))
+        with ledger_mod.use(led):
+            preflight.gate_wgl(cas_register(), hist_2k,
+                               where="test", ledger_name="pf-test")
+        recs = led.query(kind="preflight")
+        assert len(recs) == 1
+        rec = recs[0]
+        assert rec["verdict"] == "feasible"
+        assert isinstance(rec["rules"], list)
+        assert rec["preflight"]["kind"] == "wgl"
+        assert telemetry_lint.lint_ledger_file(led.index_path) == []
+        rec_file = led.record_path(rec["id"])
+        assert telemetry_lint.lint_ledger_file(rec_file) == []
+
+    def test_drifted_ledger_record_flagged(self, tmp_path):
+        import telemetry_lint
+        bad = {"schema": 1, "id": "x", "kind": "preflight",
+               "name": "n", "t": 1.0, "verdict": "maybe",
+               "rules": {}, "preflight": "nope"}
+        p = tmp_path / "index.jsonl"
+        p.write_text(json.dumps(bad) + "\n")
+        errs = telemetry_lint.lint_ledger_file(str(p))
+        assert any("verdict" in e for e in errs)
+        assert any("rules" in e for e in errs)
+        assert any("report object" in e for e in errs)
+
+    def test_status_snapshot_carries_preflight_block(self, tmp_path):
+        from jepsen_tpu import web
+        preflight.gate_elle(100, backend="auto", where="status-test")
+        snap = web.status_snapshot(str(tmp_path))
+        pf = snap["preflight"]
+        assert pf["checked"] >= 1
+        assert isinstance(pf["verdicts"], dict)
+        assert pf["recent"][-1]["where"] in ("status-test", "test")
+
+
+# ---------------------------------------------------------------------------
+# jaxlint J007 / J008 + CLI flags
+# ---------------------------------------------------------------------------
+
+class TestJaxlintNewRules:
+    def test_j007_fixture(self):
+        found = jaxlint.lint_file(
+            os.path.join(FIXTURES, "fixture_j007.py"))
+        assert {f.rule for f in found} == {"J007"}
+        assert len(found) == 2  # while-loop asarray + for-loop get
+
+    def test_j008_fixture(self):
+        found = jaxlint.lint_file(
+            os.path.join(FIXTURES, "fixture_j008.py"))
+        assert {f.rule for f in found} == {"J008"}
+        # the call form + both decorator spellings (@jax.jit and
+        # @partial(jax.jit, ...)); the donated variants stay clean
+        assert len(found) == 3
+
+    def test_j008_donated_kernel_clean(self):
+        src = ("import functools, jax\n"
+               "@functools.lru_cache\n"
+               "def build(n):\n"
+               "    def chunk_fn(consts, carry):\n"
+               "        return carry\n"
+               "    return jax.jit(chunk_fn, donate_argnums=(1,))\n")
+        assert jaxlint.lint_source(src, "ok.py") == []
+
+    def test_j007_host_only_loop_clean(self):
+        # np.asarray over host data in a for loop is idiomatic numpy
+        src = ("import numpy as np\n"
+               "def f(items):\n"
+               "    out = []\n"
+               "    for x in items:\n"
+               "        y = build(x)\n"
+               "        out.append(np.asarray(y))\n"
+               "    return out\n")
+        findings = jaxlint.lint_source(src, "host.py")
+        assert all(f.rule != "J007" for f in findings)
+
+    def test_file_level_allowlist(self):
+        src = ('"""doc\n'
+               "# jaxlint: ok-file(J007)\n"
+               '"""\n'
+               "import numpy as np\n"
+               "def poll(step, c):\n"
+               "    while True:\n"
+               "        c, s = step(c)\n"
+               "        v = np.asarray(s)\n"
+               "        if v[0]:\n"
+               "            return v\n")
+        assert jaxlint.lint_source(src, "allow.py") == []
+
+    def test_cli_rules_filter(self):
+        proc = subprocess.run(
+            [sys.executable, LINT_CLI, "--rules", "J008",
+             os.path.join(FIXTURES, "fixture_j007.py"),
+             os.path.join(FIXTURES, "fixture_j008.py")],
+            capture_output=True, text=True)
+        assert proc.returncode == 1
+        assert "J008" in proc.stderr and "J007" not in proc.stderr
+
+    def test_cli_rules_rejects_unknown(self):
+        proc = subprocess.run(
+            [sys.executable, LINT_CLI, "--rules", "J999"],
+            capture_output=True, text=True)
+        assert proc.returncode == 254
+
+    def test_cli_changed_only_scopes_to_paths(self):
+        # scoped to a directory with no changed files: exits clean
+        # whatever the work tree looks like
+        proc = subprocess.run(
+            [sys.executable, LINT_CLI, "--changed-only",
+             os.path.join(REPO, "jepsen_tpu", "dbs")],
+            capture_output=True, text=True)
+        assert proc.returncode == 0
+
+    def test_extended_default_paths_lint_clean(self):
+        # scripts/ + bench.py are gated now (satellite: the tree must
+        # stay clean under the wider net)
+        proc = subprocess.run(
+            [sys.executable, LINT_CLI, "--check"],
+            capture_output=True, text=True)
+        assert proc.returncode == 0, proc.stderr
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+class TestCli:
+    def test_preflight_dense_100k(self, capsys):
+        from jepsen_tpu import __main__ as main_mod
+        from jepsen_tpu import cli
+        rc = cli.run_cli(main_mod.COMMANDS,
+                         ["preflight", "--config", "dense_100k"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "infeasible" in out
+        assert "P002" in out
+
+    def test_preflight_unknown_config(self):
+        from jepsen_tpu import __main__ as main_mod
+        from jepsen_tpu import cli
+        rc = cli.run_cli(main_mod.COMMANDS,
+                         ["preflight", "--config", "nope"])
+        assert rc == 254
