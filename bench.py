@@ -195,6 +195,28 @@ def _config_entry(res: dict, wall: float) -> dict:
                             ("kernel", "K", "rounds_total",
                              "rounds_dropped", "fill", "memo",
                              "roofline")}
+    hbm = _measured_hbm(res)
+    if hbm is not None:
+        # the device observatory's measured window (devices.py) —
+        # peak_measured beside the preflight prediction, or the
+        # explicit stats_unavailable marker on statless backends
+        out["hbm"] = hbm
+    return out
+
+
+def _measured_hbm(res: dict) -> Optional[dict]:
+    """The compact measured-HBM block of a result: from the result's
+    own `hbm` window (wgl/batched) or the util's (elle closure)."""
+    hbm = res.get("hbm")
+    if not isinstance(hbm, dict):
+        hbm = (res.get("util") or {}).get("hbm") \
+            if isinstance(res.get("util"), dict) else None
+    if not isinstance(hbm, dict):
+        return None
+    out = {"peak_measured": hbm.get("peak_measured"),
+           "stats_available": bool(hbm.get("stats_available"))}
+    if hbm.get("stats_unavailable") or not out["stats_available"]:
+        out["stats_unavailable"] = True
     return out
 
 
@@ -221,9 +243,31 @@ def _preflight_block(model, hist, res) -> Optional[dict]:
                   "bytes_per_round_measured", "drift_x"):
             if par.get(k) is not None:
                 blk[k] = par[k]
+        _attach_hbm_drift(blk, res)
         return blk
     except Exception:  # noqa: BLE001 — the admission model must
         return None    # never cost a measured number
+
+
+def _attach_hbm_drift(blk: dict, res: dict) -> None:
+    """Measured-vs-predicted HBM closure on a preflight block: the
+    device observatory's measured peak lands beside the analytic
+    `hbm_peak_bytes`, with `hbm_drift_x` = measured/predicted
+    (devices.drift_x — the one ratio definition the regression gate
+    shares). Statless backends get the explicit marker instead of a
+    number."""
+    from jepsen_tpu import devices as devices_mod
+    hbm = _measured_hbm(res)
+    if hbm is None:
+        return
+    measured = hbm.get("peak_measured")
+    if measured is None:
+        blk["hbm_stats_unavailable"] = True
+        return
+    blk["hbm_peak_measured"] = measured
+    ratio = devices_mod.drift_x(measured, blk.get("hbm_peak_bytes"))
+    if ratio is not None:
+        blk["hbm_drift_x"] = ratio
 
 
 def run_extras(budget: float, deadline: float) -> dict:
@@ -394,10 +438,13 @@ def run_extras(budget: float, deadline: float) -> dict:
                 "verdict": rep["verdict"],
                 "engine": rep["engine"],
                 "kernel": rep.get("kernel"),
+                "hbm_peak_bytes": (rep.get("hbm") or {}).get(
+                    "peak_bytes"),
                 "rules": [r["rule"] for r in rep["rules"]],
                 "engine_match": ((rep["engine"] == "host")
                                  == (ran in ("host",
                                              "host-fallback")))}
+            _attach_hbm_drift(out["preflight"], out)
         except Exception:  # noqa: BLE001 — advisory block only
             pass
         return out
@@ -648,6 +695,13 @@ def run_bench() -> tuple[dict, int]:
     _LEDGER = ledger_mod.Ledger(os.path.join(REPO_ROOT, "store"))
     ledger_mod.set_default(_LEDGER)
     watchdog_mod.set_default(watchdog_mod.Watchdog())
+    # Device observatory (devices.py): live HBM accounting sampled at
+    # the kernels' existing poll cadences — every measured result
+    # carries hbm_peak_measured beside preflight's analytic
+    # prediction, and the drift gate flags a mispredicting byte model
+    # on this very line (compute_regressions "<name>:hbm").
+    from jepsen_tpu import devices as devices_mod
+    devices_mod.set_default(devices_mod.DeviceMonitor())
 
     from jepsen_tpu.models import cas_register
     from jepsen_tpu.ops import wgl
@@ -809,6 +863,7 @@ def run_bench() -> tuple[dict, int]:
            "configs_explored": res.get("configs_explored"),
            "util": res.get("util"),
            "occupancy": res.get("occupancy"),
+           "hbm": _measured_hbm(res),
            "telemetry": res.get("telemetry"),
            "probe_diagnostics": probe_diags}
     pf = _preflight_block(model, hist, res)
@@ -1001,6 +1056,7 @@ def load_bench_rounds(root: str = REPO_ROOT) -> list:
             continue
         configs = {}
         fills = {}
+        hbm_drift = {}
         for name, c in (parsed.get("configs") or {}).items():
             if isinstance(c, dict) and isinstance(
                     c.get("wall_s"), (int, float)):
@@ -1010,6 +1066,12 @@ def load_bench_rounds(root: str = REPO_ROOT) -> list:
             if isinstance(c, dict) and isinstance(
                     c.get("frontier_fill"), (int, float)):
                 fills[name] = c["frontier_fill"]
+            # measured-vs-predicted HBM trajectory: the compact
+            # preflight block carries hbm_drift_x per config
+            pf = c.get("preflight") if isinstance(c, dict) else None
+            if isinstance(pf, dict) and isinstance(
+                    pf.get("hbm_drift_x"), (int, float)):
+                hbm_drift[name] = pf["hbm_drift_x"]
         rounds.append({"round": int(m.group(1)),
                        "file": os.path.basename(path),
                        "value": parsed.get("value"),
@@ -1017,6 +1079,7 @@ def load_bench_rounds(root: str = REPO_ROOT) -> list:
                        "verdict": parsed.get("verdict"),
                        "configs": configs,
                        "fills": fills,
+                       "hbm_drift": hbm_drift,
                        "source": "glob"})
     by_round = {r["round"]: r for r in rounds}
     try:
@@ -1037,10 +1100,30 @@ def load_bench_rounds(root: str = REPO_ROOT) -> list:
                 "fills": {k: v for k, v in
                           (rec.get("fills") or {}).items()
                           if isinstance(v, (int, float))},
+                "hbm_drift": {k: v for k, v in
+                              (rec.get("hbm_drift") or {}).items()
+                              if isinstance(v, (int, float))},
                 "source": "ledger"}
     except Exception:  # noqa: BLE001 — a torn ledger never hides
         pass  # the glob rounds
     return sorted(by_round.values(), key=lambda r: r["round"])
+
+
+def _collect_hbm_drift(out: dict) -> dict:
+    """{config: hbm_drift_x} off the preflight blocks this run
+    attached (headline included, under its metric name) — the
+    drift-gate input compute_regressions consumes."""
+    drift: dict = {}
+    pf = out.get("preflight")
+    if isinstance(pf, dict) and isinstance(
+            pf.get("hbm_drift_x"), (int, float)):
+        drift[out.get("metric") or "headline"] = pf["hbm_drift_x"]
+    for name, c in (out.get("configs") or {}).items():
+        cpf = c.get("preflight") if isinstance(c, dict) else None
+        if isinstance(cpf, dict) and isinstance(
+                cpf.get("hbm_drift_x"), (int, float)):
+            drift[name] = cpf["hbm_drift_x"]
+    return drift
 
 
 def _delta_row(latest, priors: list, threshold: float) -> dict:
@@ -1068,7 +1151,7 @@ def compute_regressions(rounds: list, current=None,
         if not rounds:
             return {"schema": 1, "threshold_x": threshold,
                     "rounds": [], "current": None, "headline": {},
-                    "configs": {}, "regressions": [],
+                    "configs": {}, "hbm": {}, "regressions": [],
                     "note": "no parseable rounds"}
         current = rounds[-1]
         rounds = rounds[:-1]
@@ -1079,6 +1162,25 @@ def compute_regressions(rounds: list, current=None,
                  "compared_rounds": [r["round"] for r in prior],
                  "rounds": rounds, "current": current,
                  "headline": {}, "configs": {}, "regressions": []}
+    # measured-vs-predicted HBM closure (devices.py): a config whose
+    # measured peak drifts more than HBM_DRIFT_X from preflight's
+    # analytic prediction — either way — is flagged "<name>:hbm".
+    # Unlike the wall/fill rows this gate needs NO priors (the
+    # prediction IS the baseline), so it runs before the
+    # no-comparable-rounds early return: a mispredicting byte model
+    # trips on the very round that measured it.
+    from jepsen_tpu import devices as devices_mod
+    out["hbm"] = {}
+    for name, ratio in sorted((current.get("hbm_drift")
+                               or {}).items()):
+        if not isinstance(ratio, (int, float)):
+            continue
+        row = {"drift_x": round(float(ratio), 4),
+               "threshold_x": devices_mod.HBM_DRIFT_X,
+               "regressed": devices_mod.drift_regressed(ratio)}
+        out["hbm"][name] = row
+        if row["regressed"]:
+            out["regressions"].append(f"{name}:hbm")
     if not prior:
         out["note"] = (f"no prior rounds on platform {plat!r}; "
                        "nothing comparable")
@@ -1148,7 +1250,8 @@ def _export_regressions(out: dict) -> None:
                 if isinstance(c, dict)
                 and isinstance(c.get("util"), dict)
                 and isinstance(c["util"].get("frontier_fill"),
-                               (int, float))}}
+                               (int, float))},
+            "hbm_drift": _collect_hbm_drift(out)}
         threshold = float(os.environ.get(
             "JEPSEN_TPU_BENCH_REGRESSION_X", "1.5"))
         report = compute_regressions(rounds, current,
@@ -1167,7 +1270,8 @@ def _export_regressions(out: dict) -> None:
                             "verdict": current["verdict"],
                             "wall_s": current["value"],
                             "configs": current["configs"],
-                            "fills": current["fills"]})
+                            "fills": current["fills"],
+                            "hbm_drift": current["hbm_drift"]})
         art = os.path.join(REPO_ROOT, "artifacts", "telemetry")
         os.makedirs(art, exist_ok=True)
         with open(os.path.join(art, "regressions.json"), "w") as fh:

@@ -103,11 +103,29 @@ CLOSURE_LIVE_FACTOR = 3
 
 
 def device_memory_budget(platform: Optional[str] = None) -> int:
-    """The byte budget a plan's peak live bytes must fit
-    (JEPSEN_TPU_PREFLIGHT_MEM_BUDGET overrides; default: v5e HBM)."""
+    """The byte budget a plan's peak live bytes must fit. Precedence:
+
+      1. JEPSEN_TPU_PREFLIGHT_MEM_BUDGET (the operator always wins);
+      2. the chip's OWN `bytes_limit` from `Device.memory_stats()`
+         when an initialized backend reports one
+         (`devices.measured_bytes_limit` — min across local devices,
+         init-safe: never triggers or waits on a backend init), so
+         admission budgets stop assuming every chip is a v5e;
+      3. the v5e spec constant — cpu tier-1 (no allocator stats) and
+         planning-before-init land here, a conservative host budget
+         either way: the dense-closure blowups P001 exists for are
+         6-100 GB, far past any sane budget.
+    """
     env = os.environ.get("JEPSEN_TPU_PREFLIGHT_MEM_BUDGET")
     if env:
         return int(float(env))
+    try:
+        from .. import devices as devices_mod
+        measured = devices_mod.measured_bytes_limit()
+    except Exception:  # noqa: BLE001 — the budget must never raise
+        measured = None
+    if measured:
+        return int(measured)
     return V5E_HBM_CAPACITY_BYTES
 
 

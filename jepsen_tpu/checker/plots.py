@@ -272,9 +272,13 @@ def occupancy_heatmap(test, points, opts=None,
     single-search view is a 1-lane strip (occupancy.heatmap_points),
     the mesh-batched fan-out one lane per key (`wgl_batched_rounds`
     series), where stragglers show up as long hot rows and empty
-    lanes as cold ones. `out_path` renders to an explicit file (the
-    bench's artifact tree) instead of the test's store dir. Never
-    raises — occupancy rendering must not mask a verdict."""
+    lanes as cold ones. Points carrying a `device` field (the mesh
+    fan-out's lane->device attribution, parallel/batched.py) render
+    an extra per-device column strip beside the lane axis, so the
+    mesh layout is readable off the heatmap itself. `out_path`
+    renders to an explicit file (the bench's artifact tree) instead
+    of the test's store dir. Never raises — occupancy rendering must
+    not mask a verdict."""
     try:
         pts = [p for p in (points or [])
                if isinstance(p, dict)
@@ -289,10 +293,19 @@ def occupancy_heatmap(test, points, opts=None,
         ridx = {r: i for i, r in enumerate(rounds)}
         lidx = {la: i for i, la in enumerate(lanes)}
         grid = np.full((len(lanes), len(rounds)), np.nan)
+        lane_dev: dict = {}
         for p in pts:
             grid[lidx[p["lane"]], ridx[p["round"]]] = p["fill"]
-        fig, ax = plt.subplots(
-            figsize=(10, max(2.0, 0.25 * len(lanes) + 1.5)))
+            if isinstance(p.get("device"), int):
+                lane_dev[lidx[p["lane"]]] = p["device"]
+        figsize = (10, max(2.0, 0.25 * len(lanes) + 1.5))
+        if lane_dev and len(lanes) > 1:
+            fig, (ax, axd) = plt.subplots(
+                1, 2, figsize=figsize, sharey=True,
+                gridspec_kw={"width_ratios": [40, 1], "wspace": 0.02})
+        else:
+            fig, ax = plt.subplots(figsize=figsize)
+            axd = None
         im = ax.imshow(grid, aspect="auto", origin="lower",
                        interpolation="nearest", vmin=0.0, vmax=1.0,
                        cmap="viridis",
@@ -307,6 +320,26 @@ def occupancy_heatmap(test, points, opts=None,
             ax.set_yticks([])
         ax.set_title(f"{(test or {}).get('name', '')} frontier fill "
                      f"(round x lane)")
+        if axd is not None:
+            # the per-device column strip: one colored cell per lane,
+            # banded by mesh-device index — contiguous bands ARE the
+            # NamedSharding layout, so a straggler row reads straight
+            # to its chip
+            devcol = np.full((len(lanes), 1), np.nan)
+            for li, d in lane_dev.items():
+                # cycle the 10-color map past device 9 (clamping
+                # would merge devices 9..N into one band); the text
+                # label below keeps the true index readable
+                devcol[li, 0] = d % 10
+            axd.imshow(devcol, aspect="auto", origin="lower",
+                       interpolation="nearest", cmap="tab10",
+                       vmin=-0.5, vmax=9.5,
+                       extent=(-0.5, 0.5, -0.5, len(lanes) - 0.5))
+            axd.set_xticks([])
+            axd.set_title("dev", fontsize=7)
+            for li, d in sorted(lane_dev.items()):
+                axd.text(0, li, str(int(d) % 100), fontsize=5,
+                         ha="center", va="center", color="white")
         fig.colorbar(im, ax=ax, label="fill")
         if out_path:
             parent = os.path.dirname(out_path)

@@ -527,6 +527,8 @@ def serve_cmd() -> dict:
         print(f"Listening on {base}/")
         print(f"Live run status: {base}/status "
               f"(JSON: {base}/status.json)")
+        print(f"Device observatory: {base}/devices "
+              f"· occupancy: {base}/occupancy")
         try:
             server.serve_forever()
         except KeyboardInterrupt:

@@ -193,6 +193,12 @@ def run(test: dict) -> dict:
     prev_ledger = ledger_mod.set_default(
         ledger_mod.Ledger(test.get("store_root") or store.BASE_DIR)
         if writer else ledger_mod.get_default())
+    # Device observatory (devices.py): per-run HBM accounting sampled
+    # at the kernels' existing poll cadences — /status.json's `hbm`
+    # block, the /devices panel, and hbm_peak_measured on results all
+    # read from this ambient monitor.
+    from . import devices as devices_mod
+    prev_devmon = devices_mod.set_default(devices_mod.DeviceMonitor())
     wd_installed = None
     if not watchdog_mod.get_default().enabled:
         wd_installed = watchdog_mod.Watchdog()
@@ -297,6 +303,7 @@ def run(test: dict) -> dict:
             except Exception:  # noqa: BLE001
                 log.warning("ledger record failed", exc_info=True)
         ledger_mod.set_default(prev_ledger)
+        devices_mod.set_default(prev_devmon)
         if wd_installed is not None:
             wd_installed.stop()
             watchdog_mod.set_default(prev_wd)

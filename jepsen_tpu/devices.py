@@ -1,0 +1,450 @@
+"""Device observatory: live HBM accounting + per-device mesh telemetry.
+
+Every byte figure this tree reported before this module was a spec
+constant — `ops/aot.py` models a v5e's 16 GiB / 819 GB/s, and
+`analysis/preflight.py` admits plans against the same number — so the
+admission verdicts, the roofline, and the multi-chip dryruns all ran
+open-loop: nothing ever *measured* a device. This module closes the
+loop from the runtime side:
+
+  * **`DeviceMonitor`** samples `jax.local_devices()` /
+    `Device.memory_stats()` (`bytes_in_use`, `peak_bytes_in_use`,
+    `bytes_limit`) on the EXISTING poll cadences — the WGL chunk
+    poll, the batched vmap poll, the Elle closure call — so no extra
+    device round-trips exist: `memory_stats()` is a host-side
+    allocator query. Backends without stats (the cpu tier-1 runs:
+    `memory_stats()` returns None on jax's TFRT CPU devices) degrade
+    to an explicit `stats_unavailable` marker, never a guess.
+  * **measured-vs-predicted closure** — `mark()` / `measured()`
+    bracket a search so its result carries `hbm_peak_measured`
+    beside preflight's analytic `hbm.peak_bytes`; `HBM_DRIFT_X`
+    (1.25x, either way) is the drift gate `bench.compute_regressions`
+    flags `<name>:hbm` with, so P001's byte model is continuously
+    validated instead of trusted.
+  * **budget closure** — `measured_bytes_limit()` feeds
+    `analysis/preflight.device_memory_budget` the chip's OWN
+    `bytes_limit` when the backend reports one, so admission budgets
+    stop assuming every chip is a v5e (env override still wins, the
+    spec constant stays the fallback).
+
+Telemetry lands in two linted series (scripts/telemetry_lint.py,
+doc/OBSERVABILITY.md "Device & memory plane"): `hbm` (one point per
+device per poll: bytes_in_use / peak_bytes_in_use / bytes_limit) and
+`device_poll` (one point per poll: where it sampled, device count,
+how many devices actually reported stats). `/status.json` carries an
+`hbm` block and `python -m jepsen_tpu serve` renders `/devices`;
+`occupancy.perfetto_counter_tracks` turns the `hbm` series into
+per-device Perfetto counter lanes.
+
+Zero-cost contract (matching metrics/fleet/ledger): the ambient
+default is a disabled `NULL_MONITOR` whose `sample()` returns
+immediately. `bench.py` and `core.run` install a real one;
+`JEPSEN_TPU_DEVICES=1` enables it ambiently.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+import time
+from typing import Iterator, Optional
+
+# Measured-vs-predicted drift gate: a search whose measured HBM peak
+# lands more than this factor away from preflight's analytic
+# `hbm.peak_bytes` (either direction) is flagged `<name>:hbm` by
+# bench.compute_regressions — an over-prediction wastes admission
+# capacity, an under-prediction admits plans that OOM.
+HBM_DRIFT_X = 1.25
+
+# Sampling throttle: the WGL cpu poll loop runs at a few hundred Hz on
+# tiny shapes; allocator queries are cheap but not free, and per-round
+# resolution of a *memory* series is noise. ~20 Hz keeps every real
+# poll cadence (>= 75 ms on tunneled accelerators) fully sampled.
+MIN_INTERVAL_S = 0.05
+
+_STAT_KEYS = ("bytes_in_use", "peak_bytes_in_use", "bytes_limit")
+
+
+def _backend_up() -> bool:
+    """Has a jax default backend ALREADY initialized in this process?
+    A pure peek — never takes the init lock, never spawns the init
+    probe: the monitor must be safe to call from admission paths that
+    promise zero device work (preflight's contract), and a wedged
+    accelerator runtime hangs init rather than raising."""
+    try:
+        from jax._src import xla_bridge
+        return getattr(xla_bridge, "_default_backend", None) is not None
+    except Exception:  # noqa: BLE001 — private API moved: assume down
+        return False
+
+
+def read_memory_stats(dev) -> Optional[dict]:
+    """{bytes_in_use, peak_bytes_in_use, bytes_limit} for one jax
+    device (whatever subset its backend reports), or None where the
+    backend lacks stats — jax's TFRT CPU devices return None from
+    `memory_stats()`, so the cpu tier-1 runs take the graceful
+    no-stats path by construction."""
+    try:
+        ms = dev.memory_stats()
+    except Exception:  # noqa: BLE001 — older plugins raise instead
+        return None
+    if not isinstance(ms, dict):
+        return None
+    out = {}
+    for k in _STAT_KEYS:
+        v = ms.get(k)
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            out[k] = int(v)
+    return out or None
+
+
+class DeviceMonitor:
+    """Per-device memory/health sampler over the existing poll
+    cadences. Thread-safe: streamed fan-out workers and the batched
+    poll loop share one ambient monitor, and concurrent searches each
+    bracket their own `mark()`/`measured()` window.
+
+    `devices` pins an explicit device list (tests use fakes with a
+    `memory_stats()` dict); the default reads `jax.local_devices()`
+    — but ONLY when a backend is already up (`_backend_up`), so the
+    monitor can never trigger (or hang on) a backend init."""
+
+    def __init__(self, enabled: bool = True, devices=None,
+                 min_interval_s: float = MIN_INTERVAL_S):
+        self.enabled = bool(enabled)
+        self._devices = list(devices) if devices is not None else None
+        self.min_interval_s = float(min_interval_s)
+        self._lock = threading.Lock()
+        self._last: dict = {}       # label -> last per-device stat
+        self._order: list = []      # stable label order
+        self._peak_seen: dict = {}  # label -> max bytes_in_use sampled
+        self._marks: list = []      # open measurement windows
+        self._polls = 0
+        self._last_t = 0.0
+
+    # -- device list --------------------------------------------------
+    def _device_list(self) -> list:
+        if self._devices is not None:
+            return self._devices
+        if not _backend_up():
+            return []
+        try:
+            import jax
+            return jax.local_devices()
+        except Exception:  # noqa: BLE001 — a torn backend never
+            return []      # breaks the instrumented loop
+
+    # -- sampling -----------------------------------------------------
+    def sample(self, where: str = "poll", force: bool = False,
+               mx=None) -> list:
+        """One poll over every local device. Returns the per-device
+        stat dicts ([] when disabled, deviceless, or throttled) and
+        records them into the ambient metrics registry (`hbm` series
+        per stats-reporting device + one `device_poll` point). The
+        throttle keeps sub-`min_interval_s` poll loops from turning a
+        memory series into noise; `force=True` (mark/measured
+        boundaries) always samples."""
+        if not self.enabled:
+            return []
+        now = time.monotonic()
+        with self._lock:
+            if not force and now - self._last_t < self.min_interval_s:
+                return []
+            self._last_t = now
+        devs = self._device_list()
+        if not devs:
+            return []
+        from . import fleet as _fleet
+        stats = []
+        for i, d in enumerate(devs):
+            label = _fleet.device_label(d)
+            ms = read_memory_stats(d)
+            stat = {"device": label, "index": i,
+                    "kind": getattr(d, "device_kind", None),
+                    "stats": ms is not None}
+            if ms:
+                stat.update(ms)
+            stats.append(stat)
+        with self._lock:
+            self._polls += 1
+            for stat in stats:
+                label = stat["device"]
+                if label not in self._last:
+                    self._order.append(label)
+                self._last[label] = stat
+                biu = stat.get("bytes_in_use")
+                if biu is not None:
+                    self._peak_seen[label] = max(
+                        self._peak_seen.get(label, 0), biu)
+                    for mk in self._marks:
+                        w = mk["win_max"]
+                        w[label] = max(w.get(label, 0), biu)
+        self._record(stats, where, mx=mx)
+        return stats
+
+    def _record(self, stats: list, where: str, mx=None) -> None:
+        from . import metrics as _metrics
+        mx = mx if mx is not None else _metrics.get_default()
+        if not mx.enabled:
+            return
+        avail = [s for s in stats if s["stats"]]
+        series = mx.series(
+            "hbm", "per-device memory accounting sampled at existing "
+                   "poll boundaries (bytes_in_use / peak / limit)")
+        for s in avail:
+            # the linted point schema requires bytes_in_use — a
+            # backend reporting only exotic stat keys stays in the
+            # device_poll envelope, never a malformed series point
+            if s.get("bytes_in_use") is not None:
+                series.append(dict(s))
+        mx.series(
+            "device_poll",
+            "one point per device-observatory poll: where it sampled "
+            "and how many devices reported stats").append({
+                "where": str(where),
+                "n_devices": len(stats),
+                "stats_available": len(avail),
+                "bytes_in_use_total": sum(
+                    s.get("bytes_in_use") or 0 for s in avail),
+            })
+        mx.counter("device_polls_total",
+                   "device-observatory sampling polls").inc(
+            where=str(where))
+
+    # -- measurement windows ------------------------------------------
+    def mark(self, where: str = "mark") -> Optional[dict]:
+        """Open a measurement window (sampling once, unthrottled):
+        the returned token accumulates each device's max bytes_in_use
+        over later samples until `measured()` closes it. None when
+        disabled — callers keep a `None` token and skip `measured`."""
+        if not self.enabled:
+            return None
+        self.sample(where=where, force=True)
+        with self._lock:
+            token = {
+                "t0": time.monotonic(),
+                "polls0": self._polls,
+                "peak0": {lb: (self._last[lb].get("peak_bytes_in_use"))
+                          for lb in self._order},
+                "win_max": {lb: (self._last[lb].get("bytes_in_use")
+                                 or 0)
+                            for lb in self._order
+                            if self._last[lb]["stats"]},
+            }
+            self._marks.append(token)
+            del self._marks[:-64]  # bounded: leaked windows expire
+        return token
+
+    def measured(self, token: Optional[dict],
+                 where: str = "measured") -> dict:
+        """Close a window: one final sample, then the per-window HBM
+        block. Per device, `peak_measured` is the allocator's own
+        `peak_bytes_in_use` when it GREW inside the window (the new
+        high belongs to this window), else the max `bytes_in_use`
+        observed at the window's samples — a sampled lower bound,
+        honest about being one. Without stats (cpu tier-1) the block
+        is the explicit `stats_unavailable` marker."""
+        if not self.enabled or token is None:
+            return {"schema": 1, "stats_available": False,
+                    "stats_unavailable": True, "peak_measured": None,
+                    "devices": {}, "samples": 0}
+        self.sample(where=where, force=True)
+        with self._lock:
+            with contextlib.suppress(ValueError):
+                self._marks.remove(token)
+            devices: dict = {}
+            peaks: list = []
+            for label in self._order:
+                last = self._last.get(label) or {}
+                if not last.get("stats"):
+                    continue
+                peak0 = token["peak0"].get(label)
+                peak_now = last.get("peak_bytes_in_use")
+                win = token["win_max"].get(
+                    label, last.get("bytes_in_use") or 0)
+                if peak_now is not None and (peak0 is None
+                                             or peak_now > peak0):
+                    pm = max(peak_now, win)
+                else:
+                    pm = win
+                devices[label] = {
+                    "bytes_in_use": last.get("bytes_in_use"),
+                    "peak_bytes_in_use": peak_now,
+                    "bytes_limit": last.get("bytes_limit"),
+                    "peak_measured": int(pm),
+                }
+                peaks.append(int(pm))
+            # samples taken INSIDE this window — the lifetime poll
+            # count would overstate a short window's coverage by
+            # whatever the monitor did before it
+            samples = self._polls - int(token.get("polls0", 0))
+        out = {"schema": 1,
+               "stats_available": bool(devices),
+               "peak_measured": max(peaks) if peaks else None,
+               "devices": devices,
+               "samples": samples}
+        if not devices:
+            out["stats_unavailable"] = True
+        return out
+
+    # -- readers ------------------------------------------------------
+    def snapshot(self) -> dict:
+        """The `/status.json` `hbm` block: last per-device stats, the
+        run-wide sampled peaks, and how much of the fleet actually
+        reports stats."""
+        with self._lock:
+            devices = {}
+            for label in self._order:
+                last = dict(self._last.get(label) or {})
+                last.pop("device", None)
+                ps = self._peak_seen.get(label)
+                if ps is not None:
+                    last["peak_seen"] = ps
+                    limit = last.get("bytes_limit")
+                    if limit:
+                        last["utilization"] = round(
+                            (last.get("bytes_in_use") or 0) / limit, 4)
+                devices[label] = last
+            avail = sum(1 for d in devices.values() if d.get("stats"))
+            peaks = [d["peak_seen"] for d in devices.values()
+                     if d.get("peak_seen") is not None]
+            return {"active": bool(self.enabled and self._polls),
+                    "polls": self._polls,
+                    "n_devices": len(devices),
+                    "stats_available": avail,
+                    "peak_seen_bytes": max(peaks) if peaks else None,
+                    "devices": devices}
+
+
+def drift_x(measured, predicted) -> Optional[float]:
+    """measured / predicted, guarded — the ONE place the HBM drift
+    ratio is computed (bench preflight blocks + the regression gate
+    share it, so the flag and the printed number can't disagree)."""
+    if not measured or not predicted:
+        return None
+    return round(float(measured) / float(predicted), 4)
+
+
+def drift_regressed(ratio: Optional[float],
+                    threshold: float = HBM_DRIFT_X) -> bool:
+    """Is a measured-vs-predicted ratio outside the gate, either way?"""
+    if ratio is None:
+        return False
+    return ratio > threshold or ratio < 1.0 / threshold
+
+
+def measured_bytes_limit() -> Optional[int]:
+    """The chip's own reported HBM capacity: min `bytes_limit` across
+    stats-reporting local devices (min — a plan must fit the SMALLEST
+    chip it may land on), or None when no device reports one (cpu
+    backends, or no backend up yet). Reads the ambient monitor's
+    device list when one is installed (tests pin fakes through it);
+    otherwise peeks at jax directly, init-safe via `_backend_up`."""
+    mon = get_default()
+    if mon.enabled:
+        devs = mon._device_list()
+    else:
+        if not _backend_up():
+            return None
+        try:
+            import jax
+            devs = jax.local_devices()
+        except Exception:  # noqa: BLE001
+            return None
+    limits = []
+    for d in devs:
+        ms = read_memory_stats(d)
+        if ms and ms.get("bytes_limit"):
+            limits.append(int(ms["bytes_limit"]))
+    return min(limits) if limits else None
+
+
+def multichip_record(name: str, n_devices: int, results: list,
+                     wall_s: float, hbm: Optional[dict] = None,
+                     platform: Optional[str] = None,
+                     extra: Optional[dict] = None) -> dict:
+    """A `kind="multichip"` ledger record from one mesh dryrun
+    section: n_devices, the verdict roll-up, per-device key counts /
+    wall from the shard blocks the batched path already stamps, and
+    the measured HBM block. Pure dict construction (testable without
+    a mesh); `__graft_entry__.dryrun_multichip` banks one per section
+    so `/runs` aggregates and `regressions()` cover mesh rounds, not
+    just bench."""
+    per_device: dict = {}
+    verdicts: dict = {}
+    for r in results or []:
+        if not isinstance(r, dict):
+            continue
+        v = r.get("valid?")
+        key = ("true" if v is True else "false" if v is False
+               else str(v))
+        verdicts[key] = verdicts.get(key, 0) + 1
+        shard = r.get("shard") or {}
+        dev = str(shard.get("device", "host"))
+        d = per_device.setdefault(dev, {"keys": 0, "wall_s": 0.0})
+        d["keys"] += 1
+        d["wall_s"] = round(d["wall_s"]
+                            + float(shard.get("wall_s") or 0.0), 4)
+    rec = {"kind": "multichip", "name": str(name),
+           "n_devices": int(n_devices),
+           # empty sections verified nothing: "unknown", never a
+           # vacuous pass in /runs aggregates
+           "verdict": (True if verdicts and set(verdicts) <= {"true"}
+                       else False if "false" in verdicts
+                       else "unknown"),
+           "verdicts": verdicts,
+           "wall_s": round(float(wall_s), 4),
+           "per_device": per_device}
+    if platform is not None:
+        rec["platform"] = str(platform)
+    if hbm is not None:
+        rec["hbm"] = {k: hbm.get(k) for k in
+                      ("peak_measured", "stats_available",
+                       "stats_unavailable", "devices")
+                      if hbm.get(k) is not None}
+    if extra:
+        rec.update(extra)
+    return rec
+
+
+NULL_MONITOR = DeviceMonitor(enabled=False)
+
+
+def snapshot() -> dict:
+    """The ambient monitor's `/status.json` block (inactive stub when
+    disabled) — web.status_snapshot's one entry point."""
+    return get_default().snapshot()
+
+
+# -- ambient default ---------------------------------------------------------
+# A plain module global (NOT thread-local), like metrics/fleet/ledger:
+# streamed workers and engine threads must see the monitor the run
+# installed.
+_default: DeviceMonitor = (
+    DeviceMonitor() if os.environ.get("JEPSEN_TPU_DEVICES", "")
+    not in ("", "0") else NULL_MONITOR)
+
+
+def get_default() -> DeviceMonitor:
+    """The ambient DeviceMonitor — NULL_MONITOR unless
+    JEPSEN_TPU_DEVICES=1 was set at import or a caller installed one
+    (bench.py and core.run do)."""
+    return _default
+
+
+def set_default(mon: Optional[DeviceMonitor]) -> DeviceMonitor:
+    global _default
+    prev = _default
+    _default = mon if mon is not None else NULL_MONITOR
+    return prev
+
+
+@contextlib.contextmanager
+def use(mon: DeviceMonitor) -> Iterator[DeviceMonitor]:
+    """Scoped ambient monitor (restores the previous on exit)."""
+    prev = set_default(mon)
+    try:
+        yield mon
+    finally:
+        set_default(prev)

@@ -260,6 +260,7 @@ def cycle_queries(g: DepGraph,
     # source beating-silent and the monitor flags it (doc/
     # OBSERVABILITY.md "stall watchdog")
     wd = _watchdog.get_default()
+    dm, dmark = _hbm_mark()
     # stall_s override: the closure at capacity is a known-slow
     # healthy call (BENCH_r04: ~57 s of dense f32 matmuls on cpu) —
     # only a multi-minute silence is a hang here
@@ -299,6 +300,7 @@ def cycle_queries(g: DepGraph,
             "converged_at": converged_at,
             "reach_density": round(
                 float(widest[-1]) / float(n_pad) ** 2, 6)}
+    _hbm_close(util, dm, dmark)
     # the MXU plane's telemetry rides the same registry as the
     # search kernels' (doc/OBSERVABILITY.md)
     _record_closure(util, len(src), n)
@@ -323,6 +325,29 @@ def cycle_queries(g: DepGraph,
 
 
 PACKED_MAX_N = 32768
+
+
+def _hbm_mark():
+    """Open a device-observatory window around one closure-kernel
+    call (devices.py): returns (monitor, token) — token None when the
+    ambient monitor is disabled, so the hot path pays one attribute
+    check."""
+    from .. import devices as _devices
+    dm = _devices.get_default()
+    return dm, (dm.mark(where="elle-closure") if dm.enabled else None)
+
+
+def _hbm_close(util: dict, dm, dmark) -> None:
+    """Close the window onto the util block: `hbm` carries the full
+    measured block (explicit stats_unavailable marker on statless
+    backends) and `hbm_peak_measured` the scalar the ledger/bench
+    drift gate compares against preflight's analytic prediction."""
+    if dmark is None:
+        return
+    block = dm.measured(dmark, where="elle-closure")
+    util["hbm"] = block
+    if block.get("peak_measured") is not None:
+        util["hbm_peak_measured"] = block["peak_measured"]
 
 
 def _record_closure(util: dict, edges: int, n: int) -> None:
@@ -540,6 +565,7 @@ def cycle_queries_packed(g, subsets: Sequence[frozenset] = SUBSETS,
                           + q_dst_p.nbytes,
                           what="elle-closure-inputs")
     wd = _watchdog.get_default()
+    dm, dmark = _hbm_mark()
     with wd.watch("elle-closure", device="tpu", stall_s=300.0) as hb:
         wd.beat(hb, edges=int(len(src)), n=n, n_pad=n_pad,
                 iters=iters, kernel="packed")
@@ -569,6 +595,7 @@ def cycle_queries_packed(g, subsets: Sequence[frozenset] = SUBSETS,
             "converged_at": converged_at,
             "reach_density": round(
                 float(widest[-1]) / float(n_pad) ** 2, 6)}
+    _hbm_close(util, dm, dmark)
     _record_closure(util, len(src), n)
     labels = np.asarray(labels)[:, :n]
     closed = np.asarray(closed)[:, :len(rw_edges)]
@@ -885,6 +912,7 @@ def trim_cycle_search(g, max_n: int = PACKED_MAX_N) -> Optional[dict]:
                           sum(np.asarray(a).nbytes for a in ins),
                           what="elle-closure-inputs")
     wd = _watchdog.get_default()
+    dm, dmark = _hbm_mark()
     with wd.watch("elle-closure", device="tpu", stall_s=300.0) as hb:
         wd.beat(hb, edges=int(len(e_src)), n=n, n_pad=n_pad,
                 kernel="trim")
@@ -909,6 +937,7 @@ def trim_cycle_search(g, max_n: int = PACKED_MAX_N) -> Optional[dict]:
             "core_sizes": core_sizes,
             "reach_density": round(max(core_sizes) / max(n, 1), 6),
             "jumps": {"rt": use_rt, "proc": use_proc}}
+    _hbm_close(util, dm, dmark)
     _record_closure(util, len(e_src), n)
 
     out: dict = {**battery, "engine": "device", "util": util}

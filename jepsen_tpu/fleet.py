@@ -170,11 +170,76 @@ def record_shard(shard: dict, mx=None, status=None) -> None:
         st.key_done(shard)
 
 
+# Work-skew past this ratio (busiest vs laziest device wall) makes
+# summarize() emit a rebucket_hint — below it, moving keys would churn
+# the shape buckets for noise-level gains.
+REBUCKET_SKEW_X = 1.2
+
+
+def rebucket_hint(shards: list) -> Optional[dict]:
+    """The precise scheduling signal ROADMAP item 2's mesh fan-out
+    consumes: which keys to move from the busiest device to the
+    laziest one to flatten the work skew. Greedy smallest-keys-first
+    from the busiest device until the two walls would cross; None
+    when the fleet is <2 devices or already balanced. NB the gate is
+    busiest-vs-LAZIEST wall (the pair a move actually rebalances) at
+    REBUCKET_SKEW_X — intentionally sharper than summarize()'s
+    `work_skew` (busiest vs MEAN), so a hint can appear while
+    work_skew still reads under 1.2. Pure host arithmetic over the
+    shard blocks the fan-out already stamps."""
+    by_dev: dict = {}
+    for s in shards:
+        if not isinstance(s, dict):
+            continue
+        dev = str(s.get("device", "host"))
+        by_dev.setdefault(dev, []).append(
+            (float(s.get("wall_s") or 0.0), s.get("key_index")))
+    if len(by_dev) < 2:
+        return None
+    walls = {d: sum(w for w, _ in ks) for d, ks in by_dev.items()}
+    busiest = max(walls, key=lambda d: walls[d])
+    laziest = min(walls, key=lambda d: walls[d])
+    w_hi, w_lo = walls[busiest], walls[laziest]
+    if w_lo <= 0 and w_hi <= 0:
+        return None
+    skew_before = round(w_hi / max(w_lo, 1e-9), 3)
+    if w_hi <= REBUCKET_SKEW_X * max(w_lo, 1e-9):
+        return None
+    gap = (w_hi - w_lo) / 2
+    moved_keys: list = []
+    moved_wall = 0.0
+    # smallest keys first: moving a straggler key would just relocate
+    # the imbalance; small keys pack the gap tightly. Sort by wall
+    # ONLY — ties would otherwise compare key_index, which may be
+    # None (summarize tolerates missing fields; so must this)
+    for w, ki in sorted(by_dev[busiest], key=lambda t: t[0]):
+        if moved_wall + w > gap or ki is None:
+            continue
+        moved_keys.append(ki)
+        moved_wall += w
+    if not moved_keys or moved_wall <= 0:
+        # nothing movable, or only zero-wall keys fit the gap — a
+        # hint that rebalances nothing is noise, not a signal
+        return None
+    hi_after = w_hi - moved_wall
+    lo_after = w_lo + moved_wall
+    return {"from": busiest, "to": laziest,
+            "keys": moved_keys,
+            "wall_s_moved": round(moved_wall, 4),
+            "skew_before": skew_before,
+            "skew_after_est": round(
+                max(hi_after, lo_after) / max(min(hi_after, lo_after),
+                                              1e-9), 3)}
+
+
 def summarize(shards: list) -> dict:
     """Fleet aggregates over per-key shard blocks: per-device shard
     counts / wall / busy fraction, straggler ratio (max vs median
-    shard wall), engine mix, fault and fallback counts. Tolerates
-    None entries (skipped keys) and missing fields."""
+    shard wall), the work-skew index (busiest vs mean device wall),
+    engine mix, fault and fallback counts, and — when the skew says
+    keys are worth moving — a `rebucket_hint` block naming which
+    keys to move where (the mesh fan-out's scheduling input).
+    Tolerates None entries (skipped keys) and missing fields."""
     shards = [s for s in shards if isinstance(s, dict)]
     if not shards:
         return {"keys": 0, "devices": {}, "engines": {},
@@ -214,6 +279,12 @@ def summarize(shards: list) -> dict:
     for d in per_dev.values():
         d["wall_s"] = round(d["wall_s"], 4)
     keys_per_dev = [d["keys"] for d in per_dev.values()]
+    # work-skew index: busiest device's summed wall over the mean —
+    # 1.0 is perfectly balanced; a lockstep mesh pays the busiest
+    # device's wall, so (work_skew - 1) is the reclaimable fraction
+    dev_walls = [d["wall_s"] for d in per_dev.values()]
+    mean_wall = sum(dev_walls) / len(dev_walls)
+    work_skew = round(max(dev_walls) / max(mean_wall, 1e-9), 3)
     return {
         "keys": len(shards),
         "device_count": len(per_dev),
@@ -227,9 +298,11 @@ def summarize(shards: list) -> dict:
         # lockstep/batched fleets pay max while a balanced one pays
         # ~median — this ratio IS the straggler cost
         "straggler_ratio": round(w_max / max(w_median, 1e-9), 3),
+        "work_skew": work_skew,
         "imbalance": {"max_keys": max(keys_per_dev),
                       "min_keys": min(keys_per_dev),
                       "mean_keys": round(len(shards) / len(per_dev), 2)},
+        "rebucket_hint": rebucket_hint(shards),
         "span_s": round(span, 4) if span is not None else None,
     }
 

@@ -66,7 +66,7 @@ SCHEMA = 1
 _UTIL_KEYS = ("configs_per_s", "rounds", "frontier_fill",
               "memo_hit_rate", "first_call_s", "chunks",
               "backlog_peak", "kernel_s", "compile_s",
-              "achieved_tflops")
+              "achieved_tflops", "hbm_peak_measured")
 
 
 def new_id(t: Optional[float] = None) -> str:
@@ -128,10 +128,26 @@ def summarize_result(result: dict) -> dict:
         if isinstance(fleet, dict):
             u["fleet"] = {k: fleet.get(k) for k in
                           ("keys", "device_count", "faults",
-                           "fallbacks", "straggler_ratio")
+                           "fallbacks", "straggler_ratio",
+                           "work_skew")
                           if fleet.get(k) is not None}
         if u:
             out["util"] = u
+    # device-observatory closure (devices.py): the measured HBM block
+    # rides the record compactly so cross-run queries can track
+    # measured-vs-predicted drift without re-opening run artifacts
+    hbm = result.get("hbm")
+    if not isinstance(hbm, dict) and isinstance(util, dict):
+        hbm = util.get("hbm")
+    if isinstance(hbm, dict):
+        compact_hbm = {"stats_available":
+                       bool(hbm.get("stats_available"))}
+        if hbm.get("peak_measured") is not None:
+            compact_hbm["peak_measured"] = hbm["peak_measured"]
+            out["hbm_peak_measured"] = hbm["peak_measured"]
+        if hbm.get("stats_unavailable"):
+            compact_hbm["stats_unavailable"] = True
+        out["hbm"] = compact_hbm
     chunks = (result.get("telemetry") or {}).get("chunks")
     if isinstance(chunks, list):
         out["telemetry"] = {"chunks": len(chunks)}

@@ -289,6 +289,10 @@ def perfetto_counter_tracks(registry) -> dict:
       wgl fill        — per-round frontier fill (wgl_rounds)
       wgl frontier/backlog — per-poll beam + backlog (wgl_chunks)
       batched live_keys    — live lanes per poll (wgl_batched_chunks)
+      hbm bytes <device>   — bytes_in_use per device id (`hbm`
+                             series, devices.py) — one counter lane
+                             per device, so a mesh run's memory
+                             trajectory renders per chip
 
     Points ride their metrics `t` wall-clock stamps, so the counter
     graphs line up with the phase spans in ui.perfetto.dev."""
@@ -307,6 +311,14 @@ def perfetto_counter_tracks(registry) -> dict:
         add("wgl_chunks", "frontier", "wgl frontier")
         add("wgl_chunks", "backlog", "wgl backlog")
         add("wgl_batched_chunks", "live_keys", "batched live keys")
+        by_dev: dict = {}
+        for p in registry.series("hbm").points:
+            if p.get("t") is not None and isinstance(
+                    p.get("bytes_in_use"), (int, float)):
+                by_dev.setdefault(str(p.get("device")), []).append(
+                    (p["t"], p["bytes_in_use"]))
+        for dev, vals in sorted(by_dev.items()):
+            tracks[f"hbm bytes {dev}"] = vals
     except Exception:  # noqa: BLE001 — a torn registry never blocks
         pass           # the trace export itself
     return tracks

@@ -788,6 +788,7 @@ def _search_loop(enc, init_fn, chunk_jit, iinv, iopc, n, max_configs,
                  pack=False):
     import jax.numpy as jnp
 
+    from .. import devices as _devices_mod
     from .. import fleet as _fleet_mod
     from .. import metrics as _metrics_mod
     from .. import occupancy as _occ
@@ -850,6 +851,13 @@ def _search_loop(enc, init_fn, chunk_jit, iinv, iopc, n, max_configs,
     # poll — only pay it when someone is recording (the disabled run
     # must keep the original single-transfer poll, overhead-free)
     instrumented = tl_points is not None or tracer.sampled
+    # device observatory (devices.py): HBM accounting sampled at the
+    # SAME poll boundaries — memory_stats() is a host-side allocator
+    # query, so no device round-trip is added. The mark()/measured()
+    # window puts hbm_peak_measured on the result beside preflight's
+    # analytic prediction (the measured-vs-predicted closure).
+    dm = _devices_mod.get_default()
+    dmark = dm.mark(where=f"wgl/{plat}") if dm.enabled else None
     total_explored = 0
     max_lin = 0
     while True:
@@ -909,6 +917,10 @@ def _search_loop(enc, init_fn, chunk_jit, iinv, iopc, n, max_configs,
             first_call_s = _time.monotonic() - t0
         found, overflow = bool(flags[0]), bool(flags[1])
         total_explored = int(stats[0])
+        if dmark is not None:
+            # throttled HBM sample on the existing poll cadence (no
+            # extra device round-trip — a host allocator query)
+            dm.sample(where=f"wgl/{plat}", mx=mx)
         occ_new: list = []
         if tl_points is not None or status.enabled:
             # drain this chunk's per-round occupancy rows off the
@@ -1127,6 +1139,15 @@ def _search_loop(enc, init_fn, chunk_jit, iinv, iopc, n, max_configs,
         detail = {"W": enc.window_raw, "W_pad": W, "K": K,
                   "configs_explored": total_explored,
                   "wall_s": round(wall, 4), "util": util}
+        if dmark is not None:
+            # measured HBM peak for this search window — the number
+            # the preflight drift gate compares against its analytic
+            # hbm.peak_bytes (an explicit stats_unavailable marker
+            # where the backend has no allocator stats, e.g. cpu)
+            hbm_block = dm.measured(dmark, where=f"wgl/{plat}")
+            detail["hbm"] = hbm_block
+            if hbm_block.get("peak_measured") is not None:
+                util["hbm_peak_measured"] = hbm_block["peak_measured"]
         if tl_points is not None:
             # the run's own copy of the per-chunk timeseries (the
             # registry keeps the cross-run series)

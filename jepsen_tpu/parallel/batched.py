@@ -23,6 +23,7 @@ from typing import Optional, Sequence
 
 import numpy as np
 
+from .. import devices as _devices
 from .. import fleet as _fleet
 from .. import metrics as _metrics
 from .. import occupancy as _occ
@@ -785,6 +786,19 @@ def check_batched(model: Model, histories: Sequence[History],
     s = None  # last packed poll; None if cancelled before any poll
     kern = "wgl32" if not L else "wgln"
     n_polls = 0
+    # device observatory window over the whole lockstep batch: HBM
+    # sampled at the existing vmap poll cadence (host allocator query,
+    # no extra device round-trip); the per-lane results below carry
+    # their own device's slice of the measured block
+    dm = _devices.get_default()
+    dmark = dm.mark(where="batched") if dm.enabled else None
+    # lane -> mesh device index, known statically (NamedSharding lays
+    # the key axis out in contiguous blocks of bk//nd lanes): ONE
+    # derivation shared by the per-round heatmap points (the
+    # per-device column strip) and the per-key result attribution
+    # below — two copies would let the strip and the shard labels
+    # silently disagree about which device a lane ran on
+    lanes_per_dev = max(1, bk // nd)
     # per-lane occupancy bookkeeping: previous cumulative rounds per
     # lane (anchors each drain) and a bounded budget of heatmap
     # points — silent caps read as full coverage, so exhaustion is
@@ -810,6 +824,8 @@ def check_batched(model: Model, histories: Sequence[History],
             # [fr_cnt, flags, stats, bk, per-round occupancy ring]
             s = np.asarray(summary)
             n_polls += 1
+            if dmark is not None:
+                dm.sample(where="batched", mx=mx)
             fr_cnt, flags, stats = s[:, 0], s[:, 1:4], s[:, 4:10]
             found = flags[:, 0] != 0
             empty = fr_cnt == 0
@@ -884,7 +900,12 @@ def check_batched(model: Model, histories: Sequence[History],
                             rounds_series.append({
                                 "round": r["round"], "lane": lane,
                                 "fill": r["fill"],
-                                "frontier": r["frontier"]})
+                                "frontier": r["frontier"],
+                                # mesh-device attribution: the heatmap
+                                # renders a per-device column strip
+                                # from this field
+                                "device": min(lane // lanes_per_dev,
+                                              nd - 1)})
                     if occ_budget <= 0:
                         rounds_series.append({
                             "round": -1, "lane": -1, "fill": 0.0,
@@ -922,6 +943,8 @@ def check_batched(model: Model, histories: Sequence[History],
     finally:
         wd.unregister(hb)
     wall = _time.monotonic() - t0
+    hbm_block = (dm.measured(dmark, where="batched")
+                 if dmark is not None else None)
 
     if s is None:
         # soft-cancelled before the first poll landed: synthesize an
@@ -932,10 +955,9 @@ def check_batched(model: Model, histories: Sequence[History],
         empty = np.zeros(bk, dtype=bool)
         budget = np.zeros(bk, dtype=bool)
     overflow = flags[:, 1]
-    # lane -> device: the key axis is laid out in contiguous blocks of
-    # bk//nd lanes per mesh device (NamedSharding over the 1-D mesh)
+    # lane -> device: lanes_per_dev (above) maps the contiguous
+    # NamedSharding blocks back to mesh devices
     devs_flat = list(mesh.devices.flat)
-    lanes_per_dev = max(1, bk // nd)
     for lane, hist_i in enumerate(lanes):
         e = encs[lane]
         n_total = int(e.n_ok + e.n_info)
@@ -988,6 +1010,18 @@ def check_batched(model: Model, histories: Sequence[History],
                                        deadline, res)
                 engine = str(res.get("engine") or engine)
         di = min(lane // lanes_per_dev, nd - 1)
+        if hbm_block is not None:
+            # per-device attribution of the measured window: each lane
+            # carries ITS device's slice (the lane->device layout is
+            # the contiguous-block NamedSharding above)
+            dev_label = _fleet.device_label(devs_flat[di])
+            dev_hbm = (hbm_block.get("devices") or {}).get(dev_label)
+            res["hbm"] = {"device": dev_label,
+                          "stats_available": dev_hbm is not None,
+                          "peak_measured": (dev_hbm or {}).get(
+                              "peak_measured")}
+            if dev_hbm is None:
+                res["hbm"]["stats_unavailable"] = True
         results[hist_i] = _annotate_shard(
             res, key_index=hist_i,
             device=_fleet.device_label(devs_flat[di]),

@@ -247,22 +247,43 @@ def perfetto_events(spans: list, service: str = "jepsen_tpu") -> list:
     return events
 
 
-def counter_events(tracks: dict, pid: int = 1) -> list:
+def counter_events(tracks: dict, pid: int = 2) -> list:
     """`trace_event` "C" (counter) events from
     {track_name: [(t_epoch_seconds, value), ...]} — Perfetto renders
     each named track as a step graph on its own row, time-aligned
-    with the span lanes. Non-numeric values are skipped (a torn
-    series point must not sink the whole export)."""
+    with the span lanes. Counters live in their OWN process lane
+    (pid 2, named "counters" — `perfetto_events` owns pid 1's span
+    thread lanes, and sharing tids there would let a counter
+    thread_name meta rename a span row), and each track gets its own
+    tid + thread_name so multi-track exports — e.g. the per-device
+    `hbm bytes <dev>` lanes — sort as separate labeled rows instead
+    of piling onto tid 0. Samples are emitted in timestamp order per
+    track (counter graphs render wrongly from out-of-order samples);
+    non-numeric values are skipped (a torn series point must not
+    sink the whole export)."""
     events: list = []
-    for name, pts in sorted((tracks or {}).items()):
+    for lane, (name, pts) in enumerate(sorted((tracks or {}).items()),
+                                       start=1):
+        samples: list = []
         for p in pts:
             try:
-                t, v = float(p[0]), float(p[1])
+                samples.append((float(p[0]), float(p[1])))
             except (TypeError, ValueError, IndexError):
                 continue
+        if not samples:
+            continue
+        if not events:
+            events.append({"ph": "M", "name": "process_name",
+                           "pid": pid, "tid": 0,
+                           "args": {"name": "counters"}})
+        samples.sort()
+        events.append({"ph": "M", "name": "thread_name", "pid": pid,
+                       "tid": lane,
+                       "args": {"name": f"counter {name}"}})
+        for t, v in samples:
             events.append({"ph": "C", "name": str(name),
                            "cat": "counter", "ts": t * 1e6,
-                           "pid": pid, "tid": 0,
+                           "pid": pid, "tid": lane,
                            "args": {"value": v}})
     return events
 

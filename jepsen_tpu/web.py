@@ -173,6 +173,28 @@ def status_snapshot(store_root: str) -> dict:
     except Exception:  # noqa: BLE001 — the status answer must not
         snap.setdefault("preflight",  # depend on the analysis plane
                         {"checked": 0, "verdicts": {}, "recent": []})
+    # device observatory (devices.py): live HBM accounting per local
+    # device. An in-process monitor that has actually polled wins;
+    # otherwise a mirror from another process keeps its own block,
+    # and the idle stub keeps the documented schema answerable.
+    try:
+        from . import devices as devices_mod
+        hb = devices_mod.snapshot()
+        if hb["polls"] or "hbm" not in snap:
+            snap["hbm"] = hb
+        # per-device enrichment: where the fleet's device labels match
+        # the monitor's, the RunStatus devices table carries the
+        # memory column too (one joined view for /devices)
+        for label, mem in (snap.get("hbm") or {}).get(
+                "devices", {}).items():
+            d = (snap.get("devices") or {}).get(label)
+            if isinstance(d, dict) and mem.get("stats"):
+                d["hbm"] = {k: mem.get(k) for k in
+                            ("bytes_in_use", "peak_bytes_in_use",
+                             "bytes_limit", "utilization")
+                            if mem.get(k) is not None}
+    except Exception:  # noqa: BLE001 — the status answer must not
+        snap.setdefault("hbm", {"active": False})  # need the monitor
     # history, not just the live run: the last N ledger entries ride
     # every status answer so the fleet dashboard shows what the fleet
     # has DONE, not only what it is doing
@@ -300,8 +322,18 @@ def render_status(store_root: str) -> bytes:
             f"<p>occupancy: fill last <b>{_esc(occ.get('fill_last'))}"
             f"</b> &middot; mean {_esc(occ.get('fill_mean'))} &middot; "
             f"<a href='/occupancy'>occupancy panel</a></p>")
+    hbm = s.get("hbm") or {}
+    if hbm.get("active"):
+        peak = hbm.get("peak_seen_bytes")
+        parts.append(
+            f"<p>devices: {_esc(hbm.get('stats_available'))}/"
+            f"{_esc(hbm.get('n_devices'))} reporting memory stats"
+            + (f" &middot; peak seen {_esc(_fmt_bytes(peak))}"
+               if peak is not None else "")
+            + " &middot; <a href='/devices'>devices panel</a></p>")
     parts.append("<p><a href='/status.json'>status.json</a> &middot; "
                  "<a href='/occupancy'>occupancy</a> &middot; "
+                 "<a href='/devices'>devices</a> &middot; "
                  "<a href='/runs'>run ledger</a></p>")
     return _page("status", "".join(parts))
 
@@ -391,6 +423,92 @@ def render_occupancy(store_root: str) -> bytes:
     parts.append("<p><a href='/status.json'>status.json</a> (the "
                  "`occupancy` block)</p>")
     return _page("occupancy", "".join(parts))
+
+
+def _fmt_bytes(v) -> str:
+    try:
+        v = float(v)
+    except (TypeError, ValueError):
+        return "?"
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(v) < 1024 or unit == "GiB":
+            return f"{v:.2f} {unit}" if unit != "B" else f"{int(v)} B"
+        v /= 1024
+    return f"{v:.2f} GiB"
+
+
+def render_devices(store_root: str) -> bytes:
+    """The auto-refreshing /devices panel (doc/OBSERVABILITY.md
+    "Device & memory plane"): live HBM accounting per device —
+    bytes in use vs the chip's own limit, the run's sampled peak —
+    joined with the fleet's per-device shard state from the same
+    snapshot /status.json serves. Backends without allocator stats
+    (cpu tier-1) show the explicit no-stats marker, never zeros."""
+    s = status_snapshot(store_root)
+    hbm = s.get("hbm") or {}
+    devs_mem = hbm.get("devices") or {}
+    devs_fleet = s.get("devices") or {}
+    parts = ["<meta http-equiv='refresh' content='2'>",
+             "<a href='/'>jepsen_tpu</a> / "
+             "<a href='/status'>status</a> / devices",
+             f"<h1>device observatory"
+             f" &middot; {_esc(s.get('test') or 'no active run')}</h1>"]
+    if not hbm.get("active"):
+        parts.append(
+            "<p>no device samples yet — the monitor records when a "
+            "bench/run installs one or JEPSEN_TPU_DEVICES=1 "
+            "(doc/OBSERVABILITY.md \"Device &amp; memory plane\")</p>")
+    else:
+        parts.append(
+            f"<p>{_esc(hbm.get('n_devices'))} device(s), "
+            f"{_esc(hbm.get('stats_available'))} reporting memory "
+            f"stats &middot; {_esc(hbm.get('polls'))} poll(s)"
+            + (f" &middot; peak seen "
+               f"<b>{_esc(_fmt_bytes(hbm.get('peak_seen_bytes')))}</b>"
+               if hbm.get("peak_seen_bytes") is not None else "")
+            + "</p>")
+        rows = []
+        for label in sorted(devs_mem):
+            m = devs_mem[label] or {}
+            fl = devs_fleet.get(label) or {}
+            if m.get("stats"):
+                util = m.get("utilization")
+                bar = ""
+                if util is not None:
+                    pct = max(0, min(100, int(float(util) * 100)))
+                    color = (VALID_COLORS[False] if pct > 85 else
+                             VALID_COLORS["unknown"] if pct > 60
+                             else VALID_COLORS[True])
+                    bar = (f"<div style='background:#eee;width:120px'>"
+                           f"<div style='background:{color};width:"
+                           f"{max(pct, 2)}%;height:10px'></div></div>"
+                           f"{pct}%")
+                limit = (_esc(_fmt_bytes(m.get("bytes_limit")))
+                         if m.get("bytes_limit") is not None
+                         else "n/a")
+                mem_cells = (
+                    f"<td>{_esc(_fmt_bytes(m.get('bytes_in_use')))}"
+                    f"</td><td>"
+                    f"{_esc(_fmt_bytes(m.get('peak_seen')))}</td>"
+                    f"<td>{limit}</td><td>{bar}</td>")
+            else:
+                mem_cells = ("<td colspan='4' style='color:#888'>"
+                             "no allocator stats (backend reports "
+                             "none)</td>")
+            rows.append(
+                f"<tr><td>{_esc(label)}</td>"
+                f"<td>{_esc(m.get('kind') or '?')}</td>" + mem_cells
+                + f"<td>{_esc(fl.get('state') or '-')}</td>"
+                  f"<td>{_esc(fl.get('keys_done', '-'))}</td></tr>")
+        parts.append(
+            "<table><thead><tr><th>device</th><th>kind</th>"
+            "<th>in use</th><th>peak seen</th><th>limit</th>"
+            "<th>util</th><th>state</th><th>keys</th></tr></thead>"
+            "<tbody>" + "".join(rows) + "</tbody></table>")
+    parts.append("<p><a href='/status.json'>status.json</a> (the "
+                 "`hbm` block) &middot; "
+                 "<a href='/occupancy'>occupancy</a></p>")
+    return _page("devices", "".join(parts))
 
 
 def _fmt_epoch(t) -> str:
@@ -492,6 +610,7 @@ def render_home(cache: _ValidityCache) -> bytes:
     body = ("<h1>jepsen_tpu</h1>"
             "<p><a href='/status'>live run status</a> &middot; "
             "<a href='/occupancy'>occupancy</a> &middot; "
+            "<a href='/devices'>devices</a> &middot; "
             "<a href='/runs'>run ledger</a></p>"
             "<table><thead><tr><th>Name</th>"
             "<th>Time</th><th>Valid?</th><th>Results</th><th>History</th>"
@@ -655,6 +774,10 @@ class Handler(BaseHTTPRequestHandler):
             if uri == "/occupancy":
                 self._send(200, "text/html; charset=utf-8",
                            render_occupancy(self.cache.store_root))
+                return
+            if uri == "/devices":
+                self._send(200, "text/html; charset=utf-8",
+                           render_devices(self.cache.store_root))
                 return
             if uri in ("/runs", "/runs/"):
                 self._send(200, "text/html; charset=utf-8",

@@ -1,0 +1,128 @@
+#!/usr/bin/env python
+"""Fast CI gate for the device observatory (jepsen_tpu/devices.py).
+
+Three invariants, each cheap to violate silently and loud here:
+
+  * **zero-new-compile / zero-new-transfer proof** — a warm WGL check
+    with the DeviceMonitor installed must add ZERO XLA recompiles and
+    the SAME guard-counted device transfers as one without it
+    (`memory_stats()` is a host allocator query; the monitor must
+    never grow the device footprint it exists to measure);
+  * **drift gate fires on a synthetic mispredict** — a config whose
+    measured HBM peak sits 3x over (and one 3x under) the analytic
+    prediction must be flagged `<name>:hbm` by
+    `bench.compute_regressions`, and an in-bounds one must not;
+  * **series stay lint-clean** — the `hbm` / `device_poll` points a
+    monitored run records (fake stats-reporting devices + the real
+    cpu no-stats path) must pass scripts/telemetry_lint.py.
+
+~15 s on a CI cpu. Exit 0 clean, 1 on any violation.
+"""
+
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+class FakeDev:
+    """A stats-reporting stand-in device (the tests share the shape)."""
+
+    def __init__(self, name, in_use, peak, limit):
+        self._name = name
+        self.device_kind = "fake v5e"
+        self._ms = {"bytes_in_use": in_use,
+                    "peak_bytes_in_use": peak,
+                    "bytes_limit": limit}
+
+    def __repr__(self):
+        return self._name
+
+    def memory_stats(self):
+        return dict(self._ms)
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    import bench
+    from jepsen_tpu import devices, metrics, synth
+    from jepsen_tpu.analysis import guards
+    from jepsen_tpu.models import mutex
+    from jepsen_tpu.ops import wgl
+
+    failures = []
+
+    def check(cond, msg):
+        print(("ok   " if cond else "FAIL ") + msg)
+        if not cond:
+            failures.append(msg)
+
+    # -- zero-new-compile / zero-new-transfer proof -----------------
+    m, h = mutex(), synth.mutex_history(400, n_procs=4, seed=7)
+    wgl.check(m, h, time_limit=60)  # warm the shape bucket
+    with guards.CompileGuard(name="devsmoke-off") as g_off:
+        res_off = wgl.check(m, h, time_limit=60)
+    with devices.use(devices.DeviceMonitor()):
+        with guards.CompileGuard(max_compiles=0,
+                                 name="devsmoke-on") as g_on:
+            res_on = wgl.check(m, h, time_limit=60)
+    check(g_on.compiles == 0,
+          f"monitored warm run recompiles == 0 (got {g_on.compiles})")
+    check(g_on.h2d == g_off.h2d and g_on.d2h == g_off.d2h,
+          f"monitored run transfers unchanged "
+          f"(h2d {g_off.h2d}->{g_on.h2d}, d2h {g_off.d2h}->{g_on.d2h})")
+    check(res_on["valid?"] == res_off["valid?"], "verdict stable")
+    check("hbm" in res_on and res_on["hbm"].get("stats_unavailable"),
+          "cpu run carries the explicit stats_unavailable marker")
+
+    # -- drift gate fires on a synthetic mispredict -----------------
+    rep = bench.compute_regressions(
+        [], {"round": 1, "platform": "cpu", "value": 1.0,
+             "configs": {}, "fills": {},
+             "hbm_drift": {"over": 3.0, "under": 0.33, "ok": 1.1}})
+    flagged = set(rep["regressions"])
+    check("over:hbm" in flagged, "3x over-prediction flagged :hbm")
+    check("under:hbm" in flagged, "3x under-prediction flagged :hbm")
+    check("ok:hbm" not in flagged, "in-bounds drift not flagged")
+
+    # -- hbm / device_poll series lint-clean ------------------------
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import telemetry_lint
+
+    fakes = [FakeDev("FAKE_0", 1 << 30, 2 << 30, 16 << 30),
+             FakeDev("FAKE_1", 1 << 29, 1 << 30, 16 << 30)]
+    reg = metrics.Registry()
+    with metrics.use(reg):
+        mon = devices.DeviceMonitor(devices=fakes)
+        mark = mon.mark()
+        # allocator grows INSIDE the window: the new peak belongs to
+        # this window (pre-window peaks must never be claimed)
+        fakes[0]._ms["bytes_in_use"] = 3 << 30
+        fakes[0]._ms["peak_bytes_in_use"] = 4 << 30
+        mon.sample(where="smoke", force=True)
+        block = mon.measured(mark)
+        # the real no-stats path rides the same series envelope
+        with devices.use(devices.DeviceMonitor()):
+            devices.get_default().sample(where="smoke-cpu",
+                                         force=True)
+    check(block["stats_available"] and
+          block["peak_measured"] == 4 << 30,
+          f"measured window peak == in-window allocator peak "
+          f"({block['peak_measured']})")
+    with tempfile.TemporaryDirectory() as td:
+        path = os.path.join(td, "smoke_metrics.jsonl")
+        reg.export_jsonl(path)
+        errs = telemetry_lint.lint_jsonl_file(path)
+    check(not errs, f"hbm/device_poll series lint-clean ({errs[:3]})")
+
+    print(f"device telemetry smoke: {len(failures)} failure(s)")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

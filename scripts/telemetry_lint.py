@@ -85,6 +85,15 @@ SERIES_SCHEMAS = {
     # rules the P-rule ids that fired
     "preflight": {"where": str, "kind": str, "verdict": str,
                   "rules": list},
+    # the device observatory (devices.py): one `hbm` point per
+    # stats-reporting device per poll — bytes fields are the
+    # allocator's own memory_stats() numbers — and one `device_poll`
+    # envelope per sampling poll (stats_available counts how many
+    # devices actually reported; 0 on cpu tier-1, never a guess)
+    "hbm": {"device": str, "index": int, "stats": bool,
+            "bytes_in_use": int},
+    "device_poll": {"where": str, "n_devices": int,
+                    "stats_available": int},
 }
 
 REGRESSIONS_SCHEMA = {"schema": int, "threshold_x": NUM,
@@ -252,6 +261,31 @@ def lint_ledger_file(path: str) -> list:
             if not isinstance(obj.get("preflight"), dict):
                 errs.append(f"{where}: preflight record needs the "
                             "compact 'preflight' report object")
+        if obj.get("kind") == "multichip":
+            # mesh dryrun records (devices.multichip_record): device
+            # count + per-device attribution are the record's point
+            if not isinstance(obj.get("n_devices"), int) \
+                    or isinstance(obj.get("n_devices"), bool):
+                errs.append(f"{where}: multichip 'n_devices' should "
+                            "be int")
+            if not isinstance(obj.get("per_device"), dict):
+                errs.append(f"{where}: multichip record needs the "
+                            "'per_device' attribution object")
+        hb = obj.get("hbm", None)
+        if hb is not None:
+            # measured-HBM blocks (devices.py) on any record kind —
+            # bench configs, wgl/elle analyses, multichip sections
+            if not isinstance(hb, dict):
+                errs.append(f"{where}: 'hbm' should be an object")
+            else:
+                if not isinstance(hb.get("stats_available"), bool):
+                    errs.append(f"{where}: hbm block needs bool "
+                                "'stats_available'")
+                pm = hb.get("peak_measured", None)
+                if pm is not None and (not isinstance(pm, NUM)
+                                       or isinstance(pm, bool)):
+                    errs.append(f"{where}: hbm 'peak_measured' "
+                                "should be numeric or null")
         return errs
 
     if path.endswith(".jsonl"):

@@ -1,0 +1,684 @@
+"""Device observatory tests (jepsen_tpu/devices.py + the planes it
+feeds): monitor sampling over fake stats-reporting devices, the
+graceful no-stats path the cpu tier-1 backend actually takes,
+measurement windows, fleet skew/rebucket math, the measured-vs-
+predicted drift gate, /devices + /status.json surfacing, per-device
+Perfetto counter lanes, the heatmap device strip, and the telemetry
+lint schemas (good + drifted)."""
+
+import json
+import os
+import sys
+import threading
+import urllib.request
+
+import pytest
+
+from jepsen_tpu import devices, fleet, metrics, occupancy, trace
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))), "scripts"))
+import telemetry_lint  # noqa: E402
+
+
+class FakeDev:
+    """A stats-reporting stand-in for a jax Device: mutable
+    memory_stats so tests can script an allocation trajectory."""
+
+    def __init__(self, name, in_use=0, peak=0, limit=16 << 30,
+                 kind="fake v5e", stats=True):
+        self._name = name
+        self.device_kind = kind
+        self.has_stats = stats
+        self.bytes_in_use = in_use
+        self.peak_bytes_in_use = peak
+        self.bytes_limit = limit
+
+    def __repr__(self):
+        return self._name
+
+    def memory_stats(self):
+        if not self.has_stats:
+            return None
+        return {"bytes_in_use": self.bytes_in_use,
+                "peak_bytes_in_use": self.peak_bytes_in_use,
+                "bytes_limit": self.bytes_limit}
+
+
+def two_fakes():
+    return [FakeDev("FAKE_0", in_use=1 << 30, peak=2 << 30),
+            FakeDev("FAKE_1", in_use=1 << 29, peak=1 << 30)]
+
+
+class TestMonitorSampling:
+    def test_sample_reads_stats(self):
+        mon = devices.DeviceMonitor(devices=two_fakes())
+        stats = mon.sample(where="t", force=True)
+        assert [s["device"] for s in stats] == ["FAKE_0", "FAKE_1"]
+        assert stats[0]["bytes_in_use"] == 1 << 30
+        assert stats[0]["bytes_limit"] == 16 << 30
+        assert stats[0]["stats"] is True
+        assert stats[0]["kind"] == "fake v5e"
+
+    def test_no_stats_backend_graceful(self):
+        """A backend whose memory_stats() returns None (jax's TFRT
+        CPU devices — the tier-1 path) degrades to stats=False, never
+        raises, never invents bytes."""
+        mon = devices.DeviceMonitor(
+            devices=[FakeDev("CPU_0", stats=False)])
+        stats = mon.sample(force=True)
+        assert stats[0]["stats"] is False
+        assert "bytes_in_use" not in stats[0]
+        block = mon.measured(mon.mark())
+        assert block["stats_available"] is False
+        assert block["stats_unavailable"] is True
+        assert block["peak_measured"] is None
+
+    def test_real_cpu_devices_no_stats(self):
+        """The actual jax cpu backend takes the no-stats path."""
+        mon = devices.DeviceMonitor()
+        stats = mon.sample(force=True)
+        assert stats, "conftest initialized the cpu backend"
+        assert all(not s["stats"] for s in stats)
+
+    def test_disabled_monitor_noops(self):
+        mon = devices.DeviceMonitor(enabled=False,
+                                    devices=two_fakes())
+        assert mon.sample(force=True) == []
+        assert mon.mark() is None
+        blk = mon.measured(None)
+        assert blk["stats_unavailable"] is True
+        assert devices.NULL_MONITOR.sample() == []
+
+    def test_throttle(self):
+        mon = devices.DeviceMonitor(devices=two_fakes(),
+                                    min_interval_s=3600)
+        assert mon.sample(force=True)
+        assert mon.sample() == []          # inside the interval
+        assert mon.sample(force=True)      # force bypasses
+
+    def test_ambient_use_restores(self):
+        mon = devices.DeviceMonitor(devices=two_fakes())
+        prev = devices.get_default()
+        with devices.use(mon):
+            assert devices.get_default() is mon
+        assert devices.get_default() is prev
+
+
+class TestMeasurementWindow:
+    def test_peak_growth_attributed_to_window(self):
+        fakes = two_fakes()
+        mon = devices.DeviceMonitor(devices=fakes)
+        mark = mon.mark()
+        fakes[0].bytes_in_use = 3 << 30
+        fakes[0].peak_bytes_in_use = 4 << 30  # grew inside window
+        mon.sample(force=True)
+        block = mon.measured(mark)
+        assert block["stats_available"] is True
+        assert block["peak_measured"] == 4 << 30
+        assert block["devices"]["FAKE_0"]["peak_measured"] == 4 << 30
+        # the other device's peak did NOT grow: its window figure is
+        # the sampled bytes_in_use high-water, not the stale peak
+        assert block["devices"]["FAKE_1"]["peak_measured"] == 1 << 29
+
+    def test_stale_peak_not_claimed(self):
+        """A pre-window allocator peak must not be billed to this
+        window: only sampled bytes_in_use counts when peak is flat."""
+        fakes = [FakeDev("F", in_use=1 << 20, peak=8 << 30)]
+        mon = devices.DeviceMonitor(devices=fakes)
+        mark = mon.mark()
+        fakes[0].bytes_in_use = 2 << 20
+        mon.sample(force=True)
+        block = mon.measured(mark)
+        assert block["peak_measured"] == 2 << 20
+
+    def test_snapshot_schema(self):
+        mon = devices.DeviceMonitor(devices=two_fakes())
+        mon.sample(force=True)
+        snap = mon.snapshot()
+        assert snap["active"] is True
+        assert snap["n_devices"] == 2
+        assert snap["stats_available"] == 2
+        assert snap["peak_seen_bytes"] == 1 << 30  # max bytes_in_use
+        d0 = snap["devices"]["FAKE_0"]
+        assert d0["utilization"] == pytest.approx(1 / 16, abs=1e-3)
+
+
+class TestSeriesRecording:
+    def test_hbm_and_device_poll_series(self):
+        reg = metrics.Registry()
+        with metrics.use(reg):
+            mon = devices.DeviceMonitor(devices=two_fakes())
+            mon.sample(where="unit", force=True)
+        pts = reg.series("hbm").points
+        assert len(pts) == 2
+        assert {p["device"] for p in pts} == {"FAKE_0", "FAKE_1"}
+        poll = reg.series("device_poll").points
+        assert len(poll) == 1
+        assert poll[0]["where"] == "unit"
+        assert poll[0]["n_devices"] == 2
+        assert poll[0]["stats_available"] == 2
+
+    def test_no_stats_device_skips_hbm_series(self):
+        reg = metrics.Registry()
+        with metrics.use(reg):
+            mon = devices.DeviceMonitor(
+                devices=[FakeDev("CPU_0", stats=False)])
+            mon.sample(where="unit", force=True)
+        assert len(reg.series("hbm")) == 0
+        poll = reg.series("device_poll").points
+        assert poll[0]["stats_available"] == 0
+
+    def test_series_lint_clean(self, tmp_path):
+        reg = metrics.Registry()
+        with metrics.use(reg):
+            mon = devices.DeviceMonitor(devices=two_fakes())
+            mon.sample(where="unit", force=True)
+        path = str(tmp_path / "m.jsonl")
+        reg.export_jsonl(path)
+        assert telemetry_lint.lint_jsonl_file(path) == []
+
+    def test_drifted_series_caught(self, tmp_path):
+        """A stringified byte count or a dropped envelope field is
+        schema drift the linter must flag."""
+        path = str(tmp_path / "bad.jsonl")
+        with open(path, "w") as fh:
+            fh.write(json.dumps({
+                "type": "sample", "series": "hbm", "t": 1.0,
+                "device": "FAKE_0", "index": 0, "stats": True,
+                "bytes_in_use": "1073741824"}) + "\n")
+            fh.write(json.dumps({
+                "type": "sample", "series": "device_poll", "t": 1.0,
+                "where": "unit", "n_devices": 2}) + "\n")
+        errs = telemetry_lint.lint_jsonl_file(path)
+        assert any("bytes_in_use" in e for e in errs)
+        assert any("stats_available" in e for e in errs)
+
+
+class TestLedgerHbm:
+    def test_summarize_result_promotes_hbm(self):
+        from jepsen_tpu import ledger
+        out = ledger.summarize_result({
+            "valid?": True, "op_count": 10,
+            "hbm": {"schema": 1, "stats_available": True,
+                    "peak_measured": 123456,
+                    "devices": {}, "samples": 3},
+            "util": {"rounds": 5}})
+        assert out["hbm_peak_measured"] == 123456
+        assert out["hbm"] == {"stats_available": True,
+                              "peak_measured": 123456}
+
+    def test_summarize_result_marker(self):
+        from jepsen_tpu import ledger
+        out = ledger.summarize_result({
+            "valid?": True,
+            "hbm": {"stats_available": False,
+                    "stats_unavailable": True,
+                    "peak_measured": None}})
+        assert out["hbm"]["stats_unavailable"] is True
+        assert "hbm_peak_measured" not in out
+
+    def test_multichip_record_shape(self):
+        results = [
+            {"valid?": True,
+             "shard": {"device": "D0", "wall_s": 1.0}},
+            {"valid?": True,
+             "shard": {"device": "D1", "wall_s": 2.0}},
+            {"valid?": False,
+             "shard": {"device": "D1", "wall_s": 0.5}},
+        ]
+        rec = devices.multichip_record(
+            "dryrun_multichip_narrow", 2, results, 3.5,
+            hbm={"peak_measured": 1024, "stats_available": True},
+            platform="cpu")
+        assert rec["kind"] == "multichip"
+        assert rec["n_devices"] == 2
+        assert rec["verdict"] is False
+        assert rec["per_device"]["D1"] == {"keys": 2, "wall_s": 2.5}
+        assert rec["hbm"]["peak_measured"] == 1024
+
+    def test_multichip_record_empty_is_unknown(self):
+        rec = devices.multichip_record("empty", 2, [], 0.1)
+        assert rec["verdict"] == "unknown"  # never a vacuous pass
+
+    def test_multichip_record_lints(self, tmp_path):
+        from jepsen_tpu import ledger
+        led = ledger.Ledger(str(tmp_path))
+        rid = led.record(devices.multichip_record(
+            "dryrun_multichip_narrow", 4,
+            [{"valid?": True, "shard": {"device": "D0",
+                                        "wall_s": 0.1}}],
+            0.2, platform="cpu"))
+        assert rid
+        assert telemetry_lint.lint_ledger_file(led.index_path) == []
+        assert telemetry_lint.lint_ledger_file(
+            led.record_path(rid)) == []
+
+    def test_multichip_drift_caught(self, tmp_path):
+        path = str(tmp_path / "bad.json")
+        with open(path, "w") as fh:
+            json.dump({"schema": 1, "id": "x", "kind": "multichip",
+                       "name": "n", "t": 1.0,
+                       "hbm": {"peak_measured": "big"}}, fh)
+        # route through lint_path the way the CLI does for ledger dirs
+        errs = telemetry_lint.lint_ledger_file(path)
+        assert any("n_devices" in e for e in errs)
+        assert any("per_device" in e for e in errs)
+        assert any("stats_available" in e for e in errs)
+        assert any("peak_measured" in e for e in errs)
+
+
+class TestSummarizeSkew:
+    def shards(self):
+        # D0 does 6s of work over 3 keys; D1 does 1s over 1 key
+        return [
+            {"device": "D0", "key_index": 0, "wall_s": 3.0, "t0": 0.0},
+            {"device": "D0", "key_index": 1, "wall_s": 2.0, "t0": 0.0},
+            {"device": "D0", "key_index": 2, "wall_s": 1.0, "t0": 0.0},
+            {"device": "D1", "key_index": 3, "wall_s": 1.0, "t0": 0.0},
+        ]
+
+    def test_work_skew_index(self):
+        s = fleet.summarize(self.shards())
+        # walls: D0=6, D1=1; mean 3.5 -> skew 6/3.5
+        assert s["work_skew"] == pytest.approx(6 / 3.5, abs=1e-3)
+        assert s["devices"]["D0"]["busy_frac"] is not None
+
+    def test_rebucket_hint_moves_smallest_keys(self):
+        hint = fleet.rebucket_hint(self.shards())
+        assert hint["from"] == "D0"
+        assert hint["to"] == "D1"
+        # gap/2 = 2.5 -> move key 2 (1.0s) then key 1 (2.0s) would
+        # overflow, so only the smallest fits
+        assert hint["keys"] == [2]
+        assert hint["wall_s_moved"] == pytest.approx(1.0)
+        assert hint["skew_before"] == pytest.approx(6.0)
+        assert hint["skew_after_est"] < hint["skew_before"]
+
+    def test_balanced_fleet_no_hint(self):
+        shards = [{"device": "D0", "key_index": 0, "wall_s": 1.0},
+                  {"device": "D1", "key_index": 1, "wall_s": 1.0}]
+        assert fleet.rebucket_hint(shards) is None
+        s = fleet.summarize(shards)
+        assert s["rebucket_hint"] is None
+        assert s["work_skew"] == pytest.approx(1.0)
+
+    def test_single_device_no_hint(self):
+        assert fleet.rebucket_hint(
+            [{"device": "D0", "key_index": 0, "wall_s": 9.0}]) is None
+
+    def test_zero_wall_moves_suppressed(self):
+        """A hint that only 'moves' zero-wall keys rebalances nothing
+        — suppressed, not emitted as a no-op scheduling signal."""
+        shards = [
+            {"device": "D0", "key_index": 0, "wall_s": 0.0},
+            {"device": "D0", "key_index": 1, "wall_s": 5.0},
+            {"device": "D1", "key_index": 2, "wall_s": 1.0},
+        ]
+        assert fleet.rebucket_hint(shards) is None
+
+    def test_tied_walls_with_none_key_index(self):
+        """Missing key_index next to a tied wall must not crash the
+        sort (summarize tolerates missing fields; so must the hint)."""
+        shards = [
+            {"device": "D0", "key_index": None, "wall_s": 2.0},
+            {"device": "D0", "key_index": 1, "wall_s": 2.0},
+            {"device": "D0", "key_index": 2, "wall_s": 2.0},
+            {"device": "D1", "key_index": 3, "wall_s": 1.0},
+        ]
+        hint = fleet.rebucket_hint(shards)
+        assert hint["from"] == "D0"
+        assert None not in hint["keys"]
+
+    def test_summarize_carries_hint(self):
+        s = fleet.summarize(self.shards())
+        assert s["rebucket_hint"]["from"] == "D0"
+
+
+class TestDriftGate:
+    def test_drift_x_math(self):
+        assert devices.drift_x(125, 100) == 1.25
+        assert devices.drift_x(None, 100) is None
+        assert devices.drift_x(100, None) is None
+        assert devices.drift_x(100, 0) is None
+
+    def test_drift_regressed_both_ways(self):
+        assert devices.drift_regressed(1.3)
+        assert devices.drift_regressed(0.7)
+        assert not devices.drift_regressed(1.2)
+        assert not devices.drift_regressed(0.85)
+        assert not devices.drift_regressed(None)
+
+    def test_compute_regressions_flags_hbm(self):
+        import bench
+        rep = bench.compute_regressions(
+            [], {"round": 1, "platform": "cpu", "value": 1.0,
+                 "configs": {}, "fills": {},
+                 "hbm_drift": {"mutex_1k": 2.0, "headline": 1.1,
+                               "elle": 0.4}})
+        assert "mutex_1k:hbm" in rep["regressions"]
+        assert "elle:hbm" in rep["regressions"]
+        assert "headline:hbm" not in rep["regressions"]
+        assert rep["hbm"]["mutex_1k"]["regressed"] is True
+        assert rep["hbm"]["headline"]["regressed"] is False
+        assert rep["hbm"]["headline"]["threshold_x"] == \
+            devices.HBM_DRIFT_X
+
+    def test_collect_hbm_drift(self):
+        import bench
+        out = {"metric": "headline_10k",
+               "preflight": {"hbm_drift_x": 1.05},
+               "configs": {
+                   "mutex_1k": {"preflight": {"hbm_drift_x": 2.0}},
+                   "no_pf": {"wall_s": 1.0}}}
+        drift = bench._collect_hbm_drift(out)
+        assert drift == {"headline_10k": 1.05, "mutex_1k": 2.0}
+
+    def test_attach_hbm_drift(self):
+        import bench
+        blk = {"hbm_peak_bytes": 100}
+        bench._attach_hbm_drift(blk, {
+            "hbm": {"stats_available": True, "peak_measured": 250}})
+        assert blk["hbm_peak_measured"] == 250
+        assert blk["hbm_drift_x"] == 2.5
+        blk2 = {"hbm_peak_bytes": 100}
+        bench._attach_hbm_drift(blk2, {
+            "hbm": {"stats_available": False,
+                    "stats_unavailable": True,
+                    "peak_measured": None}})
+        assert blk2.get("hbm_stats_unavailable") is True
+        assert "hbm_drift_x" not in blk2
+
+
+class TestBudgetClosure:
+    def test_env_override_still_wins(self, monkeypatch):
+        from jepsen_tpu.analysis import preflight
+        monkeypatch.setenv("JEPSEN_TPU_PREFLIGHT_MEM_BUDGET", "12345")
+        with devices.use(devices.DeviceMonitor(devices=[
+                FakeDev("F", limit=99)])):
+            assert preflight.device_memory_budget() == 12345
+
+    def test_measured_limit_feeds_budget(self, monkeypatch):
+        from jepsen_tpu.analysis import preflight
+        monkeypatch.delenv("JEPSEN_TPU_PREFLIGHT_MEM_BUDGET",
+                           raising=False)
+        fakes = [FakeDev("F0", limit=8 << 30),
+                 FakeDev("F1", limit=4 << 30)]
+        with devices.use(devices.DeviceMonitor(devices=fakes)):
+            # min across devices: a plan must fit the smallest chip
+            assert devices.measured_bytes_limit() == 4 << 30
+            assert preflight.device_memory_budget() == 4 << 30
+
+    def test_spec_constant_fallback_on_cpu(self, monkeypatch):
+        from jepsen_tpu.analysis import preflight
+        monkeypatch.delenv("JEPSEN_TPU_PREFLIGHT_MEM_BUDGET",
+                           raising=False)
+        # the real cpu backend reports no bytes_limit
+        assert devices.measured_bytes_limit() is None
+        assert preflight.device_memory_budget() == \
+            preflight.V5E_HBM_CAPACITY_BYTES
+
+
+class TestStatusAndPanel:
+    @pytest.fixture()
+    def base_url(self, tmp_path):
+        from jepsen_tpu import web
+        server = web.serve(host="127.0.0.1", port=0,
+                           store_root=str(tmp_path))
+        t = threading.Thread(target=server.serve_forever, daemon=True)
+        t.start()
+        yield f"http://127.0.0.1:{server.server_port}"
+        server.shutdown()
+
+    def get(self, url):
+        resp = urllib.request.urlopen(url, timeout=10)
+        assert resp.status == 200
+        return resp.read().decode()
+
+    def test_status_json_hbm_block(self, base_url):
+        fakes = two_fakes()
+        mon = devices.DeviceMonitor(devices=fakes)
+        mon.sample(force=True)
+        with devices.use(mon):
+            snap = json.loads(self.get(base_url + "/status.json"))
+        hbm = snap["hbm"]
+        assert hbm["active"] is True
+        assert hbm["n_devices"] == 2
+        assert hbm["stats_available"] == 2
+        assert hbm["devices"]["FAKE_0"]["bytes_in_use"] == 1 << 30
+        assert hbm["peak_seen_bytes"] == 1 << 30
+
+    def test_status_json_idle_stub(self, base_url):
+        snap = json.loads(self.get(base_url + "/status.json"))
+        assert "hbm" in snap
+        assert snap["hbm"]["active"] is False
+
+    def test_devices_panel_renders(self, base_url):
+        mon = devices.DeviceMonitor(devices=two_fakes())
+        mon.sample(force=True)
+        with devices.use(mon):
+            body = self.get(base_url + "/devices")
+        assert "device observatory" in body
+        assert "FAKE_0" in body and "FAKE_1" in body
+        assert "GiB" in body  # formatted byte columns
+
+    def test_devices_panel_idle(self, base_url):
+        body = self.get(base_url + "/devices")
+        assert "no device samples yet" in body
+
+    def test_devices_panel_no_stats_marker(self, base_url):
+        mon = devices.DeviceMonitor(
+            devices=[FakeDev("CPU_0", stats=False)])
+        mon.sample(force=True)
+        with devices.use(mon):
+            body = self.get(base_url + "/devices")
+        assert "no allocator stats" in body
+
+    def test_status_merges_hbm_into_fleet_devices(self, base_url):
+        """Where the fleet's device labels match the monitor's, the
+        RunStatus devices entries carry the memory column too."""
+        st = fleet.RunStatus(test="t")
+        st.device_state("FAKE_0", "searching", key_index=1)
+        mon = devices.DeviceMonitor(devices=two_fakes())
+        mon.sample(force=True)
+        with fleet.use(st), devices.use(mon):
+            snap = json.loads(self.get(base_url + "/status.json"))
+        assert snap["devices"]["FAKE_0"]["hbm"]["bytes_in_use"] == \
+            1 << 30
+
+
+class TestPerfettoLanes:
+    def build_registry(self):
+        reg = metrics.Registry()
+        with metrics.use(reg):
+            mon = devices.DeviceMonitor(devices=two_fakes(),
+                                        min_interval_s=0.0)
+            mon.sample(where="t", force=True)
+            mon.sample(where="t", force=True)
+        return reg
+
+    def test_counter_tracks_per_device(self):
+        tracks = occupancy.perfetto_counter_tracks(
+            self.build_registry())
+        assert "hbm bytes FAKE_0" in tracks
+        assert "hbm bytes FAKE_1" in tracks
+        assert len(tracks["hbm bytes FAKE_0"]) == 2
+        t, v = tracks["hbm bytes FAKE_0"][0]
+        assert v == 1 << 30
+
+    def test_counter_events_get_own_lanes(self):
+        tracks = occupancy.perfetto_counter_tracks(
+            self.build_registry())
+        events = trace.counter_events(tracks)
+        tids = {e["tid"] for e in events if e["ph"] == "C"}
+        assert len(tids) == len(tracks)  # one lane per track
+        names = {e["args"]["name"] for e in events
+                 if e["ph"] == "M"}
+        assert "counter hbm bytes FAKE_0" in names
+
+    def test_counter_lanes_disjoint_from_span_lanes(self):
+        """Counters live in their own pid: sharing pid 1 would let a
+        counter thread_name meta rename a span thread lane."""
+        tr = trace.Tracer(sampled=True)
+        with tr.span("check"):
+            pass
+        spans = [sp.to_json() for sp in tr.spans]
+        doc = trace.to_perfetto(
+            spans, counters={"hbm bytes FAKE_0": [(1.0, 2.0)]})
+        span_lanes = {(e["pid"], e["tid"]) for e in doc["traceEvents"]
+                      if e.get("cat") == "span"}
+        counter_lanes = {(e["pid"], e["tid"])
+                         for e in doc["traceEvents"]
+                         if e["ph"] == "C"}
+        assert span_lanes and counter_lanes
+        assert not (span_lanes & counter_lanes)
+
+    def test_perfetto_export_lints(self, tmp_path):
+        tracks = occupancy.perfetto_counter_tracks(
+            self.build_registry())
+        doc = trace.to_perfetto([], counters=tracks)
+        path = str(tmp_path / "devices.perfetto.json")
+        with open(path, "w") as fh:
+            json.dump(doc, fh)
+        assert telemetry_lint.lint_perfetto_file(path) == []
+
+    def test_counter_samples_sorted(self):
+        events = trace.counter_events(
+            {"x": [(2.0, 5.0), (1.0, 3.0)]})
+        cs = [e for e in events if e["ph"] == "C"]
+        assert [e["ts"] for e in cs] == sorted(e["ts"] for e in cs)
+
+
+class TestHeatmapDeviceStrip:
+    def multichip_points(self, n_devices=8, lanes=16, rounds=6):
+        """MULTICHIP-shaped fixture: contiguous lane->device blocks,
+        exactly the layout parallel/batched.py stamps."""
+        lanes_per_dev = lanes // n_devices
+        pts = []
+        for lane in range(lanes):
+            for rnd in range(rounds):
+                pts.append({"round": rnd, "lane": lane,
+                            "fill": (lane + 1) / lanes,
+                            "frontier": lane + rnd,
+                            "device": min(lane // lanes_per_dev,
+                                          n_devices - 1)})
+        return pts
+
+    def test_strip_renders(self, tmp_path):
+        from jepsen_tpu.checker import plots
+        out = plots.occupancy_heatmap(
+            {"name": "multichip fixture"}, self.multichip_points(),
+            out_path=str(tmp_path / "hm.png"))
+        assert out and os.path.isfile(out)
+        assert os.path.getsize(out) > 0
+
+    def test_no_device_field_still_renders(self, tmp_path):
+        from jepsen_tpu.checker import plots
+        pts = [{"round": r, "lane": 0, "fill": 0.5}
+               for r in range(4)]
+        out = plots.occupancy_heatmap(
+            {"name": "plain"}, pts,
+            out_path=str(tmp_path / "hm2.png"))
+        assert out and os.path.isfile(out)
+
+    def test_batched_points_carry_device(self):
+        """The vmap fan-out stamps a device index on its per-round
+        heatmap points (the strip's data source)."""
+        from jepsen_tpu import synth
+        from jepsen_tpu.models import cas_register
+        from jepsen_tpu.parallel import check_batched
+        hists = [synth.cas_register_history(24, n_procs=3, seed=s)
+                 for s in range(4)]
+        reg = metrics.Registry()
+        with metrics.use(reg):
+            res = check_batched(cas_register(), hists,
+                                strategy="vmap", time_limit=60,
+                                oracle_fallback=False)
+        assert all(r["valid?"] in (True, False) for r in res)
+        pts = [p for p in reg.series("wgl_batched_rounds").points
+               if p.get("lane", -1) >= 0]
+        assert pts, "vmap run drained per-round lane points"
+        assert all(isinstance(p.get("device"), int) for p in pts)
+
+
+class TestSearchIntegration:
+    """The wgl/elle result-side closure — slow-ish (device kernels
+    compile), so the suite keeps them minimal."""
+
+    def test_wgl_result_hbm_marker_on_cpu(self):
+        from jepsen_tpu import synth
+        from jepsen_tpu.models import cas_register
+        from jepsen_tpu.ops import wgl
+        with devices.use(devices.DeviceMonitor()):
+            res = wgl.check(cas_register(),
+                            synth.cas_register_history(
+                                120, n_procs=3, seed=3),
+                            time_limit=60)
+        assert res["valid?"] is True
+        # cpu backend: explicit marker, never invented bytes
+        assert res["hbm"]["stats_unavailable"] is True
+        assert res["hbm"]["peak_measured"] is None
+        assert "hbm_peak_measured" not in res["util"]
+
+    def test_wgl_result_no_block_when_disabled(self):
+        from jepsen_tpu import synth
+        from jepsen_tpu.models import cas_register
+        from jepsen_tpu.ops import wgl
+        assert not devices.get_default().enabled
+        res = wgl.check(cas_register(),
+                        synth.cas_register_history(
+                            120, n_procs=3, seed=3),
+                        time_limit=60)
+        assert "hbm" not in res
+
+    def test_elle_util_hbm_marker(self):
+        from jepsen_tpu import synth
+        from jepsen_tpu.elle import append as elle_append
+        hist = synth.list_append_history(120, n_procs=3, seed=5)
+        with devices.use(devices.DeviceMonitor()):
+            res = elle_append.check(hist, cycle_backend="trim")
+        util = res.get("cycle-util") or {}
+        assert util.get("hbm", {}).get("stats_unavailable") is True
+
+
+@pytest.mark.slow
+class TestHeavyPolling:
+    """Sustained-polling behavior: thread-safety of concurrent
+    samplers and window accounting under churn — heavier loops, so
+    slow-marked (tier-1 runs near its 870 s cap)."""
+
+    def test_concurrent_samplers_consistent(self):
+        fakes = two_fakes()
+        mon = devices.DeviceMonitor(devices=fakes,
+                                    min_interval_s=0.0)
+        reg = metrics.Registry()
+        errors = []
+
+        def worker():
+            try:
+                for i in range(200):
+                    fakes[0].bytes_in_use = (i % 7 + 1) << 20
+                    mon.sample(where="stress", force=True, mx=reg)
+                    if i % 50 == 0:
+                        mon.measured(mon.mark())
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = [threading.Thread(target=worker)
+                   for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors
+        snap = mon.snapshot()
+        assert snap["polls"] >= 800
+        assert len(reg.series("device_poll")) >= 800
+
+    def test_window_churn_bounded(self):
+        """Leaked (never-measured) windows must not accumulate."""
+        mon = devices.DeviceMonitor(devices=two_fakes(),
+                                    min_interval_s=0.0)
+        for _ in range(300):
+            mon.mark()
+        assert len(mon._marks) <= 64
