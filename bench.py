@@ -639,6 +639,8 @@ def _switch_platform(plat: str, diags: Optional[list] = None) -> bool:
 
 
 def run_bench() -> tuple[dict, int]:
+    global _T0_EPOCH
+    _T0_EPOCH = time.time()  # the doctor scopes ledger reads to this run
     n_ops = int(os.environ.get("JEPSEN_TPU_BENCH_OPS", "10000"))
     budget = float(os.environ.get("JEPSEN_TPU_BENCH_BUDGET_S", "120"))
     extras = os.environ.get("JEPSEN_TPU_BENCH_EXTRAS", "1") != "0"
@@ -955,6 +957,8 @@ _PARTIAL: dict = {}
 _REGISTRY = None
 _TRACER = None
 _LEDGER = None
+_T0_EPOCH = None
+_DOCTOR_REPORT = None
 
 
 def _ledger_record_config(name: str, res: dict, wall: float,
@@ -1005,9 +1009,16 @@ def _export_telemetry(out: dict) -> None:
             from jepsen_tpu import occupancy as occupancy_mod
             counters = (occupancy_mod.perfetto_counter_tracks(
                 _REGISTRY) if _REGISTRY is not None else None)
+            # the doctor's offending-round markers ride the same
+            # export as instant-event annotations
+            instants = None
+            if _DOCTOR_REPORT is not None:
+                from jepsen_tpu import doctor as doctor_mod
+                instants = doctor_mod.perfetto_instants(
+                    _DOCTOR_REPORT) or None
             _TRACER.export_perfetto(
                 os.path.join(art, "bench_trace.perfetto.json"),
-                counters=counters)
+                counters=counters, instants=instants)
             files.append(
                 "artifacts/telemetry/bench_trace.perfetto.json")
     except OSError:
@@ -1127,15 +1138,10 @@ def _collect_hbm_drift(out: dict) -> dict:
 
 
 def _delta_row(latest, priors: list, threshold: float) -> dict:
-    prev = priors[-1] if priors else None
-    best = min(priors) if priors else None
-    row = {"latest": latest, "prev": prev, "best_prior": best}
-    if prev is not None:
-        row["delta_vs_prev_s"] = round(latest - prev, 3)
-    if best is not None and best > 0:
-        row["ratio_vs_best"] = round(latest / best, 3)
-        row["regressed"] = latest > threshold * best
-    return row
+    # one shared definition (jepsen_tpu/drift.py) with
+    # ledger.regressions() and the doctor's drift rules
+    from jepsen_tpu import drift
+    return drift.delta_row(latest, priors, threshold)
 
 
 def compute_regressions(rounds: list, current=None,
@@ -1215,9 +1221,8 @@ def compute_regressions(rounds: list, current=None,
                   if name in (r.get("fills") or {})]
         if latest is None or not priors:
             continue
-        best = max(priors)
-        row = {"latest": latest, "best_prior": best,
-               "regressed": bool(best > 0 and latest < 0.9 * best)}
+        from jepsen_tpu import drift as drift_mod
+        row = drift_mod.fill_row(latest, priors)
         out["occupancy"][name] = row
         if row["regressed"]:
             out["regressions"].append(f"{name}:fill")
@@ -1252,8 +1257,8 @@ def _export_regressions(out: dict) -> None:
                 and isinstance(c["util"].get("frontier_fill"),
                                (int, float))},
             "hbm_drift": _collect_hbm_drift(out)}
-        threshold = float(os.environ.get(
-            "JEPSEN_TPU_BENCH_REGRESSION_X", "1.5"))
+        from jepsen_tpu import drift as drift_mod
+        threshold = drift_mod.regression_threshold()
         report = compute_regressions(rounds, current,
                                      threshold=threshold)
         report["sources"] = {
@@ -1352,9 +1357,11 @@ def _export_occupancy(out: dict) -> None:
                     for name, fill in (rec.get("configs") or {}).items():
                         if isinstance(fill, (int, float)):
                             best[name] = max(best.get(name, 0.0), fill)
+                from jepsen_tpu import drift as drift_mod
                 for name, r in configs.items():
                     prior = best.get(name)
-                    if prior and r["frontier_fill"] < 0.9 * prior:
+                    if prior and drift_mod.fill_regressed(
+                            r["frontier_fill"], prior):
                         r["best_prior_fill"] = prior
                         report["fill_regressions"].append(name)
                 _LEDGER.record({
@@ -1395,6 +1402,61 @@ def _export_occupancy(out: dict) -> None:
         traceback.print_exc(file=sys.stderr)
 
 
+def _export_doctor(out: dict) -> None:
+    """Automated run diagnosis (jepsen_tpu/doctor.py): correlate this
+    round's telemetry into ranked findings — the PR-9 manual triage
+    (a human reading per-bucket compile counts out of the ledger),
+    automated. The report lands in artifacts/telemetry/doctor.json, a
+    kind="doctor" ledger record, and a compact `doctor` block on the
+    output line; when compute_regressions flagged this round, the TOP
+    finding rides the compact line as the suggested why. Pure
+    host-side reads of already-recorded artifacts — zero new
+    compiles, zero new transfers (scripts/doctor_smoke.py proves it).
+    Never raises — the JSON-line contract outranks the diagnosis."""
+    global _DOCTOR_REPORT
+    if _REGISTRY is None:
+        # the round died before installing its sinks (early init
+        # failure / SIGTERM): there is nothing of THIS round to
+        # diagnose, and falling through to the artifact files would
+        # re-report the PREVIOUS round's findings as this one's
+        return
+    try:
+        from jepsen_tpu import doctor as doctor_mod
+        view = doctor_mod.bench_view(
+            REPO_ROOT, registry=_REGISTRY, tracer=_TRACER,
+            details=out, since=_T0_EPOCH)
+        report = doctor_mod.diagnose(view)
+        _DOCTOR_REPORT = report
+        doctor_mod.record_report(
+            report, where="bench",
+            ledger_name=out.get("metric") or "bench")
+        files = []
+        try:
+            art = os.path.join(REPO_ROOT, "artifacts", "telemetry")
+            os.makedirs(art, exist_ok=True)
+            with open(os.path.join(art, "doctor.json"), "w") as fh:
+                json.dump(report, fh, indent=1, default=str)
+            files.append("artifacts/telemetry/doctor.json")
+        except OSError:
+            pass  # read-only checkout: the compact block still rides
+        blk = {"healthy": report["healthy"],
+               "rules": report["rules_fired"],
+               "findings_n": len(report["findings"]),
+               "files": files}
+        flagged = (out.get("regressions") or {}).get("flagged") or []
+        if report["findings"]:
+            top = report["findings"][0]
+            blk["top"] = {k: top.get(k) for k in
+                          ("rule", "name", "severity", "subject",
+                           "summary") if top.get(k) is not None}
+            if flagged:
+                print(f"DOCTOR: top finding for flagged round: "
+                      f"{blk['top']}", file=sys.stderr)
+        out["doctor"] = blk
+    except Exception:  # noqa: BLE001
+        traceback.print_exc(file=sys.stderr)
+
+
 def emit(out: dict) -> None:
     """The stdout contract is ONE parseable JSON line — and the
     driver records only a bounded TAIL of output, so a huge line gets
@@ -1403,9 +1465,13 @@ def emit(out: dict) -> None:
     BENCH_DETAILS.json in the repo (the round snapshot carries it to
     the judge), and stdout gets a compact summary line that always
     fits the window."""
-    _export_telemetry(out)
     _export_regressions(out)
     _export_occupancy(out)
+    # the doctor reads what the exporters above flagged and what the
+    # run recorded; it must run BEFORE the telemetry export so its
+    # findings annotate the Perfetto document as instant events
+    _export_doctor(out)
+    _export_telemetry(out)
     try:
         with open(DETAILS_PATH, "w") as f:
             json.dump(out, f, indent=1)
@@ -1416,7 +1482,7 @@ def emit(out: dict) -> None:
                ("metric", "value", "unit", "vs_baseline", "verdict",
                 "platform", "cold_s", "terminated", "error", "cause",
                 "tpu_measured", "regressions", "occupancy_report",
-                "compile_budget_exceeded", "preflight")
+                "compile_budget_exceeded", "preflight", "doctor")
                if out.get(k) is not None}
     aot = out.get("tpu_aot")
     if isinstance(aot, dict):

@@ -105,11 +105,46 @@ def preflight_cmd() -> dict:
                                    "preflight [OPTIONS ...]"}}
 
 
+def doctor_cmd() -> dict:
+    """`python -m jepsen_tpu doctor <run_id|latest|bench>` — the
+    diagnosis engine (jepsen_tpu/doctor): correlate a recorded run's
+    telemetry planes into ranked, evidence-backed findings under the
+    D001-D010 rule catalog. Pure host-side reads of already-recorded
+    artifacts — nothing executes on a device."""
+    spec = [
+        Opt("help", short="-h", help="Print out this message and exit"),
+        Opt("target", metavar="TARGET",
+            help="run_id | latest | bench (also accepted as a bare "
+                 "positional argument; default bench)"),
+        Opt("root", metavar="DIR",
+            help="Repo root for bench artifacts (default: cwd)"),
+        Opt("store", metavar="DIR",
+            help="Store root holding the ledger (default: "
+                 "<root>/store)"),
+        Opt("json", default=False,
+            help="Emit the full report as JSON"),
+        Opt("strict", default=False,
+            help="Exit 1 when any warn/critical finding fired"),
+        Opt("no_record", default=False,
+            help="Read-only: skip banking the kind=\"doctor\" "
+                 "ledger record"),
+    ]
+
+    def run(parsed):
+        from . import doctor as doctor_mod
+        return doctor_mod.cli_main(parsed.options, parsed.arguments)
+
+    return {"doctor": {"opt_spec": spec, "run": run,
+                       "usage": "Usage: python -m jepsen_tpu doctor "
+                                "[run_id|latest|bench] [OPTIONS ...]"}}
+
+
 COMMANDS = {
     **cli.single_test_cmd({"test_fn": demo_test, "opt_spec": DEMO_OPTS}),
     **cli.test_all_cmd({"tests_fn": demo_tests, "opt_spec": DEMO_OPTS}),
     **cli.serve_cmd(),
     **preflight_cmd(),
+    **doctor_cmd(),
 }
 
 

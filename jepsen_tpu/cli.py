@@ -528,7 +528,8 @@ def serve_cmd() -> dict:
         print(f"Live run status: {base}/status "
               f"(JSON: {base}/status.json)")
         print(f"Device observatory: {base}/devices "
-              f"· occupancy: {base}/occupancy")
+              f"· occupancy: {base}/occupancy "
+              f"· doctor: {base}/doctor")
         try:
             server.serve_forever()
         except KeyboardInterrupt:

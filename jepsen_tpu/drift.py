@@ -1,0 +1,94 @@
+"""Shared drift/regression math for the trend trackers.
+
+Three places used to implement "did this number regress?" on their
+own: `bench.compute_regressions` (per-config wall deltas + the 0.9x
+fill rule), `ledger.Ledger.regressions` (the same wall comparison
+generalized to every recorded run), and `bench._export_occupancy`
+(the fill rule again against ledger priors). Divergence between them
+is exactly the silent-drift failure mode the telemetry lint exists
+for — a threshold bumped in one copy changes what gets flagged
+without changing what gets printed elsewhere. This module is the one
+definition all of them (and `jepsen_tpu/doctor.py`, which turns the
+flags into diagnoses) consume:
+
+  * `regression_threshold()` — the wall-time gate
+    (`JEPSEN_TPU_BENCH_REGRESSION_X`, default 1.5x best prior);
+  * `delta_row()` — latest-vs-priors comparison row (prev/best
+    deltas, ratio, the regressed flag);
+  * `fill_regressed()` / `FILL_REGRESSION_X` — the occupancy rule: a
+    fill below 0.9x the best same-platform prior regressed, even if
+    wall time improved;
+  * HBM drift stays in `jepsen_tpu/devices.py` (`drift_x` /
+    `drift_regressed` / `HBM_DRIFT_X`) — it was already
+    single-sourced there; this module just re-exports it so drift
+    consumers have one import.
+
+Same-platform-only comparison is the CALLER's job (a cpu round next
+to a tpu round is a hardware change, not a regression) — these
+helpers only do the arithmetic.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from .devices import HBM_DRIFT_X, drift_regressed, drift_x  # noqa: F401
+
+# Wall-time regression gate: latest > REGRESSION_X * best prior.
+REGRESSION_X = 1.5
+
+# Occupancy regression gate: latest fill < FILL_REGRESSION_X * best
+# prior fill — a change that wins wall time by emptying the lanes
+# still trips the tracker (ROADMAP item 5).
+FILL_REGRESSION_X = 0.9
+
+
+def regression_threshold(default: float = REGRESSION_X) -> float:
+    """The wall-time threshold, env-overridable — the ONE place
+    JEPSEN_TPU_BENCH_REGRESSION_X is read."""
+    try:
+        return float(os.environ.get("JEPSEN_TPU_BENCH_REGRESSION_X",
+                                    str(default)))
+    except ValueError:
+        return default
+
+
+def wall_regressed(latest: float, best_prior: Optional[float],
+                   threshold: Optional[float] = None) -> bool:
+    """Is `latest` a regression against the best prior wall?"""
+    if best_prior is None or best_prior <= 0:
+        return False
+    t = regression_threshold() if threshold is None else threshold
+    return latest > t * best_prior
+
+
+def delta_row(latest: float, priors: list,
+              threshold: Optional[float] = None) -> dict:
+    """The latest-vs-priors comparison row every wall tracker emits:
+    prev/best priors, the delta and ratio, and the regressed flag
+    (`wall_regressed`). `priors` must be time-ordered (prev = last)."""
+    t = regression_threshold() if threshold is None else threshold
+    prev = priors[-1] if priors else None
+    best = min(priors) if priors else None
+    row = {"latest": latest, "prev": prev, "best_prior": best}
+    if prev is not None:
+        row["delta_vs_prev_s"] = round(latest - prev, 3)
+    if best is not None and best > 0:
+        row["ratio_vs_best"] = round(latest / best, 3)
+        row["regressed"] = wall_regressed(latest, best, t)
+    return row
+
+
+def fill_regressed(latest: float, best_prior: Optional[float]) -> bool:
+    """Is `latest` fill a regression against the best prior fill?"""
+    if best_prior is None or best_prior <= 0:
+        return False
+    return latest < FILL_REGRESSION_X * best_prior
+
+
+def fill_row(latest: float, priors: list) -> dict:
+    """The fill comparison row (best prior is the HIGHEST fill)."""
+    best = max(priors) if priors else None
+    return {"latest": latest, "best_prior": best,
+            "regressed": fill_regressed(latest, best)}

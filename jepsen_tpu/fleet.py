@@ -232,6 +232,27 @@ def rebucket_hint(shards: list) -> Optional[dict]:
                                               1e-9), 3)}
 
 
+# Bound on rebucket-hint key lists riding compact surfaces (ledger
+# records, doctor findings, /status blocks) — the full hint stays on
+# the in-memory summary.
+HINT_MAX_KEYS = 16
+
+
+def compact_hint(hint, max_keys: int = HINT_MAX_KEYS):
+    """A rebucket hint bounded for compact surfaces: long `keys`
+    lists truncate-and-count (`keys_omitted`) instead of ballooning
+    a record — the ONE truncation rule ledger.summarize_result and
+    doctor.compact_finding share."""
+    if not isinstance(hint, dict):
+        return None
+    out = dict(hint)
+    keys = out.get("keys")
+    if isinstance(keys, list) and len(keys) > max_keys:
+        out["keys"] = keys[:max_keys]
+        out["keys_omitted"] = len(keys) - max_keys
+    return out
+
+
 def summarize(shards: list) -> dict:
     """Fleet aggregates over per-key shard blocks: per-device shard
     counts / wall / busy fraction, straggler ratio (max vs median

@@ -131,6 +131,13 @@ def summarize_result(result: dict) -> dict:
                            "fallbacks", "straggler_ratio",
                            "work_skew")
                           if fleet.get(k) is not None}
+            # the scheduling remedy rides the record (bounded): a
+            # record-based diagnosis (doctor D005) must be able to
+            # hand back WHICH keys to move, not just that skew exists
+            from . import fleet as fleet_mod
+            hint = fleet_mod.compact_hint(fleet.get("rebucket_hint"))
+            if hint is not None:
+                u["fleet"]["rebucket_hint"] = hint
         if u:
             out["util"] = u
     # device-observatory closure (devices.py): the measured HBM block
@@ -407,13 +414,18 @@ class Ledger:
                 "compiles": compiles,
                 "stalls": stalls}
 
-    def regressions(self, threshold: float = 1.5,
+    def regressions(self, threshold: Optional[float] = None,
                     metric: str = "wall_s", **filters) -> dict:
         """bench.py's wall-time regression tracking generalized to ALL
         recorded runs: group by (name, platform), compare each group's
         latest `metric` against the best prior, flag slowdowns beyond
-        `threshold`x. Same-platform only — a cpu run next to a tpu run
-        is a hardware change, not a regression."""
+        `threshold`x (default: the shared drift gate —
+        `drift.regression_threshold()`, env
+        JEPSEN_TPU_BENCH_REGRESSION_X). Same-platform only — a cpu run
+        next to a tpu run is a hardware change, not a regression."""
+        from . import drift
+        if threshold is None:
+            threshold = drift.regression_threshold()
         groups: dict = {}
         for r in self.query(**filters):
             v = r.get(metric)
@@ -436,7 +448,8 @@ class Ledger:
                 row["best_prior"] = round(best, 4)
                 if best > 0:
                     row["ratio_vs_best"] = round(latest / best, 3)
-                    row["regressed"] = latest > threshold * best
+                    row["regressed"] = drift.wall_regressed(
+                        latest, best, threshold)
                     if row["regressed"]:
                         out["regressions"].append(name)
             out["groups"][f"{name}@{plat}"] = row

@@ -173,18 +173,23 @@ class Tracer:
         return len(spans)
 
     def export_perfetto(self, path: str,
-                        counters: Optional[dict] = None) -> int:
+                        counters: Optional[dict] = None,
+                        instants: Optional[list] = None) -> int:
         """Write collected spans as a Chrome/Perfetto `trace_event`
         JSON file (see `to_perfetto`); returns span count.
         `counters` — {track: [(t_epoch_s, value), ...]} — renders as
         counter tracks under the spans (the occupancy plane's
         per-round fill / frontier / backlog graphs;
         `occupancy.perfetto_counter_tracks` builds them from a
-        metrics registry)."""
+        metrics registry). `instants` — [{"t": epoch_s, "name": ...}]
+        — renders as instant-event annotations in their own lane
+        (the doctor's offending-round markers;
+        `doctor.perfetto_instants` builds them from a report)."""
         with self._lock:
             spans = list(self.spans)
         doc = to_perfetto([sp.to_json() for sp in spans],
-                          service=self.service, counters=counters)
+                          service=self.service, counters=counters,
+                          instants=instants)
         parent = os.path.dirname(path)
         if parent:
             os.makedirs(parent, exist_ok=True)
@@ -288,14 +293,46 @@ def counter_events(tracks: dict, pid: int = 2) -> list:
     return events
 
 
+def instant_events(instants: list, pid: int = 3) -> list:
+    """`trace_event` "i" (instant) annotations from
+    [{"t": epoch_seconds, "name": str}, ...] — one labeled marker per
+    point, in their own process lane (pid 3, "annotations") so they
+    never rename a span or counter row. The doctor's diagnosis plane
+    uses these to mark the offending rounds a finding's evidence
+    points at (`doctor.perfetto_instants`); malformed entries are
+    skipped, never a sunk export."""
+    events: list = []
+    for inst in instants or []:
+        try:
+            ts = float(inst["t"]) * 1e6
+            name = str(inst.get("name"))[:80]
+        except (TypeError, KeyError, ValueError):
+            continue
+        if not events:
+            events.append({"ph": "M", "name": "process_name",
+                           "pid": pid, "tid": 0,
+                           "args": {"name": "annotations"}})
+            events.append({"ph": "M", "name": "thread_name",
+                           "pid": pid, "tid": 1,
+                           "args": {"name": "doctor findings"}})
+        events.append({"ph": "i", "s": "g", "name": name,
+                       "cat": "annotation", "ts": ts,
+                       "pid": pid, "tid": 1})
+    return events
+
+
 def to_perfetto(spans: list, service: str = "jepsen_tpu",
-                counters: Optional[dict] = None) -> dict:
+                counters: Optional[dict] = None,
+                instants: Optional[list] = None) -> dict:
     """The loadable document: {"traceEvents": [...]} — the JSON object
     form both Perfetto and chrome://tracing ingest directly.
-    `counters` adds counter tracks (see `counter_events`)."""
+    `counters` adds counter tracks (see `counter_events`); `instants`
+    adds instant-event annotations (see `instant_events`)."""
     events = perfetto_events(spans, service=service)
     if counters:
         events += counter_events(counters)
+    if instants:
+        events += instant_events(instants)
     return {"traceEvents": events, "displayTimeUnit": "ms"}
 
 

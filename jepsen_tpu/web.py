@@ -195,6 +195,18 @@ def status_snapshot(store_root: str) -> dict:
                             if mem.get(k) is not None}
     except Exception:  # noqa: BLE001 — the status answer must not
         snap.setdefault("hbm", {"active": False})  # need the monitor
+    # diagnosis plane (doctor.py): diagnoses run in this process win;
+    # a mirror from another process keeps its own block, and the idle
+    # stub keeps the documented schema answerable
+    try:
+        from . import doctor as doctor_mod
+        dc = doctor_mod.snapshot()
+        if dc["checked"] or "doctor" not in snap:
+            snap["doctor"] = dc
+    except Exception:  # noqa: BLE001 — the status answer must not
+        snap.setdefault("doctor",      # depend on the doctor plane
+                        {"checked": 0, "findings": {},
+                         "healthy_last": None, "recent": []})
     # history, not just the live run: the last N ledger entries ride
     # every status answer so the fleet dashboard shows what the fleet
     # has DONE, not only what it is doing
@@ -331,9 +343,20 @@ def render_status(store_root: str) -> bytes:
             + (f" &middot; peak seen {_esc(_fmt_bytes(peak))}"
                if peak is not None else "")
             + " &middot; <a href='/devices'>devices panel</a></p>")
+    dc = s.get("doctor") or {}
+    top = dc.get("top")
+    if dc.get("checked") and top:
+        color = _SEVERITY_COLORS.get(top.get("severity"),
+                                     VALID_COLORS[None])
+        parts.append(
+            f"<p>doctor: <b style='background:{color};"
+            f"padding:1px 6px'>{_esc(top.get('rule'))}</b> "
+            f"{_esc(top.get('summary'))} &middot; "
+            f"<a href='/doctor'>doctor panel</a></p>")
     parts.append("<p><a href='/status.json'>status.json</a> &middot; "
                  "<a href='/occupancy'>occupancy</a> &middot; "
                  "<a href='/devices'>devices</a> &middot; "
+                 "<a href='/doctor'>doctor</a> &middot; "
                  "<a href='/runs'>run ledger</a></p>")
     return _page("status", "".join(parts))
 
@@ -511,6 +534,146 @@ def render_devices(store_root: str) -> bytes:
     return _page("devices", "".join(parts))
 
 
+# /doctor diagnoses the newest ledger record on demand; the (mtime,
+# size) key means a 2 s auto-refresh re-diagnoses only when the
+# ledger actually grew.
+_DOCTOR_CACHE: dict = {}
+
+_SEVERITY_COLORS = {"critical": VALID_COLORS[False],
+                    "warn": VALID_COLORS["unknown"],
+                    "info": VALID_COLORS[None]}
+
+
+def _doctor_latest(store_root: str):
+    """The report the /doctor panel renders: the last IN-PROCESS
+    diagnosis when one ran (the bench / serve-during-run path), else
+    a fresh diagnosis of the newest ledger record (cached on the
+    index file's identity)."""
+    from . import doctor as doctor_mod
+    rep = doctor_mod.last_report()
+    if rep is not None:
+        return rep
+    led = ledger_mod.Ledger(store_root)
+    try:
+        st = os.stat(led.index_path)
+        key = (st.st_mtime_ns, st.st_size)
+    except OSError:
+        return None
+    cached = _DOCTOR_CACHE.get(store_root)
+    if cached is not None and cached[0] == key:
+        return cached[1]
+    try:
+        view = doctor_mod.run_view(store_root, "latest")
+        rep = doctor_mod.diagnose(view)
+    except KeyError:
+        rep = None
+    _DOCTOR_CACHE[store_root] = (key, rep)
+    return rep
+
+
+# record diagnoses cached per RECORD-FILE identity — a polled
+# /runs/<id>.json must not re-scan the whole ledger index (twice:
+# query + the D008 prior sweep) plus the trace artifact per request.
+# Keying on the record's own (mtime, size) keeps the cache hot while
+# unrelated runs append to the index; the D008 baseline inside a
+# cached diagnosis may lag new doctor records, which is fine for a
+# finished record's page.
+_DOCTOR_REC_CACHE: dict = {}
+
+
+def doctor_for_record(store_root: str, run_id: str):
+    """The compact `doctor` block attached to /runs/<id>(.json):
+    diagnose that one record's telemetry, or None when the doctor
+    can't (a missing record 404s before this runs; a failing rule
+    never breaks the record page)."""
+    try:
+        from . import doctor as doctor_mod
+        led = ledger_mod.Ledger(store_root)
+        try:
+            st = os.stat(led.record_path(str(run_id)))
+            key = (store_root, run_id, st.st_mtime_ns, st.st_size)
+        except (OSError, TypeError):
+            key = None
+        if key is not None and key in _DOCTOR_REC_CACHE:
+            return _DOCTOR_REC_CACHE[key]
+        rep = doctor_mod.diagnose(doctor_mod.run_view(store_root,
+                                                      run_id))
+        out = doctor_mod.compact_report(rep)
+        if key is not None:
+            _DOCTOR_REC_CACHE[key] = out
+            while len(_DOCTOR_REC_CACHE) > 256:  # bounded
+                _DOCTOR_REC_CACHE.pop(next(iter(_DOCTOR_REC_CACHE)))
+        return out
+    except Exception:  # noqa: BLE001
+        return None
+
+
+def render_doctor(store_root: str) -> bytes:
+    """The auto-refreshing /doctor panel (doc/OBSERVABILITY.md
+    "Diagnosis plane"): the ranked findings of the most recent
+    diagnosis — rule id, severity, subject, evidence pointers, and
+    the suggested action — over the same ledger /runs serves."""
+    s = status_snapshot(store_root)
+    rep = _doctor_latest(store_root)
+    parts = ["<meta http-equiv='refresh' content='2'>",
+             "<a href='/'>jepsen_tpu</a> / "
+             "<a href='/status'>status</a> / doctor",
+             "<h1>run doctor"
+             f" &middot; {_esc(s.get('test') or 'no active run')}</h1>"]
+    if rep is None:
+        parts.append(
+            "<p>nothing to diagnose yet — the doctor reads ledger "
+            "records and telemetry artifacts "
+            "(doc/OBSERVABILITY.md \"Diagnosis plane\"; "
+            "<code>python -m jepsen_tpu doctor</code>)</p>")
+        return _page("doctor", "".join(parts))
+    verdict_color = (VALID_COLORS[True] if rep.get("healthy")
+                     else VALID_COLORS[False])
+    verdict = ("HEALTHY — no findings" if rep.get("healthy") else
+               f"{len(rep.get('findings') or [])} finding(s): "
+               f"{', '.join(rep.get('rules_fired') or [])}")
+    parts.append(
+        f"<p>target <b>{_esc(rep.get('target'))}</b> &middot; "
+        f"platform {_esc(rep.get('platform'))} &middot; "
+        f"<b style='background:{verdict_color};padding:2px 8px'>"
+        f"{_esc(verdict)}</b></p>")
+    rows = []
+    for f in rep.get("findings") or []:
+        color = _SEVERITY_COLORS.get(f.get("severity"),
+                                     VALID_COLORS[None])
+        ev = "; ".join(
+            f"{_esc(e.get('series'))}.{_esc(e.get('field'))}"
+            f"={_esc(e.get('values'))}"
+            for e in (f.get("evidence") or [])[:2])
+        rows.append(
+            f"<tr><td>{_esc(f.get('rule'))}</td>"
+            f"<td>{_esc(f.get('name'))}</td>"
+            f"<td style='background:{color}'>"
+            f"{_esc(f.get('severity'))}</td>"
+            f"<td>{_esc(f.get('subject') or '-')}</td>"
+            f"<td>{_esc(f.get('summary'))}<br>"
+            f"<span style='color:#555'>{ev}</span></td>"
+            f"<td>{_esc(f.get('action') or '-')}"
+            + (f"<br><span style='color:#555'>remedy: "
+               f"{_esc(f.get('remedy'))}</span>"
+               if f.get("remedy") else "") + "</td></tr>")
+    if rows:
+        parts.append(
+            "<table><thead><tr><th>rule</th><th>name</th>"
+            "<th>severity</th><th>subject</th><th>finding</th>"
+            "<th>suggested action</th></tr></thead><tbody>"
+            + "".join(rows) + "</tbody></table>")
+    ph = rep.get("phases") or {}
+    if ph.get("dominant"):
+        parts.append(
+            f"<p>dominant trace phase <b>{_esc(ph['dominant'])}</b> "
+            f"({_esc(ph.get('dominant_share'))} of traced wall)</p>")
+    parts.append("<p><a href='/status.json'>status.json</a> (the "
+                 "`doctor` block) &middot; "
+                 "<a href='/runs'>run ledger</a></p>")
+    return _page("doctor", "".join(parts))
+
+
 def _fmt_epoch(t) -> str:
     import time as _time
     try:
@@ -587,6 +750,16 @@ def render_run(store_root: str, run_id: str) -> Optional[bytes]:
         links.append(f"<a href='/runs/{_esc(run_id)}/perfetto.json'>"
                      "perfetto.json</a> (open in ui.perfetto.dev)")
     parts.append("<p>" + " &middot; ".join(links) + "</p>")
+    dc = doctor_for_record(store_root, run_id)
+    if dc is not None and dc.get("findings"):
+        items = "".join(
+            f"<li><b style='background:"
+            f"{_SEVERITY_COLORS.get(f.get('severity'), VALID_COLORS[None])}"
+            f";padding:1px 6px'>{_esc(f.get('rule'))}</b> "
+            f"{_esc(f.get('name'))}: {_esc(f.get('summary'))}</li>"
+            for f in dc["findings"][:6])
+        parts.append("<h2>doctor findings</h2><ul>" + items
+                     + "</ul><p><a href='/doctor'>doctor panel</a></p>")
     parts.append("<pre style='background:#f4f4f4;padding:10px'>"
                  + _esc(json.dumps(rec, indent=2, default=str))
                  + "</pre>")
@@ -611,6 +784,7 @@ def render_home(cache: _ValidityCache) -> bytes:
             "<p><a href='/status'>live run status</a> &middot; "
             "<a href='/occupancy'>occupancy</a> &middot; "
             "<a href='/devices'>devices</a> &middot; "
+            "<a href='/doctor'>doctor</a> &middot; "
             "<a href='/runs'>run ledger</a></p>"
             "<table><thead><tr><th>Name</th>"
             "<th>Time</th><th>Valid?</th><th>Results</th><th>History</th>"
@@ -779,6 +953,10 @@ class Handler(BaseHTTPRequestHandler):
                 self._send(200, "text/html; charset=utf-8",
                            render_devices(self.cache.store_root))
                 return
+            if uri == "/doctor":
+                self._send(200, "text/html; charset=utf-8",
+                           render_doctor(self.cache.store_root))
+                return
             if uri in ("/runs", "/runs/"):
                 self._send(200, "text/html; charset=utf-8",
                            render_runs(self.cache.store_root))
@@ -796,6 +974,12 @@ class Handler(BaseHTTPRequestHandler):
                 if rec is None:
                     self._404()
                 elif as_json:
+                    # the diagnosis plane rides every record answer:
+                    # a `doctor` block with the ranked findings for
+                    # THIS record's telemetry (None-safe)
+                    dc = doctor_for_record(self.cache.store_root, rid)
+                    if dc is not None and "doctor" not in rec:
+                        rec = {**rec, "doctor": dc}
                     self._send(200, "application/json",
                                json.dumps(rec, default=str).encode())
                 else:

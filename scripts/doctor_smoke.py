@@ -1,0 +1,157 @@
+#!/usr/bin/env python
+"""Fast CI gate for the diagnosis plane (jepsen_tpu/doctor.py).
+
+Three invariants, each cheap to violate silently and loud here:
+
+  * **healthy run -> zero findings** — a real warm WGL check's
+    telemetry (registry series + result + ledger record) must
+    diagnose HEALTHY: every rule's threshold has to clear an actual
+    well-behaved run, not just hand-picked fixtures;
+  * **seeded signatures -> the right rules** — a replay of the PR-9
+    compile-storm signature (per-key compiles against a one-bucket
+    plan) must fire D001 as the TOP finding with per-bucket compile
+    evidence, and seeded fill-collapse telemetry must fire D002 —
+    with the `doctor` series + kind="doctor" ledger records they
+    produce passing scripts/telemetry_lint.py;
+  * **zero-new-compile / zero-new-transfer proof** — diagnosis is
+    pure host-side reads of already-recorded artifacts: running the
+    doctor over a just-measured check under a CompileGuard must add
+    ZERO XLA compiles and ZERO guard-counted device transfers.
+
+~15 s on a CI cpu. Exit 0 clean, 1 on any violation.
+"""
+
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    from jepsen_tpu import doctor, ledger, metrics, synth
+    from jepsen_tpu.analysis import guards
+    from jepsen_tpu.models import mutex
+    from jepsen_tpu.ops import wgl
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import telemetry_lint
+
+    failures = []
+
+    def check(cond, msg):
+        print(("ok   " if cond else "FAIL ") + msg)
+        if not cond:
+            failures.append(msg)
+
+    # -- healthy run -> zero findings -------------------------------
+    m, h = mutex(), synth.mutex_history(400, n_procs=4, seed=7)
+    wgl.check(m, h, time_limit=60)  # warm the shape bucket
+    reg = metrics.Registry()
+    with tempfile.TemporaryDirectory() as td:
+        led = ledger.Ledger(td)
+        with metrics.use(reg), ledger.use(led):
+            res = wgl.check(m, h, time_limit=60)
+            led.record_result("checker", "doctor-smoke", res,
+                              wall_s=0.1, platform="cpu")
+        view = doctor.view_from_registry(
+            reg, target="healthy", platform="cpu",
+            results={"doctor-smoke": res}, records=led.query())
+        healthy = doctor.diagnose(view)
+    check(res["valid?"] is True, "smoke history decides valid")
+    check(healthy["healthy"] and not healthy["findings"],
+          f"healthy run diagnoses clean "
+          f"(fired {healthy['rules_fired']})")
+    check(not healthy.get("errors"),
+          f"no rule errors on the healthy run "
+          f"({healthy.get('errors')})")
+
+    # -- seeded compile-storm (the PR-9 signature) -> D001 top ------
+    storm_records = [
+        {"kind": "independent", "name": f"key-{i}", "compiles": 1,
+         "shapes": {"K": 16, "W_pad": 7}} for i in range(50)]
+    storm_records.append(
+        {"kind": "preflight", "name": "indep",
+         "verdict": "feasible",
+         "preflight": {"verdict": "feasible", "buckets": [16]}})
+    storm = doctor.diagnose(doctor.TelemetryView(
+        target="pr9-replay", platform="cpu", records=storm_records))
+    top = storm["findings"][0] if storm["findings"] else {}
+    check(top.get("rule") == "D001",
+          f"seeded compile-storm fires D001 as top "
+          f"(got {storm['rules_fired']})")
+    per_bucket = (top.get("evidence") or [{}])[0].get("per_bucket")
+    check(per_bucket == {"W=7,K=16": 50},
+          f"D001 carries per-bucket compile evidence ({per_bucket})")
+
+    # -- seeded fill-collapse -> D002 -------------------------------
+    low = [{"round": i, "fill": 0.05, "t": 1000.0 + i}
+           for i in range(20)]
+    collapse = doctor.diagnose(doctor.TelemetryView(
+        target="collapse", series={"wgl_rounds": low}))
+    check(collapse["rules_fired"] == ["D002"],
+          f"seeded fill-collapse fires D002 "
+          f"(got {collapse['rules_fired']})")
+    check(len(doctor.perfetto_instants(collapse)) > 0,
+          "fill-collapse findings carry Perfetto instants")
+
+    # -- doctor series + ledger records lint clean ------------------
+    reg2 = metrics.Registry()
+    with tempfile.TemporaryDirectory() as td:
+        led2 = ledger.Ledger(td)
+        with metrics.use(reg2), ledger.use(led2):
+            doctor.record_report(storm, where="smoke",
+                                 ledger_name="pr9-replay")
+            doctor.record_report(healthy, where="smoke",
+                                 ledger_name="healthy")
+        mpath = os.path.join(td, "doctor_metrics.jsonl")
+        reg2.export_jsonl(mpath)
+        errs = telemetry_lint.lint_jsonl_file(mpath)
+        check(not errs, f"doctor series lint-clean ({errs[:3]})")
+        rec_errs = []
+        for fn in sorted(os.listdir(led2.records_dir)):
+            rec_errs += telemetry_lint.lint_ledger_file(
+                os.path.join(led2.records_dir, fn))
+        rec_errs += telemetry_lint.lint_ledger_file(led2.index_path)
+        check(not rec_errs,
+              f"kind=doctor ledger records lint-clean "
+              f"({rec_errs[:3]})")
+        rpath = os.path.join(td, "doctor.json")
+        with open(rpath, "w") as fh:
+            json.dump(storm, fh, default=str)
+        rep_errs = telemetry_lint.lint_doctor_report_file(rpath)
+        check(not rep_errs,
+              f"doctor report lint-clean ({rep_errs[:3]})")
+
+    # -- zero-new-compile / zero-new-transfer proof -----------------
+    reg3 = metrics.Registry()
+    with metrics.use(reg3):
+        res3 = wgl.check(m, h, time_limit=60)  # warm, instrumented
+    with guards.CompileGuard(max_compiles=0,
+                             name="doctor-smoke") as g:
+        view3 = doctor.view_from_registry(
+            reg3, target="guard-proof", platform="cpu",
+            results={"doctor-smoke": res3})
+        rep3 = doctor.diagnose(view3)
+        doctor.perfetto_instants(rep3)
+    check(g.compiles == 0,
+          f"diagnosis adds zero XLA compiles (got {g.compiles})")
+    check(g.h2d == 0 and g.d2h == 0,
+          f"diagnosis adds zero device transfers "
+          f"(h2d {g.h2d}, d2h {g.d2h})")
+    check(rep3["healthy"],
+          f"warm instrumented run diagnoses clean "
+          f"(fired {rep3['rules_fired']})")
+
+    print(f"doctor smoke: {len(failures)} failure(s)")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

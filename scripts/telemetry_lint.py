@@ -94,7 +94,25 @@ SERIES_SCHEMAS = {
             "bytes_in_use": int},
     "device_poll": {"where": str, "n_devices": int,
                     "stats_available": int},
+    # the diagnosis plane (doctor.py): one point per finding a
+    # diagnosis produced — rule must be a catalog id (D001-D010),
+    # severity one of the documented levels
+    "doctor": {"rule": str, "severity": str, "target": str,
+               "summary": str, "where": str},
 }
+
+# doctor.py's rule catalog + severity levels — duplicated here as the
+# lint contract (this script is import-light on purpose: schema drift
+# in doctor.py must FAIL against this frozen enum, not silently
+# follow it)
+DOCTOR_RULE_IDS = {f"D{i:03d}" for i in range(1, 11)}
+DOCTOR_SEVERITIES = {"critical", "warn", "info"}
+
+# the bench diagnosis report (bench._export_doctor ->
+# artifacts/telemetry/doctor.json)
+DOCTOR_REPORT_SCHEMA = {"schema": int, "healthy": bool,
+                        "findings": list, "rules_evaluated": list,
+                        "rules_fired": list}
 
 REGRESSIONS_SCHEMA = {"schema": int, "threshold_x": NUM,
                       "rounds": list, "configs": dict,
@@ -144,6 +162,10 @@ def lint_line(obj: dict, where: str) -> list:
         if series_schema:
             errors += _check_fields(obj, series_schema,
                                     f"{where} [{obj.get('series')}]")
+        if obj.get("series") == "doctor" and not errors:
+            errors += _check_doctor_enums(
+                obj.get("rule"), obj.get("severity"),
+                f"{where} [doctor]")
     elif typ == "histogram" and not errors:
         buckets, counts = obj["buckets"], obj["bucket_counts"]
         if len(buckets) != len(counts):
@@ -158,6 +180,45 @@ def lint_line(obj: dict, where: str) -> list:
             errors.append(f"{where}: largest bucket count "
                           f"{max(counts)} exceeds count "
                           f"{obj['count']}")
+    return errors
+
+
+def _check_doctor_enums(rule, severity, where: str) -> list:
+    errors = []
+    if rule not in DOCTOR_RULE_IDS:
+        errors.append(f"{where}: 'rule' should be one of "
+                      f"{sorted(DOCTOR_RULE_IDS)}, got {rule!r}")
+    if severity not in DOCTOR_SEVERITIES:
+        errors.append(f"{where}: 'severity' should be one of "
+                      f"{sorted(DOCTOR_SEVERITIES)}, got "
+                      f"{severity!r}")
+    return errors
+
+
+def _check_doctor_finding(f, where: str) -> list:
+    """One finding object (doctor records + doctor.json): catalog
+    rule id, documented severity, and the evidence-entry shape
+    (series name + indices + values lists)."""
+    if not isinstance(f, dict):
+        return [f"{where}: finding is not an object"]
+    errors = _check_doctor_enums(f.get("rule"), f.get("severity"),
+                                 where)
+    if not isinstance(f.get("summary"), str):
+        errors.append(f"{where}: finding needs a str 'summary'")
+    ev = f.get("evidence")
+    if not isinstance(ev, list):
+        errors.append(f"{where}: finding 'evidence' should be a list")
+        return errors
+    for j, e in enumerate(ev):
+        ew = f"{where}.evidence[{j}]"
+        if not isinstance(e, dict):
+            errors.append(f"{ew}: entry is not an object")
+            continue
+        if not isinstance(e.get("series"), str):
+            errors.append(f"{ew}: 'series' should be str")
+        for fld in ("indices", "values"):
+            if fld in e and not isinstance(e[fld], list):
+                errors.append(f"{ew}: {fld!r} should be a list")
     return errors
 
 
@@ -261,6 +322,30 @@ def lint_ledger_file(path: str) -> list:
             if not isinstance(obj.get("preflight"), dict):
                 errs.append(f"{where}: preflight record needs the "
                             "compact 'preflight' report object")
+        if obj.get("kind") == "doctor":
+            # diagnosis records (doctor.py): the fired rules must be
+            # catalog ids, findings carry the documented shape
+            rules = obj.get("rules")
+            if not isinstance(rules, list):
+                errs.append(f"{where}: doctor 'rules' should be a "
+                            "list")
+            else:
+                for r in rules:
+                    if r not in DOCTOR_RULE_IDS:
+                        errs.append(
+                            f"{where}: doctor rule {r!r} not in the "
+                            f"catalog {sorted(DOCTOR_RULE_IDS)}")
+            if not isinstance(obj.get("healthy"), bool):
+                errs.append(f"{where}: doctor record needs bool "
+                            "'healthy'")
+            fnds = obj.get("findings")
+            if not isinstance(fnds, list):
+                errs.append(f"{where}: doctor 'findings' should be "
+                            "a list")
+            else:
+                for j, f in enumerate(fnds):
+                    errs += _check_doctor_finding(
+                        f, f"{where}.findings[{j}]")
         if obj.get("kind") == "multichip":
             # mesh dryrun records (devices.multichip_record): device
             # count + per-device attribution are the record's point
@@ -311,6 +396,33 @@ def lint_ledger_file(path: str) -> list:
     except (OSError, ValueError) as e:
         return [f"{os.path.basename(path)}: not JSON ({e})"]
     return check(obj, os.path.basename(path))
+
+
+def lint_doctor_report_file(path: str) -> list:
+    """artifacts/telemetry/doctor.json (bench._export_doctor): the
+    report envelope, catalog rule ids, and the finding/evidence
+    shape."""
+    where = os.path.basename(path)
+    try:
+        with open(path) as fh:
+            obj = json.load(fh)
+    except (OSError, ValueError) as e:
+        return [f"{where}: not JSON ({e})"]
+    if not isinstance(obj, dict):
+        return [f"{where}: not an object"]
+    errors = _check_fields(obj, DOCTOR_REPORT_SCHEMA, where)
+    for r in obj.get("rules_fired") or []:
+        if r not in DOCTOR_RULE_IDS:
+            errors.append(f"{where}: rules_fired entry {r!r} not in "
+                          f"the catalog {sorted(DOCTOR_RULE_IDS)}")
+    for j, f in enumerate(obj.get("findings") or []):
+        errors += _check_doctor_finding(f, f"{where}.findings[{j}]")
+    if isinstance(obj.get("findings"), list) \
+            and isinstance(obj.get("healthy"), bool) \
+            and obj["healthy"] != (not obj["findings"]):
+        errors.append(f"{where}: 'healthy' disagrees with the "
+                      "findings list")
+    return errors
 
 
 def lint_span_file(path: str) -> list:
@@ -383,6 +495,8 @@ def lint_path(path: str) -> list:
         return lint_regressions_file(path)
     if path.endswith("occupancy.json"):
         return lint_occupancy_file(path)
+    if path.endswith("doctor.json"):
+        return lint_doctor_report_file(path)
     if path.endswith("perfetto.json"):
         return lint_perfetto_file(path)
     # ledger/index.jsonl AND ledger/records/<id>.json — the record
@@ -424,7 +538,8 @@ def main(argv=None) -> int:
             continue
         errs = lint_path(p)
         if p.endswith((".jsonl", "regressions.json",
-                       "occupancy.json", "perfetto.json")) or \
+                       "occupancy.json", "doctor.json",
+                       "perfetto.json")) or \
                 os.path.basename(os.path.dirname(p)) == "records":
             linted += 1
         errors += errs

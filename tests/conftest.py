@@ -16,6 +16,17 @@ Suggested slice:
 Note: the environment may import jax at interpreter startup (site
 customization), which locks config defaults from the env before this file
 runs — so we set the platform through jax.config, not just os.environ.
+
+RUNTIME BUDGET: the tier-1 line (ROADMAP.md) runs `-m 'not slow'`
+under a hard 870 s timeout, and the suite runs NEAR that cap — a
+concurrent build on the same box can push it over. Before adding a
+test that compiles a new kernel shape bucket or loops a search, time
+it alone (`pytest <file> --durations=20`) and mark anything heavy
+`@pytest.mark.slow` (stress/scale tiers, multi-second integration
+runs over real artifacts); the slow tier still runs via
+`pytest -m slow` and the dedicated CI smokes in scripts/ci_checks.sh.
+Budget rule of thumb: a new FILE should stay under ~10 s, a new TEST
+under ~2 s, on an otherwise idle CI cpu.
 """
 
 import os

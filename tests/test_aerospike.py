@@ -202,6 +202,7 @@ def _options(tmp_path, which, **kw):
 
 
 @pytest.mark.parametrize("which", ["cas-register", "counter", "set"])
+@pytest.mark.slow  # ~30s alone on 1 CI cpu (tier-1 budget: tests/conftest.py)
 def test_full_suite_live(tmp_path, which):
     done = core.run(ae.aerospike_test(_options(tmp_path, which)))
     res = done["results"]

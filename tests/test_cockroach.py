@@ -117,6 +117,7 @@ def test_tests_fn_sweeps(tmp_path):
 
 
 @pytest.mark.parametrize("which", ["monotonic", "comments"])
+@pytest.mark.slow  # ~17s alone on 1 CI cpu (tier-1 budget: tests/conftest.py)
 def test_full_suite_live(tmp_path, which):
     """LIVE pgwire mini servers under the kill/restart nemesis: the
     strict-serializability checkers must hold across crash recovery

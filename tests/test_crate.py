@@ -145,6 +145,7 @@ def _options(tmp_path, which, **kw):
 
 @pytest.mark.parametrize("which", ["version-divergence",
                                    "lost-updates", "dirty-read"])
+@pytest.mark.slow  # ~24s alone on 1 CI cpu (tier-1 budget: tests/conftest.py)
 def test_full_suite_live(tmp_path, which):
     done = core.run(cr.crate_test(_options(tmp_path, which)))
     res = done["results"]

@@ -192,6 +192,7 @@ def _options(tmp_path, which, **kw):
 
 
 @pytest.mark.parametrize("which", sorted(dg.WORKLOADS))
+@pytest.mark.slow  # ~68s alone on 1 CI cpu (tier-1 budget: tests/conftest.py)
 def test_full_suite_live(tmp_path, which):
     done = core.run(dg.dgraph_test(_options(tmp_path, which)))
     res = done["results"]

@@ -1,0 +1,800 @@
+"""Diagnosis-plane tests (jepsen_tpu/doctor.py): the D001-D010 rule
+corpus over synthetic telemetry fixtures, the PR-9 compile-storm
+replay, zero false positives on a real healthy run's artifacts, the
+surfacing paths (CLI / web / ledger / Perfetto), and the lint
+contracts (good + drifted fixtures)."""
+
+import json
+import os
+import sys
+import threading
+import urllib.request
+
+import pytest
+
+from jepsen_tpu import doctor, drift, fleet, ledger, metrics, trace
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "scripts"))
+import telemetry_lint  # noqa: E402
+
+
+def view(**kw):
+    kw.setdefault("target", "test")
+    return doctor.TelemetryView(**kw)
+
+
+def fired(rep):
+    return rep["rules_fired"]
+
+
+@pytest.fixture(autouse=True)
+def _fresh_doctor_state():
+    doctor._reset()
+    yield
+    doctor._reset()
+
+
+# ---------------------------------------------------------------------------
+# rule corpus: one fires-and-doesn't pair per rule
+# ---------------------------------------------------------------------------
+
+class TestRuleCorpus:
+    def test_d001_compile_storm_from_records(self):
+        recs = [{"kind": "checker", "name": "k", "compiles": 3,
+                 "shapes": {"K": 16, "W_pad": 7}} for _ in range(5)]
+        rep = doctor.diagnose(view(records=recs))
+        assert fired(rep) == ["D001"]
+        f = rep["findings"][0]
+        assert f["severity"] == "critical"
+        ev = f["evidence"][0]
+        assert ev["series"] == "ledger"
+        assert ev["per_bucket"] == {"W=7,K=16": 15}
+        assert sum(ev["values"]) == 15
+        assert "shared_shape_bucket" in f["action"]
+
+    def test_d001_respects_planned_buckets(self):
+        # a cold run legitimately compiles one kernel per planned
+        # ladder bucket — four compiles against a four-bucket plan is
+        # healthy, not a storm
+        recs = [{"kind": "bench", "name": "headline", "compiles": 4,
+                 "shapes": {"K": 512, "W_pad": 7},
+                 "preflight": {"buckets": [2, 16, 64, 512]}}]
+        assert fired(doctor.diagnose(view(records=recs))) == []
+
+    def test_d001_absolute_floor(self):
+        recs = [{"kind": "bench", "name": "n", "compiles": 7,
+                 "shapes": {"K": 16, "W_pad": 7}}]
+        assert fired(doctor.diagnose(view(records=recs))) == []
+
+    def test_d002_fill_collapse_result(self):
+        res = {"util": {"frontier_fill": 0.1, "rounds": 100}}
+        rep = doctor.diagnose(view(results={"mutex_1k": res}))
+        assert fired(rep) == ["D002"]
+        assert rep["findings"][0]["subject"] == "mutex_1k"
+
+    def test_d002_needs_rounds(self):
+        res = {"util": {"frontier_fill": 0.1, "rounds": 3}}
+        assert fired(doctor.diagnose(
+            view(results={"tiny": res}))) == []
+
+    def test_d002_healthy_fill_quiet(self):
+        res = {"util": {"frontier_fill": 0.92, "rounds": 100}}
+        assert fired(doctor.diagnose(view(results={"h": res}))) == []
+
+    def test_d002_series_carries_round_stamps(self):
+        pts = [{"round": i, "fill": 0.02, "t": 100.0 + i}
+               for i in range(16)]
+        rep = doctor.diagnose(view(series={"wgl_rounds": pts}))
+        assert fired(rep) == ["D002"]
+        assert rep["findings"][0]["evidence"][0]["t"]
+
+    def test_d003_thrash_from_path(self):
+        adapt = {"ladder": [2, 16, 64], "switches": 5,
+                 "path": [[2, 16, "g"], [16, 64, "g"], [64, 16, "s"],
+                          [16, 64, "g"], [64, 16, "s"]]}
+        rep = doctor.diagnose(
+            view(results={"m": {"util": {"adapt": adapt}}}))
+        assert fired(rep) == ["D003"]
+
+    def test_d003_one_way_climb_quiet(self):
+        adapt = {"ladder": [2, 16, 64], "switches": 2,
+                 "path": [[2, 16, "g"], [16, 64, "g"]]}
+        assert fired(doctor.diagnose(
+            view(results={"m": {"util": {"adapt": adapt}}}))) == []
+
+    def test_d003_from_series(self):
+        # one search: chunk counter grows and the switches CHAIN
+        # (next from_K == last to_K)
+        pts = [{"chunk": c, "from_K": f, "to_K": k, "t": float(c)}
+               for c, f, k in [(1, 2, 16), (3, 16, 64),
+                               (5, 64, 16), (8, 16, 64)]]
+        assert fired(doctor.diagnose(
+            view(series={"wgl_adapt": pts}))) == ["D003"]
+
+    def test_d003_fanout_series_not_thrash(self):
+        # N independent keys each escalating ONCE to the same bucket
+        # interleave into the shared series (chunk resets per search)
+        # — identical to_K values across searches are not revisits
+        pts = [{"chunk": 0, "from_K": 16, "to_K": 64, "t": float(i)}
+               for i in range(8)]
+        assert fired(doctor.diagnose(
+            view(series={"wgl_adapt": pts}))) == []
+        # keys switching at DIFFERENT (increasing) chunks still
+        # segment apart: their from_K doesn't chain off the previous
+        # point's to_K, so they can't be one search
+        pts2 = [{"chunk": c, "from_K": 16, "to_K": 32, "t": float(c)}
+                for c in (2, 3, 4)]
+        assert fired(doctor.diagnose(
+            view(series={"wgl_adapt": pts2}))) == []
+        # no chunk field at all: conservative, never fires
+        pts3 = [{"to_K": 64, "t": float(i)} for i in range(8)]
+        assert fired(doctor.diagnose(
+            view(series={"wgl_adapt": pts3}))) == []
+
+    def test_d004_under_prediction_warns(self):
+        res = {"preflight": {"hbm_drift_x": 2.0,
+                             "hbm_peak_measured": 2 << 30,
+                             "hbm_peak_bytes": 1 << 30}}
+        rep = doctor.diagnose(view(results={"c": res}))
+        assert fired(rep) == ["D004"]
+        assert rep["findings"][0]["severity"] == "warn"
+
+    def test_d004_over_prediction_info(self):
+        res = {"preflight": {"hbm_drift_x": 0.4}}
+        rep = doctor.diagnose(view(results={"c": res}))
+        assert [f["severity"] for f in rep["findings"]] == ["info"]
+
+    def test_d004_in_bounds_quiet(self):
+        res = {"preflight": {"hbm_drift_x": 1.1}}
+        assert fired(doctor.diagnose(view(results={"c": res}))) == []
+
+    def test_d005_skew_with_remedy(self):
+        hint = {"from": "dev0", "to": "dev1", "keys": [3, 4],
+                "wall_s_moved": 2.0}
+        fl = {"work_skew": 1.8, "keys": 10, "device_count": 2,
+              "fallbacks": 0,
+              "devices": {"dev0": {"wall_s": 9.0},
+                          "dev1": {"wall_s": 1.0}},
+              "rebucket_hint": hint}
+        rep = doctor.diagnose(
+            view(results={"indep": {"util": {"fleet": fl}}}))
+        assert fired(rep) == ["D005"]
+        assert rep["findings"][0]["remedy"] == hint
+
+    def test_d005_balanced_quiet(self):
+        fl = {"work_skew": 1.05, "keys": 10, "device_count": 2,
+              "fallbacks": 0}
+        assert fired(doctor.diagnose(
+            view(results={"i": {"util": {"fleet": fl}}}))) == []
+
+    def test_d005_from_shards_series(self):
+        shards = ([{"key_index": i, "device": "d0", "engine": "tpu",
+                    "wall_s": 5.0} for i in range(4)] +
+                  [{"key_index": 4 + i, "device": "d1",
+                    "engine": "tpu", "wall_s": 0.1}
+                   for i in range(4)])
+        rep = doctor.diagnose(view(series={"fleet_shards": shards}))
+        assert fired(rep) == ["D005"]
+        # the remedy is the same hint fleet.summarize would emit
+        assert rep["findings"][0]["remedy"] == \
+            fleet.summarize(shards)["rebucket_hint"]
+
+    def test_d006_stall_series_is_critical(self):
+        pts = [{"source": "wgl/cpu", "age_s": 42.0, "beats": 3,
+                "escalation": "record", "t": 9.0}]
+        rep = doctor.diagnose(view(series={"watchdog_stalls": pts}))
+        assert fired(rep) == ["D006"]
+        assert rep["findings"][0]["severity"] == "critical"
+
+    def test_d006_record_stalls(self):
+        assert fired(doctor.diagnose(
+            view(results={"r": {"stalls": 1}}))) == ["D006"]
+
+    def test_d007_measured_mismatch(self):
+        res = {"engine": "device", "cycle-route-reason": "bfs-model",
+               "closure_row": {"verdict": True, "wall_s": 5.0},
+               "host_row": {"verdict": True, "wall_s": 1.0}}
+        rep = doctor.diagnose(view(results={"elle_8k": res}))
+        assert fired(rep) == ["D007"]
+        assert rep["findings"][0]["severity"] == "warn"
+
+    def test_d007_router_right_quiet(self):
+        res = {"engine": "device",
+               "closure_row": {"verdict": True, "wall_s": 0.7},
+               "host_row": {"verdict": True, "wall_s": 5.6}}
+        assert fired(doctor.diagnose(
+            view(results={"elle_8k": res}))) == []
+
+    def test_d007_dnf_alternative_quiet(self):
+        # beating a DNF row is exactly what the router is for
+        res = {"engine": "device",
+               "device_row": {"verdict": True, "wall_s": 10.0},
+               "oracle_row": {"verdict": "unknown", "wall_s": 0.5}}
+        assert fired(doctor.diagnose(view(results={"a": res}))) == []
+
+    def test_d007_plan_mismatch_is_info(self):
+        res = {"engine": "device",
+               "preflight": {"engine_match": False,
+                             "engine": "host"}}
+        rep = doctor.diagnose(view(results={"e": res}))
+        assert fired(rep) == ["D007"]
+        assert rep["findings"][0]["severity"] == "info"
+
+    @staticmethod
+    def _span(name, t0, t1):
+        return {"name": name, "startTimeUnixNano": int(t0 * 1e9),
+                "endTimeUnixNano": int(t1 * 1e9)}
+
+    def test_d008_dominant_shift(self):
+        spans = [self._span("encode", 0, 8),
+                 self._span("device-round", 8, 10)]
+        rep = doctor.diagnose(view(
+            platform="cpu", spans=spans,
+            prior_phases=[{"platform": "cpu",
+                           "dominant": "device-round"}]))
+        assert fired(rep) == ["D008"]
+        assert "encode" in rep["findings"][0]["summary"]
+
+    def test_d008_same_dominant_quiet(self):
+        spans = [self._span("device-round", 0, 8),
+                 self._span("encode", 8, 10)]
+        assert fired(doctor.diagnose(view(
+            platform="cpu", spans=spans,
+            prior_phases=[{"platform": "cpu",
+                           "dominant": "device-round"}]))) == []
+
+    def test_d008_no_prior_baseline_quiet(self):
+        spans = [self._span("encode", 0, 8),
+                 self._span("device-round", 8, 10)]
+        assert fired(doctor.diagnose(
+            view(platform="cpu", spans=spans))) == []
+
+    def test_d008_modal_prior_not_last(self):
+        # one odd prior round must not become the baseline
+        spans = [self._span("device-round", 0, 8),
+                 self._span("encode", 8, 10)]
+        priors = [{"platform": "cpu", "dominant": "device-round"},
+                  {"platform": "cpu", "dominant": "device-round"},
+                  {"platform": "cpu", "dominant": "encode"}]
+        assert fired(doctor.diagnose(view(
+            platform="cpu", spans=spans, prior_phases=priors))) == []
+
+    def test_d009_degrade_that_ran_fine(self):
+        res = {"valid?": True,
+               "preflight": {"verdict": "degrade",
+                             "rules": ["P005"]}}
+        rep = doctor.diagnose(view(results={"c": res}))
+        assert fired(rep) == ["D009"]
+        assert rep["findings"][0]["severity"] == "info"
+
+    def test_d009_degrade_that_struggled_quiet(self):
+        res = {"valid?": "unknown",
+               "preflight": {"verdict": "degrade"}}
+        assert fired(doctor.diagnose(view(results={"c": res}))) == []
+        res2 = {"valid?": True, "stalls": 1,
+                "preflight": {"verdict": "degrade"}}
+        assert "D009" not in fired(doctor.diagnose(
+            view(results={"c": res2})))
+
+    def test_d010_fallback_burst(self):
+        fl = {"keys": 10, "fallbacks": 5, "work_skew": 1.0}
+        rep = doctor.diagnose(
+            view(results={"i": {"util": {"fleet": fl}}}))
+        assert fired(rep) == ["D010"]
+
+    def test_d010_attrition_quiet(self):
+        fl = {"keys": 100, "fallbacks": 2, "work_skew": 1.0}
+        assert fired(doctor.diagnose(
+            view(results={"i": {"util": {"fleet": fl}}}))) == []
+
+    def test_d010_from_shards_series(self):
+        shards = ([{"key_index": i, "device": "d0",
+                    "engine": "oracle-fallback", "wall_s": 1.0}
+                   for i in range(4)] +
+                  [{"key_index": 4 + i, "device": "d0",
+                    "engine": "tpu", "wall_s": 1.0}
+                   for i in range(4)])
+        assert "D010" in fired(doctor.diagnose(
+            view(series={"fleet_shards": shards})))
+
+
+# ---------------------------------------------------------------------------
+# the PR-9 replay + healthy-run zero-false-positive
+# ---------------------------------------------------------------------------
+
+def pr9_replay_records():
+    """The independent_100x2k regression signature, replayed from what
+    the ledger actually showed: one compile per key inside the
+    measured window, against a plan with ONE shared bucket."""
+    recs = [{"kind": "independent", "name": f"key-{i}", "compiles": 1,
+             "shapes": {"K": 16, "W_pad": 7},
+             "verdict": True} for i in range(50)]
+    recs.append({"kind": "preflight", "name": "independent_100x2k",
+                 "verdict": "feasible",
+                 "preflight": {"verdict": "feasible",
+                               "buckets": [16]}})
+    return recs
+
+
+class TestReplayAndHealthy:
+    def test_pr9_compile_storm_replay(self):
+        rep = doctor.diagnose(view(target="pr9", platform="cpu",
+                                   records=pr9_replay_records()))
+        assert rep["healthy"] is False
+        top = rep["findings"][0]
+        assert top["rule"] == "D001"
+        assert top["severity"] == "critical"
+        ev = top["evidence"][0]
+        assert ev["per_bucket"] == {"W=7,K=16": 50}
+        assert ev["planned_buckets"] == 1
+        assert ev["indices"][:3] == [0, 1, 2]
+        assert all(v == 1 for v in ev["values"])
+
+    def test_healthy_real_run_zero_findings(self):
+        from jepsen_tpu import synth
+        from jepsen_tpu.models import cas_register
+        from jepsen_tpu.ops import wgl
+        m = cas_register()
+        h = synth.cas_register_history(600, n_procs=4, seed=11)
+        reg = metrics.Registry()
+        with metrics.use(reg):
+            res = wgl.check(m, h, time_limit=60)
+        assert res["valid?"] is True
+        v = doctor.view_from_registry(
+            reg, target="healthy", platform="cpu",
+            results={"cas_600": res})
+        rep = doctor.diagnose(v)
+        assert rep["healthy"] is True, rep["findings"]
+        assert not rep.get("errors")
+
+    def test_ranking_severity_then_score(self):
+        recs = pr9_replay_records()
+        res = {"util": {"frontier_fill": 0.1, "rounds": 100},
+               "preflight": {"verdict": "degrade"}, "valid?": True}
+        rep = doctor.diagnose(view(records=recs,
+                                   results={"cfg": res}))
+        sevs = [f["severity"] for f in rep["findings"]]
+        assert sevs == sorted(
+            sevs, key=lambda s: -doctor._SEVERITY_RANK[s])
+        assert rep["findings"][0]["rule"] == "D001"
+
+    def test_rule_error_never_loses_diagnosis(self, monkeypatch):
+        def boom(_view):
+            raise RuntimeError("rule exploded")
+        monkeypatch.setattr(doctor, "_RULE_FNS",
+                            (boom, doctor._d006))
+        rep = doctor.diagnose(view(results={"r": {"stalls": 1}}))
+        assert fired(rep) == ["D006"]
+        assert any("rule exploded" in e for e in rep["errors"])
+
+
+# ---------------------------------------------------------------------------
+# surfacing: record_report / snapshot / ledger / perfetto
+# ---------------------------------------------------------------------------
+
+class TestSurfacing:
+    def test_record_report_series_and_ledger(self, tmp_path):
+        reg = metrics.Registry()
+        led = ledger.Ledger(str(tmp_path))
+        rep = doctor.diagnose(view(target="pr9",
+                                   records=pr9_replay_records()))
+        with metrics.use(reg), ledger.use(led):
+            doctor.record_report(rep, where="test",
+                                 ledger_name="pr9")
+        pts = reg.series("doctor").points
+        assert pts and pts[0]["rule"] == "D001"
+        assert reg.counter("doctor_findings_total").value(
+            rule="D001", severity="critical") == 1
+        recs = led.query(kind="doctor")
+        assert len(recs) == 1
+        assert recs[0]["rules"] == ["D001"]
+        assert recs[0]["healthy"] is False
+        assert recs[0]["findings"][0]["evidence"]
+
+    def test_snapshot_window(self):
+        rep = doctor.diagnose(view(records=pr9_replay_records()))
+        doctor.record_report(rep, where="test")
+        snap = doctor.snapshot()
+        assert snap["checked"] == 1
+        assert snap["healthy_last"] is False
+        assert snap["findings"].get("critical") == 1
+        assert snap["recent"][0]["rule"] == "D001"
+        assert snap["top"]["rule"] == "D001"
+
+    def test_snapshot_top_is_top_ranked_and_clears_on_healthy(self):
+        # a diagnosis with [critical, info] must surface the critical
+        # as `top`, and a later healthy diagnosis must clear it (the
+        # recent window keeps history; the banner must not)
+        rep = doctor.diagnose(view(
+            records=pr9_replay_records(),
+            results={"c": {"valid?": True,
+                           "preflight": {"verdict": "degrade",
+                                         "rules": ["P005"]}}}))
+        assert {f["severity"] for f in rep["findings"]} == \
+            {"critical", "info"}
+        doctor.record_report(rep, where="test")
+        assert doctor.snapshot()["top"]["severity"] == "critical"
+        doctor.record_report(doctor.diagnose(view()), where="test")
+        snap = doctor.snapshot()
+        assert snap["top"] is None
+        assert snap["recent"]  # history stays
+
+    def test_doctor_records_feed_d008_baseline(self, tmp_path):
+        led = ledger.Ledger(str(tmp_path))
+        span = {"name": "device-round",
+                "startTimeUnixNano": 0,
+                "endTimeUnixNano": int(8e9)}
+        span2 = {"name": "encode",
+                 "startTimeUnixNano": int(8e9),
+                 "endTimeUnixNano": int(10e9)}
+        with ledger.use(led):
+            rep = doctor.diagnose(view(platform="cpu",
+                                       spans=[span, span2]))
+            doctor.record_report(rep, where="test", ledger_name="r1")
+        led.record_result("checker", "r2",
+                          {"valid?": True}, wall_s=0.1,
+                          platform="cpu")
+        priors = doctor._prior_phase_records(led, "cpu")
+        assert priors and priors[0]["dominant"] == "device-round"
+
+    def test_perfetto_instants_lint_clean(self, tmp_path):
+        pts = [{"round": i, "fill": 0.02, "t": 100.0 + i}
+               for i in range(16)]
+        rep = doctor.diagnose(view(series={"wgl_rounds": pts}))
+        instants = doctor.perfetto_instants(rep)
+        assert instants and all("t" in i for i in instants)
+        doc = trace.to_perfetto([], instants=instants)
+        phases = {e["ph"] for e in doc["traceEvents"]}
+        assert "i" in phases
+        p = tmp_path / "doctor.perfetto.json"
+        p.write_text(json.dumps(doc))
+        assert telemetry_lint.lint_perfetto_file(str(p)) == []
+
+    def test_compact_report_shape(self):
+        rep = doctor.diagnose(view(records=pr9_replay_records()))
+        comp = doctor.compact_report(rep)
+        assert comp["healthy"] is False
+        assert comp["rules_fired"] == ["D001"]
+        f = comp["findings"][0]
+        assert set(f) >= {"rule", "severity", "summary", "evidence"}
+
+    def test_compact_finding_carries_bounded_remedy(self):
+        hint = {"from": "d0", "to": "d1",
+                "keys": list(range(40)), "wall_s_moved": 3.0}
+        fl = {"work_skew": 1.8, "keys": 50, "device_count": 2,
+              "fallbacks": 0, "rebucket_hint": hint}
+        rep = doctor.diagnose(
+            view(results={"i": {"util": {"fleet": fl}}}))
+        cf = doctor.compact_finding(rep["findings"][0])
+        assert cf["remedy"]["from"] == "d0"
+        assert len(cf["remedy"]["keys"]) == 16
+        assert cf["remedy"]["keys_omitted"] == 24
+        # and it survives the ledger + /runs surfaces end to end
+        comp = doctor.compact_report(rep)
+        assert comp["findings"][0]["remedy"]["to"] == "d1"
+
+
+# ---------------------------------------------------------------------------
+# views over persisted artifacts + the CLI
+# ---------------------------------------------------------------------------
+
+def _bank_run(led, name="run-a", **extra):
+    return led.record_result(
+        "checker", name,
+        {"valid?": True,
+         "util": {"frontier_fill": 0.95, "rounds": 40}},
+        wall_s=0.5, platform="cpu", **extra)
+
+
+class TestViewsAndCli:
+    def test_run_view_latest_and_id(self, tmp_path):
+        led = ledger.Ledger(str(tmp_path))
+        rid = _bank_run(led)
+        v = doctor.run_view(str(tmp_path), "latest")
+        assert v.target == rid
+        assert "run-a" in v.results
+        v2 = doctor.run_view(str(tmp_path), rid)
+        assert v2.target == rid
+        with pytest.raises(KeyError):
+            doctor.run_view(str(tmp_path), "nope")
+
+    def test_bench_view_scopes_records_to_latest_round(self,
+                                                       tmp_path):
+        # many prior healthy rounds each banked cold compiles; the
+        # CLI path (no explicit `since`) must not pool them into a
+        # false compile-storm — the bench-round markers bound the
+        # newest round
+        led = ledger.Ledger(str(tmp_path / "store"))
+        for rnd in range(1, 5):
+            t0 = 1000.0 * rnd
+            led.record({"kind": "bench", "name": "headline",
+                        "compiles": 4, "platform": "cpu", "t": t0,
+                        "shapes": {"K": 16, "W_pad": 7}})
+            led.record({"kind": "bench-round", "name": "bench",
+                        "round": rnd, "value": 1.0, "t": t0 + 1})
+        (tmp_path / "BENCH_DETAILS.json").write_text(
+            json.dumps({"metric": "headline", "platform": "cpu",
+                        "verdict": True}))
+        v = doctor.bench_view(str(tmp_path))
+        compiles = [r.get("compiles") for r in v.records
+                    if r.get("compiles")]
+        assert compiles == [4]  # the newest round only
+        assert doctor.diagnose(v)["rules_fired"] == []
+
+    def test_bench_view_reads_artifacts(self, tmp_path):
+        root = str(tmp_path)
+        art = tmp_path / "artifacts" / "telemetry"
+        art.mkdir(parents=True)
+        reg = metrics.Registry()
+        for i in range(16):
+            reg.series("wgl_rounds").append(
+                {"round": i, "fill": 0.03, "t": 10.0 + i})
+        reg.export_jsonl(str(art / "bench_metrics.jsonl"))
+        details = {"metric": "headline", "platform": "cpu",
+                   "verdict": True,
+                   "configs": {"mutex_1k": {
+                       "verdict": True, "wall_s": 0.05,
+                       "util": {"frontier_fill": 0.1,
+                                "rounds": 100}}}}
+        (tmp_path / "BENCH_DETAILS.json").write_text(
+            json.dumps(details))
+        v = doctor.bench_view(root)
+        rep = doctor.diagnose(v)
+        assert "D002" in fired(rep)
+        subjects = {f.get("subject") for f in rep["findings"]}
+        assert "mutex_1k" in subjects
+
+    def test_cli_latest_text_and_json(self, tmp_path, capsys):
+        led = ledger.Ledger(str(tmp_path))
+        _bank_run(led)
+        rc = doctor.cli_main({"store": str(tmp_path),
+                              "no_record": True}, ["latest"])
+        assert rc == 0
+        assert "HEALTHY" in capsys.readouterr().out
+        rc = doctor.cli_main({"store": str(tmp_path), "json": True,
+                              "no_record": True}, ["latest"])
+        out = json.loads(capsys.readouterr().out)
+        assert rc == 0 and out["schema"] == 1
+
+    def test_cli_unknown_target(self, tmp_path, capsys):
+        assert doctor.cli_main({"store": str(tmp_path),
+                                "no_record": True}, ["zzz"]) == 254
+
+    def test_cli_records_doctor_ledger_record(self, tmp_path):
+        led = ledger.Ledger(str(tmp_path))
+        _bank_run(led)
+        assert doctor.cli_main({"store": str(tmp_path)},
+                               ["latest"]) == 0
+        assert led.query(kind="doctor")
+
+    def test_cli_strict_exit(self, tmp_path, capsys):
+        led = ledger.Ledger(str(tmp_path))
+        led.record_result("checker", "stalled",
+                          {"valid?": "unknown",
+                           "stall": {"source": "wgl/cpu"}},
+                          wall_s=1.0, platform="cpu")
+        rc = doctor.cli_main({"store": str(tmp_path), "strict": True,
+                              "no_record": True}, ["latest"])
+        assert rc == 1
+
+    def test_module_cli_registered(self):
+        from jepsen_tpu.__main__ import COMMANDS
+        assert "doctor" in COMMANDS
+
+
+# ---------------------------------------------------------------------------
+# lint contracts: good + drifted fixtures
+# ---------------------------------------------------------------------------
+
+class TestLint:
+    def test_doctor_series_good(self, tmp_path):
+        reg = metrics.Registry()
+        rep = doctor.diagnose(view(records=pr9_replay_records()))
+        with metrics.use(reg):
+            doctor.record_report(rep, where="test")
+        p = tmp_path / "m.jsonl"
+        reg.export_jsonl(str(p))
+        assert telemetry_lint.lint_jsonl_file(str(p)) == []
+
+    def test_doctor_series_drifted(self, tmp_path):
+        p = tmp_path / "m.jsonl"
+        p.write_text(json.dumps(
+            {"type": "sample", "series": "doctor", "t": 1.0,
+             "rule": "D099", "severity": "mild", "target": "x",
+             "summary": "s", "where": "w"}) + "\n")
+        errs = telemetry_lint.lint_jsonl_file(str(p))
+        assert any("D099" in e for e in errs)
+        assert any("severity" in e for e in errs)
+
+    def test_doctor_ledger_record_good(self, tmp_path):
+        led = ledger.Ledger(str(tmp_path))
+        rep = doctor.diagnose(view(records=pr9_replay_records()))
+        with ledger.use(led):
+            doctor.record_report(rep, where="t", ledger_name="pr9")
+        errs = telemetry_lint.lint_ledger_file(led.index_path)
+        for fn in os.listdir(led.records_dir):
+            errs += telemetry_lint.lint_ledger_file(
+                os.path.join(led.records_dir, fn))
+        assert errs == []
+
+    def test_doctor_ledger_record_drifted(self, tmp_path):
+        p = tmp_path / "index.jsonl"
+        bad = {"schema": 1, "id": "x", "kind": "doctor", "name": "n",
+               "t": 1.0, "rules": ["D042"], "healthy": "yes",
+               "findings": [{"rule": "D001", "severity": "critical",
+                             "summary": "s",
+                             "evidence": "not-a-list"}]}
+        p.write_text(json.dumps(bad) + "\n")
+        errs = telemetry_lint.lint_ledger_file(str(p))
+        assert any("D042" in e for e in errs)
+        assert any("healthy" in e for e in errs)
+        assert any("evidence" in e for e in errs)
+
+    def test_doctor_report_file_good_and_drifted(self, tmp_path):
+        rep = doctor.diagnose(view(records=pr9_replay_records()))
+        good = tmp_path / "doctor.json"
+        good.write_text(json.dumps(rep, default=str))
+        assert telemetry_lint.lint_doctor_report_file(
+            str(good)) == []
+        bad_rep = dict(rep, healthy=True)  # disagrees with findings
+        bad = tmp_path / "bad" / "doctor.json"
+        bad.parent.mkdir()
+        bad.write_text(json.dumps(bad_rep, default=str))
+        errs = telemetry_lint.lint_doctor_report_file(str(bad))
+        assert any("disagrees" in e for e in errs)
+        # and lint_path routes *doctor.json to this linter
+        assert telemetry_lint.lint_path(str(bad)) == errs
+
+
+# ---------------------------------------------------------------------------
+# the shared drift helper (bench / ledger / doctor single-sourcing)
+# ---------------------------------------------------------------------------
+
+class TestDriftHelper:
+    def test_delta_row(self):
+        row = drift.delta_row(3.0, [1.0, 2.0], 1.5)
+        assert row["best_prior"] == 1.0
+        assert row["prev"] == 2.0
+        assert row["delta_vs_prev_s"] == 1.0
+        assert row["ratio_vs_best"] == 3.0
+        assert row["regressed"] is True
+        assert drift.delta_row(1.2, [1.0], 1.5)["regressed"] is False
+        assert "regressed" not in drift.delta_row(1.0, [], 1.5)
+
+    def test_env_threshold_single_source(self, monkeypatch):
+        monkeypatch.setenv("JEPSEN_TPU_BENCH_REGRESSION_X", "3.0")
+        assert drift.regression_threshold() == 3.0
+        assert drift.wall_regressed(2.5, 1.0) is False
+        assert drift.wall_regressed(3.5, 1.0) is True
+
+    def test_fill_row(self):
+        assert drift.fill_row(0.5, [0.9])["regressed"] is True
+        assert drift.fill_row(0.85, [0.9])["regressed"] is False
+        assert drift.fill_row(0.85, [])["regressed"] is False
+
+    def test_bench_and_ledger_share_the_gate(self, tmp_path,
+                                             monkeypatch):
+        import bench
+        monkeypatch.setenv("JEPSEN_TPU_BENCH_REGRESSION_X", "2.0")
+        rounds = [{"round": 1, "platform": "cpu", "value": 1.0,
+                   "configs": {"a": 1.0}, "fills": {},
+                   "hbm_drift": {}}]
+        cur = {"round": 2, "platform": "cpu", "value": 1.0,
+               "configs": {"a": 2.5}, "fills": {}, "hbm_drift": {}}
+        rep = bench.compute_regressions(
+            rounds, cur, threshold=drift.regression_threshold())
+        assert rep["regressions"] == ["a"]
+        led = ledger.Ledger(str(tmp_path))
+        led.record({"kind": "bench", "name": "a", "platform": "cpu",
+                    "wall_s": 1.0, "t": 1.0})
+        led.record({"kind": "bench", "name": "a", "platform": "cpu",
+                    "wall_s": 2.5, "t": 2.0})
+        # ledger default threshold now reads the same env knob
+        assert led.regressions()["regressions"] == ["a"]
+        monkeypatch.setenv("JEPSEN_TPU_BENCH_REGRESSION_X", "3.0")
+        assert led.regressions()["regressions"] == []
+
+    def test_hbm_gate_reexported(self):
+        assert drift.HBM_DRIFT_X == 1.25
+        assert drift.drift_regressed(2.0) is True
+        assert drift.drift_regressed(1.1) is False
+
+
+# ---------------------------------------------------------------------------
+# web surfacing
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def doctor_store(tmp_path):
+    led = ledger.Ledger(str(tmp_path))
+    led.record({"kind": "independent", "name": "key-0", "t": 1.0,
+                "compiles": 10, "platform": "cpu",
+                "shapes": {"K": 16, "W_pad": 7}, "verdict": True})
+    return str(tmp_path)
+
+
+@pytest.fixture()
+def doctor_base_url(doctor_store):
+    from jepsen_tpu import web
+    web._DOCTOR_CACHE.clear()
+    server = web.serve(host="127.0.0.1", port=0,
+                       store_root=doctor_store)
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{server.server_port}", doctor_store
+    server.shutdown()
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        assert resp.status == 200
+        return resp.read().decode()
+
+
+class TestWeb:
+    def test_status_json_doctor_block(self, doctor_base_url):
+        base, _root = doctor_base_url
+        snap = json.loads(_get(base + "/status.json"))
+        assert "doctor" in snap
+        assert set(snap["doctor"]) >= {"checked", "recent"}
+
+    def test_doctor_panel_renders_findings(self, doctor_base_url):
+        base, _root = doctor_base_url
+        body = _get(base + "/doctor")
+        assert "D001" in body
+        assert "compile-storm" in body
+
+    def test_doctor_panel_no_data(self, tmp_path):
+        from jepsen_tpu import web
+        web._DOCTOR_CACHE.clear()
+        body = web.render_doctor(str(tmp_path)).decode()
+        assert "nothing to diagnose" in body
+
+    def test_run_json_carries_doctor_block(self, doctor_base_url):
+        base, root = doctor_base_url
+        rid = ledger.Ledger(root).query()[0]["id"]
+        rec = json.loads(_get(f"{base}/runs/{rid}.json"))
+        assert "doctor" in rec
+        assert rec["doctor"]["rules_fired"] == ["D001"]
+
+    def test_run_page_shows_findings(self, doctor_base_url):
+        base, root = doctor_base_url
+        rid = ledger.Ledger(root).query()[0]["id"]
+        body = _get(f"{base}/runs/{rid}")
+        assert "doctor findings" in body
+
+    def test_in_process_report_wins_panel(self, doctor_store):
+        from jepsen_tpu import web
+        rep = doctor.diagnose(view(target="in-proc",
+                                   results={"r": {"stalls": 1}}))
+        doctor.record_report(rep, where="test")
+        body = web.render_doctor(doctor_store).decode()
+        assert "in-proc" in body
+        assert "D006" in body
+
+    def test_status_banner_shows_top_finding(self, doctor_store):
+        from jepsen_tpu import web
+        rep = doctor.diagnose(view(results={"r": {"stalls": 1}}))
+        doctor.record_report(rep, where="test")
+        body = web.render_status(doctor_store).decode()
+        assert "doctor panel" in body and "D006" in body
+
+    def test_record_block_cached_on_record_identity(self,
+                                                    doctor_store):
+        from jepsen_tpu import web
+        web._DOCTOR_REC_CACHE.clear()
+        led = ledger.Ledger(doctor_store)
+        rid = led.query()[0]["id"]
+        first = web.doctor_for_record(doctor_store, rid)
+        assert first is not None
+        assert len(web._DOCTOR_REC_CACHE) == 1
+        assert web.doctor_for_record(doctor_store, rid) is first
+        # UNRELATED index appends must not evict (a polled record
+        # page during an active run stays cache-hot)
+        led.record({"kind": "checker", "name": "other"})
+        assert web.doctor_for_record(doctor_store, rid) is first
+        # the record file itself changing does invalidate
+        os.utime(led.record_path(rid), (1, 1))
+        assert web.doctor_for_record(doctor_store, rid) is not first

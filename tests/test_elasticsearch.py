@@ -181,6 +181,7 @@ def test_full_suite_live(tmp_path):
     assert res["valid?"] is True, res
 
 
+@pytest.mark.slow  # ~63s alone on 1 CI cpu (tier-1 budget: tests/conftest.py)
 def test_lossy_live_caught(tmp_path):
     """The acked-then-lost counterexample against LIVE servers."""
     done = core.run(es.elasticsearch_test(_mini_options(

@@ -205,6 +205,7 @@ def _options(tmp_path, which, **kw):
 
 
 @pytest.mark.parametrize("which", sorted(fn.WORKLOADS))
+@pytest.mark.slow  # ~51s alone on 1 CI cpu (tier-1 budget: tests/conftest.py)
 def test_full_suite_live(tmp_path, which):
     done = core.run(fn.fauna_test(_options(tmp_path, which)))
     res = done["results"]

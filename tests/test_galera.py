@@ -147,6 +147,7 @@ def _options(tmp_path, which, **kw):
 
 
 @pytest.mark.parametrize("which", ["set", "bank", "dirty-reads"])
+@pytest.mark.slow  # ~24s alone on 1 CI cpu (tier-1 budget: tests/conftest.py)
 def test_full_suite_live(tmp_path, which):
     done = core.run(ga.galera_test(_options(tmp_path, which)))
     res = done["results"]

@@ -173,6 +173,7 @@ def _options(tmp_path, which, **kw):
 
 
 @pytest.mark.parametrize("which", sorted(hz.WORKLOADS))
+@pytest.mark.slow  # ~41s alone on 1 CI cpu (tier-1 budget: tests/conftest.py)
 def test_full_suite_live(tmp_path, which):
     done = core.run(hz.hazelcast_test(_options(tmp_path, which)))
     res = done["results"]

@@ -157,6 +157,7 @@ def test_register_live(tmp_path):
 @pytest.mark.parametrize("conc,iso", [
     ("PESSIMISTIC", "REPEATABLE_READ"),
     ("OPTIMISTIC", "SERIALIZABLE")])
+@pytest.mark.slow  # ~21s alone on 1 CI cpu (tier-1 budget: tests/conftest.py)
 def test_bank_live(tmp_path, conc, iso):
     done = core.run(ig.ignite_test(_options(
         tmp_path, "bank", tx_concurrency=conc, tx_isolation=iso)))

@@ -182,6 +182,7 @@ def test_db_commands():
     assert db.log_files(test, "n1") == [mdb.LOGFILE]
 
 
+@pytest.mark.slow  # ~84s alone on 1 CI cpu (tier-1 budget: tests/conftest.py)
 def test_full_suite_with_stub(stub, tmp_path):
     port = stub.server_address[1]
     opts = {"nodes": ["n1", "n2"], "concurrency": 4, "time_limit": 4,
@@ -247,6 +248,7 @@ def test_smartos_path(tmp_path):
     assert isinstance(t["net"], jnet.IPFilter)
 
 
+@pytest.mark.slow  # ~16s alone on 1 CI cpu (tier-1 budget: tests/conftest.py)
 def test_logger_full_suite_with_stub(stub, tmp_path):
     port = stub.server_address[1]
     opts = {"nodes": ["n1", "n2"], "concurrency": 4, "time_limit": 4,
@@ -271,6 +273,7 @@ def _mini_options(tmp_path, which, **kw):
 
 
 @pytest.mark.parametrize("which", ["register", "logger"])
+@pytest.mark.slow  # ~35s alone on 1 CI cpu (tier-1 budget: tests/conftest.py)
 def test_full_suite_live(tmp_path, which):
     """LIVE mini-mongod processes under the kill/restart nemesis:
     the wire client, DB automation, and crash recovery all real."""

@@ -67,6 +67,7 @@ def test_deb_commands():
     assert "/etc/my.cnf" in dests and "/etc/my.config.ini" in dests
 
 
+@pytest.mark.slow  # ~17s alone on 1 CI cpu (tier-1 budget: tests/conftest.py)
 def test_register_live(tmp_path):
     done = core.run(mc.ndb_test({
         "nodes": ["m1"],

@@ -76,6 +76,7 @@ def _options(tmp_path, which, **kw):
 
 @pytest.mark.parametrize("lock,in_place", [
     ("none", False), ("update", True), ("share", False)])
+@pytest.mark.slow  # ~28s alone on 1 CI cpu (tier-1 budget: tests/conftest.py)
 def test_bank_live(tmp_path, lock, in_place):
     done = core.run(pc.percona_test(_options(
         tmp_path, "bank", lock_type=lock, in_place=in_place)))

@@ -155,6 +155,7 @@ def test_db_commands():
 
 # -- full suite -------------------------------------------------------------
 
+@pytest.mark.slow  # ~31s alone on 1 CI cpu (tier-1 budget: tests/conftest.py)
 def test_full_suite_with_stub(resp_server, tmp_path):
     # the source-mode suite shape, driven against the in-process stub
     # (DB automation goes to the dummy remote; the wire contract is
@@ -174,6 +175,7 @@ def test_full_suite_with_stub(resp_server, tmp_path):
 
 # -- full suite, LIVE processes ---------------------------------------------
 
+@pytest.mark.slow  # ~36s alone on 1 CI cpu (tier-1 budget: tests/conftest.py)
 def test_full_suite_live_mini(tmp_path):
     """install -> daemon start -> real-TCP RESP workload -> kill/
     restart nemesis -> AOF replay -> checker, all against live

@@ -132,6 +132,7 @@ def _options(tmp_path, **kw):
             "sandbox": str(tmp_path / "cluster"), **kw}
 
 
+@pytest.mark.slow  # ~16s alone on 1 CI cpu (tier-1 budget: tests/conftest.py)
 def test_full_suite_live(tmp_path):
     done = core.run(rt.rethinkdb_test(
         _options(tmp_path, write_acks="majority",
@@ -140,6 +141,7 @@ def test_full_suite_live(tmp_path):
     assert res["valid?"] is True, res
 
 
+@pytest.mark.slow  # ~16s alone on 1 CI cpu (tier-1 budget: tests/conftest.py)
 def test_full_suite_reconfigure(tmp_path):
     done = core.run(rt.rethinkdb_test(
         _options(tmp_path, reconfigure=True)))

@@ -22,6 +22,7 @@ from jepsen_tpu.ops import wgl, wgl_ref
 
 @pytest.mark.skipif(os.environ.get("JEPSEN_TPU_SOAK", "1") == "0",
                     reason="soak tier disabled: JEPSEN_TPU_SOAK=0")
+@pytest.mark.slow  # ~45s alone on 1 CI cpu (tier-1 budget: tests/conftest.py)
 def test_differential_soak():
     budget = float(os.environ.get("JEPSEN_TPU_SOAK_S", "45"))
     rng = random.Random(int(os.environ.get("JEPSEN_TPU_SOAK_SEED",

@@ -147,6 +147,7 @@ def _options(tmp_path, which, **kw):
 
 
 @pytest.mark.parametrize("which", ["ledger", "append"])
+@pytest.mark.slow  # ~19s alone on 1 CI cpu (tier-1 budget: tests/conftest.py)
 def test_full_suite_live(tmp_path, which):
     done = core.run(st.stolon_test(_options(tmp_path, which)))
     res = done["results"]

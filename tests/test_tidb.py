@@ -160,6 +160,7 @@ def _options(tmp_path, which, **kw):
     ("table", {}),
     ("bank-multitable", {"update_in_place": False}),
 ])
+@pytest.mark.slow  # ~42s alone on 1 CI cpu (tier-1 budget: tests/conftest.py)
 def test_full_suite_live(tmp_path, which, axes):
     done = core.run(ti.tidb_test(_options(tmp_path, which, **axes)))
     res = done["results"]

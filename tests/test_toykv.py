@@ -32,6 +32,7 @@ def options(tmp_path, **kw):
     }
 
 
+@pytest.mark.slow  # ~38s alone on 1 CI cpu (tier-1 budget: tests/conftest.py)
 def test_full_suite_valid(tmp_path):
     """A durable cluster under a kill/restart nemesis stays
     linearizable; artifacts land in the store."""
@@ -85,6 +86,7 @@ def test_set_durability_under_kill(tmp_path, volatile, expect):
         assert t["results"]["lost-count"] > 0
 
 
+@pytest.mark.slow  # ~17s alone on 1 CI cpu (tier-1 budget: tests/conftest.py)
 def test_cli_entry(tmp_path):
     """The suite's CLI main end to end with exit-code semantics."""
     rc = cli.run_cli(toykv.COMMANDS, [

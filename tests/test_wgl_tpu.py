@@ -251,6 +251,7 @@ class TestWideWindow:
         assert enc.window % 128 == 0
 
 
+@pytest.mark.slow  # ~25s alone on 1 CI cpu (tier-1 budget: tests/conftest.py)
 def test_beam_escalation(monkeypatch):
     """Past the exploration threshold the beam widens to _K_BIG and the
     carry (incl. memo table) migrates — verdict unchanged. This is the

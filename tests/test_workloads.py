@@ -114,6 +114,7 @@ def test_bank_end_to_end(tmp_path):
 
 # -- linearizable-register --------------------------------------------------
 
+@pytest.mark.slow  # ~18s alone on 1 CI cpu (tier-1 budget: tests/conftest.py)
 def test_register_workload_end_to_end(tmp_path):
     w = linearizable_register.workload(
         {"nodes": ["n1", "n2"], "per_key_limit": 12, "algorithm": "wgl"})
@@ -134,6 +135,7 @@ def test_register_workload_end_to_end(tmp_path):
         assert r["linear"]["valid?"] is True
 
 
+@pytest.mark.slow  # ~28s alone on 1 CI cpu (tier-1 budget: tests/conftest.py)
 def test_register_workload_catches_lying_key(tmp_path):
     w = linearizable_register.workload(
         {"nodes": ["n1"], "per_key_limit": 10, "algorithm": "wgl"})

@@ -43,6 +43,7 @@ def test_tests_fn_sweeps_expected(tmp_path):
 
 
 @pytest.mark.parametrize("which", ["ycql/set", "ycql/counter"])
+@pytest.mark.slow  # ~19s alone on 1 CI cpu (tier-1 budget: tests/conftest.py)
 def test_ycql_live(tmp_path, which):
     # generous time_limit: a loaded CI machine restarts the killed
     # server slowly, and the final read must land after recovery
@@ -54,6 +55,7 @@ def test_ycql_live(tmp_path, which):
 
 @pytest.mark.parametrize("which", ["ysql/set", "ysql/counter",
                                    "ysql/append"])
+@pytest.mark.slow  # ~25s alone on 1 CI cpu (tier-1 budget: tests/conftest.py)
 def test_ysql_live(tmp_path, which):
     done = core.run(yuga.yuga_test(_options(tmp_path, which)))
     res = done["results"]
@@ -77,6 +79,7 @@ def test_ysql_long_fork_live(tmp_path):
 
 @pytest.mark.parametrize("which", ["ycql/multi-key-acid",
                                    "ysql/multi-key-acid"])
+@pytest.mark.slow  # ~41s alone on 1 CI cpu (tier-1 budget: tests/conftest.py)
 def test_multi_key_acid_live(tmp_path, which):
     """multi_key_acid.clj: txn batches over 3-subkey groups checked
     linearizable against the multi-register model, on BOTH API
