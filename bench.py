@@ -184,7 +184,8 @@ def _config_entry(res: dict, wall: float) -> dict:
            "op_count": res.get("op_count")}
     for k in ("W", "W_pad", "K", "configs_explored", "cause", "engine",
               "route_reason", "shape", "util", "device_row",
-              "oracle_row"):
+              "oracle_row", "mesh", "streamed_row",
+              "speedup_vs_streamed", "parity"):
         if res.get(k) is not None:
             out[k] = res[k]
     occ = res.get("occupancy")
@@ -545,6 +546,99 @@ def run_extras(budget: float, deadline: float) -> dict:
     # the heavyweight config: don't start it on a nearly-spent budget
     run(f"independent_{n_keys}x{per_key_label}", None, None,
         checker=indep, need=150)
+
+    # Mesh-sharded fan-out (parallel/mesh.py, ISSUE 14): the
+    # independent_200x10k-class config, CI-scaled through env knobs —
+    # the lane-packed scheduler vs the streamed shared_shape_bucket
+    # path on the SAME key set, same round. The entry carries per-key
+    # verdict parity, the speedup ratio, and the scheduler's per-shard
+    # occupancy (keys / wall / steals per mesh device) — the compact
+    # line keeps the bounded `mesh` block, BENCH_DETAILS the full one.
+    n_mkeys = int(os.environ.get("JEPSEN_TPU_BENCH_MESH_KEYS", "24"))
+    per_mkey = int(os.environ.get("JEPSEN_TPU_BENCH_MESH_PER_KEY",
+                                  "600"))
+
+    def indep_mesh():
+        from jepsen_tpu.ops.encode import encode
+        from jepsen_tpu.parallel import check_batched
+        from jepsen_tpu.parallel import mesh as mesh_mod
+
+        model = cas_register()
+        hists = [synth.cas_register_history(per_mkey, n_procs=5,
+                                            seed=1000 + s,
+                                            crash_p=0.002)
+                 for s in range(n_mkeys)]
+        encs = [encode(model, h) for h in hists]
+        left = max(30.0, deadline - time.monotonic() - 20)
+        # warm the plan OUTSIDE the measured window (the PR-9 lesson:
+        # compile warm-up inside it is a measurement bug, not a
+        # result) — the same zero-recompile path the service uses
+        try:
+            from jepsen_tpu.ops import aot as aot_mod2
+            from jepsen_tpu.parallel.batched import (
+                default_mesh, shared_shape_bucket)
+            aot_mod2.precompile_mesh_plan(
+                shared_shape_bucket(encs), default_mesh(),
+                n_keys=len(encs), model_name="cas_register")
+        except Exception:  # noqa: BLE001 — warm-up is best-effort
+            pass
+        runs_before = mesh_mod.snapshot()["runs"]
+        t0 = time.monotonic()
+        res_m = check_batched(model, hists, strategy="mesh",
+                              time_limit=left / 2,
+                              oracle_fallback=True)
+        mesh_wall = time.monotonic() - t0
+        # strategy="mesh" silently degrades to streaming on a
+        # single-device box or an infeasible plan — detect it, or the
+        # entry would misattribute a stale (or absent) mesh summary
+        # and report a streamed-vs-streamed "speedup"
+        used_mesh = mesh_mod.snapshot()["runs"] > runs_before
+        t0 = time.monotonic()
+        res_s = check_batched(model, hists, strategy="stream",
+                              time_limit=max(30.0, left - mesh_wall),
+                              oracle_fallback=True)
+        stream_wall = time.monotonic() - t0
+        parity = all(a["valid?"] == b["valid?"]
+                     for a, b in zip(res_m, res_s))
+        bad = [i for i, r in enumerate(res_m)
+               if r["valid?"] is not True]
+        invalid = [i for i in bad if res_m[i]["valid?"] is False]
+        out = {
+            "valid?": (True if not bad else
+                       False if invalid else "unknown"),
+            "op_count": sum(len(h) for h in hists),
+            "K": n_mkeys,
+            "engine": ("device-mesh" if used_mesh
+                       else "degraded-streamed"),
+            "parity": parity,
+            "cause": (None if parity else
+                      "MESH/STREAM VERDICT DISAGREEMENT"),
+            "streamed_row": {"wall_s": round(stream_wall, 2)}}
+        if not used_mesh:
+            out["cause"] = out["cause"] or                 "mesh degraded (single device or infeasible plan)"
+            return out
+        summ = mesh_mod.last_summary() or {}
+        out["speedup_vs_streamed"] = round(
+            stream_wall / max(mesh_wall, 1e-9), 2)
+        out["mesh"] = {
+            "wall_s": round(mesh_wall, 2),
+            "n_devices": summ.get("n_devices"),
+            "steals": summ.get("steals"),
+            "rebuckets": summ.get("rebuckets"),
+            "work_skew_before": summ.get("work_skew_before"),
+            "work_skew_after": summ.get("work_skew_after"),
+            "per_shard": summ.get("per_shard"),
+            "groups": [{k: g.get(k) for k in
+                        ("group", "keys", "lanes_per_device",
+                         "K_final", "ladder", "steals",
+                         "rebuckets")}
+                       for g in (summ.get("groups") or [])]}
+        return out
+
+    per_mkey_label = (f"{per_mkey // 1000}k" if per_mkey >= 1000
+                      else str(per_mkey))
+    run(f"independent_mesh_{n_mkeys}x{per_mkey_label}", None, None,
+        checker=indep_mesh, need=150)
     return configs
 
 
@@ -1509,6 +1603,19 @@ def emit(out: dict) -> None:
                 for k in ("frontier_fill", "memo_hit_rate"):
                     if util.get(k) is not None:
                         row[k] = util[k]
+            # per-shard occupancy of the mesh fan-out on the compact
+            # line: keys/wall/steals per mesh device plus the skew the
+            # scheduler closed (full block stays in BENCH_DETAILS)
+            mesh_blk = v.get("mesh")
+            if isinstance(mesh_blk, dict):
+                row["mesh"] = {k: mesh_blk.get(k) for k in
+                               ("wall_s", "n_devices", "steals",
+                                "rebuckets", "work_skew_before",
+                                "work_skew_after", "per_shard")
+                               if mesh_blk.get(k) is not None}
+                if v.get("speedup_vs_streamed") is not None:
+                    row["speedup_vs_streamed"] = \
+                        v["speedup_vs_streamed"]
             compact["configs"][name] = row
     compact["details"] = "BENCH_DETAILS.json"
     print(json.dumps(compact), flush=True)

@@ -880,6 +880,106 @@ def gate_fanout(model, histories, *, encs=None, where: str,
     return rejected or None
 
 
+def plan_mesh(encs, *, n_devices: int,
+              lanes_per_device: Optional[int] = None,
+              platform: Optional[str] = None,
+              axes=("keys",),
+              compile_budget: Optional[int] = None) -> dict:
+    """The mesh fan-out's plan report (`parallel/mesh.py`): one
+    `mesh`-annotated plan node per (lane group x ladder bucket), each
+    billed for `lanes_per_device` resident lanes — the per-SHARD cost
+    of the lane-packed scheduler, not the whole-batch cost the vmap
+    path pays. P001 fires when a shard's lane group blows the device
+    budget; P003 when the ladder's cold executables exceed the compile
+    budget (the remedy is `aot.precompile_mesh_plan`, not the
+    single-search ladder warm). The caller degrades an infeasible
+    report to the streamed path — `gate_mesh` below — so a too-big
+    lane group costs a routing decision, never a crash."""
+    from ..parallel import mesh as mesh_mod
+    from ..parallel.batched import shared_shape_bucket
+
+    plat = _safe_platform(platform)
+    s_d = int(lanes_per_device or mesh_mod.MESH_LANES_PER_DEVICE)
+    groups = [("narrow", [i for i, e in enumerate(encs)
+                          if e.window_raw <= 32]),
+              ("wide", [i for i, e in enumerate(encs)
+                        if e.window_raw > 32])]
+    nodes: list = []
+    rules: list = []
+    group_reports: list = []
+    for gname, idxs in groups:
+        if not idxs:
+            continue
+        grp = [encs[i] for i in idxs]
+        bucket = shared_shape_bucket(grp)
+        # bill the CALLER's lane count verbatim: an explicit
+        # lanes_per_device allocates that many resident lanes per
+        # shard regardless of group size, and for the derived case a
+        # small group billed at the larger group's width merely
+        # over-bills — admission must err toward degrade, never
+        # under-bill an allocation that then OOMs at run time
+        g_sd = s_d
+        rep_enc = max(grp, key=lambda e: (len(e.inv), e.window_raw))
+        rep = plan_wgl(enc=rep_enc, platform=plat,
+                       shape_bucket=bucket, lanes=g_sd,
+                       compile_budget=compile_budget)
+        mesh_note = {"group": gname, "keys": len(idxs),
+                     "n_devices": int(n_devices),
+                     "lanes_per_device": g_sd,
+                     "axes": [str(a) for a in axes]}
+        for node in rep.get("plan", []):
+            nodes.append(dict(node, mesh=dict(mesh_note)))
+        for r in rep.get("rules", []):
+            if r["rule"] == "P003":
+                r = dict(r, suggestion="warm the mesh plan first: "
+                                       "aot.precompile_mesh_plan("
+                                       "shape_bucket, mesh)")
+            rules.append(r)
+        group_reports.append({"group": gname, "keys": len(idxs),
+                              "kernel": rep.get("kernel"),
+                              "buckets": rep.get("buckets"),
+                              "verdict": rep["verdict"]})
+    verdict, suggestion = _verdict(rules)
+    peak = max((nd["hbm_bytes"] for nd in nodes), default=0)
+    return {
+        "schema": 1, "kind": "mesh", "platform": plat,
+        "engine": "device",
+        "mesh": {"n_devices": int(n_devices),
+                 "lanes_per_device": s_d,
+                 "axes": [str(a) for a in axes]},
+        "groups": group_reports, "plan": nodes,
+        "hbm": {"peak_bytes": peak,
+                "budget_bytes": device_memory_budget(plat)},
+        "compiles": {"cold_max": len(nodes),
+                     "budget": _compile_budget(compile_budget)},
+        "rules": rules, "verdict": verdict, "suggestion": suggestion,
+    }
+
+
+def gate_mesh(encs, *, n_devices: int,
+              lanes_per_device: Optional[int] = None,
+              where: str = "parallel.mesh",
+              platform: Optional[str] = None,
+              axes=("keys",)) -> Optional[dict]:
+    """Admission gate for the mesh fan-out: None when the mesh plan is
+    admissible; else the report — the caller answers by STREAMING
+    per-key kernels, so the decision actually delivered is a degrade
+    (recorded as one), never a rejection."""
+    try:
+        rep = plan_mesh(encs, n_devices=n_devices,
+                        lanes_per_device=lanes_per_device,
+                        platform=platform, axes=axes)
+    except Exception:  # noqa: BLE001 — an unplannable batch is the
+        return None    # engines' problem, not the gate's
+    if rep["verdict"] == "infeasible":
+        _register(dict(rep, verdict="degrade",
+                       suggestion="stream per-key kernels "
+                                  "(check_streamed)"), where)
+        return rep
+    _register(rep, where)
+    return None
+
+
 # ---------------------------------------------------------------------------
 # CLI (`python -m jepsen_tpu preflight`)
 # ---------------------------------------------------------------------------

@@ -266,7 +266,8 @@ def search_progress_graph(test, chunks, opts=None,
 
 def occupancy_heatmap(test, points, opts=None,
                       filename="occupancy-heatmap.png",
-                      out_path: Optional[str] = None) -> Optional[str]:
+                      out_path: Optional[str] = None,
+                      events=None) -> Optional[str]:
     """occupancy-heatmap.png: frontier fill as a (lane x round) grid
     from occupancy points [{"round", "lane", "fill"}] — the
     single-search view is a 1-lane strip (occupancy.heatmap_points),
@@ -278,7 +279,11 @@ def occupancy_heatmap(test, points, opts=None,
     mesh layout is readable off the heatmap itself. `out_path`
     renders to an explicit file (the bench's artifact tree) instead
     of the test's store dir. Never raises — occupancy rendering must
-    not mask a verdict."""
+    not mask a verdict. `events` (mesh scheduler actions from the
+    `mesh_sched` series, each with a `round` coordinate) render as
+    dashed vertical markers — steals grey, rebuckets labeled
+    K->K' — so a scheduling decision is readable against the fill
+    pattern that triggered it."""
     try:
         pts = [p for p in (points or [])
                if isinstance(p, dict)
@@ -340,6 +345,20 @@ def occupancy_heatmap(test, points, opts=None,
             for li, d in sorted(lane_dev.items()):
                 axd.text(0, li, str(int(d) % 100), fontsize=5,
                          ha="center", va="center", color="white")
+        for ev in (events or []):
+            if not isinstance(ev, dict) \
+                    or not isinstance(ev.get("round"), int) \
+                    or not (rounds[0] <= ev["round"] <= rounds[-1]):
+                continue
+            is_rebucket = ev.get("event") == "rebucket"
+            ax.axvline(ev["round"], lw=0.9, ls="--",
+                       color="#d62728" if is_rebucket else "#999999",
+                       alpha=0.8)
+            label = (f"K{ev.get('from_K')}→{ev.get('to_K')}"
+                     if is_rebucket else "steal")
+            ax.annotate(label, (ev["round"], len(lanes) - 0.5),
+                        fontsize=5, ha="left", va="top",
+                        color="#ffffff", rotation=90)
         fig.colorbar(im, ax=ax, label="fill")
         if out_path:
             parent = os.path.dirname(out_path)

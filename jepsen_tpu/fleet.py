@@ -232,6 +232,86 @@ def rebucket_hint(shards: list) -> Optional[dict]:
                                               1e-9), 3)}
 
 
+def steal_plan(pending: dict, walls: dict,
+               skew_x: float = REBUCKET_SKEW_X) -> Optional[dict]:
+    """The EXECUTABLE half of `rebucket_hint`: given per-shard PENDING
+    work (`{shard: [(est, key), ...]}` — est in whatever work currency
+    the caller has, e.g. encoded op counts) and per-shard completed
+    walls, decide which not-yet-started keys to move off the busiest
+    shard onto the laziest. `rebucket_hint` names completed keys (a
+    post-hoc diagnosis); this names movable ones (the live scheduler's
+    input — the mesh fan-out and the streamed pool both call it
+    between polls).
+
+    Gate: busiest-vs-laziest completed wall past `skew_x`, the same
+    trigger `rebucket_hint` uses. Moves the SMALLEST pending keys
+    first (moving a straggler key just relocates the imbalance) until
+    half the pending-work gap is packed. None when the fleet is <2
+    shards, balanced, or the busiest shard has nothing left to give.
+    Pure host arithmetic — unit-testable with fabricated queues."""
+    if len(walls) < 2:
+        return None
+    busiest = max(walls, key=lambda d: walls[d])
+    laziest = min(walls, key=lambda d: walls[d])
+    if busiest == laziest:
+        return None
+    w_hi, w_lo = float(walls[busiest]), float(walls[laziest])
+    if w_lo <= 0:
+        # a shard with no completed wall yet is unknown, not lazy —
+        # it may be grinding its first (heavy) key, and "rebalancing"
+        # onto it would pile work on the actual straggler. Wait for a
+        # completion on every shard before trusting the ratio (the
+        # mesh scheduler's idle-pull trigger covers genuinely idle
+        # shards without wall evidence).
+        return None
+    if w_hi <= skew_x * w_lo:
+        return None
+    donor = list(pending.get(busiest) or [])
+    if not donor:
+        return None
+    have = sum(float(e) for e, _ in donor)
+    lazy_have = sum(float(e) for e, _ in (pending.get(laziest) or []))
+    gap = (have - lazy_have) / 2
+    if gap <= 0:
+        return None
+    moved: list = []
+    acc = 0.0
+    for est, key in sorted(donor, key=lambda t: float(t[0])):
+        if acc >= gap:
+            break
+        if moved and acc + float(est) > gap:
+            # ascending order: every later key overshoots harder —
+            # moving past the gap would just relocate the imbalance.
+            # (The FIRST key always moves, so a queue of only-big
+            # keys still sheds one.)
+            break
+        moved.append(key)
+        acc += float(est)
+    if not moved:
+        return None
+    return {"from": busiest, "to": laziest, "keys": moved,
+            "est_moved": round(acc, 4),
+            "skew_before": round(w_hi / max(w_lo, 1e-9), 3)}
+
+
+def record_sched_event(series: str, point: dict, mx=None) -> None:
+    """One scheduler action (`mesh_sched` / `fleet_sched` series +
+    `<series>_total{event}` counter) into the ambient registry —
+    schemas in doc/OBSERVABILITY.md "Mesh scheduling", linted by
+    scripts/telemetry_lint.py. No-op when metrics are disabled (the
+    zero-cost contract)."""
+    mx = mx if mx is not None else _metrics.get_default()
+    if not mx.enabled:
+        return
+    desc = ("scheduler events of the mesh-sharded fan-out"
+            if series == "mesh_sched" else
+            "rebucket actions applied by the streamed fan-out pool")
+    mx.series(series, desc).append(dict(point))
+    mx.counter(f"{series}_total",
+               f"{series} scheduler actions").inc(
+        event=str(point.get("event", "unknown")))
+
+
 # Bound on rebucket-hint key lists riding compact surfaces (ledger
 # records, doctor findings, /status blocks) — the full hint stays on
 # the in-memory summary.

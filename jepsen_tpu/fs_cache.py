@@ -95,6 +95,31 @@ def load_data(path: Sequence) -> Any:
     return None if s is None else json.loads(s)
 
 
+def list_data(prefix: Sequence) -> list:
+    """Every JSON value cached under a logical path prefix (depth-
+    first) — the registry walk `aot.precompile_cached_mesh_plans`
+    uses to re-warm all recorded mesh plans after a process restart.
+    Unreadable or non-JSON entries are skipped, not raised: a torn
+    cache entry must not break warm-up."""
+    root = fs_path(prefix)
+    out = []
+    if os.path.isfile(root):
+        try:
+            with open(root, "rb") as fh:
+                out.append(json.loads(fh.read().decode()))
+        except (OSError, ValueError):
+            pass
+        return out
+    for dirpath, _dirs, files in sorted(os.walk(root)):
+        for f in sorted(files):
+            try:
+                with open(os.path.join(dirpath, f), "rb") as fh:
+                    out.append(json.loads(fh.read().decode()))
+            except (OSError, ValueError):
+                continue
+    return out
+
+
 def save_file(path: Sequence, local_file: str) -> str:
     atomic_write(fs_path(path),
                  lambda fh: shutil.copyfileobj(open(local_file, "rb"), fh))

@@ -289,6 +289,11 @@ def perfetto_counter_tracks(registry) -> dict:
       wgl fill        — per-round frontier fill (wgl_rounds)
       wgl frontier/backlog — per-poll beam + backlog (wgl_chunks)
       batched live_keys    — live lanes per poll (wgl_batched_chunks)
+      mesh sched actions   — cumulative scheduler actions of the
+                             mesh fan-out (`mesh_sched` series,
+                             parallel/mesh.py): each steal/rebucket
+                             steps the counter, so scheduling bursts
+                             line up with the fill lanes above
       hbm bytes <device>   — bytes_in_use per device id (`hbm`
                              series, devices.py) — one counter lane
                              per device, so a mesh run's memory
@@ -311,6 +316,14 @@ def perfetto_counter_tracks(registry) -> dict:
         add("wgl_chunks", "frontier", "wgl frontier")
         add("wgl_chunks", "backlog", "wgl backlog")
         add("wgl_batched_chunks", "live_keys", "batched live keys")
+        n_sched = 0
+        sched_vals = []
+        for p in registry.series("mesh_sched").points:
+            if p.get("t") is not None:
+                n_sched += 1
+                sched_vals.append((p["t"], n_sched))
+        if sched_vals:
+            tracks["mesh sched actions"] = sched_vals
         by_dev: dict = {}
         for p in registry.series("hbm").points:
             if p.get("t") is not None and isinstance(

@@ -227,6 +227,28 @@ def migrate_frontier(carry, k_new: int):
     return (fr, *carry[1:])
 
 
+def migrate_frontier_batch(carry, k_new: int):
+    """`migrate_frontier` for a VMAPPED carry: the frontier is
+    (Bk, K, C) — lane axis in front — so the pad/slice runs on axis 1.
+    Same contract as the single-search migration: only shrink when
+    every live lane's polled fr_cnt fits k_new (the mesh scheduler's
+    sparse rule guarantees it; retired lanes are exempt — their
+    kernels no longer expand); memo/backlog/flags/stats/ring ride
+    along untouched, so frontier state crosses bucket switches AND
+    shard migrations without a restart."""
+    import jax.numpy as jnp
+
+    fr = carry[0]
+    k_old = fr.shape[1]
+    if k_new == k_old:
+        return carry
+    if k_new > k_old:
+        fr = jnp.pad(fr, [(0, 0), (0, k_new - k_old), (0, 0)])
+    else:
+        fr = fr[:, :k_new]
+    return (fr, *carry[1:])
+
+
 def precompile_ladder(*, n_pad: int, ic_pad: int, S: int, O: int,
                       H: int, B: int, chunk: int, probes: int,
                       W: int, L: int = 0, accel: bool = False,

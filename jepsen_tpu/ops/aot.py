@@ -250,6 +250,76 @@ def precompile_wgl_ladder(*, n_pad: int, ic_pad: int, S: int, O: int,
         ladder=ladder or LADDER32, compile_now=True)
 
 
+def precompile_mesh_plan(shape_bucket: dict, mesh=None, *,
+                         lanes_per_device: Optional[int] = None,
+                         n_keys: Optional[int] = None,
+                         chunk: int = 1024, model_name: str = "any",
+                         save: bool = True) -> dict:
+    """precompile_wgl_ladder's sibling for the mesh fan-out
+    (parallel/mesh.py): backend-compile every executable the lane
+    scheduler may touch for one shared shape bucket — each adaptive-
+    ladder bucket's vmapped kernel, the jitted init + selective lane
+    reset, and the adjacent-bucket frontier migrations. After this
+    returns, a `check_mesh` over the same bucket stays at ZERO
+    recompiles no matter what the scheduler does (retire/refill,
+    rebucket, steal) — the CompileGuard proof in
+    scripts/mesh_smoke.py. The plan is registered in `fs_cache` keyed
+    on (model, W, K, lane shapes, mesh axes), so a fresh process can
+    re-warm the same plans before traffic
+    (`precompile_cached_mesh_plans`; pair with the persistent jax
+    compilation cache to skip the XLA work too). `mesh` defaults to
+    every visible device on a 1-D "keys" axis. Pass `n_keys` (or an
+    explicit `lanes_per_device`) matching the traffic you are warming
+    for: the batch width is part of the executable shape, so a warm
+    at the wrong lane count compiles a never-used kernel set
+    (`mesh.lanes_for` is the scheduler's own derivation). Returns
+    {K: compile_seconds}."""
+    from ..parallel import mesh as mesh_mod
+
+    if mesh is None:
+        from ..parallel.batched import default_mesh
+        mesh = default_mesh()
+    return mesh_mod.warm_plan(
+        shape_bucket, mesh=mesh, lanes_per_device=lanes_per_device,
+        n_keys=n_keys, chunk=chunk, model_name=model_name, save=save)
+
+
+def precompile_cached_mesh_plans(mesh=None) -> list:
+    """Re-warm every mesh plan earlier traffic registered in fs_cache
+    (`precompile_mesh_plan(save=True)`): the service restart path —
+    a fresh process walks the ("mesh-plan",) registry and backend-
+    compiles each recorded (bucket, lanes, axes) plan before traffic
+    arrives. Plans whose recorded device count no longer matches the
+    live mesh are skipped (their executables would never be used).
+    Returns [{key shapes..., "compile_s": {K: s}}] per warmed plan."""
+    from .. import fs_cache
+    from ..parallel import mesh as mesh_mod
+
+    if mesh is None:
+        from ..parallel.batched import default_mesh
+        mesh = default_mesh()
+    nd = int(mesh.devices.size)
+    out = []
+    for plan in fs_cache.list_data(("mesh-plan",)):
+        if not isinstance(plan, dict) or "bucket" not in plan:
+            continue
+        if int(plan.get("n_devices") or 0) != nd:
+            continue
+        try:
+            compile_s = mesh_mod.warm_plan(
+                plan["bucket"], mesh=mesh,
+                lanes_per_device=plan.get("lanes_per_device"),
+                chunk=int(plan.get("chunk") or 1024),
+                model_name=plan.get("model") or "any", save=False)
+        except Exception:  # noqa: BLE001 — one stale plan must not
+            continue       # block the others' warm-up
+        out.append({"model": plan.get("model"),
+                    "bucket": plan["bucket"],
+                    "lanes_per_device": plan.get("lanes_per_device"),
+                    "compile_s": compile_s})
+    return out
+
+
 def precompile_elle_closure(shape_bucket: dict,
                             kernels: Optional[tuple] = None) -> dict:
     """precompile_wgl_ladder's sibling for the Elle cycle engines:

@@ -17,6 +17,7 @@ aggregates from them via `fleet.summarize`.
 
 from .batched import (BatchEncoded, check_batched, check_streamed,
                       default_mesh, encode_batch)
+from .mesh import check_mesh
 
-__all__ = ["BatchEncoded", "check_batched", "check_streamed",
-           "default_mesh", "encode_batch"]
+__all__ = ["BatchEncoded", "check_batched", "check_mesh",
+           "check_streamed", "default_mesh", "encode_batch"]

@@ -518,21 +518,91 @@ def check_streamed(model: Model, histories: Sequence[History],
             results[i] = one(devices[0], i)
         return results  # type: ignore[return-value]
 
-    # One worker thread per device; each pulls the next unclaimed
-    # history (work-stealing), so uneven keys never serialize behind a
-    # statically pinned device.
-    import itertools
+    # One worker thread per device, each draining its OWN pending
+    # queue (keys assigned LPT by encoded op count) and stealing the
+    # smallest pending key off the heaviest queue when it runs dry —
+    # so uneven keys never serialize behind a statically pinned
+    # device. Between keys the finishing worker additionally ACTS on
+    # the fleet's rebucket signal: when the completed shard walls show
+    # work_skew past fleet.REBUCKET_SKEW_X, pending keys move
+    # smallest-first off the busiest device's queue onto the laziest's
+    # (fleet.steal_plan — the hint PR 12's summarize() only computed),
+    # recorded as a `fleet_sched` event so doctor D005 sees the skew
+    # HANDLED on the rerun, not just measured.
     import threading
-    counter = itertools.count()
+    from collections import deque
+    est = [float(encs[i].n_ok) if encs else float(len(histories[i]))
+           for i in range(len(histories))]
+    labels = [_fleet.device_label(d) for d in devices]
+    queues = [deque() for _ in devices]
+    dev_wall = [0.0] * len(devices)
+    load = [0.0] * len(devices)
+    for i in sorted(range(len(histories)), key=lambda i: -est[i]):
+        d = load.index(min(load))
+        queues[d].append(i)
+        load[d] += est[i]
+    qlock = threading.Lock()
+
+    def _claim(di):
+        with qlock:
+            if queues[di]:
+                return queues[di].popleft()
+            donor = max(range(len(devices)),
+                        key=lambda d: sum(est[j] for j in queues[d]))
+            if donor == di or not queues[donor]:
+                return None
+            # smallest-first off the heaviest queue: moving a
+            # straggler key would just relocate the imbalance
+            j = min(queues[donor], key=lambda j: est[j])
+            queues[donor].remove(j)
+            return j
+
+    def _rebalance():
+        if len(devices) < 2:
+            return
+        with qlock:
+            walls = {labels[d]: dev_wall[d]
+                     for d in range(len(devices))}
+            pending = {labels[d]: [(est[j], j) for j in queues[d]]
+                       for d in range(len(devices))}
+        plan = _fleet.steal_plan(pending, walls)
+        if plan is None:
+            return
+        with qlock:
+            fdi = labels.index(plan["from"])
+            tdi = labels.index(plan["to"])
+            # keys may have been claimed since the snapshot — move
+            # only what is still pending
+            moved = [j for j in plan["keys"] if j in queues[fdi]]
+            for j in moved:
+                queues[fdi].remove(j)
+                queues[tdi].append(j)
+        if not moved:
+            return
+        _fleet.record_sched_event("fleet_sched", {
+            "event": "rebucket", "from": plan["from"],
+            "to": plan["to"],
+            "keys": [key_indices[j] if key_indices is not None else j
+                     for j in moved],
+            "skew_before": plan["skew_before"],
+            "est_moved": plan["est_moved"]})
 
     def worker(dev):
+        di = devices.index(dev)
         while True:
-            i = next(counter)
-            if i >= len(histories) or wd.cancelled():
+            if wd.cancelled():
+                return
+            i = _claim(di)
+            if i is None:
                 return
             if results[i] is not None:  # preflight-rejected key
                 continue
             results[i] = one(dev, i)
+            with qlock:
+                dev_wall[di] += float(
+                    (results[i].get("shard") or {}).get("wall_s")
+                    or 0.0)
+            _rebalance()
 
     # daemon only under cancel-escalation: that is the one mode where
     # the join below may abandon a hung worker, and a non-daemon zombie
@@ -600,12 +670,20 @@ def check_batched(model: Model, histories: Sequence[History],
     """Check many independent histories against `model` on the
     accelerator. Returns one result dict per history, in order.
 
-    strategy: "vmap" — one mesh-sharded lockstep search over the whole
-    key batch (all lanes step until the slowest finishes; best when
-    histories are small and uniform, and the path the multi-chip dryrun
-    validates); "stream" — per-key single-kernel checks fanned over
-    devices (best for large histories; see check_streamed); "auto" —
-    stream when the biggest history exceeds ~512 completed ops.
+    strategy: "mesh" — the lane-packed mesh scheduler
+    (parallel/mesh.py: per-device lane groups, retire/refill,
+    telemetry-driven rebucketing + work stealing; the default
+    multi-device path on "auto", degrading to the decisions below
+    when the mesh plan is infeasible or fewer than 2 devices exist);
+    "vmap" — one mesh-sharded lockstep search over the whole key
+    batch (all lanes step until the slowest finishes; best when
+    histories are small and uniform, and the path the multi-chip
+    dryrun's narrow/wide/mesh2d sections validate — an explicitly
+    passed `mesh` with strategy="auto" pins it); "stream" — per-key
+    single-kernel checks fanned over devices (best for large
+    histories; see check_streamed); "auto" — mesh for >=4 encodable
+    keys, else stream when the biggest history exceeds ~512 completed
+    ops.
 
     `max_configs` is a per-key exploration budget. With `oracle_fallback`,
     keys the device leaves "unknown" are re-checked by the host oracle
@@ -650,6 +728,34 @@ def check_batched(model: Model, histories: Sequence[History],
     if not encs:
         return results  # type: ignore[return-value]
 
+    if strategy == "auto":
+        # The DEFAULT multi-device path is the mesh scheduler
+        # (parallel/mesh.py): lane-packed lockstep rounds with
+        # retire/refill, telemetry-driven rebucketing, and work
+        # stealing — it subsumes both older trades (streaming's
+        # per-key dispatch cost AND the vmap batch paying every lane
+        # until the slowest finishes). An explicitly passed mesh
+        # still pins the vmap path (the MULTICHIP dryrun sections
+        # and their tests prove that path as-is); small key sets
+        # fall through to the old stream/vmap decision below.
+        from . import mesh as _mesh_mod
+        if mesh is None and _mesh_mod.enabled() \
+                and len(encs) >= _mesh_mod.MIN_MESH_KEYS:
+            strategy = "mesh"
+    if strategy == "mesh":
+        from . import mesh as _mesh_mod
+        out = _mesh_mod.check_mesh(
+            model, [histories[i] for i in lanes], encs=encs,
+            time_limit=time_limit, max_configs=max_configs,
+            mesh=mesh, oracle_fallback=oracle_fallback,
+            key_indices=lanes, chunk=chunk)
+        if out is not None:
+            for i, res in zip(lanes, out):
+                results[i] = res
+            return results  # type: ignore[return-value]
+        # degraded (single device / backend timeout / infeasible
+        # mesh plan): fall through to the old auto decision
+        strategy = "auto"
     if strategy == "auto":
         # An explicitly passed mesh pins the caller to the mesh-sharded
         # vmap path. On a CPU backend, large per-key histories stream

@@ -1,0 +1,1040 @@
+"""Mesh-sharded fan-out: lane-packed lockstep search with a
+telemetry-driven host scheduler (ROADMAP item 2).
+
+`check_streamed` pays a python dispatch + kernel launch per key per
+chunk, serialized over however few devices exist; `check_batched`'s
+vmap path pays EVERY key's frontier rows every round until the slowest
+key finishes. This module is the middle path the north star's
+"1000 keys x 1M total ops" target needs: keys are packed into
+shape-bucketed padded lanes (`shared_shape_bucket` generalized from
+one host bucket to per-device lane groups), the lane batch is laid out
+over the (hosts, chips) mesh with a `NamedSharding` — each device owns
+a contiguous block of `lanes_per_device` slots — and driven in
+lockstep vmap rounds. Between polls a HOST scheduler spends the
+telemetry PRs 9/12 already record:
+
+  * decided lanes are **retired** and their slots refilled from the
+    owning shard's pending queue (the lane's carry is reset in place —
+    one jitted select per poll, no recompile, no fresh kernel);
+  * the whole batch is **re-bucketed** through the adaptive ladder
+    when the per-lane `adapt.recommend` hints say the shared K is
+    wrong — frontier state crosses the switch via
+    `adapt.migrate_frontier_batch`, a pad/slice, never a restart;
+  * pending keys are **work-stolen** from straggler shards when
+    `fleet.summarize()` over the completed shard blocks reports
+    `work_skew` above `fleet.REBUCKET_SKEW_X` — executing the
+    `rebucket_hint` PR 12 only computed (`fleet.steal_plan`).
+
+Every migration/steal lands in the linted `mesh_sched` series; per
+lane-per-round fill points carry their mesh-device index so the
+existing occupancy heatmap renders a per-shard strip. The plan is
+preflight-costed per shard (`analysis/preflight.plan_mesh` — P001/P003
+with a `mesh` plan node): an infeasible lane group makes `check_mesh`
+return None and the caller degrades to the streamed path, not a crash.
+`warm_plan` backend-compiles every ladder bucket + the scheduler's
+reset/migration helpers ahead of traffic (`aot.precompile_mesh_plan`),
+with the plan registered in `fs_cache` so a fresh process can re-warm
+before traffic (`aot.precompile_cached_mesh_plans`).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+import os
+import threading
+import time as _time
+from collections import deque
+from typing import Optional, Sequence
+
+import numpy as np
+
+from .. import devices as _devices
+from .. import fleet as _fleet
+from .. import metrics as _metrics
+from .. import occupancy as _occ
+from .. import watchdog as _watchdog
+from ..history import History
+from ..models.core import Model
+from ..ops import adapt as _adapt
+from ..ops.encode import INF, Encoded
+from .batched import (_annotate_shard, _backend_ready_or_fallback,
+                      _batch_capacities, _compiled_batched,
+                      _oracle_fallback, default_mesh,
+                      shared_shape_bucket)
+
+# Lane slots per device: the active window is n_devices x this many
+# lanes; the rest of the keys wait in per-shard pending queues. Small
+# keeps the lockstep round cost proportional to the window, not the
+# whole key set (the vmap path's failure mode on big batches).
+MESH_LANES_PER_DEVICE = int(os.environ.get("JEPSEN_TPU_MESH_LANES",
+                                           "4"))
+
+# Below this many encodable keys the scheduler machinery cannot pay
+# for itself — check_batched's auto path keeps the old stream/vmap
+# decision there.
+MIN_MESH_KEYS = 4
+
+# Bound on ladder switches per group run: an oscillating mixed batch
+# must not thrash executables (the adapt.Policy burn rule, bluntly).
+MAX_REBUCKETS = 6
+
+# Scheduler events kept on the run summary (the series keeps them
+# all); the summary rides ledger records and BENCH_DETAILS.
+EVENT_CAP = 128
+
+
+def enabled(default: bool = True) -> bool:
+    """Kill-switch: JEPSEN_TPU_MESH=0 pins the pre-mesh fan-out
+    routing (the streamed / vmap auto decision)."""
+    v = os.environ.get("JEPSEN_TPU_MESH")
+    if v is None:
+        return default
+    return v not in ("0", "false", "no")
+
+
+def kernel_params(bucket: dict, bk: int, chunk: int = 1024) -> dict:
+    """The ONE derivation of the mesh batch kernel from a shared shape
+    bucket: variant, padded widths, capacities, and the adaptive
+    ladder the scheduler may climb. `warm_plan`, `check_mesh`, and
+    `analysis/preflight.plan_mesh` all read this, so the warmed, the
+    executed, and the admitted kernels cannot drift."""
+    from ..util import safe_backend
+
+    wide = int(bucket["w_eff"]) > 32
+    if wide:
+        W = int(bucket["w_eff"])
+        L = W // 32
+        chunk = min(chunk, 128)
+    else:
+        W = max(8, int(bucket["w_eff"]))
+        L = 0
+    n_pad = int(bucket["n_pad"])
+    ic_eff = max(8, int(bucket["ic_eff"]))
+    K_cap, H, B = _batch_capacities(bk, W, n_pad, L)
+    if L:
+        ladder = _adapt.ladder_for(K_cap, k_min=max(16, K_cap // 16),
+                                   step=8)
+    else:
+        ladder = _adapt.ladder_for(K_cap, k_min=2, step=8)
+    return {"n_pad": n_pad, "ic_pad": ic_eff, "W": W, "L": L,
+            "S": int(bucket["S"]), "O": int(bucket["O"]),
+            "H": H, "B": B, "chunk": chunk, "probes": 4,
+            "ladder": ladder, "K_cap": K_cap,
+            "accel": safe_backend() not in (None, "cpu")}
+
+
+@functools.lru_cache(maxsize=16)
+def _mesh_compiled(n_pad: int, ic_pad: int, W: int, S: int, O: int,
+                   K: int, H: int, B: int, chunk: int, probes: int,
+                   L: int, accel: bool):
+    """(jitted vinit, jitted vchunk) for one (shapes, K) bucket — the
+    SAME `_compiled_batched` builders the vmap path uses (shared lru
+    caches, shared executables), plus a jitted init so the scheduler's
+    carry resets stay recompile-free once warmed."""
+    import jax
+
+    vinit, vchunk = _compiled_batched(n_pad, ic_pad, W, S, O, K, H, B,
+                                      chunk, probes, L=L, accel=accel)
+    return jax.jit(vinit), vchunk
+
+
+@functools.lru_cache(maxsize=4)
+def _reset_fn():
+    """Jitted selective carry reset: lanes where `mask` is True take
+    the fresh init state (slot refilled with a new key), the rest keep
+    their search state. One executable per carry-shape set — jax.jit
+    caches by shape, and `warm_plan` warms it."""
+    import jax
+    import jax.numpy as jnp
+
+    def f(carry, init, mask):
+        def sel(c, i):
+            m = mask.reshape((-1,) + (1,) * (c.ndim - 1))
+            return jnp.where(m, i, c)
+        return jax.tree.map(sel, carry, init)
+    return jax.jit(f)
+
+
+@functools.lru_cache(maxsize=16)
+def _migrate_fn(k_new: int):
+    """Jitted `adapt.migrate_frontier_batch` at a static target K."""
+    import jax
+
+    return jax.jit(
+        lambda c: _adapt.migrate_frontier_batch(c, k_new))
+
+
+def _shard_tree(shard, tree):
+    import jax
+
+    return jax.tree.map(shard, tree)
+
+
+# ---------------------------------------------------------------------------
+# live snapshot (the /status.json `mesh` block)
+# ---------------------------------------------------------------------------
+
+_LOCK = threading.Lock()
+_SNAP: dict = {"active": False, "runs": 0, "steals": 0,
+               "rebuckets": 0, "last": None}
+
+
+def snapshot() -> dict:
+    """The `/status.json` `mesh` block: how many mesh fan-out runs
+    this process scheduled, total steal/rebucket actions, and the last
+    run's per-shard summary."""
+    with _LOCK:
+        return dict(_SNAP, last=(dict(_SNAP["last"])
+                                 if _SNAP["last"] else None))
+
+
+def last_summary() -> Optional[dict]:
+    """The most recent `check_mesh` scheduler summary (per-shard
+    keys/wall/steals, skew before/after, rebucket path) — the bench
+    mesh config and the multichip dryrun bank it."""
+    with _LOCK:
+        return dict(_SNAP["last"]) if _SNAP["last"] else None
+
+
+def _record_run(summary: dict) -> None:
+    with _LOCK:
+        _SNAP["runs"] += 1
+        _SNAP["steals"] += int(summary.get("steals") or 0)
+        _SNAP["rebuckets"] += int(summary.get("rebuckets") or 0)
+        _SNAP["last"] = summary
+        _SNAP["active"] = True
+
+
+# ---------------------------------------------------------------------------
+# warm path (aot.precompile_mesh_plan delegates here)
+# ---------------------------------------------------------------------------
+
+def plan_cache_key(bucket: dict, *, n_devices: int,
+                   lanes_per_device: int, axes: Sequence[str],
+                   model_name: str = "any") -> tuple:
+    """The fs_cache key one warmed mesh plan registers under:
+    (model, W, K ceiling, lane shapes, mesh axes) — everything that
+    picks the executables — so a fresh process can re-warm the exact
+    plans earlier traffic used (`aot.precompile_cached_mesh_plans`)."""
+    bk = n_devices * lanes_per_device
+    p = kernel_params(bucket, bk)
+    return ("mesh-plan", str(model_name or "any"),
+            f"W{p['W']}", f"L{p['L']}", f"K{p['K_cap']}",
+            f"n{p['n_pad']}", f"ic{p['ic_pad']}",
+            f"S{p['S']}", f"O{p['O']}", f"accel{int(p['accel'])}",
+            f"mesh-{n_devices}x{lanes_per_device}",
+            "-".join(str(a) for a in axes))
+
+
+def lanes_for(n_keys: int, n_devices: int) -> int:
+    """check_mesh's lanes-per-device derivation, exported so warm
+    callers compile the SAME batch width the scheduler will run —
+    a warm at a different bk is a different executable set, i.e.
+    compile time inside the measured window (the PR-9 lesson)."""
+    return min(MESH_LANES_PER_DEVICE,
+               max(1, math.ceil(n_keys / max(n_devices, 1))))
+
+
+def warm_plan(bucket: dict, *, n_devices: Optional[int] = None,
+              mesh=None, lanes_per_device: Optional[int] = None,
+              n_keys: Optional[int] = None,
+              chunk: int = 1024, axes: Sequence[str] = ("keys",),
+              model_name: str = "any", save: bool = True) -> dict:
+    """Backend-compile every executable a mesh run over this shape
+    bucket may touch: each ladder bucket's vmapped kernel (one
+    zero-config-budget call per K — the while-loop exits before its
+    first round, so the call costs pure trace + XLA compile), the
+    jitted init + selective reset, and the adjacent-bucket frontier
+    migrations both ways. After this returns, a `check_mesh` over the
+    same bucket stays at ZERO recompiles no matter what the scheduler
+    does (the CompileGuard proof in scripts/mesh_smoke.py). The plan
+    is registered in fs_cache under `plan_cache_key` so warm mesh
+    rounds survive process restarts: a fresh process re-warms from the
+    registry (through the persistent jax compilation cache, when
+    enabled) before traffic. Returns {K: compile_seconds}.
+
+    Pass the live `mesh` whenever one exists: the executables are
+    compiled against the batch's INPUT SHARDINGS, so a warm run laid
+    out with the run's `NamedSharding` is what makes the later
+    scheduler calls cache hits — an unsharded warm compiles a
+    different (never-used) executable set."""
+    import jax
+    import jax.numpy as jnp
+
+    if mesh is not None:
+        n_devices = int(mesh.devices.size)
+        axes = tuple(str(a) for a in mesh.axis_names)
+    elif n_devices is None:
+        raise ValueError("warm_plan needs mesh= or n_devices=")
+    # lanes default: the exact derivation check_mesh uses for this
+    # key count (pass n_keys!), else the configured slot width
+    s_d = int(lanes_per_device
+              or (lanes_for(int(n_keys), int(n_devices)) if n_keys
+                  else MESH_LANES_PER_DEVICE))
+    bk = int(n_devices) * s_d
+
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec
+        axis = tuple(mesh.axis_names) if len(mesh.axis_names) > 1 \
+            else mesh.axis_names[0]
+
+        def shard(x):
+            spec = PartitionSpec(axis) if x.ndim else PartitionSpec()
+            return jax.device_put(x, NamedSharding(mesh, spec))
+    else:
+        def shard(x):
+            return x
+    p = kernel_params(bucket, bk, chunk)
+    z2 = jnp.zeros((bk, p["n_pad"]), jnp.int32)
+    consts = tuple(shard(a) for a in (
+        z2, z2, z2, jnp.zeros((bk, p["n_pad"] + 1), jnp.int32),
+        jnp.zeros((bk, p["ic_pad"]), jnp.int32),
+        jnp.zeros((bk, p["ic_pad"]), jnp.int32),
+        jnp.zeros((bk, p["S"], p["O"]), jnp.int32),
+        jnp.zeros((bk,), jnp.int32), jnp.zeros((bk,), jnp.int32),
+        jnp.zeros((bk,), jnp.int32)))  # max_cfg 0: no rounds run
+    out: dict = {}
+    carries: dict = {}
+    for k in p["ladder"]:
+        t0 = _time.monotonic()
+        jinit, vchunk = _mesh_compiled(
+            p["n_pad"], p["ic_pad"], p["W"], p["S"], p["O"], k,
+            p["H"], p["B"], p["chunk"], p["probes"], p["L"],
+            p["accel"])
+        carry = _reset_fn()(
+            _shard_tree(shard, jinit(jnp.zeros(bk, jnp.int32))),
+            _shard_tree(shard, jinit(jnp.zeros(bk, jnp.int32))),
+            jnp.asarray(np.zeros(bk, dtype=bool)))
+        carry, summary = vchunk(consts, carry)
+        # per-bucket warm compile: one sync per executable IS the job
+        jax.block_until_ready(summary)  # jaxlint: ok(J007)
+        carries[k] = carry
+        out[k] = round(_time.monotonic() - t0, 3)
+    # adjacent-bucket migrations, both directions — the scheduler's
+    # only other device ops
+    ladder = p["ladder"]
+    for a, b in zip(ladder, ladder[1:]):
+        jax.block_until_ready(  # jaxlint: ok(J007)
+            _migrate_fn(b)(carries[a])[0])
+        jax.block_until_ready(  # jaxlint: ok(J007)
+            _migrate_fn(a)(carries[b])[0])
+    if save:
+        try:
+            from .. import fs_cache
+            fs_cache.save_data(
+                plan_cache_key(bucket, n_devices=n_devices,
+                               lanes_per_device=s_d, axes=axes,
+                               model_name=model_name),
+                {"bucket": {k: bool(v) if k == "pack" else int(v)
+                            for k, v in bucket.items()},
+                 "n_devices": int(n_devices),
+                 "lanes_per_device": s_d, "chunk": int(chunk),
+                 "axes": [str(a) for a in axes],
+                 "model": str(model_name or "any"),
+                 "compile_s": out})
+        except Exception:  # noqa: BLE001 — the registry is a warm-up
+            pass           # accelerant, never a correctness gate
+    return out
+
+
+# ---------------------------------------------------------------------------
+# the scheduler
+# ---------------------------------------------------------------------------
+
+class _GroupRun:
+    """One kernel branch's lane group (narrow or wide) scheduled over
+    the mesh: owns the slot window, the per-shard pending queues, the
+    packed consts arrays, and the per-poll bookkeeping."""
+
+    def __init__(self, encs, idxs, mesh, *, chunk: int,
+                 lanes_per_device: Optional[int], assign: str,
+                 deadline: Optional[float], max_configs: int,
+                 oracle_fallback: bool, key_indices, group: str,
+                 steal: bool = True):
+        self.encs = encs
+        self.idxs = list(idxs)
+        self.deadline = deadline
+        self.max_configs = max_configs
+        self.oracle_fallback = oracle_fallback
+        self.key_indices = key_indices
+        self.group = group
+        self.steal_enabled = steal
+        self.nd = int(mesh.devices.size)
+        self.devs_flat = list(mesh.devices.flat)
+        self.labels = [_fleet.device_label(d) for d in self.devs_flat]
+        self.s_d = int(lanes_per_device
+                       or lanes_for(len(self.idxs), self.nd))
+        self.bk = self.nd * self.s_d
+        self.bucket = shared_shape_bucket([encs[i] for i in self.idxs])
+        self.params = kernel_params(self.bucket, self.bk, chunk)
+        # per-shard pending queues: LPT by encoded op count (assign=
+        # "block" keeps the caller's order in contiguous blocks — the
+        # deterministic-skew harness the smoke and tests use)
+        self.queues = [deque() for _ in range(self.nd)]
+        if assign == "block":
+            per = math.ceil(len(self.idxs) / self.nd)
+            for j, i in enumerate(self.idxs):
+                self.queues[min(j // per, self.nd - 1)].append(i)
+        else:
+            load = [0.0] * self.nd
+            for i in sorted(self.idxs,
+                            key=lambda i: -int(encs[i].n_ok)):
+                d = load.index(min(load))
+                self.queues[d].append(i)
+                load[d] += int(encs[i].n_ok)
+        # slot state (host side)
+        self.slot_key = np.full(self.bk, -1, dtype=np.int64)
+        self.slot_t0 = np.zeros(self.bk)
+        self.prev_rounds = np.zeros(self.bk, dtype=np.int64)
+        self.prev_expl = np.zeros(self.bk, dtype=np.int64)
+        # per-shard accounting for the run summary / multichip record
+        self.shard_stats = [{"keys": 0, "wall_s": 0.0, "steals": 0}
+                            for _ in range(self.nd)]
+        self.completed_shards: list = []
+        self.events: list = []
+        self.steals = 0
+        self.rebuckets = 0
+        self.skew_before: Optional[float] = None
+        self.completed_since_steal = 0
+        self.results: dict = {}           # local idx -> result
+        self.pending_fallback: dict = {}  # local idx -> (res, info)
+        self._init_consts()
+
+    # -- lane packing -------------------------------------------------
+    def _init_consts(self):
+        p = self.params
+        bk, n_pad, ic = self.bk, p["n_pad"], p["ic_pad"]
+        self.c_inv = np.full((bk, n_pad), INF, dtype=np.int32)
+        self.c_ret = np.full((bk, n_pad), INF, dtype=np.int32)
+        self.c_opc = np.zeros((bk, n_pad), dtype=np.int32)
+        self.c_suf = np.full((bk, n_pad + 1), INF, dtype=np.int32)
+        self.c_iinv = np.full((bk, ic), INF, dtype=np.int32)
+        self.c_iopc = np.zeros((bk, ic), dtype=np.int32)
+        self.c_table = np.full((bk, p["S"], p["O"]), -1,
+                               dtype=np.int32)
+        self.c_nok = np.zeros(bk, dtype=np.int32)
+        self.c_ninfo = np.zeros(bk, dtype=np.int32)
+        self.c_maxcfg = np.full(bk, self.max_configs, dtype=np.int32)
+
+    def load_slot(self, sl: int, enc: Encoded) -> None:
+        """Pack one key's encoding into a lane slot (the bucket pad:
+        rows past the key's own length stay INF/zero)."""
+        self.clear_slot(sl)
+        ic = self.params["ic_pad"]
+        self.c_inv[sl, :len(enc.inv)] = enc.inv
+        self.c_ret[sl, :len(enc.ret)] = enc.ret
+        self.c_opc[sl, :len(enc.opcode)] = enc.opcode
+        self.c_suf[sl, :len(enc.sufminret)] = enc.sufminret
+        w = min(len(enc.inv_info), ic)
+        self.c_iinv[sl, :w] = enc.inv_info[:w]
+        self.c_iopc[sl, :w] = enc.opcode_info[:w]
+        s, o = enc.table.shape
+        self.c_table[sl, :s, :o] = enc.table
+        self.c_nok[sl] = enc.n_ok
+        self.c_ninfo[sl] = enc.n_info
+
+    def unpack_slot(self, sl: int) -> dict:
+        """The inverse of `load_slot` for one lane: the packed rows
+        trimmed back to the key's own length — the pack/unpack
+        round-trip proof in tests/test_mesh.py."""
+        real = int((self.c_inv[sl] < INF).sum())
+        return {"inv": self.c_inv[sl, :real].copy(),
+                "ret": self.c_ret[sl, :real].copy(),
+                "opcode": self.c_opc[sl, :real].copy(),
+                "n_ok": int(self.c_nok[sl]),
+                "n_info": int(self.c_ninfo[sl])}
+
+    def clear_slot(self, sl: int) -> None:
+        self.c_inv[sl] = INF
+        self.c_ret[sl] = INF
+        self.c_opc[sl] = 0
+        self.c_suf[sl] = INF
+        self.c_iinv[sl] = INF
+        self.c_iopc[sl] = 0
+        self.c_table[sl] = -1
+        self.c_nok[sl] = 0
+        self.c_ninfo[sl] = 0
+
+    # -- queue ops ----------------------------------------------------
+    def pack_initial(self) -> None:
+        """Fill each shard's slots from its OWN queue (no stealing at
+        t=0: the queues were just balanced by assignment)."""
+        now = _time.monotonic()
+        for sl in range(self.bk):
+            i = self.claim(sl // self.s_d)
+            if i is None:
+                continue
+            self.load_slot(sl, self.encs[i])
+            self.slot_key[sl] = i
+            self.slot_t0[sl] = now
+
+    def claim(self, d: int) -> Optional[int]:
+        """Next key for shard d — its OWN queue only. Cross-shard
+        moves happen exclusively through the scheduler's steal pass
+        (`maybe_steal`), so every migration is one recorded decision,
+        never an emergent race between idle workers."""
+        return self.queues[d].popleft() if self.queues[d] else None
+
+    def _ki(self, i: int) -> int:
+        return (self.key_indices[i] if self.key_indices is not None
+                else i)
+
+    def _event(self, point: dict) -> None:
+        point = dict(point, group=self.group)
+        if len(self.events) < EVENT_CAP:
+            self.events.append(point)
+        elif len(self.events) == EVENT_CAP:
+            self.events.append({"event": "truncated",
+                                "note": f"first {EVENT_CAP} kept"})
+        _fleet.record_sched_event("mesh_sched", point)
+
+    # -- skew-triggered stealing --------------------------------------
+    def maybe_steal(self, *, poll: int, wall: float,
+                    rnd: Optional[int] = None) -> None:
+        """The scheduler's one cross-shard migration pass, two
+        triggers:
+
+        * **work-skew** — execute the rebucket hint: when
+          `fleet.summarize()` over the completed shard blocks reports
+          work_skew past REBUCKET_SKEW_X, move pending keys
+          smallest-first off the busiest shard's queue
+          (fleet.steal_plan).
+        * **idle pull** — a shard with no active lanes and an empty
+          queue while another queue holds >1 pending keys: the
+          completed-wall skew cannot see a shard that never finishes
+          (its wall is still 0), so starving idle capacity is pulled
+          to without waiting for the gate.
+
+        `steal=False` on check_mesh disables both — the measured
+        no-steal baseline the smoke/dryrun compare the banked
+        work_skew against."""
+        if not self.steal_enabled or self.nd < 2:
+            return
+        if not any(self.queues[d] for d in range(self.nd)):
+            return
+        # idle pull first: it needs no completed-wall evidence
+        idle = [d for d in range(self.nd)
+                if not self.queues[d] and not any(
+                    self.slot_key[d * self.s_d:(d + 1) * self.s_d]
+                    >= 0)]
+        if idle:
+            donor = max(range(self.nd),
+                        key=lambda q: len(self.queues[q]))
+            if len(self.queues[donor]) > 1:
+                tdi = idle[0]
+                if self.skew_before is None and self.completed_shards:
+                    self.skew_before = float(_fleet.summarize(
+                        self.completed_shards).get("work_skew") or 0.0)
+                moved = []
+                for _ in range(max(1, len(self.queues[donor]) // 2)):
+                    i = min(self.queues[donor],
+                            key=lambda j: int(self.encs[j].n_ok))
+                    self.queues[donor].remove(i)
+                    self.queues[tdi].append(i)
+                    moved.append(i)
+                self.shard_stats[tdi]["steals"] += len(moved)
+                self.steals += len(moved)
+                self._event({"event": "steal", "reason": "idle",
+                             "poll": poll, "wall_s": round(wall, 4),
+                             "round": rnd,
+                             "from_shard": donor, "to_shard": tdi,
+                             "keys": [self._ki(i) for i in moved]})
+                return
+        if self.completed_since_steal <= 0:
+            return
+        summ = _fleet.summarize(self.completed_shards)
+        skew = float(summ.get("work_skew") or 0.0)
+        if skew <= _fleet.REBUCKET_SKEW_X:
+            return
+        walls = {self.labels[d]: self.shard_stats[d]["wall_s"]
+                 for d in range(self.nd)}
+        pending = {self.labels[d]: [(int(self.encs[i].n_ok), i)
+                                    for i in self.queues[d]]
+                   for d in range(self.nd)}
+        plan = _fleet.steal_plan(pending, walls)
+        if plan is None:
+            return
+        fdi = self.labels.index(plan["from"])
+        tdi = self.labels.index(plan["to"])
+        for i in plan["keys"]:
+            self.queues[fdi].remove(i)
+            self.queues[tdi].append(i)
+        self.shard_stats[tdi]["steals"] += len(plan["keys"])
+        self.steals += len(plan["keys"])
+        self.completed_since_steal = 0
+        if self.skew_before is None:
+            self.skew_before = skew
+        self._event({"event": "steal", "reason": "work-skew",
+                     "poll": poll, "wall_s": round(wall, 4),
+                     "round": rnd,
+                     "from_shard": fdi, "to_shard": tdi,
+                     "keys": [self._ki(i) for i in plan["keys"]],
+                     "skew": skew,
+                     "est_moved": plan["est_moved"]})
+
+    # -- results ------------------------------------------------------
+    def retire(self, sl: int, row: np.ndarray, *, found: bool,
+               empty: bool, overflow: bool, budget: bool, K: int,
+               stalled: bool = False, timed_out: bool = False
+               ) -> None:
+        """One decided (or abandoned) lane becomes a per-key result.
+        Keys whose device verdict stays "unknown" and that are owed an
+        oracle fallback are parked in `pending_fallback` — the shard
+        block is annotated ONCE, after the oracle ran (streamed-path
+        semantics: a key is counted decided exactly once)."""
+        i = int(self.slot_key[sl])
+        self.slot_key[sl] = -1
+        e = self.encs[i]
+        di = sl // self.s_d
+        wall = _time.monotonic() - self.slot_t0[sl]
+        stats = row[4:10]
+        rounds = int(stats[5])
+        n_total = int(e.n_ok + e.n_info)
+        detail = {
+            "W": e.window_raw, "W_pad": self.params["W"], "K": K,
+            "configs_explored": int(stats[0]),
+            "util": {
+                "rounds": rounds,
+                "frontier_fill": round(
+                    int(stats[0]) / max(rounds * K, 1), 4),
+                "memo_hit_rate": _occ.memo_hit_rate(
+                    int(stats[3]), int(stats[4]))},
+            "occupancy": {
+                "lane": sl, "K": K,
+                "fill_last": round(int(row[0]) / max(K, 1), 4),
+                "rounds": rounds,
+                "hint": _adapt.recommend(
+                    self.params["ladder"],
+                    int(stats[0]) / max(rounds, 1))},
+            "mesh": {"shard": di, "slot": sl, "group": self.group}}
+        if found:
+            res = {"valid?": True, "op_count": n_total, **detail}
+        elif empty and not overflow:
+            res = {"valid?": False, "op_count": n_total,
+                   "max_linearized": int(stats[2]), **detail}
+        else:
+            cause = ("stalled" if stalled
+                     else "backlog-overflow" if overflow
+                     else "config-limit" if budget else "timeout")
+            res = {"valid?": "unknown", "cause": cause,
+                   "op_count": n_total, **detail}
+            if stalled:
+                res["partial"] = {"configs_explored": int(stats[0]),
+                                  "rounds": rounds,
+                                  "ops_linearized": int(stats[2])}
+        info = {"key_index": self._ki(i), "device": self.labels[di],
+                "device_index": di, "t0": self.slot_t0[sl],
+                "wall_s": wall,
+                "extra": {"rounds": rounds,
+                          "configs_explored": int(stats[0])}}
+        self.shard_stats[di]["keys"] += 1
+        self.shard_stats[di]["wall_s"] = round(
+            self.shard_stats[di]["wall_s"] + wall, 4)
+        # the skew telemetry reads these (device + wall + t0 are what
+        # summarize/steal_plan consume); the fleet registry gets the
+        # ONE annotated shard below / after fallback
+        self.completed_shards.append(
+            {"device": self.labels[di], "wall_s": wall,
+             "key_index": info["key_index"], "t0": self.slot_t0[sl]})
+        self.completed_since_steal += 1
+        if res.get("valid?") == "unknown" and self.oracle_fallback \
+                and res.get("cause") in ("backlog-overflow",
+                                         "config-limit"):
+            self.pending_fallback[i] = (res, info)
+            return
+        self.results[i] = _annotate_shard(
+            res, key_index=info["key_index"], device=info["device"],
+            device_index=di, engine="device-mesh", t0=info["t0"],
+            wall_s=wall, extra=info["extra"])
+
+    def summary(self, k_final: int) -> dict:
+        fin = _fleet.summarize(self.completed_shards)
+        return {"group": self.group, "n_devices": self.nd,
+                "lanes_per_device": self.s_d,
+                "keys": len(self.idxs),
+                "K_final": k_final, "ladder": list(
+                    self.params["ladder"]),
+                "steals": self.steals, "rebuckets": self.rebuckets,
+                "work_skew_before": self.skew_before,
+                "work_skew_after": fin.get("work_skew"),
+                "per_shard": {self.labels[d]: dict(self.shard_stats[d])
+                              for d in range(self.nd)},
+                "events": list(self.events)}
+
+
+def check_mesh(model: Model, histories: Sequence[History], *,
+               encs: Sequence[Encoded],
+               time_limit: Optional[float] = None,
+               max_configs: int = 50_000_000,
+               mesh=None, oracle_fallback: bool = True,
+               key_indices: Optional[Sequence[int]] = None,
+               chunk: int = 1024,
+               lanes_per_device: Optional[int] = None,
+               assign: str = "lpt", steal: bool = True
+               ) -> Optional[list]:
+    """Check `histories` (all encodable — the caller host-decides the
+    rest, as `check_batched` does) over the mesh with the lane-packing
+    scheduler. Returns one result per history, in order — or None when
+    the mesh path must degrade (single device, backend init timeout,
+    or an infeasible preflight mesh plan): None never means failure,
+    it means "take the streamed path"."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    max_configs = min(max_configs, 2**30)
+    if len(encs) < 2:
+        return None
+    if not _backend_ready_or_fallback(time_limit):
+        return None
+    if mesh is None:
+        mesh = default_mesh()
+    nd = int(mesh.devices.size)
+    if nd < 2:
+        return None
+    deadline = _time.monotonic() + time_limit if time_limit else None
+
+    groups = [("narrow", [i for i, e in enumerate(encs)
+                          if e.window_raw <= 32]),
+              ("wide", [i for i, e in enumerate(encs)
+                        if e.window_raw > 32])]
+    groups = [(g, idxs) for g, idxs in groups if idxs]
+
+    # admission: the mesh plan nodes (P001/P003) — an infeasible lane
+    # group degrades the WHOLE request to the streamed path (whose own
+    # per-group gate re-decides with per-key kernels)
+    from ..analysis import preflight
+    s_d_plan = int(lanes_per_device
+                   or lanes_for(max(len(i) for _, i in groups), nd))
+    bad = preflight.gate_mesh(
+        list(encs), n_devices=nd, lanes_per_device=s_d_plan,
+        where="parallel.mesh",
+        axes=tuple(str(a) for a in mesh.axis_names))
+    if bad is not None:
+        return None
+
+    axis = tuple(mesh.axis_names) if len(mesh.axis_names) > 1 \
+        else mesh.axis_names[0]
+
+    def shard(x):
+        spec = PartitionSpec(axis) if x.ndim else PartitionSpec()
+        return jax.device_put(x, NamedSharding(mesh, spec))
+
+    status = _fleet.get_default()
+    mx = _metrics.get_default()
+    wd = _watchdog.get_default()
+    dm = _devices.get_default()
+    t0_all = _time.monotonic()
+    results: list = [None] * len(histories)
+    run_summaries: list = []
+
+    for gname, idxs in groups:
+        gr = _GroupRun(encs, idxs, mesh, chunk=chunk,
+                       lanes_per_device=lanes_per_device,
+                       assign=assign, deadline=deadline,
+                       max_configs=max_configs,
+                       oracle_fallback=oracle_fallback,
+                       key_indices=key_indices, group=gname,
+                       steal=steal)
+        k_final = _run_group(gr, shard, status, mx, wd, dm, t0_all)
+        run_summaries.append(gr.summary(k_final))
+        for i, res in gr.results.items():
+            results[i] = res
+        # oracle fallback for kernel-unknown keys, inside what remains
+        # of the deadline (competition semantics, annotated once)
+        for i, (res, info) in gr.pending_fallback.items():
+            out = _oracle_fallback(model, histories[i], deadline, res)
+            results[i] = _annotate_shard(
+                out, key_index=info["key_index"],
+                device=info["device"],
+                device_index=info["device_index"],
+                engine=str(out.get("engine") or "device-mesh"),
+                t0=info["t0"],
+                wall_s=_time.monotonic() - info["t0"],
+                extra=info["extra"])
+
+    total = {
+        "wall_s": round(_time.monotonic() - t0_all, 4),
+        "n_devices": nd,
+        "keys": len(histories),
+        "steals": sum(s["steals"] for s in run_summaries),
+        "rebuckets": sum(s["rebuckets"] for s in run_summaries),
+        "work_skew_before": next(
+            (s["work_skew_before"] for s in run_summaries
+             if s.get("work_skew_before") is not None), None),
+        "work_skew_after": next(
+            (s["work_skew_after"] for s in run_summaries
+             if s.get("work_skew_after") is not None), None),
+        "groups": run_summaries,
+        "per_shard": _merge_shards(run_summaries),
+    }
+    _record_run(total)
+    return results
+
+
+def _merge_shards(summaries: list) -> dict:
+    out: dict = {}
+    for s in summaries:
+        for dev, row in (s.get("per_shard") or {}).items():
+            d = out.setdefault(dev, {"keys": 0, "wall_s": 0.0,
+                                     "steals": 0})
+            d["keys"] += row.get("keys", 0)
+            d["wall_s"] = round(d["wall_s"]
+                                + float(row.get("wall_s") or 0.0), 4)
+            d["steals"] += row.get("steals", 0)
+    return out
+
+
+def _run_group(gr: _GroupRun, shard, status, mx, wd, dm,
+               t0_all: float) -> int:
+    """The scheduler loop for one lane group. Returns the final K."""
+    import jax.numpy as jnp
+
+    p = gr.params
+    ladder = p["ladder"]
+    K = ladder[0]
+    jinit, vchunk = _mesh_compiled(
+        p["n_pad"], p["ic_pad"], p["W"], p["S"], p["O"], K,
+        p["H"], p["B"], p["chunk"], p["probes"], p["L"], p["accel"])
+    kern = "wgl32" if not p["L"] else "wgln"
+    gr.pack_initial()
+
+    def upload():
+        # refills re-upload the WHOLE const set: device_put is a pure
+        # transfer with a shape-stable layout, so the zero-recompile
+        # warm contract holds no matter how many lanes changed —
+        # per-lane .at[idx].set updates would key a fresh executable
+        # on every distinct refill count. The table is the dominant
+        # buffer (~bk*S*O*4 B per refill poll); revisit with donated
+        # scatter updates if transfers show up in mesh profiles.
+        return tuple(shard(jnp.asarray(a)) for a in (
+            gr.c_inv, gr.c_ret, gr.c_opc, gr.c_suf, gr.c_iinv,
+            gr.c_iopc, gr.c_table, gr.c_nok, gr.c_ninfo, gr.c_maxcfg))
+
+    def fresh_init():
+        # two separate trees: vchunk DONATES its carry argument, so
+        # the reset template must never alias the live carry
+        return _shard_tree(shard, jinit(jnp.zeros(gr.bk, jnp.int32)))
+
+    consts = upload()
+    carry = fresh_init()
+    init_carry = fresh_init()
+
+    hb = wd.register("wgl-mesh", device=f"mesh[{gr.nd}]",
+                     grace_s=300.0)
+    dmark = dm.mark(where="mesh") if dm.enabled else None
+    t0 = _time.monotonic()
+    stalled = timed_out = False
+    n_polls = 0
+    sparse_streak = 0
+    occ_budget = 8192
+    s = None
+    try:
+        while True:
+            if wd.cancelled(hb):
+                stalled = True
+                break
+            t_poll = _time.monotonic()
+            carry, summary = vchunk(consts, carry)
+            s = np.asarray(summary)
+            n_polls += 1
+            wall = _time.monotonic() - t0_all
+            if dmark is not None:
+                dm.sample(where="mesh", mx=mx)
+            fr_cnt, flags, stats = s[:, 0], s[:, 1:4], s[:, 4:10]
+            found = flags[:, 0] != 0
+            overflow = flags[:, 1] != 0
+            empty = fr_cnt == 0
+            budget = stats[:, 0] >= gr.max_configs
+            active = gr.slot_key >= 0
+            decided = active & (found | empty | budget)
+            live = active & ~decided
+
+            # per-lane deltas (rebucket hints) BEFORE retirement
+            r_delta = np.maximum(stats[:, 5].astype(np.int64)
+                                 - gr.prev_rounds, 0)
+            e_delta = np.maximum(stats[:, 0].astype(np.int64)
+                                 - gr.prev_expl, 0)
+            occupied = np.where(r_delta > 0,
+                                e_delta / np.maximum(r_delta, 1), 0.0)
+            if mx.enabled:
+                fills = np.round(fr_cnt / max(K, 1), 4)
+                hints = [_adapt.recommend(ladder, float(occupied[sl]))
+                         for sl in range(gr.bk)]
+                mx.series(
+                    "wgl_batched_lanes",
+                    "per-poll per-lane frontier fill of the "
+                    "mesh-batched search").append({
+                        "poll": n_polls - 1,
+                        "wall_s": round(wall, 4),
+                        "K": K, "kernel": kern,
+                        "live": int(live.sum()),
+                        "empty_lanes": int(
+                            (fr_cnt[active] == 0).sum()),
+                        "fill": [float(f) for f in fills],
+                        "hints": [int(h) for h in hints],
+                        "scheduler": "mesh"})
+                rounds_series = mx.series(
+                    "wgl_batched_rounds",
+                    "per-round per-lane frontier fill drained from "
+                    "the vmapped kernel rings (round x lane heatmap "
+                    "input)")
+                if occ_budget > 0:
+                    for sl in np.nonzero(active)[0]:
+                        rows, _ = _occ.drain_chunk(
+                            s[sl], int(gr.prev_rounds[sl]), K)
+                        for r in rows[:max(0, occ_budget)]:
+                            occ_budget -= 1
+                            rounds_series.append({
+                                "round": r["round"], "lane": int(sl),
+                                "fill": r["fill"],
+                                "frontier": r["frontier"],
+                                "device": int(sl // gr.s_d)})
+                    if occ_budget <= 0:
+                        rounds_series.append({
+                            "round": -1, "lane": -1, "fill": 0.0,
+                            "frontier": 0,
+                            "note": "point budget exhausted; later "
+                                    "rounds not drained"})
+                        occ_budget = -1
+            gr.prev_expl = stats[:, 0].astype(np.int64)
+            prev_rounds_next = stats[:, 5].astype(np.int64)
+
+            n_act = int(active.sum())
+            wd.beat(hb, live_keys=int(live.sum()),
+                    decided_keys=len(gr.results)
+                    + len(gr.pending_fallback),
+                    configs_explored=int(stats[active, 0].sum())
+                    if n_act else 0)
+            if status.enabled:
+                status.search_poll({
+                    "mode": "mesh-sched", "kernel": kern, "K": K,
+                    "frontier": int(fr_cnt[active].sum())
+                    if n_act else 0,
+                    "backlog": int(s[active, 10].sum())
+                    if n_act else 0,
+                    "explored": int(stats[active, 0].sum())
+                    if n_act else 0,
+                    "poll_s": round(_time.monotonic() - t_poll, 4)},
+                    search_id="mesh")
+                af = (fr_cnt[active] / max(K, 1) if n_act
+                      else np.zeros(1))
+                status.occupancy_poll({
+                    "mode": "mesh", "kernel": kern,
+                    "platform": f"mesh[{gr.nd}]", "K": K,
+                    "fill_last": round(float(af.mean()), 4),
+                    "fill_mean": round(float(af.mean()), 4),
+                    "lanes": {"n": n_act,
+                              "fill_min": round(float(af.min()), 4),
+                              "fill_max": round(float(af.max()), 4),
+                              "empty": int((fr_cnt[active] == 0).sum())
+                              if n_act else 0}},
+                    search_id="mesh")
+
+            # retire decided lanes
+            for sl in np.nonzero(decided)[0]:
+                gr.retire(int(sl), s[sl], found=bool(found[sl]),
+                          empty=bool(empty[sl]),
+                          overflow=bool(overflow[sl]),
+                          budget=bool(budget[sl]), K=K)
+
+            # act on the skew telemetry, then refill freed slots
+            rnd_now = int(stats[:, 5].max()) if len(stats) else 0
+            gr.maybe_steal(poll=n_polls - 1, wall=wall, rnd=rnd_now)
+            refill_mask = np.zeros(gr.bk, dtype=bool)
+            now = _time.monotonic()
+            # EVERY idle slot refills (not just this poll's retirees):
+            # a key stolen into a previously-idle shard's queue must
+            # be picked up at the very next poll
+            for sl in np.nonzero(gr.slot_key < 0)[0]:
+                i = gr.claim(int(sl) // gr.s_d)
+                if i is None:
+                    continue
+                gr.load_slot(int(sl), gr.encs[i])
+                gr.slot_key[sl] = i
+                gr.slot_t0[sl] = now
+                refill_mask[sl] = True
+                prev_rounds_next[sl] = 0
+                gr.prev_expl[sl] = 0
+            gr.prev_rounds = prev_rounds_next
+
+            # re-bucket through the ladder on the live lanes' hints
+            # (lanes refilled THIS poll carry a stale occupant's
+            # occupancy — they don't vote)
+            voters = (gr.slot_key >= 0) & ~refill_mask & live
+            if voters.any() and gr.rebuckets < MAX_REBUCKETS:
+                want = max(_adapt.recommend(ladder,
+                                            float(occupied[sl]))
+                           for sl in np.nonzero(voters)[0])
+                switch_to = None
+                if want > K:
+                    switch_to = want
+                    sparse_streak = 0
+                elif want < K:
+                    # shrink only when every still-expanding lane's
+                    # frontier fits the smaller beam (retired/found
+                    # lanes no longer expand — their rows are inert)
+                    fits = bool((fr_cnt[~found] <= want).all())
+                    sparse_streak = sparse_streak + 1 if fits else 0
+                    if sparse_streak >= 2:
+                        switch_to = want
+                        sparse_streak = 0
+                else:
+                    sparse_streak = 0
+                if switch_to is not None:
+                    carry = _migrate_fn(switch_to)(carry)
+                    jinit, vchunk = _mesh_compiled(
+                        p["n_pad"], p["ic_pad"], p["W"], p["S"],
+                        p["O"], switch_to, p["H"], p["B"], p["chunk"],
+                        p["probes"], p["L"], p["accel"])
+                    init_carry = fresh_init()
+                    gr.rebuckets += 1
+                    gr._event({"event": "rebucket",
+                               "poll": n_polls - 1,
+                               "wall_s": round(wall, 4),
+                               "round": rnd_now,
+                               "from_K": K, "to_K": switch_to,
+                               "reason": ("explored-threshold"
+                                          if switch_to > K
+                                          else "sparse-frontier")})
+                    K = switch_to
+
+            if refill_mask.any():
+                consts = upload()
+                carry = _reset_fn()(carry, init_carry,
+                                    jnp.asarray(refill_mask))
+
+            if not (gr.slot_key >= 0).any() \
+                    and not any(gr.queues[d] for d in range(gr.nd)):
+                break
+            if gr.deadline is not None \
+                    and _time.monotonic() > gr.deadline:
+                timed_out = True
+                break
+    finally:
+        wd.unregister(hb)
+        if dmark is not None:
+            dm.measured(dmark, where="mesh")
+
+    # keys the loop never decided (deadline / stall): report partials,
+    # never silence — active slots off the last summary, pending keys
+    # as plain timeouts
+    if stalled or timed_out:
+        cause = "stalled" if stalled else "timeout"
+        for sl in np.nonzero(gr.slot_key >= 0)[0]:
+            row = (s[sl] if s is not None
+                   else np.zeros(16, dtype=np.int64))
+            gr.retire(int(sl), row, found=False, empty=False,
+                      overflow=False, budget=False, K=K,
+                      stalled=stalled, timed_out=timed_out)
+        for d in range(gr.nd):
+            while gr.queues[d]:
+                i = gr.queues[d].popleft()
+                res = {"valid?": "unknown", "cause": cause,
+                       "op_count": int(gr.encs[i].n_ok
+                                       + gr.encs[i].n_info)}
+                gr.results[i] = _annotate_shard(
+                    res, key_index=gr._ki(i),
+                    device=gr.labels[d], device_index=d,
+                    engine="none", t0=_time.monotonic(), wall_s=0.0)
+    return K

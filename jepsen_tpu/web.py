@@ -195,6 +195,18 @@ def status_snapshot(store_root: str) -> dict:
                             if mem.get(k) is not None}
     except Exception:  # noqa: BLE001 — the status answer must not
         snap.setdefault("hbm", {"active": False})  # need the monitor
+    # mesh fan-out scheduler (parallel/mesh.py): runs scheduled in
+    # this process win; a mirror from another process keeps its own
+    # block, and the idle stub keeps the documented schema answerable
+    try:
+        from .parallel import mesh as mesh_mod
+        ms = mesh_mod.snapshot()
+        if ms["runs"] or "mesh" not in snap:
+            snap["mesh"] = ms
+    except Exception:  # noqa: BLE001 — the status answer must not
+        snap.setdefault("mesh",        # depend on the mesh plane
+                        {"active": False, "runs": 0, "steals": 0,
+                         "rebuckets": 0, "last": None})
     # diagnosis plane (doctor.py): diagnoses run in this process win;
     # a mirror from another process keeps its own block, and the idle
     # stub keeps the documented schema answerable
@@ -343,6 +355,17 @@ def render_status(store_root: str) -> bytes:
             + (f" &middot; peak seen {_esc(_fmt_bytes(peak))}"
                if peak is not None else "")
             + " &middot; <a href='/devices'>devices panel</a></p>")
+    ms = s.get("mesh") or {}
+    if ms.get("runs"):
+        last = ms.get("last") or {}
+        parts.append(
+            f"<p>mesh fan-out: {_esc(ms.get('runs'))} run(s) &middot; "
+            f"steals {_esc(ms.get('steals'))} &middot; rebuckets "
+            f"{_esc(ms.get('rebuckets'))}"
+            + (f" &middot; last skew "
+               f"{_esc(last.get('work_skew_after'))} over "
+               f"{_esc(last.get('n_devices'))} shards"
+               if last else "") + "</p>")
     dc = s.get("doctor") or {}
     top = dc.get("top")
     if dc.get("checked") and top:

@@ -22,6 +22,9 @@ JAX_PLATFORMS=cpu python scripts/adaptive_smoke.py
 echo "== elle device-plane smoke =="
 JAX_PLATFORMS=cpu python scripts/elle_smoke.py
 
+echo "== mesh fan-out smoke =="
+JAX_PLATFORMS=cpu python scripts/mesh_smoke.py
+
 echo "== device telemetry smoke =="
 JAX_PLATFORMS=cpu python scripts/device_telemetry_smoke.py
 

@@ -1,0 +1,189 @@
+#!/usr/bin/env python
+"""Fast CI gate for the mesh-sharded fan-out (parallel/mesh.py).
+
+Runs the lane-packing scheduler on a 2x4 fake-device CPU mesh and
+fails loudly when a scheduler regression lands:
+
+  * **parity** — mesh verdicts equal the streamed path's and the host
+    oracle's on a mixed valid/invalid key set;
+  * **steal** — a deliberately skewed workload (block assignment,
+    heavy keys front-loaded on shard 0) makes the work-skew trigger
+    fire EXACTLY once, with per-shard attribution in the `mesh_sched`
+    series and the post-steal skew below the pre-steal value;
+  * **warm plan** — after `aot.precompile_mesh_plan`, a full
+    `check_mesh` run stays at ZERO XLA recompiles under CompileGuard
+    (retire/refill resets, rebucket migrations and all);
+  * the recorded `mesh_sched` / `wgl_batched_lanes` series lint clean
+    against scripts/telemetry_lint.py.
+
+~40 s on a CI cpu. Exit 0 clean, 1 on any violation.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from jepsen_tpu import metrics, synth
+    from jepsen_tpu.analysis import guards
+    from jepsen_tpu.models import cas_register
+    from jepsen_tpu.ops import aot, wgl_ref
+    from jepsen_tpu.ops.encode import encode
+    from jepsen_tpu.parallel import check_streamed
+    from jepsen_tpu.parallel import mesh as mesh_mod
+    from jepsen_tpu.parallel.batched import shared_shape_bucket
+
+    failures = []
+
+    def check(cond, msg):
+        print(("ok   " if cond else "FAIL ") + msg)
+        if not cond:
+            failures.append(msg)
+
+    devs = np.asarray(jax.devices()[:8]).reshape(2, 4)
+    mesh = Mesh(devs, ("hosts", "chips"))  # the 2-D pod layout
+    model = cas_register()
+
+    # -- parity: mesh == streamed == oracle on mixed keys -----------
+    hists = [synth.cas_register_history(
+        30, n_procs=3, seed=s, lie_p=(0.08 if s % 3 == 0 else 0.0),
+        crash_p=0.05) for s in range(12)]
+    encs = [encode(model, h) for h in hists]
+    res_m = mesh_mod.check_mesh(model, hists, encs=encs, mesh=mesh,
+                                chunk=64, time_limit=120)
+    check(res_m is not None, "mesh path ran (no degrade)")
+    res_s = check_streamed(model, hists, encs=encs, race=False,
+                           time_limit=120)
+    ora = [wgl_ref.check(model, h) for h in hists]
+    check(all(a["valid?"] == o["valid?"]
+              for a, o in zip(res_m or [], ora)),
+          "mesh verdicts == host oracle (12 mixed keys)")
+    check(all(a["valid?"] == b["valid?"]
+              for a, b in zip(res_m or [], res_s)),
+          "mesh verdicts == streamed verdicts")
+    check(all(r["shard"]["engine"] == "device-mesh"
+              for r in res_m or []),
+          "every key decided by the device-mesh engine")
+
+    # -- skew-triggered steal fires exactly once --------------------
+    # 16 keys, 2 per shard (block assignment), shard 0's block heavy:
+    # the tiny shards finish fast, the first heavy completion trips
+    # the work_skew gate while ONE heavy key is still pending — one
+    # steal moves it to the laziest shard and empties the donor
+    # queue, so a second fire is impossible.
+    hists2 = [synth.cas_register_history(200 if j < 2 else 24,
+                                         n_procs=3, seed=j)
+              for j in range(16)]
+    encs2 = [encode(model, h) for h in hists2]
+    # warm the scenario's plan: per-key walls drive the skew
+    # telemetry, and a compile folded into the first poll would warp
+    # every wall by seconds
+    aot.precompile_mesh_plan(shared_shape_bucket(encs2), mesh,
+                             lanes_per_device=1, chunk=16, save=False)
+    # no-steal baseline on the SAME workload: the honest pre-steal
+    # skew — the shard walls the run ends with when the scheduler is
+    # not allowed to move keys
+    with metrics.use(metrics.Registry()):
+        res_base = mesh_mod.check_mesh(model, hists2, encs=encs2,
+                                       mesh=mesh, lanes_per_device=1,
+                                       assign="block", chunk=16,
+                                       steal=False, time_limit=120)
+    base = mesh_mod.last_summary() or {}
+    check(res_base is not None and base.get("steals") == 0,
+          "no-steal baseline ran with zero steals")
+    reg = metrics.Registry()
+    with metrics.use(reg):
+        res2 = mesh_mod.check_mesh(model, hists2, encs=encs2,
+                                   mesh=mesh, lanes_per_device=1,
+                                   assign="block", chunk=16,
+                                   time_limit=120)
+    check(res2 is not None
+          and all(r["valid?"] is True for r in res2),
+          "skew scenario: all keys decided valid")
+    summ = mesh_mod.last_summary() or {}
+    steals = [p for p in reg.series("mesh_sched").points
+              if p.get("event") == "steal"]
+    check(len(steals) == 1,
+          f"work-skew steal fired exactly once (saw {len(steals)})")
+    check(steals and steals[0].get("reason") == "work-skew",
+          "the steal's recorded reason is work-skew")
+    check(steals and steals[0].get("from_shard") == 0,
+          "the steal moved keys off the overloaded shard 0")
+    skew_b = base.get("work_skew_after")
+    skew_a = summ.get("work_skew_after")
+    check(skew_b is not None and skew_a is not None
+          and skew_a < skew_b,
+          f"work_skew after stealing {skew_a} < no-steal baseline "
+          f"{skew_b}")
+    check(summ.get("work_skew_before") is not None,
+          "the trigger-time skew is recorded on the summary")
+    per_shard = summ.get("per_shard") or {}
+    check(sum(s.get("keys", 0) for s in per_shard.values()) == 16,
+          "per-shard key attribution sums to the key set")
+
+    # -- zero-recompile warm plan -----------------------------------
+    # a FRESH key count (20 keys -> lanes_for gives a batch width no
+    # earlier section compiled), so the warm plan itself — not a
+    # leftover cache from the parity run — must provide every
+    # executable the scheduler touches
+    hists3 = [synth.cas_register_history(
+        30, n_procs=3, seed=100 + s,
+        lie_p=(0.08 if s % 4 == 0 else 0.0)) for s in range(20)]
+    encs3 = [encode(model, h) for h in hists3]
+    bucket = shared_shape_bucket(encs3)
+    compile_s = aot.precompile_mesh_plan(bucket, mesh,
+                                         n_keys=len(encs3),
+                                         chunk=64, save=False)
+    check(bool(compile_s), f"warm plan compiled ladder {compile_s}")
+    with guards.CompileGuard(max_compiles=0, name="mesh-warm") as g:
+        res3 = mesh_mod.check_mesh(model, hists3, encs=encs3,
+                                   mesh=mesh, chunk=64,
+                                   time_limit=120)
+    check(res3 is not None and g.compiles == 0,
+          "warm check_mesh runs at zero XLA recompiles "
+          "(fresh batch width)")
+    check(all(r["valid?"] == wgl_ref.check(model, h)["valid?"]
+              for r, h in zip(res3 or [], hists3)),
+          "warm-run verdicts still match the oracle")
+
+    # -- recorded series lint clean ---------------------------------
+    import subprocess
+    import tempfile
+    with tempfile.TemporaryDirectory() as td:
+        path = os.path.join(td, "mesh_metrics.jsonl")
+        reg.export_jsonl(path)
+        proc = subprocess.run(
+            [sys.executable,
+             os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "telemetry_lint.py"), path],
+            capture_output=True, text=True)
+        check(proc.returncode == 0,
+              "mesh_sched/wgl_batched_lanes series lint clean"
+              + ("" if proc.returncode == 0
+                 else f": {proc.stderr[-400:]}"))
+        series = {json.loads(ln).get("series")
+                  for ln in open(path) if '"sample"' in ln}
+        check("mesh_sched" in series,
+              "mesh_sched series was actually recorded")
+
+    print(f"\nmesh smoke: {len(failures)} failure(s)")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -99,6 +99,17 @@ SERIES_SCHEMAS = {
     # severity one of the documented levels
     "doctor": {"rule": str, "severity": str, "target": str,
                "summary": str, "where": str},
+    # the mesh fan-out scheduler (parallel/mesh.py): one point per
+    # scheduler action — event in {steal, rebucket}, poll/wall stamp
+    # the acting poll; steals carry from_shard/to_shard/keys,
+    # rebuckets from_K/to_K/reason
+    "mesh_sched": {"event": str, "poll": int, "wall_s": NUM,
+                   "group": str},
+    # the streamed pool's applied rebucket hints (parallel/batched.py
+    # check_streamed): keys moved smallest-first off the busiest
+    # device's pending queue when work_skew trips
+    "fleet_sched": {"event": str, "from": str, "to": str,
+                    "keys": list, "skew_before": NUM},
 }
 
 # doctor.py's rule catalog + severity levels — duplicated here as the

@@ -1,0 +1,523 @@
+"""Mesh-sharded fan-out scheduler tests (parallel/mesh.py, ISSUE 14).
+
+Runs on the 8-device virtual CPU mesh from conftest.py. The
+end-to-end acceptance path (skew-triggered steal exactly once,
+no-steal baseline comparison, zero-recompile warm plan under
+CompileGuard) lives in scripts/mesh_smoke.py; this file covers the
+scheduler's parts: lane pack/unpack round-trips, frontier migration,
+verdict parity vs the streamed path and the host oracle (with a
+mid-run rebucket), the synthetic-skew steal decision, the preflight
+mesh degrade, and the mesh_sched/fleet_sched series schemas.
+"""
+
+import importlib.util
+import os
+import sys
+from unittest import mock
+
+import numpy as np
+import pytest
+
+from jepsen_tpu import fleet, metrics, synth
+from jepsen_tpu.models import core as models
+from jepsen_tpu.ops import wgl_ref
+from jepsen_tpu.ops.encode import INF, encode
+from jepsen_tpu.parallel import check_streamed, default_mesh
+from jepsen_tpu.parallel import mesh as mesh_mod
+
+LINT = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "scripts", "telemetry_lint.py")
+
+
+def _lint_mod():
+    spec = importlib.util.spec_from_file_location("tlint", LINT)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _group(encs, idxs=None, **kw):
+    kw.setdefault("chunk", 64)
+    kw.setdefault("lanes_per_device", 1)
+    kw.setdefault("assign", "lpt")
+    kw.setdefault("deadline", None)
+    kw.setdefault("max_configs", 2**20)
+    kw.setdefault("oracle_fallback", False)
+    kw.setdefault("key_indices", None)
+    kw.setdefault("group", "narrow")
+    return mesh_mod._GroupRun(encs, idxs or list(range(len(encs))),
+                              default_mesh(), **kw)
+
+
+# ---------------------------------------------------------------------------
+# lane packing
+# ---------------------------------------------------------------------------
+
+class TestLanePacking:
+    def test_pack_unpack_roundtrip(self):
+        m = models.cas_register()
+        encs = [encode(m, synth.cas_register_history(
+            20 + 8 * i, n_procs=3, seed=i)) for i in range(3)]
+        gr = _group(encs)
+        for sl, e in enumerate(encs):
+            gr.load_slot(sl, e)
+            back = gr.unpack_slot(sl)
+            # unpack trims the bucket pad back to the key's own rows
+            real = int((np.asarray(e.inv) < INF).sum())
+            np.testing.assert_array_equal(back["inv"], e.inv[:real])
+            np.testing.assert_array_equal(back["ret"], e.ret[:real])
+            np.testing.assert_array_equal(back["opcode"],
+                                          e.opcode[:real])
+            assert back["n_ok"] == e.n_ok
+            assert back["n_info"] == e.n_info
+        # a cleared slot is a dummy lane: no ops, zero n_ok
+        gr.clear_slot(0)
+        assert gr.unpack_slot(0)["n_ok"] == 0
+        assert (gr.c_inv[0] == INF).all()
+
+    def test_reload_after_retire_overwrites_fully(self):
+        """A slot reused for a SMALLER key must not leak the previous
+        occupant's rows past the new key's length."""
+        m = models.cas_register()
+        big = encode(m, synth.cas_register_history(60, seed=1))
+        small = encode(m, synth.cas_register_history(16, seed=2))
+        gr = _group([big, small])
+        gr.load_slot(0, big)
+        gr.load_slot(0, small)
+        back = gr.unpack_slot(0)
+        real = int((np.asarray(small.inv) < INF).sum())
+        assert len(back["inv"]) == real
+        np.testing.assert_array_equal(back["inv"], small.inv[:real])
+
+    def test_lpt_assignment_balances_est(self):
+        m = models.cas_register()
+        encs = [encode(m, synth.cas_register_history(
+            16 + 8 * i, n_procs=3, seed=i)) for i in range(16)]
+        gr = _group(encs)
+        loads = [sum(int(encs[i].n_ok) for i in q)
+                 for q in gr.queues]
+        # LPT keeps the max/min pending-op spread tight
+        assert max(loads) - min(loads) <= max(
+            int(e.n_ok) for e in encs)
+
+    def test_block_assignment_is_contiguous(self):
+        m = models.cas_register()
+        encs = [encode(m, synth.cas_register_history(20, seed=i))
+                for i in range(16)]
+        gr = _group(encs, assign="block")
+        assert list(gr.queues[0]) == [0, 1]
+        assert list(gr.queues[7]) == [14, 15]
+
+
+# ---------------------------------------------------------------------------
+# frontier migration
+# ---------------------------------------------------------------------------
+
+class TestMigration:
+    def test_migrate_frontier_batch_roundtrip(self):
+        import jax.numpy as jnp
+
+        from jepsen_tpu.ops.adapt import migrate_frontier_batch
+
+        fr = jnp.arange(2 * 4 * 3, dtype=jnp.int32).reshape(2, 4, 3)
+        rest = (jnp.int32(1), jnp.zeros((2, 5), jnp.int32))
+        carry = (fr, *rest)
+        up = migrate_frontier_batch(carry, 16)
+        assert up[0].shape == (2, 16, 3)
+        np.testing.assert_array_equal(np.asarray(up[0][:, :4]),
+                                      np.asarray(fr))
+        assert (np.asarray(up[0][:, 4:]) == 0).all()
+        down = migrate_frontier_batch(up, 4)
+        np.testing.assert_array_equal(np.asarray(down[0]),
+                                      np.asarray(fr))
+        # untouched leaves ride along by identity
+        assert down[2] is carry[2]
+
+    def test_migrate_noop_at_same_k(self):
+        import jax.numpy as jnp
+
+        from jepsen_tpu.ops.adapt import migrate_frontier_batch
+
+        carry = (jnp.zeros((2, 4, 3), jnp.int32), jnp.int32(0))
+        assert migrate_frontier_batch(carry, 4) is carry
+
+
+# ---------------------------------------------------------------------------
+# parity (mesh == streamed == oracle), with a mid-run rebucket
+# ---------------------------------------------------------------------------
+
+class TestParity:
+    def test_mesh_verdicts_match_streamed_and_oracle(self):
+        """Mixed valid/invalid keys with two heavier ones: the
+        scheduler retires/refills, grows the ladder bucket at least
+        once (migrating every live frontier across the switch), and
+        still lands bit-equal verdicts with the streamed path and the
+        host oracle."""
+        m = models.cas_register()
+        hists = [synth.cas_register_history(
+            100 if s < 2 else 24, n_procs=3, seed=s,
+            lie_p=(0.1 if s % 3 == 1 else 0.0)) for s in range(10)]
+        encs = [encode(m, h) for h in hists]
+        reg = metrics.Registry()
+        with metrics.use(reg):
+            res_m = mesh_mod.check_mesh(
+                m, hists, encs=encs, lanes_per_device=1, chunk=16,
+                time_limit=120)
+        assert res_m is not None
+        # mesh-vs-STREAMED parity runs in scripts/mesh_smoke.py (CI);
+        # the host oracle is the authority here — streaming the same
+        # keys again would double this test's kernel compiles
+        for i, h in enumerate(hists):
+            ref = wgl_ref.check(m, h)
+            assert res_m[i]["valid?"] == ref["valid?"], (
+                i, res_m[i], ref["valid?"])
+        assert all(r["shard"]["engine"] == "device-mesh"
+                   for r in res_m)
+        summ = mesh_mod.last_summary()
+        assert summ["rebuckets"] >= 1, summ
+        ev = [p for p in reg.series("mesh_sched").points
+              if p["event"] == "rebucket"]
+        assert ev and ev[0]["to_K"] > ev[0]["from_K"]
+        # per-key mesh coordinates: shard/slot/group stamped
+        for r in res_m:
+            blk = r.get("mesh")
+            assert blk and blk["group"] == "narrow"
+            assert 0 <= blk["shard"] < 8
+
+    def test_results_keep_batch_key_indices(self):
+        m = models.cas_register()
+        hists = [synth.cas_register_history(24, seed=s)
+                 for s in range(5)]
+        encs = [encode(m, h) for h in hists]
+        reg = metrics.Registry()
+        with metrics.use(reg):
+            res = mesh_mod.check_mesh(m, hists, encs=encs,
+                                      key_indices=[3, 5, 7, 9, 11],
+                                      chunk=64, time_limit=60)
+        assert [r["shard"]["key_index"] for r in res] == \
+            [3, 5, 7, 9, 11]
+
+
+# ---------------------------------------------------------------------------
+# stealing under synthetic skew (host-side decision logic)
+# ---------------------------------------------------------------------------
+
+def _skewed_group():
+    """A fabricated mid-run state: every shard busy (active slots),
+    shard 0's completed wall 10x everyone's, and shard 0's pending
+    queue one key deeper than the laziest's — the exact inputs
+    maybe_steal reads."""
+    m = models.cas_register()
+    encs = [encode(m, synth.cas_register_history(24, seed=s))
+            for s in range(24)]
+    gr = _group(encs, assign="block")   # 3 keys per shard queue
+    gr.queues[0].append(gr.queues[1].pop())  # shard 0: 4, shard 1: 2
+    gr.slot_key[:] = 1                  # every lane looks active
+    for d in range(8):
+        gr.shard_stats[d]["wall_s"] = 10.0 if d == 0 else 1.0
+    gr.completed_shards = [
+        {"device": gr.labels[d], "wall_s": gr.shard_stats[d][
+            "wall_s"], "key_index": d, "t0": 0.0}
+        for d in range(8)]
+    gr.completed_since_steal = 1
+    return gr
+
+
+class TestStealing:
+
+    def test_synthetic_skew_moves_smallest_pending_key(self):
+        reg = metrics.Registry()
+        with metrics.use(reg):
+            gr = _skewed_group()
+            before = list(gr.queues[0])
+            gr.maybe_steal(poll=3, wall=1.0, rnd=42)
+        assert gr.steals >= 1
+        assert len(gr.queues[0]) < len(before)
+        moved = [p for p in reg.series("mesh_sched").points
+                 if p["event"] == "steal"]
+        assert moved and moved[0]["reason"] == "work-skew"
+        assert moved[0]["from_shard"] == 0
+        assert moved[0]["round"] == 42
+        assert gr.skew_before is not None
+        # smallest-first: the moved key's est is the queue minimum
+        est = {i: int(gr.encs[i].n_ok) for i in before}
+        assert est[moved[0]["keys"][0]] == min(est.values())
+
+    def test_no_steal_when_balanced(self):
+        reg = metrics.Registry()
+        with metrics.use(reg):
+            gr = _skewed_group()
+            for d in range(8):
+                gr.shard_stats[d]["wall_s"] = 1.0
+            for s in gr.completed_shards:
+                s["wall_s"] = 1.0
+            gr.maybe_steal(poll=0, wall=0.0)
+        assert gr.steals == 0
+        assert not reg.series("mesh_sched").points
+
+    def test_steal_disabled_never_moves(self):
+        reg = metrics.Registry()
+        with metrics.use(reg):
+            gr = _skewed_group()
+            gr.steal_enabled = False
+            gr.maybe_steal(poll=0, wall=0.0)
+        assert gr.steals == 0
+
+    def test_idle_pull_reaches_starving_shard(self):
+        """A shard with no active lanes and an empty queue pulls work
+        from a deep queue even before any completed-wall skew exists
+        (the skew gate cannot see a shard that never finishes)."""
+        reg = metrics.Registry()
+        with metrics.use(reg):
+            gr = _skewed_group()
+            gr.completed_shards = []  # no completions at all yet
+            gr.completed_since_steal = 0
+            # shard 7 idle: no slots active, queue empty
+            gr.queues[7].clear()
+            gr.slot_key[7 * gr.s_d:(7 + 1) * gr.s_d] = -1
+            gr.maybe_steal(poll=0, wall=0.0)
+        pts = [p for p in reg.series("mesh_sched").points
+               if p["event"] == "steal"]
+        assert pts and pts[0]["reason"] == "idle"
+        assert pts[0]["to_shard"] == 7
+        assert gr.queues[7]
+
+    def test_steal_plan_units(self):
+        # below the gate: no plan
+        assert fleet.steal_plan(
+            {"a": [(5, 1)], "b": []}, {"a": 1.0, "b": 0.9}) is None
+        # busiest has nothing pending: no plan
+        assert fleet.steal_plan(
+            {"a": [], "b": [(5, 1)]}, {"a": 10.0, "b": 1.0}) is None
+        # smallest-first until half the gap
+        plan = fleet.steal_plan(
+            {"a": [(8, 1), (2, 2), (4, 3)], "b": []},
+            {"a": 10.0, "b": 1.0})
+        assert plan["from"] == "a" and plan["to"] == "b"
+        assert plan["keys"] == [2, 3]  # 2 then 4 >= gap 7
+        assert plan["skew_before"] == 10.0
+        # single shard: no plan
+        assert fleet.steal_plan({"a": [(1, 1)]}, {"a": 5.0}) is None
+
+
+# ---------------------------------------------------------------------------
+# preflight mesh degrade
+# ---------------------------------------------------------------------------
+
+class TestPreflightMesh:
+    def test_plan_mesh_nodes_carry_mesh_annotation(self):
+        from jepsen_tpu.analysis import preflight
+        m = models.cas_register()
+        encs = [encode(m, synth.cas_register_history(30, seed=s))
+                for s in range(6)]
+        rep = preflight.plan_mesh(encs, n_devices=8,
+                                  lanes_per_device=2,
+                                  axes=("hosts", "chips"))
+        assert rep["kind"] == "mesh"
+        assert rep["verdict"] == "feasible"
+        assert rep["plan"], "no plan nodes"
+        for node in rep["plan"]:
+            assert node["mesh"]["n_devices"] == 8
+            assert node["mesh"]["axes"] == ["hosts", "chips"]
+        assert rep["mesh"]["lanes_per_device"] == 2
+
+    def test_infeasible_plan_degrades_not_crashes(self, monkeypatch):
+        from jepsen_tpu.analysis import preflight
+        m = models.cas_register()
+        encs = [encode(m, synth.cas_register_history(30, seed=s))
+                for s in range(6)]
+        monkeypatch.setenv("JEPSEN_TPU_PREFLIGHT_MEM_BUDGET", "1000")
+        rep = preflight.plan_mesh(encs, n_devices=8)
+        assert rep["verdict"] == "infeasible"
+        assert any(r["rule"] == "P001" for r in rep["rules"])
+        # the gate registers the delivered decision as a DEGRADE and
+        # hands the report back for the caller to stream instead
+        bad = preflight.gate_mesh(encs, n_devices=8, where="test")
+        assert bad is not None
+        snap = preflight.snapshot()
+        assert any(e["kind"] == "mesh" and e["verdict"] == "degrade"
+                   for e in snap["recent"])
+        # check_mesh answers the degrade with None — never a crash
+        hists = [synth.cas_register_history(30, seed=s)
+                 for s in range(6)]
+        assert mesh_mod.check_mesh(m, hists, encs=encs,
+                                   time_limit=10) is None
+
+    def test_compile_budget_names_mesh_warm_path(self):
+        from jepsen_tpu.analysis import preflight
+        m = models.cas_register()
+        encs = [encode(m, synth.cas_register_history(30, seed=s))
+                for s in range(6)]
+        rep = preflight.plan_mesh(encs, n_devices=8,
+                                  compile_budget=1)
+        p3 = [r for r in rep["rules"] if r["rule"] == "P003"]
+        assert p3 and "precompile_mesh_plan" in p3[0]["suggestion"]
+        assert rep["verdict"] == "degrade"
+
+
+# ---------------------------------------------------------------------------
+# streamed pool: applied rebucket hints (fleet_sched)
+# ---------------------------------------------------------------------------
+
+class TestStreamedRebalance:
+    def test_streamed_pool_applies_hint_and_records(self):
+        """When the completed walls show skew mid-run, the streamed
+        pool moves pending keys off the busiest device's queue and
+        records the applied hint as a fleet_sched event — D005's skew
+        is HANDLED, not just measured."""
+        m = models.cas_register()
+        hists = [synth.cas_register_history(24, n_procs=3, seed=s)
+                 for s in range(24)]
+        calls = []
+        real_plan = fleet.steal_plan
+
+        def fake_plan(pending, walls, skew_x=fleet.REBUCKET_SKEW_X):
+            # force one applied hint on the first evaluation that has
+            # anything pending, then defer to the real gate
+            if not calls:
+                for dev, keys in pending.items():
+                    if keys:
+                        others = [d for d in pending if d != dev]
+                        if not others:
+                            return None
+                        calls.append(dev)
+                        return {"from": dev, "to": others[0],
+                                "keys": [keys[0][1]],
+                                "est_moved": float(keys[0][0]),
+                                "skew_before": 9.9}
+                return None
+            return real_plan(pending, walls, skew_x)
+
+        reg = metrics.Registry()
+        with mock.patch.object(fleet, "steal_plan", fake_plan), \
+                metrics.use(reg):
+            res = check_streamed(m, hists, race=False,
+                                 time_limit=120)
+        assert all(r["valid?"] is True for r in res)
+        pts = reg.series("fleet_sched").points
+        assert pts, "no fleet_sched event recorded"
+        assert pts[0]["event"] == "rebucket"
+        assert pts[0]["skew_before"] == 9.9
+        assert isinstance(pts[0]["keys"], list) and pts[0]["keys"]
+        assert reg.counter("fleet_sched_total").samples()
+
+
+# ---------------------------------------------------------------------------
+# schemas + surfaces
+# ---------------------------------------------------------------------------
+
+class TestTelemetry:
+    def test_mesh_sched_series_lints_good(self):
+        lint = _lint_mod()
+        good = {"type": "sample", "series": "mesh_sched", "t": 1.0,
+                "event": "steal", "poll": 3, "wall_s": 1.5,
+                "group": "narrow", "from_shard": 0, "to_shard": 2,
+                "keys": [4]}
+        assert lint.lint_line(good, "t") == []
+        good_rb = {"type": "sample", "series": "mesh_sched", "t": 1.0,
+                   "event": "rebucket", "poll": 1, "wall_s": 0.2,
+                   "group": "wide", "from_K": 2, "to_K": 16,
+                   "reason": "explored-threshold"}
+        assert lint.lint_line(good_rb, "t") == []
+
+    def test_mesh_sched_series_drift_fails(self):
+        lint = _lint_mod()
+        drifted = {"type": "sample", "series": "mesh_sched", "t": 1.0,
+                   "poll": "three", "wall_s": 1.5, "group": "narrow"}
+        errs = lint.lint_line(drifted, "t")
+        assert any("event" in e for e in errs)
+        assert any("poll" in e for e in errs)
+
+    def test_fleet_sched_series_schema(self):
+        lint = _lint_mod()
+        good = {"type": "sample", "series": "fleet_sched", "t": 1.0,
+                "event": "rebucket", "from": "TFRT_CPU_0",
+                "to": "TFRT_CPU_1", "keys": [1, 2],
+                "skew_before": 1.5}
+        assert lint.lint_line(good, "t") == []
+        errs = lint.lint_line(
+            {"type": "sample", "series": "fleet_sched", "t": 1.0,
+             "event": "rebucket", "from": "a", "to": "b",
+             "keys": 2, "skew_before": 1.5}, "t")
+        assert any("keys" in e for e in errs)
+
+    def test_real_run_export_lints_clean(self, tmp_path):
+        import json
+        import subprocess
+        reg = metrics.Registry()
+        with metrics.use(reg):
+            gr = _skewed_group()
+            gr.maybe_steal(poll=0, wall=0.5, rnd=7)
+        path = str(tmp_path / "m.jsonl")
+        assert reg.export_jsonl(path) > 0
+        proc = subprocess.run([sys.executable, LINT, path],
+                              capture_output=True, text=True)
+        assert proc.returncode == 0, proc.stderr
+        series = {json.loads(ln)["series"] for ln in open(path)
+                  if '"sample"' in ln}
+        assert "mesh_sched" in series
+
+    def test_status_mesh_block(self):
+        snap = mesh_mod.snapshot()
+        assert {"active", "runs", "steals", "rebuckets",
+                "last"} <= set(snap)
+
+    def test_plan_cache_registry_roundtrip(self, tmp_path,
+                                           monkeypatch):
+        from jepsen_tpu import fs_cache
+        monkeypatch.setattr(fs_cache, "DIR", str(tmp_path))
+        m = models.cas_register()
+        encs = [encode(m, synth.cas_register_history(24, seed=s))
+                for s in range(4)]
+        from jepsen_tpu.parallel.batched import shared_shape_bucket
+        bucket = shared_shape_bucket(encs)
+        key = mesh_mod.plan_cache_key(bucket, n_devices=8,
+                                      lanes_per_device=2,
+                                      axes=("keys",),
+                                      model_name="cas")
+        fs_cache.save_data(key, {"bucket": bucket, "n_devices": 8,
+                                 "lanes_per_device": 2,
+                                 "axes": ["keys"], "model": "cas",
+                                 "chunk": 64})
+        plans = fs_cache.list_data(("mesh-plan",))
+        assert len(plans) == 1 and plans[0]["model"] == "cas"
+        # the restart re-warm delegates each recorded plan to
+        # warm_plan; device-count mismatches are skipped
+        from jepsen_tpu.ops import aot
+        warmed = []
+        with mock.patch.object(mesh_mod, "warm_plan",
+                               lambda b, **kw: warmed.append(kw)
+                               or {2: 0.1}):
+            out = aot.precompile_cached_mesh_plans(default_mesh())
+        assert len(out) == 1 and len(warmed) == 1
+        fs_cache.save_data(
+            mesh_mod.plan_cache_key(bucket, n_devices=4,
+                                    lanes_per_device=2,
+                                    axes=("keys",), model_name="x"),
+            {"bucket": bucket, "n_devices": 4, "lanes_per_device": 2,
+             "axes": ["keys"], "model": "x", "chunk": 64})
+        with mock.patch.object(mesh_mod, "warm_plan",
+                               lambda b, **kw: {2: 0.1}):
+            out = aot.precompile_cached_mesh_plans(default_mesh())
+        assert len(out) == 1  # the 4-device plan was skipped
+
+
+# ---------------------------------------------------------------------------
+# heatmap: scheduler-event markers
+# ---------------------------------------------------------------------------
+
+class TestHeatmapEvents:
+    def test_heatmap_renders_event_markers(self, tmp_path):
+        pytest.importorskip("matplotlib")
+        from jepsen_tpu.checker import plots
+        points = [{"round": r, "lane": la, "fill": 0.5,
+                   "device": la // 2}
+                  for r in range(8) for la in range(4)]
+        events = [{"event": "rebucket", "round": 3, "from_K": 2,
+                   "to_K": 16},
+                  {"event": "steal", "round": 5},
+                  {"event": "steal", "round": 99}]  # out of range: ok
+        out = plots.occupancy_heatmap(
+            {"name": "t"}, points, events=events,
+            out_path=str(tmp_path / "hm.png"))
+        assert out and os.path.exists(out)
