@@ -109,7 +109,7 @@ def doctor_cmd() -> dict:
     """`python -m jepsen_tpu doctor <run_id|latest|bench>` — the
     diagnosis engine (jepsen_tpu/doctor): correlate a recorded run's
     telemetry planes into ranked, evidence-backed findings under the
-    D001-D010 rule catalog. Pure host-side reads of already-recorded
+    D001-D012 rule catalog. Pure host-side reads of already-recorded
     artifacts — nothing executes on a device."""
     spec = [
         Opt("help", short="-h", help="Print out this message and exit"),

@@ -507,7 +507,10 @@ def test_all_cmd(opts: dict) -> dict:
 
 
 def serve_cmd() -> dict:
-    """Build the results web-server command (cli.clj:334-354)."""
+    """Build the results web-server command (cli.clj:334-354). With
+    --service it also fronts the checker-as-a-service admission queue
+    (jepsen_tpu/service.py): POST /check, SSE at /events and
+    /runs/<id>/events, objectives at /slo."""
     spec = [
         Opt("help", short="-h", help="Print out this message and exit"),
         Opt("host", short="-b", metavar="HOST", default="0.0.0.0",
@@ -516,24 +519,54 @@ def serve_cmd() -> dict:
             parse=pos_int, help="Port number to bind to"),
         Opt("store_root", metavar="DIR", default="store",
             help="Store directory to serve"),
+        Opt("service", default=False,
+            help="Attach the checker service (POST /check + SSE + "
+                 "warm worker pool; re-warms cached bucket plans)"),
+        Opt("workers", metavar="N", default=1, parse=pos_int,
+            help="Service worker threads (with --service)"),
+        Opt("quota_device_s", metavar="SECONDS", parse=float,
+            help="Per-tenant device-seconds quota over the rolling "
+                 "window (with --service; default: unlimited)"),
     ]
 
     def run(parsed: Parsed):
         from . import web
         o = parsed.options
+        svc = None
+        if o.get("service"):
+            from .service import Service
+            svc = Service(o["store_root"],
+                          workers=o.get("workers") or 1,
+                          quota_device_s=o.get("quota_device_s"))
         server = web.serve(host=o["host"], port=o["port"],
-                           store_root=o["store_root"])
+                           store_root=o["store_root"], service=svc)
+        if svc is not None:
+            # re-warm cached bucket plans only AFTER the bind
+            # succeeded — minutes of XLA compiles must not precede
+            # an EADDRINUSE
+            warmed = svc.rewarm()
+            if warmed:
+                print(f"Re-warmed {len(warmed)} cached bucket "
+                      "plan(s) from fs_cache")
         base = f"http://{o['host']}:{server.server_port}"
         print(f"Listening on {base}/")
         print(f"Live run status: {base}/status "
               f"(JSON: {base}/status.json)")
         print(f"Device observatory: {base}/devices "
               f"· occupancy: {base}/occupancy "
-              f"· doctor: {base}/doctor")
+              f"· doctor: {base}/doctor "
+              f"· slo: {base}/slo")
+        if svc is not None:
+            print(f"Checker service: POST {base}/check "
+                  f"· events: {base}/events "
+                  f"({svc.workers} worker(s))")
         try:
             server.serve_forever()
         except KeyboardInterrupt:
             pass
+        finally:
+            if svc is not None:
+                svc.close()
         return EXIT_OK
 
     return {"serve": {"opt_spec": spec, "run": run}}

@@ -11,7 +11,7 @@ at scale (JVM Knossos "times out" with no attribution); a system built
 to fix that should diagnose itself. This module closes the telemetry
 into diagnoses:
 
-  * a **rule catalog** D001-D010 over the recorded series and ledger
+  * a **rule catalog** D001-D012 over the recorded series and ledger
     records — each rule correlates planes (e.g. D001 joins
     CompileGuard counts against preflight's planned buckets; D005
     joins `fleet_shards` walls into `fleet.summarize`'s rebucket
@@ -56,11 +56,19 @@ Rule catalog (doc/OBSERVABILITY.md "Diagnosis plane"):
   D009 preflight-misprediction degraded admission that ran fine
   D010 oracle-fallback-burst   the host oracle deciding keys the
                                device engine declined
+  D011 slo-burn                an SLO error budget burning past the
+                               multi-window gate; evidence names the
+                               slowest requests' phase walls and the
+                               remedy their dominant phase
+  D012 queue-backlog           service admission-queue depth growing;
+                               warm-hit rate splits the diagnosis
+                               (warm -> capacity, cold -> compile
+                               storm, cross-linking D001)
 
 Thresholds are single-sourced from the planes that own them
 (`occupancy.TARGET_FILL`, `devices.HBM_DRIFT_X` via `drift`,
-`fleet.REBUCKET_SKEW_X`); the doctor-only knobs live here as module
-constants.
+`fleet.REBUCKET_SKEW_X`, `slo.burn_threshold`); the doctor-only knobs
+live here as module constants.
 """
 
 from __future__ import annotations
@@ -86,6 +94,8 @@ RULES = {
     "D008": "dominant-phase-shift",
     "D009": "preflight-misprediction",
     "D010": "oracle-fallback-burst",
+    "D011": "slo-burn",
+    "D012": "queue-backlog",
 }
 
 SEVERITIES = ("critical", "warn", "info")
@@ -122,11 +132,23 @@ PHASE_SHIFT_SHARE = 0.35
 FALLBACK_BURST_MIN = 3
 FALLBACK_BURST_FRAC = 0.25
 
+# D011: how many of the slowest service requests anchor the
+# dominant-phase evidence.
+SLO_SLOW_REQUESTS = 3
+
+# D012: queue depth must be observed over this many service points,
+# grow by at least this much, and end at its window peak before a
+# backlog is declared; the warm-hit rate above the split means the
+# pool is warm (capacity problem), below it cold (compile storm).
+QUEUE_BACKLOG_MIN_POINTS = 6
+QUEUE_BACKLOG_GROWTH = 4
+QUEUE_WARM_SPLIT = 0.6
+
 # Series the view pulls from a registry / metrics JSONL export.
 SERIES_OF_INTEREST = (
     "wgl_rounds", "wgl_chunks", "wgl_adapt", "wgl_batched_lanes",
     "fleet_shards", "fleet_faults", "watchdog_stalls", "hbm",
-    "preflight")
+    "preflight", "service", "slo")
 
 # Bounds on what rides a finding (the full series stay in their
 # artifacts; evidence is for pointing, not re-exporting).
@@ -964,8 +986,166 @@ def _d010(view: TelemetryView) -> list:
     return out
 
 
+def _burn_x() -> float:
+    """slo.burn_threshold without requiring the slo module at
+    diagnosis time (the _target_fill pattern)."""
+    try:
+        from .slo import burn_threshold
+        return burn_threshold()
+    except Exception:  # noqa: BLE001
+        return 2.0
+
+
+_PHASE_REMEDY = {
+    "queue_wait_s": "queue-wait dominates — add service workers / "
+                    "devices, or raise the batch size so same-bucket "
+                    "arrivals coalesce harder",
+    "warm_s": "warm-dispatch dominates — pre-warm the shape buckets "
+              "ahead of traffic (aot.precompile_service_bucket; "
+              "Service.rewarm restores the fs_cache plan registry "
+              "after a restart)",
+    "search_s": "the search itself dominates — this is a kernel "
+                "problem, not a serving one; read the occupancy/"
+                "roofline planes for the offending shape",
+    "preflight_s": "admission analysis dominates — cache the plan "
+                   "per shape bucket (analysis/preflight)",
+    "admit_s": "request parsing dominates — histories this large "
+               "should stream, not POST",
+    "respond_s": "response accounting dominates — the ledger write "
+                 "path is in the request loop",
+}
+
+
+def _slowest_phases(view: TelemetryView) -> tuple:
+    """(evidence entry, dominant phase) over the slowest
+    service-request records' phase walls — the D011 anchor. (None,
+    None) when no phased requests are recorded."""
+    # indices are into view.records (the convention every
+    # ledger-evidence rule shares, e.g. _d001) — NOT into the
+    # filtered service-request subset, which would dereference
+    # unrelated records on a real interleaved ledger
+    svc = [(i, r) for i, r in enumerate(view.records)
+           if r.get("kind") == "service-request"
+           and isinstance(r.get("wall_s"), (int, float))
+           and isinstance(r.get("phases"), dict)]
+    if not svc:
+        return None, None
+    svc.sort(key=lambda ir: -float(ir[1]["wall_s"]))
+    slow = svc[:SLO_SLOW_REQUESTS]
+    totals: dict = {}
+    per_req = {}
+    for _i, rec in slow:
+        per_req[str(rec.get("id"))] = rec["phases"]
+        for ph, v in rec["phases"].items():
+            if isinstance(v, (int, float)):
+                totals[ph] = totals.get(ph, 0.0) + float(v)
+    dominant = max(totals, key=lambda p: totals[p]) if totals else None
+    ev = evidence("ledger", "wall_s", [i for i, _ in slow],
+                  [rec["wall_s"] for _, rec in slow],
+                  phases=per_req, dominant_phase=dominant)
+    return ev, dominant
+
+
+def _d011(view: TelemetryView) -> list:
+    """SLO-burn: an error budget burning past the multi-window gate
+    (slo.Engine's burn alert) — the serving plane's equivalent of a
+    wall regression, with the evidence pointing at the slowest
+    requests' phase walls and the remedy naming the dominant one."""
+    burning: dict = {}
+    idxs: list = []
+    rates: list = []
+    pts = view.series("slo")
+    for i, p in enumerate(pts):
+        br = p.get("burn_rate")
+        if not isinstance(br, (int, float)):
+            continue
+        if p.get("burn_alert") is True or (
+                p.get("met") is False and br > _burn_x()):
+            name = str(p.get("objective"))
+            if br >= burning.get(name, 0.0):
+                burning[name] = br
+            idxs.append(i)
+            rates.append(br)
+    for rec in view.records:
+        if rec.get("kind") != "slo":
+            continue
+        alerted = {str(a) for a in rec.get("burn_alerts") or []}
+        for row in rec.get("objectives") or []:
+            name = str(row.get("name"))
+            br = row.get("burn_rate")
+            if name in alerted and isinstance(br, (int, float)):
+                burning[name] = max(burning.get(name, 0.0), br)
+    if not burning:
+        return []
+    worst = max(burning.values())
+    ev = []
+    if idxs:
+        ev.append(evidence("slo", "burn_rate", idxs, rates,
+                           objectives=sorted(burning)))
+    slow_ev, dominant = _slowest_phases(view)
+    if slow_ev is not None:
+        ev.append(slow_ev)
+    action = _PHASE_REMEDY.get(
+        dominant,
+        "inspect the phase walls on the slowest service-request "
+        "records — the burning objective names which wall to cut")
+    remedy = {"dominant_phase": dominant} if dominant else None
+    return [finding(
+        "D011", "warn",
+        f"SLO error budget burning: {sorted(burning)} at up to "
+        f"{round(worst, 2)}x budget (gate {_burn_x()}x, "
+        f"multi-window)",
+        subject=",".join(sorted(burning)), evidence=ev, score=worst,
+        action=action, remedy=remedy)]
+
+
+def _d012(view: TelemetryView) -> list:
+    """Queue-backlog: the admission queue deepening across service
+    completions. A warm pool falling behind is a capacity problem;
+    a cold one is paying compiles inside the serve path — the D001
+    compile-storm signature arriving through the front door."""
+    pts = [p for p in view.series("service")
+           if isinstance(p.get("queue_depth"), int)]
+    if len(pts) < QUEUE_BACKLOG_MIN_POINTS:
+        return []
+    window = pts[-12:]
+    depths = [p["queue_depth"] for p in window]
+    growth = depths[-1] - depths[0]
+    rising = sum(1 for a, b in zip(depths, depths[1:]) if b >= a)
+    if growth < QUEUE_BACKLOG_GROWTH \
+            or depths[-1] != max(depths) \
+            or rising < 0.7 * (len(depths) - 1):
+        return []
+    warm = [bool(p.get("warm_hit")) for p in window]
+    warm_rate = sum(warm) / len(warm)
+    base = max(0, len(pts) - len(window))
+    idxs = [base + i for i in range(len(window))]
+    ev = [evidence("service", "queue_depth", idxs, depths,
+                   t=[p["t"] for p in window
+                      if p.get("t") is not None],
+                   warm_rate=round(warm_rate, 3))]
+    if warm_rate >= QUEUE_WARM_SPLIT:
+        action = ("the pool is warm but falling behind — add "
+                  "service workers / devices, or raise max_batch so "
+                  "coalescing amortizes harder (capacity)")
+    else:
+        action = ("cold buckets are paying compiles inside the "
+                  "serve path — warm ahead of traffic "
+                  "(aot.precompile_service_bucket / Service.rewarm)"
+                  "; see D001 compile-storm for the kernel-side "
+                  "signature")
+        ev.append(evidence("service", "warm_hit", idxs,
+                           warm, related_rule="D001"))
+    return [finding(
+        "D012", "warn",
+        f"admission queue depth grew {depths[0]} -> {depths[-1]} "
+        f"over {len(window)} request(s) at warm-hit rate "
+        f"{round(warm_rate, 2)}",
+        evidence=ev, score=growth, action=action)]
+
+
 _RULE_FNS: tuple = (_d001, _d002, _d003, _d004, _d005, _d006, _d007,
-                    _d008, _d009, _d010)
+                    _d008, _d009, _d010, _d011, _d012)
 
 
 # ---------------------------------------------------------------------------
@@ -1058,7 +1238,7 @@ def record_report(report: dict, *, where: str,
         if mx.enabled:
             series = mx.series(
                 "doctor", "diagnosis findings from the run doctor "
-                          "(rule catalog D001-D010)")
+                          "(rule catalog D001-D012)")
             for f in findings:
                 series.append({"rule": f["rule"],
                                "severity": f["severity"],

@@ -175,6 +175,17 @@ class Timeseries:
     def __len__(self) -> int:
         return len(self._points)
 
+    def trim(self, keep: int) -> int:
+        """Drop all but the newest `keep` points; returns how many
+        were dropped. Long-lived recorders (the service plane)
+        rotate their series with this — bench/test runs never call
+        it, so their exports stay complete."""
+        with self._lock:
+            dropped = max(0, len(self._points) - max(0, int(keep)))
+            if dropped:
+                del self._points[:dropped]
+        return dropped
+
 
 class _NullInstrument:
     """Shared no-op stand-in for every instrument kind: all recording
@@ -209,6 +220,9 @@ class _NullInstrument:
 
     def samples(self) -> list:
         return []
+
+    def trim(self, keep: int) -> int:
+        return 0
 
     def __len__(self) -> int:
         return 0

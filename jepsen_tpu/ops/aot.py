@@ -250,6 +250,39 @@ def precompile_wgl_ladder(*, n_pad: int, ic_pad: int, S: int, O: int,
         ladder=ladder or LADDER32, compile_now=True)
 
 
+def precompile_service_bucket(shape_bucket: dict, *,
+                              accel: bool = False) -> dict:
+    """precompile_wgl_ladder driven by a service.bucket_for CANONICAL
+    shape bucket: derive the exact kernel plan `wgl.check` will run
+    for any member of the bucket (via the shared `wgl.derive_plan` —
+    the single source of truth, so the warmed executables ARE the
+    scheduled ones) and backend-compile every ladder bucket. After
+    this returns, any `wgl.check(shape_bucket=bucket)` over the same
+    canonical bucket stays at ZERO recompiles — the service warm path
+    (jepsen_tpu/service.py) and its restart re-warm both use it;
+    scripts/service_smoke.py carries the CompileGuard proof. Returns
+    {K: compile_seconds}."""
+    from . import wgl as wgl_mod
+
+    b = shape_bucket
+    w_eff = int(b["w_eff"])
+    wide = w_eff > 32
+    # any window_raw on the right side of the 32 branch point yields
+    # this bucket's plan: derive_plan maxes W_eff with the bucket's
+    window_raw = w_eff if wide else min(32, w_eff)
+    plan = wgl_mod.derive_plan(
+        window_raw=window_raw, W=(w_eff if wide else 32),
+        ic_pad=int(b["ic_pad"]),
+        n=int(b.get("n_cap") or b["n_pad"]),
+        n_info=int(b["ic_pad"]), accel=accel, shape_bucket=b)
+    return precompile_wgl_ladder(
+        n_pad=int(b["n_pad"]), ic_pad=plan["ic_eff"],
+        S=int(b["S"]), O=int(b["O"]), H=plan["H"], B=plan["B"],
+        chunk=plan["chunk"], W=plan["W_eff"], L=plan["L"],
+        accel=accel, depth=plan["depth"], pack=bool(b.get("pack")),
+        ladder=tuple(plan["ladder"] or plan["buckets"]))
+
+
 def precompile_mesh_plan(shape_bucket: dict, mesh=None, *,
                          lanes_per_device: Optional[int] = None,
                          n_keys: Optional[int] = None,

@@ -1,0 +1,953 @@
+"""Checker-as-a-service: admission queue, warm worker pool, and the
+request-scoped observability plane (ROADMAP item 1).
+
+Every ingredient existed — preflight admission (P001-P006), the warm
+AOT ladders (`aot.precompile_wgl_ladder` / `precompile_elle_closure`),
+`parallel.shared_shape_bucket`, per-run ledger records with
+device-seconds, the stall watchdog — but nothing composed them into a
+serving loop, and none of the telemetry planes could see a *request*:
+no queue-wait measurement, no warm-hit rate, nothing tracking the
+item-1 target ("p50 < 1 s warm, admission-to-verdict"). This module
+is that composition, built so the measurement plane IS the skeleton:
+
+  request lifecycle (one `trace.Tracer` id threaded through):
+
+    POST /check ──> admit ──> preflight ──> [bucket queue] ──>
+      queue-wait ──> warm-dispatch ──> search ──> respond
+
+  * **admit** — parse model + history + params, tenant quota check
+    (device-seconds from the ledger's `kind="service-request"`
+    aggregates over a rolling window);
+  * **preflight** — the static admission gate
+    (`analysis/preflight.gate_wgl` / `gate_elle`): infeasible
+    requests reject with zero compiles and zero device bytes;
+  * **bucket queue** — requests land in a per-shape-bucket queue
+    keyed on a CANONICAL quantized bucket (`bucket_for`: n_pad to a
+    256 quantum, ic to 32, S/O to table quanta, the kernel branch,
+    the packed-table bit) so same-bucket arrivals coalesce into one
+    batch that shares ONE compiled kernel per ladder bucket — the
+    `shared_shape_bucket` fix (PR 9), applied to serving;
+  * **warm-dispatch** — the resident worker pool holds warm jitted
+    ladders across requests: a bucket's first batch pays
+    `aot.precompile_service_bucket` once (recorded in `fs_cache`
+    under ("service-plan", ...) so `rewarm()` restores the warm set
+    after a process restart — cold-start disappears across
+    restarts), every later same-bucket request is a warm hit;
+  * **search / respond** — `ops/wgl.check` (or the elle checkers)
+    with the service registry/tracer installed, then a
+    `kind="service-request"` ledger record carrying verdict, phase
+    walls, device-seconds (the per-tenant billing unit), warm-hit
+    and batch attribution.
+
+Surfaces: a linted `service` metrics series (one point per request:
+queue depth, wait/serve/total wall, warm-hit, batch fill, verdict) +
+counters; Server-Sent-Events feeds (`events_since` / `run_events` —
+web.py streams them at `/events` and `/runs/<id>/events` so a remote
+client watches queue position, progress, and the verdict without
+polling); a `service` block on `/status.json`; and the SLO engine
+(slo.py) evaluating the recorded requests into error budgets and
+burn alerts, diagnosed by doctor rules D011/D012. Schemas in
+doc/OBSERVABILITY.md "Service & SLO plane"; CI gate in
+scripts/service_smoke.py.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Optional
+
+from . import fleet
+from . import ledger as ledger_mod
+from . import metrics as metrics_mod
+from . import slo as slo_mod
+from . import trace as trace_mod
+
+SCHEMA = 1
+
+# Shape-bucket quanta: requests quantize into canonical buckets so
+# "the same workload again" lands in the SAME bucket (and therefore
+# warm kernels), while _apply_bucket padding keeps verdicts exact.
+# Coarse on purpose — a serving pool trades padded lanes for warm-hit
+# rate (narrow windows always run at W_eff 32, the branch maximum:
+# per-request concurrency jitter must not fragment the warm set).
+BUCKET_N_QUANTUM = 256
+BUCKET_IC_QUANTUM = 32
+NARROW_W_EFF = 32
+# model-table quanta: the observed op alphabet (and so the (S, O)
+# transition table) varies per history — pad both axes so alphabet
+# jitter can't fragment the warm set (_apply_bucket pads tables with
+# -1, the same mechanism shared_shape_bucket relies on)
+BUCKET_S_QUANTUM = 16
+BUCKET_O_QUANTUM = 32
+
+# Elle requests bucket on quantized txn count (the closure shapes
+# scale with it); no array padding is involved, the bucket only keys
+# the queue + warm registry.
+ELLE_TXN_QUANTUM = 1024
+
+# Bounded in-memory state: finished requests kept addressable, the
+# global SSE event feed, and the rotating telemetry window (spans +
+# series points) — a serving process must not grow linearly with
+# request count (TRIM_EVERY completions trigger one rotation).
+RUNS_CAP = 512
+EVENTS_CAP = 1024
+SPANS_CAP = 4096
+SERIES_CAP = 4096
+TRIM_EVERY = 256
+
+_CHECKERS = ("wgl", "elle-append", "elle-wr")
+
+
+class _Request:
+    """One admitted request's lifecycle state (internal)."""
+
+    __slots__ = ("id", "tenant", "checker", "model_name", "model",
+                 "history", "params", "t_epoch", "t_mono", "state",
+                 "bucket_key", "bucket", "enc", "result", "events",
+                 "phases", "wait_s", "serve_s", "total_s", "warm_hit",
+                 "batch_n", "position")
+
+    def __init__(self, rid: str, tenant: str, checker: str):
+        self.id = rid
+        self.tenant = tenant
+        self.checker = checker
+        self.model_name: Optional[str] = None
+        self.model = None
+        self.history = None
+        self.params: dict = {}
+        self.t_epoch = time.time()
+        self.t_mono = time.monotonic()
+        self.state = "queued"
+        self.bucket_key: Optional[tuple] = None
+        self.bucket: Optional[dict] = None
+        self.enc = None
+        self.result: Optional[dict] = None
+        self.events: list = []
+        self.phases: dict = {}
+        self.wait_s: Optional[float] = None
+        self.serve_s: Optional[float] = None
+        self.total_s: Optional[float] = None
+        self.warm_hit = False
+        self.batch_n = 0
+        self.position: Optional[int] = None
+
+
+def _models() -> dict:
+    from . import models
+    return {"register": models.register,
+            "cas-register": models.cas_register,
+            "cas_register": models.cas_register,
+            "mutex": models.mutex,
+            "fifo-queue": models.fifo_queue,
+            "fifo_queue": models.fifo_queue}
+
+
+def _parse_history(raw):
+    """A History from either an Op list (in-process callers) or the
+    POST body's op dicts."""
+    from . import history as h
+    if isinstance(raw, h.History):
+        return raw
+    if not isinstance(raw, (list, tuple)):
+        raise ValueError("history must be a list of op objects")
+    ops = []
+    for d in raw:
+        if isinstance(d, h.Op):
+            ops.append(d)
+        elif isinstance(d, dict) and "type" in d:
+            ops.append(h.Op.from_dict(d))
+        else:
+            raise ValueError(f"history op needs a 'type': {d!r}")
+    return h.History(ops)
+
+
+def _quantize(n: int, q: int) -> int:
+    return max(q, ((int(n) + q - 1) // q) * q)
+
+
+def bucket_for(enc) -> tuple:
+    """(key, bucket) for one encoding: the CANONICAL quantized shape
+    bucket the request serves under. Deterministic from the encoding
+    alone (unlike `shared_shape_bucket`, which derives from whatever
+    batch happens to be in flight) so identical workloads always key
+    the same warm kernels — the second same-bucket POST must hit the
+    jit cache, CompileGuard-proven by scripts/service_smoke.py.
+    `ic_eff` pins to `ic_pad` so `wgl.derive_plan` resolves the same
+    effective widths for every member of the bucket."""
+    from .ops.encode import _pad_to
+    from .ops.wgl import _packable
+    wide = enc.window_raw > 32
+    if wide:
+        w_eff = _pad_to(enc.window_raw, 32)
+    else:
+        w_eff = NARROW_W_EFF
+    n_pad = _quantize(len(enc.inv), BUCKET_N_QUANTUM)
+    ic_pad = _quantize(max(len(enc.inv_info), 1), BUCKET_IC_QUANTUM)
+    S = _quantize(int(enc.table.shape[0]), BUCKET_S_QUANTUM)
+    O = _quantize(int(enc.table.shape[1]), BUCKET_O_QUANTUM)
+    pack = bool(_packable(enc))
+    bucket = {"n_pad": n_pad, "ic_pad": ic_pad, "S": S, "O": O,
+              "w_eff": int(w_eff), "ic_eff": ic_pad, "n_cap": n_pad,
+              "pack": pack}
+    key = ("wgl", "wide" if wide else "narrow", n_pad, ic_pad, S, O,
+           int(w_eff), pack)
+    return key, bucket
+
+
+def _key_str(key: Optional[tuple]) -> str:
+    return "/".join(str(k) for k in key) if key else "?"
+
+
+class Service:
+    """The admission queue + resident worker pool. Construct one per
+    store root; `web.serve(service=...)` fronts it with POST /check
+    and the SSE endpoints. Thread-safe throughout; all device work
+    happens on the worker threads."""
+
+    def __init__(self, store_root: str, *, workers: int = 1,
+                 warm_ladder: bool = True, rewarm: bool = False,
+                 registry: Optional[metrics_mod.Registry] = None,
+                 tracer: Optional[trace_mod.Tracer] = None,
+                 quota_device_s: Optional[float] = None,
+                 quota_window_s: float = 3600.0,
+                 max_queue: int = 256, max_batch: int = 8,
+                 slo_engine: Optional[slo_mod.Engine] = None,
+                 slo_every_s: float = 30.0,
+                 default_time_limit: float = 60.0):
+        self.store_root = store_root
+        self.ledger = ledger_mod.Ledger(store_root)
+        # the service owns an ENABLED registry by default: a request
+        # plane that records nothing cannot be billed or SLO'd
+        self.mx = registry if registry is not None \
+            else metrics_mod.Registry()
+        self.tracer = tracer if tracer is not None \
+            else trace_mod.Tracer(sampled=True, service="service")
+        self.workers = max(1, int(workers))
+        self.warm_ladder = bool(warm_ladder)
+        self.quota_device_s = quota_device_s
+        self.quota_window_s = float(quota_window_s)
+        self.max_queue = int(max_queue)
+        self.max_batch = max(1, int(max_batch))
+        self.default_time_limit = float(default_time_limit)
+        self.slo = slo_engine if slo_engine is not None \
+            else slo_mod.Engine(ledger=self.ledger)
+        self.slo_every_s = float(slo_every_s)
+        self._last_slo = 0.0
+        self._lock = threading.RLock()
+        self._cv = threading.Condition(self._lock)     # workers
+        self._ev_cv = threading.Condition(self._lock)  # SSE readers
+        self._queues: dict = {}   # bucket key -> deque[_Request]
+        self._runs: dict = {}     # run id -> _Request (bounded)
+        self._warm: dict = {}     # bucket key -> warm info
+        self._warming: dict = {}  # bucket key -> in-flight Event
+        self._usage: dict = {}    # tenant -> [(t, device_s)] window
+        self._usage_seeded: set = set()
+        self._events: deque = deque(maxlen=EVENTS_CAP)
+        self._seq = 0
+        self._hold = False
+        self._stop = False
+        self._threads: list = []
+        self._stats = {"submitted": 0, "served": 0, "rejected": 0,
+                       "warm_hits": 0, "batches": 0, "errors": 0}
+        if rewarm:
+            self.rewarm()
+
+    # -- lifecycle ----------------------------------------------------
+    def start(self) -> "Service":
+        with self._lock:
+            if self._threads:
+                return self
+            self._stop = False
+            for i in range(self.workers):
+                t = threading.Thread(target=self._worker_loop,
+                                     name=f"service-worker-{i}",
+                                     daemon=True)
+                t.start()
+                self._threads.append(t)
+        set_default(self)
+        return self
+
+    def close(self, timeout: float = 5.0) -> None:
+        with self._cv:
+            self._stop = True
+            self._cv.notify_all()
+            self._ev_cv.notify_all()
+        for t in self._threads:
+            t.join(timeout=timeout)
+        with self._lock:
+            self._threads = []
+
+    @property
+    def closed(self) -> bool:
+        """True once close() ran — SSE streamers check this and end
+        their streams instead of spinning on a drained feed (the
+        event waiters return immediately when stopped)."""
+        return self._stop
+
+    def hold(self, flag: bool) -> None:
+        """Pause (True) / resume (False) dequeueing — the
+        deterministic coalescing control: hold, submit N same-bucket
+        requests, release, and they serve as ONE batch."""
+        with self._cv:
+            self._hold = bool(flag)
+            if not flag:
+                self._cv.notify_all()
+
+    # -- events -------------------------------------------------------
+    def _emit(self, req: Optional[_Request], event: str,
+              **data) -> None:
+        with self._lock:
+            self._seq += 1
+            entry = {"seq": self._seq, "t": round(time.time(), 3),
+                     "event": event}
+            if req is not None:
+                entry["run_id"] = req.id
+            entry.update(data)
+            self._events.append(entry)
+            if req is not None:
+                req.events.append(entry)
+                del req.events[:-64]
+            self._ev_cv.notify_all()
+
+    def events_since(self, after: int = 0,
+                     timeout: float = 0.0) -> list:
+        """Global feed entries with seq > `after`; blocks up to
+        `timeout` for the first new one (the /events SSE source)."""
+        deadline = time.monotonic() + max(0.0, timeout)
+        with self._ev_cv:
+            while True:
+                out = [e for e in self._events if e["seq"] > after]
+                if out or self._stop:
+                    return out
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    return []
+                self._ev_cv.wait(timeout=min(left, 0.5))
+
+    def run_events(self, run_id: str, after: int = 0,
+                   timeout: float = 0.0) -> tuple:
+        """(new events, done?) for one run — the /runs/<id>/events
+        SSE source. Unknown ids return ([], True)."""
+        deadline = time.monotonic() + max(0.0, timeout)
+        with self._ev_cv:
+            while True:
+                req = self._runs.get(run_id)
+                if req is None:
+                    return [], True
+                out = [e for e in req.events if e["seq"] > after]
+                done = req.state in ("done", "rejected")
+                if out or done or self._stop:
+                    return out, done
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    return [], False
+                self._ev_cv.wait(timeout=min(left, 0.5))
+
+    def get(self, run_id: str) -> Optional[dict]:
+        """Compact view of one request (None when unknown)."""
+        with self._lock:
+            req = self._runs.get(run_id)
+            if req is None:
+                return None
+            out = {"id": req.id, "state": req.state,
+                   "tenant": req.tenant, "checker": req.checker,
+                   "model": req.model_name,
+                   "bucket": _key_str(req.bucket_key),
+                   "warm_hit": req.warm_hit,
+                   "wait_s": req.wait_s, "serve_s": req.serve_s,
+                   "wall_s": req.total_s, "phases": dict(req.phases),
+                   "events": list(req.events)}
+            if req.result is not None:
+                out["verdict"] = req.result.get("valid?")
+                if req.result.get("cause") is not None:
+                    out["cause"] = req.result.get("cause")
+            return out
+
+    # -- admission ----------------------------------------------------
+    def tenant_usage(self, tenant: str,
+                     window_s: Optional[float] = None) -> float:
+        """Device-seconds this tenant consumed inside the rolling
+        quota window — the per-tenant accounting ROADMAP item 1
+        names. The ledger is scanned ONCE per tenant per process to
+        seed the window (prior traffic, possibly another process's);
+        after that the window is an in-memory list `_record` appends
+        to — an admission-path check must never scale with total
+        ledger history."""
+        tenant = str(tenant)
+        window = (window_s if window_s is not None
+                  else self.quota_window_s)
+        now = time.time()
+        with self._lock:
+            seeded = tenant in self._usage_seeded
+        if not seeded:
+            try:
+                recs = self.ledger.query(kind="service-request",
+                                         since=now - window)
+            except Exception:  # noqa: BLE001 — a torn ledger
+                recs = []      # seeds an empty window
+            rows = [(float(r.get("t") or 0),
+                     float(r.get("device_s") or 0.0))
+                    for r in recs if r.get("tenant") == tenant]
+            with self._lock:
+                if tenant not in self._usage_seeded:
+                    self._usage[tenant] = rows + \
+                        self._usage.get(tenant, [])
+                    self._usage_seeded.add(tenant)
+        with self._lock:
+            rows = self._usage.setdefault(tenant, [])
+            rows[:] = [(t, d) for t, d in rows
+                       if t >= now - window]
+            return round(sum(d for _, d in rows), 6)
+
+    def submit(self, payload: dict) -> dict:
+        """The POST /check entry: admit + preflight + enqueue.
+        Returns {"id", "state", ...} — admission rejections (quota,
+        preflight, malformed history) come back as an already-decided
+        run with verdict "unknown" and a cause, so the client always
+        gets a ledger-addressable run id. Raises ValueError only for
+        requests too malformed to account (no model, no history)."""
+        if not isinstance(payload, dict):
+            raise ValueError("request body must be a JSON object")
+        checker = str(payload.get("checker") or "wgl")
+        if checker not in _CHECKERS:
+            raise ValueError(f"unknown checker {checker!r} "
+                             f"(known: {_CHECKERS})")
+        tenant = str(payload.get("tenant") or "default")
+        rid = ledger_mod.new_id()
+        req = _Request(rid, tenant, checker)
+        req.params = dict(payload.get("params") or {})
+        t0 = time.monotonic()
+        ctx = None  # the request trace id: every later span adopts it
+        with self.tracer.span("admit", attrs={"run_id": rid,
+                                              "tenant": tenant}):
+            ctx = self.tracer.context()
+            if checker == "wgl":
+                name = str(payload.get("model") or "")
+                factory = _models().get(name)
+                if factory is None:
+                    raise ValueError(
+                        f"unknown model {name!r} "
+                        f"(known: {sorted(_models())})")
+                req.model_name = name
+                req.model = factory()
+            req.history = _parse_history(payload.get("history"))
+            if len(req.history) == 0:
+                raise ValueError("history is empty")
+        req.phases["admit_s"] = round(time.monotonic() - t0, 6)
+        with self._lock:
+            self._stats["submitted"] += 1
+        # tenant quota: billed from the ledger aggregates, enforced
+        # BEFORE any encode/preflight work
+        if self.quota_device_s is not None:
+            used = self.tenant_usage(tenant)
+            if used >= self.quota_device_s:
+                return self._reject(
+                    req, ctx, "quota",
+                    detail={"tenant": tenant,
+                            "device_s_used": used,
+                            "device_s_quota": self.quota_device_s})
+        t1 = time.monotonic()
+        with self.tracer.span("preflight", parent=ctx,
+                              attrs={"run_id": rid}):
+            gate = self._preflight(req)
+        req.phases["preflight_s"] = round(time.monotonic() - t1, 6)
+        if gate is not None:
+            return self._reject(req, ctx, "preflight", result=gate)
+        with self._cv:
+            depth = sum(len(q) for q in self._queues.values())
+            if depth >= self.max_queue:
+                return self._reject(
+                    req, ctx, "queue-full",
+                    detail={"depth": depth,
+                            "max_queue": self.max_queue})
+            q = self._queues.setdefault(req.bucket_key, deque())
+            q.append(req)
+            req.position = len(q)
+            req.state = "queued"
+            self._runs[req.id] = req
+            self._trim_runs_locked()
+            # the trace context rides the request into the workers
+            req.params["_ctx"] = ctx
+            self.mx.gauge("service_queue_depth",
+                          "requests waiting in the admission queue"
+                          ).set(depth + 1)
+            self._cv.notify()
+        self._emit(req, "queued", position=req.position,
+                   depth=depth + 1, bucket=_key_str(req.bucket_key))
+        self.start()
+        return {"id": req.id, "state": "queued",
+                "position": req.position, "depth": depth + 1,
+                "bucket": _key_str(req.bucket_key)}
+
+    def _preflight(self, req: _Request) -> Optional[dict]:
+        """Static admission (analysis/preflight) + bucket derivation.
+        Returns the reject result when infeasible, else None with
+        `req.enc`/`req.bucket_key`/`req.bucket` populated."""
+        from .analysis import preflight
+        if req.checker == "wgl":
+            from .ops.encode import EncodingUnsupported, encode
+            try:
+                req.enc = encode(req.model, req.history)
+                req.bucket_key, req.bucket = bucket_for(req.enc)
+            except EncodingUnsupported:
+                # the engine will fast-fail it with the structured
+                # encoding block; bucket on the model alone
+                req.enc = None
+                req.bucket_key = ("wgl-unencodable", req.model_name)
+            with ledger_mod.use(self.ledger):
+                return preflight.gate_wgl(
+                    req.model, req.history, enc=req.enc,
+                    where="service",
+                    ledger_name=f"service:{req.model_name}")
+        n_txns = sum(1 for op in req.history if op.is_ok)
+        req.bucket_key = (req.checker,
+                          _quantize(max(n_txns, 1), ELLE_TXN_QUANTUM))
+        backend = str(req.params.get("cycle_backend") or "auto")
+        with ledger_mod.use(self.ledger):
+            return preflight.gate_elle(
+                n_txns, backend=backend, where="service",
+                ledger_name=f"service:{req.checker}")
+
+    def _reject(self, req: _Request, ctx, cause: str,
+                result: Optional[dict] = None,
+                detail: Optional[dict] = None) -> dict:
+        req.state = "rejected"
+        req.result = result if result is not None else {
+            "valid?": "unknown", "cause": cause, **(detail or {})}
+        req.result.setdefault("cause", cause)
+        req.wait_s = req.serve_s = 0.0
+        req.total_s = round(time.monotonic() - req.t_mono, 6)
+        with self._lock:
+            self._runs[req.id] = req
+            self._trim_runs_locked()
+            self._stats["rejected"] += 1
+        self._emit(req, "rejected", cause=req.result["cause"])
+        with self.tracer.span("respond", parent=ctx,
+                              attrs={"run_id": req.id,
+                                     "cause": req.result["cause"]}):
+            self._record(req)
+        return {"id": req.id, "state": "rejected",
+                "verdict": "unknown", "cause": req.result["cause"]}
+
+    def _trim_runs_locked(self) -> None:
+        while len(self._runs) > RUNS_CAP:
+            self._runs.pop(next(iter(self._runs)))
+
+    # -- the worker pool ----------------------------------------------
+    def _accel(self) -> bool:
+        from .util import safe_backend
+        return safe_backend() not in (None, "cpu")
+
+    def _pick_key_locked(self):
+        best = None
+        best_t = None
+        for key, q in self._queues.items():
+            if not q:
+                continue
+            if best_t is None or q[0].t_mono < best_t:
+                best, best_t = key, q[0].t_mono
+        return best
+
+    def _next_batch(self) -> Optional[list]:
+        with self._cv:
+            while not self._stop:
+                if not self._hold:
+                    key = self._pick_key_locked()
+                    if key is not None:
+                        q = self._queues[key]
+                        batch = []
+                        while q and len(batch) < self.max_batch:
+                            batch.append(q.popleft())
+                        if not q:
+                            del self._queues[key]
+                        depth = sum(len(qq) for qq
+                                    in self._queues.values())
+                        self.mx.gauge(
+                            "service_queue_depth",
+                            "requests waiting in the admission "
+                            "queue").set(depth)
+                        for r in batch:
+                            r.state = "serving"
+                        return batch
+                self._cv.wait(timeout=0.2)
+        return None
+
+    def _worker_loop(self) -> None:
+        while not self._stop:
+            batch = self._next_batch()
+            if not batch:
+                continue
+            try:
+                self._serve_batch(batch)
+            except Exception as e:  # noqa: BLE001 — a worker crash
+                # must fail the batch's requests, never the pool
+                for req in batch:
+                    if req.state != "done":
+                        self._finish(
+                            req,
+                            {"valid?": "unknown",
+                             "cause": f"service-error: {e}"[:200]},
+                            warm_hit=False, batch_n=len(batch),
+                            t_serve0=time.monotonic())
+                fleet.record_fault(fleet.fault_event(
+                    e, stage="service-worker"), mx=self.mx)
+                with self._lock:
+                    self._stats["errors"] += 1
+            self._maybe_evaluate_slo()
+            self._maybe_trim_telemetry()
+
+    def _serve_batch(self, batch: list) -> None:
+        key = batch[0].bucket_key
+        with self._lock:
+            self._stats["batches"] += 1
+        self.mx.counter("service_batches_total",
+                        "coalesced service batches").inc(
+            bucket=_key_str(key))
+        ctx0 = batch[0].params.get("_ctx")
+        t_dispatch = time.monotonic()
+        warm_s = 0.0
+        # one warm per bucket even across workers: the first worker
+        # to claim the key compiles; a sibling worker serving a
+        # same-bucket batch mid-warm WAITS on the claim instead of
+        # paying a duplicate ladder compile in its serve path
+        with self._lock:
+            warm_hit = key in self._warm
+            pending = self._warming.get(key)
+            claim = None
+            if not warm_hit and pending is None:
+                claim = self._warming[key] = threading.Event()
+        if claim is not None:
+            try:
+                with self.tracer.span(
+                        "warm-dispatch", parent=ctx0,
+                        attrs={"bucket": _key_str(key),
+                               "batch_n": len(batch)}):
+                    warmed = self._warm_bucket(batch[0])
+                warm_s = round(time.monotonic() - t_dispatch, 6)
+                if warmed:
+                    # only a SUCCESSFUL warm-up marks the bucket warm
+                    # — a failed precompile must retry on the next
+                    # cold batch, not report warm_hit=True while
+                    # paying compiles in-band (that would judge cold
+                    # requests against the warm SLO target)
+                    with self._lock:
+                        self._warm[key] = {"t": time.time(),
+                                           "warm_s": warm_s}
+            finally:
+                with self._lock:
+                    self._warming.pop(key, None)
+                claim.set()
+        elif not warm_hit and pending is not None:
+            pending.wait(timeout=600.0)
+            warm_s = round(time.monotonic() - t_dispatch, 6)
+        for req in batch:
+            if warm_s:
+                req.phases["warm_s"] = warm_s
+            self._serve_one(req, warm_hit, len(batch))
+
+    def _warm_bucket(self, req: _Request) -> bool:
+        """Pay the bucket's ladder compiles ONCE, ahead of its first
+        search, and register the plan in fs_cache so a restarted
+        process re-warms before traffic (`rewarm`). First-touch
+        accounting (return True without compiling) when ladder
+        warming is off or the bucket has no canonical shape (elle /
+        unencodable — the process jit cache is the warm set there);
+        False only when the precompile itself failed, so the caller
+        retries instead of mislabeling the bucket warm."""
+        if not self.warm_ladder or req.bucket is None:
+            return True
+        try:
+            from .ops import aot
+            compile_s = aot.precompile_service_bucket(
+                req.bucket, accel=self._accel())
+        except Exception as e:  # noqa: BLE001 — a failed warm-up
+            # degrades to in-band compiles, never a failed request
+            fleet.record_fault(fleet.fault_event(
+                e, stage="service-warm"), mx=self.mx)
+            return False
+        self._emit(req, "warmed", bucket=_key_str(req.bucket_key),
+                   compile_s=compile_s)
+        try:
+            from . import fs_cache
+            keystr = "-".join(str(k) for k in req.bucket_key)
+            fs_cache.save_data(
+                ("service-plan", str(req.model_name), keystr),
+                {"bucket": req.bucket, "key": list(req.bucket_key),
+                 "model": req.model_name, "t": round(time.time(), 3)})
+        except Exception:  # noqa: BLE001 — the plan registry is an
+            pass           # optimization, not a correctness need
+        return True
+
+    def rewarm(self) -> list:
+        """The restart warm path: re-compile every bucket plan earlier
+        traffic registered in fs_cache (("service-plan", ...)), so a
+        fresh process answers its first same-bucket request warm.
+        Returns the warmed plans; stale/unreadable entries skip."""
+        from . import fs_cache
+        try:
+            plans = fs_cache.list_data(("service-plan",))
+        except Exception:  # noqa: BLE001
+            return []
+        out = []
+        for plan in plans:
+            if not isinstance(plan, dict) or "bucket" not in plan:
+                continue
+            try:
+                from .ops import aot
+                compile_s = aot.precompile_service_bucket(
+                    plan["bucket"], accel=self._accel())
+            except Exception:  # noqa: BLE001 — one stale plan must
+                continue       # not block the others' warm-up
+            key = tuple(plan.get("key") or ())
+            if key:
+                with self._lock:
+                    self._warm[key] = {"t": time.time(),
+                                       "rewarmed": True}
+            out.append({"key": key, "compile_s": compile_s})
+        return out
+
+    def _serve_one(self, req: _Request, warm_hit: bool,
+                   batch_n: int) -> None:
+        ctx = req.params.get("_ctx")
+        # queue wait ends when THIS request's search is about to run:
+        # a batch serves serially, so members after the first spend
+        # real wall waiting on their siblings — that wait must land
+        # in queue_wait_s (it is what the queue-wait SLO objective
+        # and D011's dominant-phase remedy measure), not vanish
+        # between phases. The bucket warm is attributed to its own
+        # warm_s phase, so it is subtracted here.
+        req.wait_s = round(time.monotonic() - req.t_mono
+                           - (req.phases.get("warm_s") or 0.0), 6)
+        req.warm_hit = warm_hit
+        req.batch_n = batch_n
+        # the queue-wait span covers submit-to-dispatch, backdated to
+        # the submit stamp so the flame chart shows the real wait
+        with self.tracer.span("queue-wait", parent=ctx,
+                              attrs={"run_id": req.id}) as sp:
+            if sp is not None:
+                sp.start_s = req.t_epoch
+        req.phases["queue_wait_s"] = req.wait_s
+        self._emit(req, "serving", wait_s=req.wait_s,
+                   warm_hit=warm_hit, batch_n=batch_n)
+        t_serve0 = time.monotonic()
+        with self.tracer.span(
+                "search", parent=ctx,
+                attrs={"run_id": req.id, "checker": req.checker,
+                       "warm_hit": warm_hit}):
+            try:
+                res = self._run_check(req)
+            except Exception as e:  # noqa: BLE001
+                res = {"valid?": "unknown",
+                       "cause": f"service-error: {e}"[:200]}
+                fleet.record_fault(fleet.fault_event(
+                    e, stage="service-search"), mx=self.mx)
+        req.phases["search_s"] = round(time.monotonic() - t_serve0, 6)
+        self._finish(req, res, warm_hit=warm_hit, batch_n=batch_n,
+                     t_serve0=t_serve0, ctx=ctx)
+
+    def _run_check(self, req: _Request) -> dict:
+        p = req.params
+        tl = float(p.get("time_limit") or self.default_time_limit)
+        if req.checker == "wgl":
+            from .ops import wgl
+            return wgl.check(req.model, req.history, time_limit=tl,
+                             enc=req.enc, shape_bucket=req.bucket,
+                             metrics=self.mx, tracer=self.tracer)
+        backend = str(p.get("cycle_backend") or "auto")
+        with metrics_mod.use(self.mx):
+            if req.checker == "elle-append":
+                from .elle import append
+                return append.check(req.history,
+                                    cycle_backend=backend)
+            from .elle import wr
+            return wr.check(req.history, cycle_backend=backend)
+
+    def _finish(self, req: _Request, res: dict, *, warm_hit: bool,
+                batch_n: int, t_serve0: float, ctx=None) -> None:
+        t_done = time.monotonic()
+        req.serve_s = round(t_done - t_serve0, 6)
+        req.total_s = round(t_done - req.t_mono, 6)
+        req.result = res
+        req.state = "done"
+        with self._lock:
+            self._stats["served"] += 1
+            if warm_hit:
+                self._stats["warm_hits"] += 1
+        with self.tracer.span("respond", parent=ctx,
+                              attrs={"run_id": req.id}):
+            # respond covers everything after the search returned:
+            # verdict bookkeeping up to (and estimated through) the
+            # ledger write — stamped BEFORE _record so the recorded
+            # phases block carries it
+            req.phases["respond_s"] = round(
+                time.monotonic() - t_done, 6)
+            self._record(req)
+        self._emit(req, "done",
+                   verdict=_verdict_str(res.get("valid?")),
+                   cause=res.get("cause"), wall_s=req.total_s,
+                   warm_hit=warm_hit)
+
+    # -- accounting ---------------------------------------------------
+    def _record(self, req: _Request) -> None:
+        """One `kind="service-request"` ledger record + one `service`
+        series point per request — the billing/SLO substrate. Never
+        raises."""
+        res = req.result or {}
+        verdict = _verdict_str(res.get("valid?"))
+        with self._lock:
+            depth = sum(len(q) for q in self._queues.values())
+        try:
+            base = ledger_mod.summarize_result(res)
+            rec = {"kind": "service-request", "id": req.id,
+                   "name": f"service:{req.model_name or req.checker}",
+                   "model": req.model_name, **base,
+                   "tenant": req.tenant,
+                   "checker": req.checker,
+                   "warm_hit": bool(req.warm_hit),
+                   "batch_n": int(req.batch_n),
+                   "bucket": _key_str(req.bucket_key),
+                   "wall_s": round(req.total_s or 0.0, 4),
+                   "phases": {k: round(float(v), 6)
+                              for k, v in req.phases.items()}}
+            rec.setdefault("op_count",
+                           len(req.history) if req.history else 0)
+            rec.setdefault("device_s", 0.0)
+            self.ledger.record(rec)
+            # rolling quota window: seeded tenants accumulate
+            # in-memory (unseeded ones pick this record up from the
+            # ledger scan their first quota check runs)
+            if self.quota_device_s is not None:
+                with self._lock:
+                    if req.tenant in self._usage_seeded:
+                        self._usage.setdefault(
+                            req.tenant, []).append(
+                            (time.time(),
+                             float(rec.get("device_s") or 0.0)))
+        except Exception:  # noqa: BLE001
+            pass
+        try:
+            if self.mx.enabled:
+                self.mx.series(
+                    "service",
+                    "per-request lifecycle telemetry of the "
+                    "checker service (doc/OBSERVABILITY.md "
+                    "\"Service & SLO plane\")").append({
+                        "run_id": req.id, "tenant": req.tenant,
+                        "bucket": _key_str(req.bucket_key),
+                        "verdict": verdict,
+                        "cause": res.get("cause"),
+                        "wait_s": float(req.wait_s or 0.0),
+                        "serve_s": float(req.serve_s or 0.0),
+                        "total_s": float(req.total_s or 0.0),
+                        "warm_hit": bool(req.warm_hit),
+                        "batch_n": int(req.batch_n),
+                        "queue_depth": int(depth)})
+                self.mx.counter(
+                    "service_requests_total",
+                    "service requests by verdict").inc(
+                    verdict=verdict, tenant=req.tenant)
+                if req.warm_hit:
+                    self.mx.counter(
+                        "service_warm_hits_total",
+                        "requests served from a warm bucket").inc()
+        except Exception:  # noqa: BLE001
+            pass
+
+    def _maybe_trim_telemetry(self) -> None:
+        """Rotate the resident telemetry every TRIM_EVERY
+        completions: spans and series keep a bounded recent window
+        (a serving process otherwise grows without bound — the
+        per-run ledger/artifacts remain the durable history)."""
+        with self._lock:
+            total = self._stats["served"] + self._stats["rejected"]
+        if total % TRIM_EVERY:
+            return
+        try:
+            self.tracer.trim(SPANS_CAP)
+            for inst in self.mx.instruments():
+                if inst.kind == "series":
+                    inst.trim(SERIES_CAP)
+        except Exception:  # noqa: BLE001
+            pass
+
+    def _maybe_evaluate_slo(self) -> None:
+        if self.slo is None:
+            return
+        now = time.monotonic()
+        if now - self._last_slo < self.slo_every_s:
+            return
+        self._last_slo = now
+        try:
+            self.slo.evaluate_and_publish(mx=self.mx,
+                                          led=self.ledger)
+        except Exception:  # noqa: BLE001 — the objectives outrank
+            pass           # their scheduler
+
+    # -- status -------------------------------------------------------
+    def snapshot(self) -> dict:
+        """The `/status.json` `service` block."""
+        with self._lock:
+            depth = sum(len(q) for q in self._queues.values())
+            buckets = {_key_str(k): len(q)
+                       for k, q in self._queues.items() if q}
+            stats = dict(self._stats)
+            warm = len(self._warm)
+            recent = []
+            for req in list(self._runs.values())[-8:]:
+                recent.append({
+                    "id": req.id, "state": req.state,
+                    "tenant": req.tenant,
+                    "verdict": (_verdict_str(
+                        req.result.get("valid?"))
+                        if req.result else None),
+                    "wall_s": req.total_s,
+                    "warm_hit": req.warm_hit})
+            active = bool(self._threads) and not self._stop
+        served = stats["served"]
+        return {"active": active, "workers": self.workers,
+                "queued": depth, "buckets": buckets,
+                "warm_buckets": warm, **stats,
+                "warm_rate": (round(stats["warm_hits"] / served, 4)
+                              if served else None),
+                "recent": recent}
+
+
+def _verdict_str(v) -> str:
+    if v is True:
+        return "true"
+    if v is False:
+        return "false"
+    return str(v if v is not None else "unknown")
+
+
+# -- ambient default ---------------------------------------------------------
+# The serve process's service answers /status.json's `service` block
+# (the preflight/doctor snapshot pattern); web.serve(service=...) and
+# Service.start() both install it.
+_default: Optional[Service] = None
+
+
+def get_default() -> Optional[Service]:
+    return _default
+
+
+def set_default(svc: Optional[Service]) -> Optional[Service]:
+    global _default
+    prev = _default
+    _default = svc
+    return prev
+
+
+def snapshot() -> dict:
+    """The module-level `/status.json` `service` block: the default
+    instance's snapshot, or the explicit inactive stub."""
+    svc = _default
+    if svc is None:
+        return {"active": False, "workers": 0, "queued": 0,
+                "buckets": {}, "warm_buckets": 0, "submitted": 0,
+                "served": 0, "rejected": 0, "warm_hits": 0,
+                "batches": 0, "errors": 0, "warm_rate": None,
+                "recent": []}
+    return svc.snapshot()

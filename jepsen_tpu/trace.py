@@ -157,6 +157,18 @@ class Tracer:
         if sp is not None:
             sp.attrs[str(k)] = v
 
+    def trim(self, keep: int) -> int:
+        """Drop all but the newest `keep` finished spans; returns how
+        many were dropped. Long-lived processes (the service worker
+        pool) rotate their tracer with this so request spans don't
+        grow without bound — exports after a trim carry the recent
+        window only."""
+        with self._lock:
+            dropped = max(0, len(self.spans) - max(0, int(keep)))
+            if dropped:
+                del self.spans[:dropped]
+        return dropped
+
     # -- export --------------------------------------------------------
     def export(self, path: str) -> int:
         """Write collected spans as JSON lines; returns span count."""

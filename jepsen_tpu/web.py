@@ -27,6 +27,7 @@ import logging
 import os
 import re
 import threading
+import time
 import urllib.parse
 import zipfile
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -78,6 +79,10 @@ class _ValidityCache:
         self.store_root = store_root
         self.cache: dict = {}
         self.lock = threading.Lock()
+        # whole-table cache keyed on the store walk's (mtime, size)
+        # identity — see runs()
+        self._runs_key: Optional[tuple] = None
+        self._runs_out: Optional[list] = None
 
     def read_valid(self, run_dir: str):
         jf_path = os.path.join(run_dir, "test.jepsen")
@@ -94,23 +99,42 @@ class _ValidityCache:
             return "incomplete"
 
     def runs(self) -> list:
-        """[(name, time, path, valid?)] sorted newest-first."""
+        """[(name, time, path, valid?)] sorted newest-first.
+
+        The whole table is cached on the store walk's (mtime_ns,
+        size) identity — every run's test.jepsen stat, pure stats, no
+        file reads — so the SSE/status polling the service plane
+        added never turns the home page into a per-request disk scan
+        (the `_last_runs`/`doctor_for_record` keying, applied here).
+        A mid-write run's file changes its stat, which invalidates
+        the table and re-reads it through the MUTABLE_WINDOW rule."""
         entries = []
+        sig = []
         for name, by_time in store.tests(self.store_root).items():
             for t, path in by_time.items():
                 entries.append((t, name, path))
+                try:
+                    st = os.stat(os.path.join(path, "test.jepsen"))
+                    sig.append((name, t, st.st_mtime_ns, st.st_size))
+                except OSError:
+                    sig.append((name, t, None, None))
         entries.sort(reverse=True)
-        out = []
+        key = tuple(sorted(sig))
         with self.lock:
+            if key == self._runs_key and self._runs_out is not None:
+                return list(self._runs_out)
+            out = []
             for i, (t, name, path) in enumerate(entries):
-                key = (name, t)
-                if i >= MUTABLE_WINDOW and key in self.cache:
-                    v = self.cache[key]
+                ck = (name, t)
+                if i >= MUTABLE_WINDOW and ck in self.cache:
+                    v = self.cache[ck]
                 else:
                     v = self.read_valid(path)
-                    self.cache[key] = v
+                    self.cache[ck] = v
                 out.append((name, t, path, v))
-        return out
+            self._runs_key = key
+            self._runs_out = out
+        return list(out)
 
 
 def _esc(s) -> str:
@@ -219,6 +243,30 @@ def status_snapshot(store_root: str) -> dict:
         snap.setdefault("doctor",      # depend on the doctor plane
                         {"checked": 0, "findings": {},
                          "healthy_last": None, "recent": []})
+    # service plane (service.py): the admission queue + warm pool of
+    # the serving process wins; a mirror from another process keeps
+    # its own block, and the idle stub keeps the schema answerable
+    try:
+        from . import service as service_mod
+        sv = service_mod.snapshot()
+        if sv.get("active") or sv.get("submitted") \
+                or "service" not in snap:
+            snap["service"] = sv
+    except Exception:  # noqa: BLE001 — the status answer must not
+        snap.setdefault("service",  # depend on the service plane
+                        {"active": False, "queued": 0,
+                         "submitted": 0, "served": 0})
+    # SLO plane (slo.py): evaluations run in this process win; the
+    # idle stub keeps the documented schema answerable
+    try:
+        from . import slo as slo_mod
+        sl = slo_mod.snapshot()
+        if sl.get("checked") or "slo" not in snap:
+            snap["slo"] = sl
+    except Exception:  # noqa: BLE001 — the status answer must not
+        snap.setdefault("slo",       # depend on the SLO plane
+                        {"checked": 0, "alerts_total": 0,
+                         "burning": [], "last": None})
     # history, not just the live run: the last N ledger entries ride
     # every status answer so the fleet dashboard shows what the fleet
     # has DONE, not only what it is doing
@@ -376,10 +424,26 @@ def render_status(store_root: str) -> bytes:
             f"padding:1px 6px'>{_esc(top.get('rule'))}</b> "
             f"{_esc(top.get('summary'))} &middot; "
             f"<a href='/doctor'>doctor panel</a></p>")
+    sv = s.get("service") or {}
+    if sv.get("active") or sv.get("submitted"):
+        parts.append(
+            f"<p>service: {_esc(sv.get('served'))} served / "
+            f"{_esc(sv.get('queued'))} queued &middot; warm rate "
+            f"{_esc(sv.get('warm_rate'))} &middot; rejected "
+            f"{_esc(sv.get('rejected'))} &middot; "
+            f"<a href='/slo'>slo panel</a> &middot; "
+            f"<a href='/events'>event stream</a></p>")
+    sl = s.get("slo") or {}
+    if sl.get("burning"):
+        parts.append(
+            f"<p style='background:{VALID_COLORS[False]};padding:6px'>"
+            f"SLO burn alert: <b>{_esc(sl['burning'])}</b> &middot; "
+            f"<a href='/slo'>slo panel</a></p>")
     parts.append("<p><a href='/status.json'>status.json</a> &middot; "
                  "<a href='/occupancy'>occupancy</a> &middot; "
                  "<a href='/devices'>devices</a> &middot; "
                  "<a href='/doctor'>doctor</a> &middot; "
+                 "<a href='/slo'>slo</a> &middot; "
                  "<a href='/runs'>run ledger</a></p>")
     return _page("status", "".join(parts))
 
@@ -697,6 +761,124 @@ def render_doctor(store_root: str) -> bytes:
     return _page("doctor", "".join(parts))
 
 
+# /slo out-of-process fallback: evaluating a store's ledger per
+# request would re-scan the index; the (mtime, size) key re-evaluates
+# only when the ledger actually grew — PLUS a short TTL, because an
+# SLO evaluation is time-dependent (rolling windows anchored at now):
+# an unchanged ledger must still drain out of its windows rather than
+# serve a frozen burn alert forever.
+_SLO_CACHE: dict = {}
+_SLO_CACHE_TTL_S = 5.0
+# the serving process's own last evaluation is preferred only while
+# fresh: evaluations happen after served batches, so once traffic
+# stops the last report ages — and its windows must be allowed to
+# drain (a burn alert is not forever) via the read-only fallback
+_SLO_STALE_S = 60.0
+
+
+def _slo_latest(store_root: str):
+    """The compact evaluation the /slo panel renders: the serving
+    process's own last evaluation when one ran recently, else a
+    read-only evaluation of the store's ledger (cached on the index
+    file's identity + a TTL)."""
+    from . import slo as slo_mod
+    last = slo_mod.last_report()
+    if last is not None and \
+            time.time() - float(last.get("t") or 0) < _SLO_STALE_S:
+        return slo_mod.compact_report(last)
+    led = ledger_mod.Ledger(store_root)
+    try:
+        st = os.stat(led.index_path)
+        key = (st.st_mtime_ns, st.st_size)
+    except OSError:
+        return None
+    cached = _SLO_CACHE.get(store_root)
+    if cached is not None and cached[0] == key \
+            and time.monotonic() - cached[2] < _SLO_CACHE_TTL_S:
+        return cached[1]
+    try:
+        rep = slo_mod.compact_report(
+            slo_mod.evaluate_store(store_root))
+    except Exception:  # noqa: BLE001
+        rep = None
+    _SLO_CACHE[store_root] = (key, rep, time.monotonic())
+    return rep
+
+
+def render_slo(store_root: str) -> bytes:
+    """The auto-refreshing /slo panel (doc/OBSERVABILITY.md "Service
+    & SLO plane"): every objective's rolling-window value against its
+    target, the error budget remaining, burn-rate alerts, and the
+    service plane's live queue/warm stats."""
+    s = status_snapshot(store_root)
+    rep = _slo_latest(store_root)
+    parts = ["<meta http-equiv='refresh' content='2'>",
+             "<a href='/'>jepsen_tpu</a> / "
+             "<a href='/status'>status</a> / slo",
+             "<h1>service objectives"
+             f" &middot; {_esc(s.get('test') or 'no active run')}"
+             "</h1>"]
+    sv = s.get("service") or {}
+    if sv.get("active") or sv.get("submitted"):
+        parts.append(
+            f"<p>service: {_esc(sv.get('served'))} served &middot; "
+            f"{_esc(sv.get('queued'))} queued &middot; "
+            f"{_esc(sv.get('rejected'))} rejected &middot; warm rate "
+            f"{_esc(sv.get('warm_rate'))} &middot; "
+            f"{_esc(sv.get('warm_buckets'))} warm bucket(s)</p>")
+    if rep is None:
+        parts.append(
+            "<p>no SLO evaluations yet — the engine reads "
+            "<code>kind=\"service-request\"</code> ledger records "
+            "(POST /check some work, or run the service smoke)</p>")
+        return _page("slo", "".join(parts))
+    alerts = rep.get("alerts") or []
+    if alerts:
+        names = [a.get("objective") for a in alerts]
+        parts.append(
+            f"<p style='background:{VALID_COLORS[False]};padding:6px'>"
+            f"BURN ALERT: <b>{_esc(names)}</b> — the error budget is "
+            f"burning across every window</p>")
+    rows = []
+    for o in rep.get("objectives") or []:
+        met = o.get("met")
+        color = (VALID_COLORS[True] if met is True else
+                 VALID_COLORS[False] if met is False else
+                 VALID_COLORS[None])
+        budget = o.get("budget_remaining")
+        bar = ""
+        if budget is not None:
+            pct = max(0, min(100, int(float(budget) * 100)))
+            bcolor = (VALID_COLORS[True] if pct > 50 else
+                      VALID_COLORS["unknown"] if pct > 20
+                      else VALID_COLORS[False])
+            bar = (f"<div style='background:#eee;width:120px'>"
+                   f"<div style='background:{bcolor};width:"
+                   f"{max(pct, 2)}%;height:10px'></div></div>{pct}%")
+        rows.append(
+            f"<tr><td>{_esc(o.get('name'))}</td>"
+            f"<td>{_esc(o.get('window_s'))}s / n={_esc(o.get('n'))}"
+            f"</td>"
+            f"<td>{_esc(o.get('good_frac'))} vs "
+            f"{_esc(o.get('target_frac'))}</td>"
+            f"<td>{_esc(o.get('observed'))}"
+            + (f" (target {_esc(o.get('threshold_s'))}s)"
+               if o.get("threshold_s") is not None else "")
+            + f"</td><td style='background:{color}'>{_esc(met)}</td>"
+            f"<td>{_esc(o.get('burn_rate'))}x</td><td>{bar}</td>"
+            f"</tr>")
+    parts.append(
+        "<table><thead><tr><th>objective</th><th>window</th>"
+        "<th>good frac</th><th>observed</th><th>met</th>"
+        "<th>burn</th><th>budget left</th></tr></thead><tbody>"
+        + "".join(rows) + "</tbody></table>")
+    parts.append("<p><a href='/status.json'>status.json</a> (the "
+                 "`slo` block) &middot; <a href='/events'>event "
+                 "stream</a> &middot; <a href='/runs'>run ledger</a>"
+                 "</p>")
+    return _page("slo", "".join(parts))
+
+
 def _fmt_epoch(t) -> str:
     import time as _time
     try:
@@ -808,6 +990,7 @@ def render_home(cache: _ValidityCache) -> bytes:
             "<a href='/occupancy'>occupancy</a> &middot; "
             "<a href='/devices'>devices</a> &middot; "
             "<a href='/doctor'>doctor</a> &middot; "
+            "<a href='/slo'>slo</a> &middot; "
             "<a href='/runs'>run ledger</a></p>"
             "<table><thead><tr><th>Name</th>"
             "<th>Time</th><th>Valid?</th><th>Results</th><th>History</th>"
@@ -910,8 +1093,19 @@ def in_scope(store_root: str, path: str) -> bool:
     return real == rootp or real.startswith(rootp + os.sep)
 
 
+# POST /check bodies larger than this are refused outright (a 10k-op
+# history is ~1 MB of JSON; this bound is generous, not a quota).
+MAX_POST_BYTES = 64 << 20
+
+# SSE defaults: a stream with no explicit ?wait= cap closes itself
+# after this long so abandoned clients can't pin handler threads
+# forever; ?limit= bounds the event count (the tests use both).
+SSE_MAX_WAIT_S = 300.0
+
+
 class Handler(BaseHTTPRequestHandler):
     cache: _ValidityCache  # injected by serve()
+    service = None         # optional jepsen_tpu.service.Service
 
     def log_message(self, fmt, *args):  # route through logging
         log.debug("%s " + fmt, self.address_string(), *args)
@@ -923,8 +1117,158 @@ class Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    def _send_json(self, code: int, obj) -> None:
+        self._send(code, "application/json",
+                   json.dumps(obj, default=str).encode())
+
     def _404(self):
         self._send(404, "text/plain", b"404 not found")
+
+    # -- the service front door (POST /check) -------------------------
+    def do_POST(self):  # noqa: N802 (http.server API)
+        try:
+            uri = urllib.parse.unquote(
+                urllib.parse.urlparse(self.path).path)
+            if uri != "/check":
+                self._404()
+                return
+            svc = self.service
+            if svc is None:
+                self._send_json(503, {
+                    "error": "no service attached — start with "
+                             "`python -m jepsen_tpu serve "
+                             "--service`"})
+                return
+            try:
+                length = int(self.headers.get("Content-Length") or 0)
+            except ValueError:
+                length = 0
+            if length <= 0 or length > MAX_POST_BYTES:
+                self._send_json(400, {"error": "body required "
+                                      f"(<= {MAX_POST_BYTES} bytes)"})
+                return
+            try:
+                payload = json.loads(self.rfile.read(length))
+            except ValueError as e:
+                self._send_json(400, {"error": f"not JSON: {e}"})
+                return
+            try:
+                out = svc.submit(payload)
+            except ValueError as e:
+                self._send_json(400, {"error": str(e)})
+                return
+            out = dict(out)
+            out["watch"] = f"/runs/{out['id']}/events"
+            self._send_json(202, out)
+        except BrokenPipeError:
+            pass
+        except Exception:  # noqa: BLE001
+            log.warning("error serving %s", self.path, exc_info=True)
+            try:
+                self._send_json(500, {"error": "internal error"})
+            except OSError:
+                pass
+
+    # -- Server-Sent-Events streams -----------------------------------
+    def _sse_params(self) -> tuple:
+        q = urllib.parse.parse_qs(
+            urllib.parse.urlparse(self.path).query)
+
+        def _num(name, default):
+            try:
+                return float(q[name][0])
+            except (KeyError, IndexError, ValueError):
+                return default
+        return (_num("limit", float("inf")),
+                min(_num("wait", SSE_MAX_WAIT_S), SSE_MAX_WAIT_S))
+
+    def _sse_start(self) -> None:
+        self.send_response(200)
+        self.send_header("Content-Type", "text/event-stream")
+        self.send_header("Cache-Control", "no-store")
+        self.end_headers()
+
+    def _sse_write(self, event: str, data) -> None:
+        self.wfile.write(
+            (f"event: {event}\n"
+             f"data: {json.dumps(data, default=str)}\n\n").encode())
+        self.wfile.flush()
+
+    def _serve_run_events(self, run_id: str) -> None:
+        """/runs/<id>/events: the one run's lifecycle as SSE —
+        queued (with position), serving (queue wait, warm hit),
+        done (verdict) — then the stream closes. A client watches
+        admission-to-verdict without polling."""
+        svc = self.service
+        if svc is None or svc.get(run_id) is None:
+            self._404()
+            return
+        limit, wait = self._sse_params()
+        self._sse_start()
+        self._sse_write("snapshot", svc.get(run_id))
+        sent = 0
+        last_seq = 0
+        deadline = time.monotonic() + wait
+        while sent < limit:
+            evs, done = svc.run_events(run_id, after=last_seq,
+                                       timeout=1.0)
+            for e in evs:
+                last_seq = max(last_seq, e["seq"])
+                self._sse_write(str(e.get("event")), e)
+                sent += 1
+                if sent >= limit:
+                    break
+            if done and not evs:
+                self._sse_write("end", {"run_id": run_id})
+                break
+            if not evs and getattr(svc, "closed", False):
+                # a closed service's waiters return immediately —
+                # end the stream rather than spin to the deadline
+                self._sse_write("end", {"run_id": run_id})
+                break
+            if time.monotonic() > deadline:
+                break
+
+    def _serve_events(self) -> None:
+        """/events: the global service feed as SSE, with a throttled
+        `status` event (the /status.json snapshot's live-run slice —
+        phase, keys, ETA) whenever the feed idles, so one stream
+        watches both the queue and a live run's progress."""
+        svc = self.service
+        limit, wait = self._sse_params()
+        self._sse_start()
+        sent = 0
+        last_seq = 0
+        deadline = time.monotonic() + wait
+        while sent < limit and time.monotonic() < deadline:
+            evs = (svc.events_since(after=last_seq, timeout=1.0)
+                   if svc is not None else [])
+            if evs:
+                for e in evs:
+                    last_seq = max(last_seq, e["seq"])
+                    self._sse_write(str(e.get("event")), e)
+                    sent += 1
+                    if sent >= limit:
+                        break
+            else:
+                if svc is not None and getattr(svc, "closed", False):
+                    # a closed service's waiters return immediately
+                    # — end the stream rather than spin flooding
+                    # status events until the deadline
+                    break
+                s = status_snapshot(self.cache.store_root)
+                self._sse_write("status", {
+                    "active": s.get("active"),
+                    "phase": s.get("phase"),
+                    "keys": s.get("keys"),
+                    "eta_s": s.get("eta_s"),
+                    "service": {k: (s.get("service") or {}).get(k)
+                                for k in ("queued", "served",
+                                          "warm_rate")}})
+                sent += 1
+                if svc is None:
+                    time.sleep(min(1.0, max(
+                        0.0, deadline - time.monotonic())))
 
     def _serve_perfetto(self, run_id: str):
         """Convert a ledger record's exported trace.jsonl into the
@@ -979,6 +1323,17 @@ class Handler(BaseHTTPRequestHandler):
             if uri == "/doctor":
                 self._send(200, "text/html; charset=utf-8",
                            render_doctor(self.cache.store_root))
+                return
+            if uri == "/slo":
+                self._send(200, "text/html; charset=utf-8",
+                           render_slo(self.cache.store_root))
+                return
+            if uri == "/events":
+                self._serve_events()
+                return
+            m = re.match(r"^/runs/([A-Za-z0-9][\w.-]*)/events$", uri)
+            if m:
+                self._serve_run_events(m.group(1))
                 return
             if uri in ("/runs", "/runs/"):
                 self._send(200, "text/html; charset=utf-8",
@@ -1048,9 +1403,24 @@ class Handler(BaseHTTPRequestHandler):
 
 
 def serve(host: str = "0.0.0.0", port: int = 8080,
-          store_root: str = store.BASE_DIR) -> ThreadingHTTPServer:
+          store_root: str = store.BASE_DIR,
+          service=None) -> ThreadingHTTPServer:
     """Build the server (web.clj:385-390). Caller runs serve_forever();
-    port 0 picks a free port (the tests use this)."""
+    port 0 picks a free port (the tests use this). `service` — a
+    `jepsen_tpu.service.Service` — enables the checker-as-a-service
+    front door: POST /check plus the /events and /runs/<id>/events
+    SSE streams (doc/OBSERVABILITY.md "Service & SLO plane")."""
     cache = _ValidityCache(store_root)
-    handler = type("BoundHandler", (Handler,), {"cache": cache})
-    return ThreadingHTTPServer((host, port), handler)
+    handler = type("BoundHandler", (Handler,),
+                   {"cache": cache, "service": service})
+    # bind FIRST: a failed bind (port in use) must not leave worker
+    # threads running behind an installed ambient default.
+    # Service.start() installs the module default itself.
+    server = ThreadingHTTPServer((host, port), handler)
+    if service is not None:
+        try:
+            service.start()
+        except Exception:
+            server.server_close()
+            raise
+    return server

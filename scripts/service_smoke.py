@@ -1,0 +1,211 @@
+#!/usr/bin/env python
+"""Fast CI gate for the service plane (jepsen_tpu/service.py + slo.py).
+
+Five invariants, each cheap to violate silently and loud here:
+
+  * **cold POST compiles, then decides** — the first request of a
+    shape bucket pays the ladder warm-up in-band and still returns a
+    verdict;
+  * **warm same-bucket POST is zero-recompile** — the second POST of
+    the same canonical bucket decides under a CompileGuard with ZERO
+    new XLA compiles (the resident warm pool actually persists), and
+    the measured warm admission-to-verdict p50 lands under the
+    configured SLO (env JEPSEN_TPU_SLO_WARM_P50_S);
+  * **same-bucket arrivals coalesce** — two concurrent POSTs of one
+    bucket serve as ONE batch (batch_n == 2 on their `service`
+    series points);
+  * **a seeded burn alarms** — slow warm requests banked into a
+    fresh ledger drive the SLO engine to a multi-window burn alert
+    AND the doctor's D011 slo-burn finding, with the remedy naming
+    the dominant phase;
+  * **everything emitted lints** — the `service`/`slo` series, the
+    `kind="service-request"`/`kind="slo"` ledger records, and the
+    request trace export all pass scripts/telemetry_lint.py.
+
+~25 s on a CI cpu. Exit 0 clean, 1 on any violation.
+"""
+
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ.setdefault("JEPSEN_TPU_NO_CACHE", "1")
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    from jepsen_tpu import doctor, fs_cache, ledger, metrics
+    from jepsen_tpu import service as service_mod
+    from jepsen_tpu import slo as slo_mod
+    from jepsen_tpu import synth, web
+    from jepsen_tpu.analysis import guards
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import telemetry_lint
+
+    failures = []
+
+    def check(cond, msg):
+        print(("ok   " if cond else "FAIL ") + msg)
+        if not cond:
+            failures.append(msg)
+
+    tmp = tempfile.mkdtemp(prefix="service-smoke-")
+    fs_cache.DIR = os.path.join(tmp, "cache")  # keep plans out of ~
+    store = os.path.join(tmp, "store")
+    slo_mod._reset()
+    svc = service_mod.Service(store, workers=1, slo_every_s=3600.0)
+    server = web.serve(host="127.0.0.1", port=0, store_root=store,
+                       service=svc)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    base = f"http://127.0.0.1:{server.server_port}"
+
+    def post(h, tenant="smoke"):
+        body = json.dumps({
+            "model": "cas-register", "tenant": tenant,
+            "history": [op.to_dict() for op in h]}).encode()
+        req = urllib.request.Request(
+            base + "/check", data=body,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            assert resp.status == 202, resp.status
+            return json.loads(resp.read())
+
+    def wait_done(rid, timeout=180.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            info = svc.get(rid)
+            if info and info["state"] in ("done", "rejected"):
+                return info
+            time.sleep(0.05)
+        raise RuntimeError(f"run {rid} never finished")
+
+    # -- cold POST: compiles in-band, still decides -----------------
+    h_cold = synth.cas_register_history(200, n_procs=4, seed=11)
+    with guards.CompileGuard(name="service-cold") as g_cold:
+        i1 = wait_done(post(h_cold)["id"])
+    check(i1["verdict"] is True, "cold POST decides valid")
+    check(g_cold.compiles > 0,
+          f"cold POST warmed the bucket ({g_cold.compiles} "
+          "compile(s), paid once)")
+    check(i1["warm_hit"] is False, "first bucket touch is cold")
+
+    # -- warm same-bucket POST: ZERO recompiles ---------------------
+    h_warm = synth.cas_register_history(200, n_procs=4, seed=12)
+    with guards.CompileGuard(max_compiles=0,
+                             name="service-warm") as g_warm:
+        i2 = wait_done(post(h_warm)["id"])
+    check(i2["verdict"] is True and i2["warm_hit"] is True,
+          "second same-bucket POST is a warm hit")
+    check(g_warm.compiles == 0,
+          "warm POST adds ZERO XLA compiles (CompileGuard)")
+
+    # -- concurrent same-bucket POSTs coalesce into one batch -------
+    svc.hold(True)
+    outs = []
+    hs = [synth.cas_register_history(180, n_procs=4, seed=s)
+          for s in (13, 14)]
+    ths = [threading.Thread(target=lambda h=h: outs.append(post(h)))
+           for h in hs]
+    for t in ths:
+        t.start()
+    for t in ths:
+        t.join()
+    svc.hold(False)
+    infos = [wait_done(o["id"]) for o in outs]
+    pts = {p["run_id"]: p for p in svc.mx.series("service").points}
+    batch_ns = [pts[o["id"]]["batch_n"] for o in outs]
+    check(batch_ns == [2, 2],
+          f"two concurrent same-bucket POSTs coalesced into one "
+          f"batch (batch_n={batch_ns})")
+    check(all(i["verdict"] is True for i in infos),
+          "coalesced requests both decide valid")
+
+    # -- warm p50 lands under the configured SLO --------------------
+    # (one more warm request clears the engine's MIN_EVENTS floor:
+    # i2 + the two coalesced + this one = 4 warm samples)
+    wait_done(post(synth.cas_register_history(
+        190, n_procs=4, seed=15))["id"])
+    rep = svc.slo.evaluate_and_publish(mx=svc.mx, led=svc.ledger)
+    warm = next(o for o in rep["objectives"]
+                if o["name"] == "warm-p50")
+    observed = (warm["windows"][-1] or {}).get("observed")
+    check(warm["met"] is True,
+          f"warm admission-to-verdict p50 {observed}s under the "
+          f"{warm['threshold_s']}s SLO "
+          f"(n={warm['windows'][-1]['n']})")
+    check(not warm["burn_alert"],
+          "healthy warm traffic raises no warm-p50 burn alert")
+
+    # -- seeded slow run: burn alert + doctor D011 ------------------
+    burn_dir = os.path.join(tmp, "burn-store")
+    burn_led = ledger.Ledger(burn_dir)
+    now = time.time()
+    for i in range(8):
+        burn_led.record({
+            "kind": "service-request", "name": "service:seeded",
+            "t": now - 2 * i, "verdict": True, "tenant": "smoke",
+            "warm_hit": True, "batch_n": 1, "device_s": 0.5,
+            "wall_s": 9.0,
+            "phases": {"queue_wait_s": 8.2, "search_s": 0.7,
+                       "respond_s": 0.1}})
+    burn_reg = metrics.Registry()
+    burn_eng = slo_mod.Engine(burn_led, windows_s=(60.0, 600.0))
+    burn_rep = burn_eng.evaluate_and_publish(mx=burn_reg,
+                                             led=burn_led)
+    alerted = [a["objective"] for a in burn_rep["alerts"]]
+    check("warm-p50" in alerted,
+          f"seeded slow run fires the SLO burn alert ({alerted})")
+    view = doctor.TelemetryView(
+        target="burn", series={
+            "slo": burn_reg.series("slo").points},
+        records=burn_led.query(kind="service-request"))
+    diag = doctor.diagnose(view)
+    check("D011" in diag["rules_fired"],
+          f"doctor fires D011 on the seeded burn "
+          f"({diag['rules_fired']})")
+    top = next((f for f in diag["findings"]
+                if f["rule"] == "D011"), {})
+    check((top.get("remedy") or {}).get("dominant_phase")
+          == "queue_wait_s",
+          "D011 remedy names the dominant phase of the slowest "
+          "requests")
+
+    # -- every emitted artifact lints clean -------------------------
+    art = os.path.join(tmp, "artifacts")
+    os.makedirs(art, exist_ok=True)
+    svc_metrics = os.path.join(art, "service_metrics.jsonl")
+    svc.mx.export_jsonl(svc_metrics)
+    burn_metrics = os.path.join(art, "burn_metrics.jsonl")
+    burn_reg.export_jsonl(burn_metrics)
+    trace_path = os.path.join(art, "service_trace.jsonl")
+    svc.tracer.export(trace_path)
+    paths = [svc_metrics, burn_metrics, trace_path,
+             os.path.join(store, "ledger", "index.jsonl"),
+             os.path.join(burn_dir, "ledger", "index.jsonl")]
+    rec_dir = os.path.join(store, "ledger", "records")
+    paths += [os.path.join(rec_dir, f)
+              for f in sorted(os.listdir(rec_dir))]
+    rc = telemetry_lint.main(paths)
+    check(rc == 0, "service/slo series + records + trace lint clean")
+
+    server.shutdown()
+    svc.close()
+    if failures:
+        print(f"\nservice smoke: {len(failures)} FAILURE(S)")
+        return 1
+    print("\nservice smoke: all checks passed")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

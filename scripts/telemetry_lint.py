@@ -95,7 +95,7 @@ SERIES_SCHEMAS = {
     "device_poll": {"where": str, "n_devices": int,
                     "stats_available": int},
     # the diagnosis plane (doctor.py): one point per finding a
-    # diagnosis produced — rule must be a catalog id (D001-D010),
+    # diagnosis produced — rule must be a catalog id (D001-D012),
     # severity one of the documented levels
     "doctor": {"rule": str, "severity": str, "target": str,
                "summary": str, "where": str},
@@ -110,13 +110,28 @@ SERIES_SCHEMAS = {
     # device's pending queue when work_skew trips
     "fleet_sched": {"event": str, "from": str, "to": str,
                     "keys": list, "skew_before": NUM},
+    # the service plane (jepsen_tpu/service.py): one point per
+    # request completion — verdict is the checker enum as a string
+    # ("true"/"false"/"unknown"), walls in seconds, warm_hit whether
+    # the bucket's kernels were already resident, batch_n how many
+    # same-bucket requests coalesced, queue_depth at completion
+    "service": {"run_id": str, "tenant": str, "bucket": str,
+                "verdict": str, "wait_s": NUM, "serve_s": NUM,
+                "total_s": NUM, "warm_hit": bool, "batch_n": int,
+                "queue_depth": int},
+    # the SLO engine (jepsen_tpu/slo.py): one point per objective per
+    # evaluation — good_frac over the longest rolling window,
+    # burn_rate in error-budget multiples (1.0 = consuming exactly
+    # the budget), met the window verdict
+    "slo": {"objective": str, "window_s": NUM, "good_frac": NUM,
+            "target_frac": NUM, "met": bool, "burn_rate": NUM},
 }
 
 # doctor.py's rule catalog + severity levels — duplicated here as the
 # lint contract (this script is import-light on purpose: schema drift
 # in doctor.py must FAIL against this frozen enum, not silently
 # follow it)
-DOCTOR_RULE_IDS = {f"D{i:03d}" for i in range(1, 11)}
+DOCTOR_RULE_IDS = {f"D{i:03d}" for i in range(1, 13)}
 DOCTOR_SEVERITIES = {"critical", "warn", "info"}
 
 # the bench diagnosis report (bench._export_doctor ->
@@ -357,6 +372,60 @@ def lint_ledger_file(path: str) -> list:
                 for j, f in enumerate(fnds):
                     errs += _check_doctor_finding(
                         f, f"{where}.findings[{j}]")
+        if obj.get("kind") == "service-request":
+            # checker-as-a-service records (jepsen_tpu/service.py):
+            # verdict is the checker enum, phase walls are numeric,
+            # tenant/warm-hit carry the billing + SLO attribution
+            if obj.get("verdict") not in (True, False, "unknown"):
+                errs.append(
+                    f"{where}: service-request 'verdict' should be "
+                    f"true/false/\"unknown\", got "
+                    f"{obj.get('verdict')!r}")
+            if not isinstance(obj.get("tenant"), str):
+                errs.append(f"{where}: service-request needs a str "
+                            "'tenant'")
+            if not isinstance(obj.get("warm_hit"), bool):
+                errs.append(f"{where}: service-request needs bool "
+                            "'warm_hit'")
+            ph = obj.get("phases")
+            if not isinstance(ph, dict):
+                errs.append(f"{where}: service-request needs the "
+                            "'phases' wall object")
+            else:
+                for k, v in ph.items():
+                    if not isinstance(v, NUM) or isinstance(v, bool):
+                        errs.append(
+                            f"{where}: phases[{k!r}] should be "
+                            f"numeric, got {type(v).__name__}")
+        if obj.get("kind") == "slo":
+            # SLO evaluations (jepsen_tpu/slo.py): per-objective
+            # budget/burn fields must stay numeric, met bool
+            if not isinstance(obj.get("windows_s"), list):
+                errs.append(f"{where}: slo record needs the "
+                            "'windows_s' list")
+            objs = obj.get("objectives")
+            if not isinstance(objs, list):
+                errs.append(f"{where}: slo 'objectives' should be a "
+                            "list")
+            else:
+                for j, row in enumerate(objs):
+                    ow = f"{where}.objectives[{j}]"
+                    if not isinstance(row, dict):
+                        errs.append(f"{ow}: entry is not an object")
+                        continue
+                    if not isinstance(row.get("name"), str):
+                        errs.append(f"{ow}: 'name' should be str")
+                    if not isinstance(row.get("met"), bool):
+                        errs.append(f"{ow}: 'met' should be bool")
+                    for fld in ("burn_rate", "budget_remaining"):
+                        v = row.get(fld)
+                        if not isinstance(v, NUM) \
+                                or isinstance(v, bool):
+                            errs.append(f"{ow}: {fld!r} should be "
+                                        "numeric")
+            if not isinstance(obj.get("burn_alerts"), list):
+                errs.append(f"{where}: slo record needs the "
+                            "'burn_alerts' list")
         if obj.get("kind") == "multichip":
             # mesh dryrun records (devices.multichip_record): device
             # count + per-device attribution are the record's point

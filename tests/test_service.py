@@ -1,0 +1,501 @@
+"""Service-plane tests (jepsen_tpu/service.py): canonical bucket
+keying, admission (malformed / queue-full / quota), queue semantics
+and coalescing, the warm registry + fs_cache restart re-warm, the
+request-scoped trace/series/ledger surfaces, and the web front door
+(POST /check, SSE framing, /status.json service block). Histories
+are small (one tiny shape bucket per process) and ladder warming is
+off (`warm_ladder=False`, first-touch accounting) so the file stays
+inside the tier-1 budget; the full warm-ladder zero-recompile proof
+runs in scripts/service_smoke.py."""
+
+import json
+import os
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from jepsen_tpu import fs_cache, ledger, synth, web
+from jepsen_tpu import service as service_mod
+from jepsen_tpu import slo as slo_mod
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "scripts"))
+import telemetry_lint  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _isolate(monkeypatch, tmp_path):
+    monkeypatch.setattr(fs_cache, "DIR",
+                        str(tmp_path / "fs-cache-iso"))
+    prev = service_mod.set_default(None)
+    slo_mod._reset()
+    yield
+    service_mod.set_default(prev)
+    slo_mod._reset()
+
+
+def _service(root, **kw):
+    kw.setdefault("workers", 1)
+    kw.setdefault("warm_ladder", False)
+    kw.setdefault("slo_every_s", 3600.0)
+    return service_mod.Service(str(root), **kw)
+
+
+def _hist(n=120, seed=1):
+    return synth.cas_register_history(n, n_procs=4, seed=seed)
+
+
+def _post(svc, h, **kw):
+    payload = {"model": "cas-register", "history": h, **kw}
+    return svc.submit(payload)
+
+
+def _wait(svc, rid, timeout=120.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        info = svc.get(rid)
+        if info and info["state"] in ("done", "rejected"):
+            return info
+        time.sleep(0.02)
+    raise AssertionError(f"run {rid} never finished")
+
+
+# --- canonical bucket keying (pure host) -----------------------------------
+
+def _fake_enc(window_raw=10, n=100, ic=4, S=16, O=32, times_max=100):
+    z = np.full(n, times_max, dtype=np.int32)
+    return SimpleNamespace(
+        window_raw=window_raw, inv=z, ret=z,
+        sufminret=np.full(n + 1, times_max, dtype=np.int32),
+        inv_info=np.full(ic, times_max, dtype=np.int32),
+        table=np.zeros((S, O), dtype=np.int32))
+
+
+class TestBucketFor:
+    def test_same_quantum_same_key(self):
+        k1, b1 = service_mod.bucket_for(_fake_enc(n=100, ic=4))
+        k2, b2 = service_mod.bucket_for(_fake_enc(n=250, ic=30))
+        assert k1 == k2
+        assert b1 == b2
+        assert b1["n_pad"] == 256 and b1["ic_pad"] == 32
+
+    def test_concurrency_jitter_does_not_fragment_narrow(self):
+        # narrow windows all key at W_eff 32 — per-request jitter in
+        # window_raw must not defeat the warm pool
+        keys = {service_mod.bucket_for(_fake_enc(window_raw=w))[0]
+                for w in (6, 10, 17, 32)}
+        assert len(keys) == 1
+
+    def test_quantum_straddle_splits(self):
+        k1, _ = service_mod.bucket_for(_fake_enc(n=250))
+        k2, _ = service_mod.bucket_for(_fake_enc(n=270))
+        assert k1 != k2
+
+    def test_wide_branch_splits(self):
+        k_narrow, b_n = service_mod.bucket_for(_fake_enc(
+            window_raw=30))
+        k_wide, b_w = service_mod.bucket_for(_fake_enc(
+            window_raw=40))
+        assert k_narrow != k_wide
+        assert b_w["w_eff"] == 64 and b_n["w_eff"] == 32
+
+    def test_pack_bit_in_key(self):
+        from jepsen_tpu.ops.wgl32 import PACK_MAX
+        k_packed, _ = service_mod.bucket_for(_fake_enc())
+        k_unpacked, _ = service_mod.bucket_for(
+            _fake_enc(times_max=PACK_MAX + 1))
+        assert k_packed != k_unpacked
+
+
+# --- admission --------------------------------------------------------------
+
+class TestAdmission:
+    def test_malformed_requests_raise(self, tmp_path):
+        svc = _service(tmp_path)
+        with pytest.raises(ValueError, match="unknown model"):
+            svc.submit({"model": "nope", "history": _hist()})
+        with pytest.raises(ValueError, match="empty"):
+            svc.submit({"model": "cas-register", "history": []})
+        with pytest.raises(ValueError, match="unknown checker"):
+            svc.submit({"checker": "zap", "history": _hist()})
+        with pytest.raises(ValueError, match="'type'"):
+            svc.submit({"model": "cas-register",
+                        "history": [{"f": "read"}]})
+        svc.close()
+
+    def test_submit_queues_with_position(self, tmp_path):
+        svc = _service(tmp_path)
+        svc.hold(True)
+        out1 = _post(svc, _hist(seed=1))
+        out2 = _post(svc, _hist(seed=2))
+        assert out1["state"] == "queued" and out1["position"] == 1
+        assert out2["position"] == 2 and out2["depth"] == 2
+        assert out1["bucket"] == out2["bucket"]
+        info = svc.get(out1["id"])
+        assert info["state"] == "queued"
+        assert [e["event"] for e in info["events"]] == ["queued"]
+        assert svc.get("no-such-run") is None
+        svc.close()
+
+    def test_queue_full_rejects(self, tmp_path):
+        svc = _service(tmp_path, max_queue=1)
+        svc.hold(True)
+        _post(svc, _hist(seed=1))
+        out = _post(svc, _hist(seed=2))
+        assert out["state"] == "rejected"
+        assert out["cause"] == "queue-full"
+        svc.close()
+
+    def test_quota_rejects_and_is_per_tenant(self, tmp_path):
+        led = ledger.Ledger(str(tmp_path))
+        led.record({"kind": "service-request", "name": "s",
+                    "verdict": True, "tenant": "greedy",
+                    "warm_hit": True, "batch_n": 1,
+                    "device_s": 2.0, "wall_s": 2.0,
+                    "phases": {"search_s": 2.0}})
+        svc = _service(tmp_path, quota_device_s=1.0)
+        svc.hold(True)
+        assert svc.tenant_usage("greedy") == 2.0
+        out = _post(svc, _hist(seed=1), tenant="greedy")
+        assert out["state"] == "rejected" and out["cause"] == "quota"
+        rec = svc.ledger.get(out["id"])
+        assert rec["verdict"] == "unknown"
+        assert rec["cause"] == "quota"
+        assert rec["tenant"] == "greedy"
+        # another tenant is not throttled by greedy's spend
+        out2 = _post(svc, _hist(seed=2), tenant="frugal")
+        assert out2["state"] == "queued"
+        svc.close()
+
+    def test_rejection_excluded_from_slo(self, tmp_path):
+        svc = _service(tmp_path, quota_device_s=0.0)
+        svc.hold(True)
+        out = _post(svc, _hist(seed=1), tenant="t")
+        assert out["state"] == "rejected"
+        rec = svc.ledger.get(out["id"])
+        for obj in slo_mod.default_objectives():
+            assert obj.good(rec) is None
+        svc.close()
+
+
+# --- end-to-end serve -------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def served(tmp_path_factory):
+    """One service, two sequential same-bucket requests (the second
+    is a first-touch warm hit) — shared by the result/telemetry
+    assertions below so the kernel compile is paid once."""
+    root = tmp_path_factory.mktemp("service-store")
+    prev_dir = fs_cache.DIR
+    fs_cache.DIR = str(tmp_path_factory.mktemp("fs-cache"))
+    svc = _service(root)
+    infos = []
+    for seed in (1, 2):
+        out = _post(svc, _hist(seed=seed), tenant="tester")
+        infos.append(_wait(svc, out["id"]))
+    yield svc, infos
+    svc.close()
+    fs_cache.DIR = prev_dir
+    service_mod.set_default(None)
+
+
+class TestServe:
+    def test_verdicts_and_warm_accounting(self, served):
+        svc, (i1, i2) = served
+        assert i1["verdict"] is True and i2["verdict"] is True
+        assert i1["warm_hit"] is False
+        assert i2["warm_hit"] is True
+        assert i1["bucket"] == i2["bucket"]
+
+    def test_lifecycle_events(self, served):
+        svc, (i1, _) = served
+        names = [e["event"] for e in i1["events"]]
+        assert names == ["queued", "serving", "done"]
+        done = i1["events"][-1]
+        assert done["verdict"] == "true"
+        assert isinstance(done["wall_s"], float)
+
+    def test_phase_walls(self, served):
+        svc, (i1, _) = served
+        assert set(i1["phases"]) >= {"admit_s", "preflight_s",
+                                     "queue_wait_s", "search_s",
+                                     "respond_s"}
+        assert all(isinstance(v, float) and v >= 0
+                   for v in i1["phases"].values())
+
+    def test_request_spans_share_one_trace(self, served):
+        svc, (i1, _) = served
+        spans = [sp for sp in svc.tracer.spans
+                 if sp.attrs.get("run_id") == i1["id"]]
+        names = {sp.name for sp in spans}
+        assert names >= {"admit", "preflight", "queue-wait",
+                         "search", "respond"}
+        assert len({sp.trace_id for sp in spans}) == 1
+
+    def test_ledger_records(self, served):
+        svc, (i1, i2) = served
+        recs = svc.ledger.query(kind="service-request")
+        assert len(recs) == 2
+        by_id = {r["id"]: r for r in recs}
+        assert by_id[i1["id"]]["warm_hit"] is False
+        assert by_id[i2["id"]]["warm_hit"] is True
+        for r in recs:
+            assert r["verdict"] is True
+            assert r["tenant"] == "tester"
+            assert isinstance(r["phases"], dict)
+            assert isinstance(r["device_s"], (int, float))
+        idx = os.path.join(svc.store_root, "ledger", "index.jsonl")
+        assert telemetry_lint.lint_ledger_file(idx) == []
+
+    def test_service_series_lints(self, served, tmp_path):
+        svc, _infos = served
+        pts = svc.mx.series("service").points
+        assert len(pts) == 2
+        for p in pts:
+            assert p["verdict"] == "true"
+            assert isinstance(p["queue_depth"], int)
+            assert isinstance(p["batch_n"], int)
+        path = str(tmp_path / "service_metrics.jsonl")
+        svc.mx.export_jsonl(path)
+        assert telemetry_lint.lint_jsonl_file(path) == []
+
+    def test_snapshot_and_status_block(self, served):
+        svc, _infos = served
+        snap = svc.snapshot()
+        assert snap["served"] == 2 and snap["rejected"] == 0
+        assert snap["warm_rate"] == 0.5
+        assert snap["warm_buckets"] == 1
+        # the serving process's default answers the status block
+        # (the autouse isolation fixture cleared it)
+        service_mod.set_default(svc)
+        s = web.status_snapshot(svc.store_root)
+        assert s["service"]["served"] == 2
+        assert s["service"]["active"] is True
+
+    def test_drifted_series_point_fails_lint(self, tmp_path):
+        pt = {"type": "sample", "series": "service", "t": 1.0,
+              "run_id": "r", "tenant": "t", "bucket": "b",
+              "verdict": True, "wait_s": 0.1, "serve_s": 0.1,
+              "total_s": 0.2, "warm_hit": "yes", "batch_n": 1,
+              "queue_depth": 0}
+        p = tmp_path / "m.jsonl"
+        p.write_text(json.dumps(pt) + "\n")
+        errs = telemetry_lint.lint_jsonl_file(str(p))
+        assert any("verdict" in e for e in errs)
+        assert any("warm_hit" in e for e in errs)
+
+    def test_drifted_record_fails_lint(self, tmp_path):
+        rec = {"schema": 1, "id": "x", "kind": "service-request",
+               "name": "s", "t": 1.0, "verdict": "valid",
+               "tenant": "t", "warm_hit": True,
+               "phases": {"search_s": "fast"}}
+        p = tmp_path / "index.jsonl"
+        (tmp_path / "nothing").mkdir()
+        p.write_text(json.dumps(rec) + "\n")
+        errs = telemetry_lint.lint_ledger_file(str(p))
+        assert any("verdict" in e for e in errs)
+        assert any("search_s" in e for e in errs)
+
+
+class TestCoalesce:
+    def test_held_same_bucket_requests_serve_as_one_batch(
+            self, tmp_path):
+        svc = _service(tmp_path)
+        svc.hold(True)
+        outs = [_post(svc, _hist(seed=s)) for s in (3, 4)]
+        svc.hold(False)
+        infos = [_wait(svc, o["id"]) for o in outs]
+        assert all(i["verdict"] is True for i in infos)
+        pts = {p["run_id"]: p for p in
+               svc.mx.series("service").points}
+        assert [pts[o["id"]]["batch_n"] for o in outs] == [2, 2]
+        assert svc.snapshot()["batches"] == 1
+        svc.close()
+
+
+class TestElle:
+    def test_elle_append_request(self, tmp_path):
+        svc = _service(tmp_path)
+        h = synth.list_append_history(60, n_procs=4, seed=1)
+        out = svc.submit({"checker": "elle-append", "history": h,
+                          "tenant": "e"})
+        assert out["bucket"].startswith("elle-append/")
+        info = _wait(svc, out["id"])
+        assert info["verdict"] is True
+        rec = svc.ledger.get(out["id"])
+        assert rec["checker"] == "elle-append"
+        assert rec["verdict"] is True
+        svc.close()
+
+
+class TestRewarm:
+    def test_plan_registry_round_trip(self, tmp_path, monkeypatch):
+        """A warmed bucket's plan lands in fs_cache; a NEW service
+        (the process-restart stand-in) re-warms it and answers its
+        first same-bucket request as a warm hit. The precompile is
+        stubbed — the real zero-recompile proof is the smoke's."""
+        calls = []
+
+        def fake_precompile(bucket, accel=False):
+            calls.append(dict(bucket))
+            return {2: 0.0}
+
+        import jepsen_tpu.ops.aot as aot
+        monkeypatch.setattr(aot, "precompile_service_bucket",
+                            fake_precompile)
+        svc = _service(tmp_path / "a", warm_ladder=True)
+        out = _post(svc, _hist(seed=5))
+        _wait(svc, out["id"])
+        svc.close()
+        assert len(calls) == 1
+        plans = fs_cache.list_data(("service-plan",))
+        assert len(plans) == 1 and plans[0]["bucket"] == calls[0]
+
+        svc2 = _service(tmp_path / "b", warm_ladder=True,
+                        rewarm=True)
+        assert len(calls) == 2  # restart re-warmed the plan
+        out2 = _post(svc2, _hist(seed=6))
+        info = _wait(svc2, out2["id"])
+        assert info["warm_hit"] is True
+        svc2.close()
+
+
+# --- the web front door -----------------------------------------------------
+
+@pytest.fixture(scope="module")
+def http_service(tmp_path_factory):
+    root = str(tmp_path_factory.mktemp("http-store"))
+    prev_dir = fs_cache.DIR
+    fs_cache.DIR = str(tmp_path_factory.mktemp("http-cache"))
+    svc = service_mod.Service(root, workers=1, warm_ladder=False,
+                              slo_every_s=3600.0)
+    server = web.serve(host="127.0.0.1", port=0, store_root=root,
+                       service=svc)
+    threading.Thread(target=server.serve_forever,
+                     daemon=True).start()
+    base = f"http://127.0.0.1:{server.server_port}"
+    yield base, svc
+    server.shutdown()
+    svc.close()
+    fs_cache.DIR = prev_dir
+    service_mod.set_default(None)
+
+
+def _http_post(base, path, obj, expect=202):
+    data = json.dumps(obj, default=str).encode()
+    req = urllib.request.Request(
+        base + path, data=data,
+        headers={"Content-Type": "application/json"})
+    try:
+        resp = urllib.request.urlopen(req, timeout=60)
+        assert resp.status == expect
+        return json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        assert e.code == expect, (e.code, e.read())
+        return json.loads(e.read())
+
+
+def _sse_events(raw: str):
+    """[(event, data dict)] from an SSE stream body."""
+    out = []
+    ev = None
+    for line in raw.splitlines():
+        if line.startswith("event: "):
+            ev = line[len("event: "):]
+        elif line.startswith("data: "):
+            out.append((ev, json.loads(line[len("data: "):])))
+    return out
+
+
+class TestHTTP:
+    def test_post_check_and_sse_stream(self, http_service):
+        base, svc = http_service
+        h = [op.to_dict() for op in _hist(seed=7)]
+        out = _http_post(base, "/check",
+                         {"model": "cas-register", "history": h,
+                          "tenant": "http"})
+        assert out["state"] == "queued"
+        assert out["watch"] == f"/runs/{out['id']}/events"
+        _wait(svc, out["id"])
+        raw = urllib.request.urlopen(
+            base + out["watch"] + "?wait=30",
+            timeout=60).read().decode()
+        events = _sse_events(raw)
+        names = [e for e, _ in events]
+        assert names[0] == "snapshot"
+        assert names[-1] == "end"
+        assert {"queued", "serving", "done"} <= set(names)
+        done = next(d for e, d in events if e == "done")
+        assert done["verdict"] == "true"
+        assert done["run_id"] == out["id"]
+
+    def test_global_events_stream_carries_status(self,
+                                                 http_service):
+        base, _svc = http_service
+        raw = urllib.request.urlopen(
+            base + "/events?limit=2&wait=5",
+            timeout=30).read().decode()
+        events = _sse_events(raw)
+        assert events, "stream yielded nothing"
+        # an idle feed falls back to throttled status events
+        statuses = [d for e, d in events if e == "status"]
+        for s in statuses:
+            assert "keys" in s and "service" in s
+
+    def test_unknown_run_events_404(self, http_service):
+        base, _svc = http_service
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(base + "/runs/nope/events",
+                                   timeout=10)
+        assert ei.value.code == 404
+
+    def test_bad_post_bodies(self, http_service):
+        base, _svc = http_service
+        out = _http_post(base, "/check", {"model": "nope",
+                                          "history": [1]},
+                         expect=400)
+        assert "error" in out
+        req = urllib.request.Request(
+            base + "/check", data=b"not json",
+            headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=10)
+        assert ei.value.code == 400
+
+    def test_post_without_service_503(self, tmp_path):
+        server = web.serve(host="127.0.0.1", port=0,
+                           store_root=str(tmp_path))
+        threading.Thread(target=server.serve_forever,
+                         daemon=True).start()
+        base = f"http://127.0.0.1:{server.server_port}"
+        out = _http_post(base, "/check", {"model": "cas-register",
+                                          "history": []},
+                         expect=503)
+        assert "no service" in out["error"]
+        server.shutdown()
+
+    def test_status_json_service_block(self, http_service):
+        base, svc = http_service
+        # the autouse isolation fixture clears the module default
+        # each test; the serve process installs it once at startup
+        service_mod.set_default(svc)
+        s = json.loads(urllib.request.urlopen(
+            base + "/status.json", timeout=10).read())
+        assert s["service"]["active"] is True
+        assert set(s["service"]) >= {"queued", "served", "rejected",
+                                     "warm_rate", "recent"}
+        assert set(s["slo"]) >= {"checked", "alerts_total",
+                                 "burning", "last"}
+
+    def test_slo_panel_served(self, http_service):
+        base, _svc = http_service
+        resp = urllib.request.urlopen(base + "/slo", timeout=10)
+        assert resp.status == 200
+        assert b"service objectives" in resp.read()

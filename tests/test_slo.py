@@ -1,0 +1,408 @@
+"""SLO-engine tests (jepsen_tpu/slo.py): objective predicates,
+rolling-window burn-rate math (both windows), budget accounting,
+publish surfaces (series + ledger + fleet faults) with lint, the
+/status.json `slo` block schema, the /slo panel render, and the
+doctor's D011/D012 correlation rules. Pure host arithmetic over
+fabricated records — no device work; the end-to-end path runs in
+scripts/service_smoke.py."""
+
+import json
+import os
+import sys
+import time
+
+import pytest
+
+from jepsen_tpu import doctor, fleet, ledger, metrics
+from jepsen_tpu import slo as slo_mod
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "scripts"))
+import telemetry_lint  # noqa: E402
+
+NOW = 1_700_000_000.0
+
+
+def _req(t, *, wall=0.1, warm=True, verdict=True, queue_wait=0.01,
+         cause=None, tenant="t"):
+    rec = {"kind": "service-request", "t": t, "verdict": verdict,
+           "wall_s": wall, "warm_hit": warm, "tenant": tenant,
+           "batch_n": 1, "device_s": 0.01,
+           "phases": {"queue_wait_s": queue_wait,
+                      "search_s": max(wall - queue_wait, 0.0)}}
+    if cause:
+        rec["cause"] = cause
+    return rec
+
+
+def _engine(**kw):
+    kw.setdefault("windows_s", (60.0, 600.0))
+    return slo_mod.Engine(**kw)
+
+
+def _obj(rep, name):
+    return next(o for o in rep["objectives"] if o["name"] == name)
+
+
+@pytest.fixture(autouse=True)
+def _isolate():
+    slo_mod._reset()
+    yield
+    slo_mod._reset()
+
+
+class TestObjectivePredicates:
+    def test_latency_good_bad(self):
+        obj = slo_mod.Objective("o", "", 0.5, threshold_s=1.0)
+        assert obj.good(_req(NOW, wall=0.5)) is True
+        assert obj.good(_req(NOW, wall=2.0)) is False
+
+    def test_warm_only_excludes_cold(self):
+        obj = slo_mod.Objective("o", "", 0.5, threshold_s=1.0,
+                                warm_only=True)
+        assert obj.good(_req(NOW, warm=False, wall=9.0)) is None
+        assert obj.good(_req(NOW, warm=True, wall=9.0)) is False
+
+    def test_admission_rejections_excluded_everywhere(self):
+        for obj in slo_mod.default_objectives():
+            for cause in ("preflight", "quota"):
+                assert obj.good(_req(NOW, verdict="unknown",
+                                     cause=cause)) is None
+
+    def test_availability_counts_unknown_as_bad(self):
+        obj = slo_mod.Objective("a", "", 0.99)
+        assert obj.good(_req(NOW, verdict=True)) is True
+        assert obj.good(_req(NOW, verdict=False)) is True  # decided
+        assert obj.good(_req(NOW, verdict="unknown")) is False
+
+    def test_phase_field_objective(self):
+        obj = slo_mod.Objective("q", "", 0.95, threshold_s=0.5,
+                                phase="queue_wait_s")
+        assert obj.good(_req(NOW, queue_wait=0.1)) is True
+        assert obj.good(_req(NOW, queue_wait=0.9)) is False
+
+
+class TestBurnRateMath:
+    def test_empty_window_abstains(self):
+        rep = _engine().evaluate(now=NOW, records=[])
+        for o in rep["objectives"]:
+            assert o["met"] is None
+            assert not o["burn_alert"]
+        assert rep["met"] is None
+
+    def test_below_min_events_abstains(self):
+        recs = [_req(NOW - i, wall=9.0) for i in range(3)]
+        rep = _engine().evaluate(now=NOW, records=recs)
+        assert _obj(rep, "warm-p50")["met"] is None
+
+    def test_healthy_traffic_meets_and_keeps_budget(self):
+        recs = [_req(NOW - i) for i in range(10)]
+        rep = _engine().evaluate(now=NOW, records=recs)
+        warm = _obj(rep, "warm-p50")
+        assert warm["met"] is True
+        assert not warm["burn_alert"]
+        assert warm["budget"]["remaining_frac"] == 1.0
+        assert rep["alerts"] == []
+        assert rep["met"] is True
+
+    def test_both_windows_burning_alerts(self):
+        # slow warm requests spaced so BOTH windows are populated:
+        # every window burns at the p50 cap (2x) -> alert
+        recs = [_req(NOW - 7 * i, wall=9.0) for i in range(10)]
+        rep = _engine().evaluate(now=NOW, records=recs)
+        warm = _obj(rep, "warm-p50")
+        wins = {w["window_s"]: w for w in warm["windows"]}
+        assert wins[60.0]["burn_rate"] == 2.0
+        assert wins[600.0]["burn_rate"] == 2.0
+        assert warm["burn_alert"]
+        assert "warm-p50" in [a["objective"] for a in rep["alerts"]]
+        assert rep["met"] is False
+
+    def test_short_window_blip_does_not_alert(self):
+        # recent burst is bad, but the long window absorbs it: the
+        # multi-window gate holds the alarm
+        recs = [_req(NOW - i, wall=9.0) for i in range(4)]
+        recs += [_req(NOW - 100 - 10 * i, wall=0.1)
+                 for i in range(46)]
+        rep = _engine().evaluate(now=NOW, records=recs)
+        warm = _obj(rep, "warm-p50")
+        wins = {w["window_s"]: w for w in warm["windows"]}
+        assert wins[60.0]["burn_rate"] >= 2.0     # fast window burns
+        assert wins[600.0]["burn_rate"] < 2.0     # slow one absorbs
+        assert not warm["burn_alert"]
+        assert "warm-p50" not in [a["objective"]
+                                  for a in rep["alerts"]]
+
+    def test_p95_gate_fires_below_nominal_threshold(self):
+        # 10% of requests over the queue-wait target burns a 0.95
+        # objective at 2x even though 90% are fine
+        recs = [_req(NOW - i, queue_wait=0.9 if i % 10 == 0
+                     else 0.01) for i in range(50)]
+        rep = _engine().evaluate(now=NOW, records=recs)
+        q = _obj(rep, "queue-wait-p95")
+        assert q["burn_alert"]
+
+    def test_observed_percentile_reported(self):
+        recs = [_req(NOW - i, wall=float(i % 5)) for i in range(20)]
+        rep = _engine().evaluate(now=NOW, records=recs)
+        warm = _obj(rep, "warm-p50")
+        longest = warm["windows"][-1]
+        assert isinstance(longest["observed"], float)
+
+    def test_budget_spend_caps(self):
+        recs = [_req(NOW - i, verdict="unknown") for i in range(20)]
+        rep = _engine().evaluate(now=NOW, records=recs)
+        avail = _obj(rep, "availability")
+        assert avail["budget"]["spent_frac"] == 10.0  # capped
+        assert avail["budget"]["remaining_frac"] == 0.0
+
+    def test_windows_from_env(self, monkeypatch):
+        monkeypatch.setenv("JEPSEN_TPU_SLO_WINDOWS", "30,900")
+        assert slo_mod.windows_from_env() == (30.0, 900.0)
+        monkeypatch.setenv("JEPSEN_TPU_SLO_WINDOWS", "garbage")
+        assert slo_mod.windows_from_env() == \
+            slo_mod.DEFAULT_WINDOWS_S
+
+
+class TestPublish:
+    def _publish(self, tmp_path, recs):
+        led = ledger.Ledger(str(tmp_path))
+        reg = metrics.Registry()
+        eng = _engine(ledger=led)
+        rep = eng.evaluate_and_publish(now=NOW, records=recs,
+                                       mx=reg, led=led)
+        return rep, reg, led
+
+    def test_series_points_and_record(self, tmp_path):
+        recs = [_req(NOW - i) for i in range(10)]
+        rep, reg, led = self._publish(tmp_path, recs)
+        pts = reg.series("slo").points
+        assert {p["objective"] for p in pts} == \
+            {"warm-p50", "queue-wait-p95", "availability"}
+        for p in pts:
+            assert isinstance(p["burn_rate"], (int, float))
+            assert isinstance(p["met"], bool)
+        recs = led.query(kind="slo")
+        assert len(recs) == 1
+        assert recs[0]["verdict"] is True
+        assert recs[0]["burn_alerts"] == []
+        assert all(isinstance(o["budget_remaining"], (int, float))
+                   for o in recs[0]["objectives"])
+
+    def test_burn_alert_lands_as_fleet_fault(self, tmp_path):
+        recs = [_req(NOW - 40 * i, wall=9.0) for i in range(10)]
+        st = fleet.RunStatus(enabled=True, progress=False)
+        prev = fleet.set_default(st)
+        try:
+            rep, reg, led = self._publish(tmp_path, recs)
+        finally:
+            fleet.set_default(prev)
+        faults = reg.series("fleet_faults").points
+        assert any(f["fault_type"] == "slo-burn" and
+                   f["stage"] == "slo" for f in faults)
+        snap = st.snapshot()
+        assert any(f["type"] == "slo-burn" for f in snap["faults"])
+        assert reg.counter("slo_burn_alerts_total").value(
+            objective="warm-p50") >= 1
+
+    def test_exports_lint_clean(self, tmp_path):
+        recs = [_req(NOW - 40 * i, wall=9.0) for i in range(10)]
+        _rep, reg, led = self._publish(tmp_path, recs)
+        p = str(tmp_path / "slo_metrics.jsonl")
+        reg.export_jsonl(p)
+        assert telemetry_lint.lint_jsonl_file(p) == []
+        idx = os.path.join(str(tmp_path), "ledger", "index.jsonl")
+        assert telemetry_lint.lint_ledger_file(idx) == []
+
+    def test_drifted_record_fixture_fails_lint(self, tmp_path):
+        bad = {"schema": 1, "id": "x", "kind": "slo", "name": "e",
+               "t": NOW, "verdict": True, "windows_s": [60],
+               "burn_alerts": [],
+               "objectives": [{"name": "warm-p50", "met": "yes",
+                               "burn_rate": "2.0"}]}
+        p = tmp_path / "ledger" / "index.jsonl"
+        p.parent.mkdir(parents=True)
+        p.write_text(json.dumps(bad) + "\n")
+        errs = telemetry_lint.lint_ledger_file(str(p))
+        assert any("met" in e for e in errs)
+        assert any("burn_rate" in e for e in errs)
+        assert any("budget_remaining" in e for e in errs)
+
+    def test_drifted_series_fixture_fails_lint(self, tmp_path):
+        pt = {"type": "sample", "series": "slo", "t": NOW,
+              "objective": "warm-p50", "window_s": 600,
+              "good_frac": 1.0, "target_frac": 0.5, "met": True,
+              "burn_rate": None}
+        p = tmp_path / "m.jsonl"
+        p.write_text(json.dumps(pt) + "\n")
+        errs = telemetry_lint.lint_jsonl_file(str(p))
+        assert any("burn_rate" in e for e in errs)
+
+
+class TestSnapshotAndPanel:
+    def test_snapshot_stub_schema(self):
+        snap = slo_mod.snapshot()
+        assert snap == {"checked": 0, "alerts_total": 0,
+                        "burning": [], "last": None}
+
+    def test_snapshot_after_publish(self, tmp_path):
+        recs = [_req(NOW - 40 * i, wall=9.0) for i in range(10)]
+        eng = _engine(ledger=ledger.Ledger(str(tmp_path)))
+        eng.evaluate_and_publish(now=NOW, records=recs,
+                                 mx=metrics.NULL)
+        snap = slo_mod.snapshot()
+        assert snap["checked"] == 1
+        assert "warm-p50" in snap["burning"]
+        last = snap["last"]
+        assert {o["name"] for o in last["objectives"]} >= \
+            {"warm-p50", "availability"}
+        for o in last["objectives"]:
+            assert set(o) >= {"name", "met", "burn_rate",
+                              "budget_remaining", "target_frac"}
+
+    def test_status_json_slo_block(self, tmp_path):
+        from jepsen_tpu import web
+        snap = web.status_snapshot(str(tmp_path))
+        assert set(snap["slo"]) >= {"checked", "alerts_total",
+                                    "burning", "last"}
+        eng = _engine(ledger=ledger.Ledger(str(tmp_path)))
+        eng.evaluate_and_publish(
+            now=NOW, records=[_req(NOW - i) for i in range(10)],
+            mx=metrics.NULL)
+        snap = web.status_snapshot(str(tmp_path))
+        assert snap["slo"]["checked"] == 1
+        assert snap["slo"]["last"]["met"] is True
+
+    def test_panel_renders_objectives_and_alert(self, tmp_path):
+        # a FRESH report renders from the in-process engine (a stale
+        # one falls back to the read-only store evaluation — burn
+        # alerts must drain once traffic stops, web._SLO_STALE_S)
+        from jepsen_tpu import web
+        now = time.time()
+        eng = _engine(ledger=ledger.Ledger(str(tmp_path)))
+        eng.evaluate_and_publish(
+            now=now,
+            records=[_req(now - 40 * i, wall=9.0)
+                     for i in range(10)],
+            mx=metrics.NULL)
+        body = web.render_slo(str(tmp_path)).decode()
+        assert "warm-p50" in body
+        assert "BURN ALERT" in body
+
+    def test_panel_stale_report_falls_back(self, tmp_path):
+        from jepsen_tpu import web
+        eng = _engine(ledger=ledger.Ledger(str(tmp_path)))
+        eng.evaluate_and_publish(   # ancient evaluation: stale
+            now=NOW,
+            records=[_req(NOW - 40 * i, wall=9.0)
+                     for i in range(10)],
+            mx=metrics.NULL)
+        body = web.render_slo(str(tmp_path)).decode()
+        assert "BURN ALERT" not in body  # windows drained
+
+    def test_panel_empty_store(self, tmp_path):
+        from jepsen_tpu import web
+        body = web.render_slo(str(tmp_path)).decode()
+        assert "no SLO evaluations yet" in body
+
+    def test_evaluate_store_reads_ledger(self, tmp_path):
+        led = ledger.Ledger(str(tmp_path))
+        now = time.time()
+        for i in range(6):
+            led.record(_req(now - i))
+        rep = slo_mod.evaluate_store(str(tmp_path),
+                                     windows_s=(60.0, 600.0))
+        assert _obj(rep, "warm-p50")["met"] is True
+
+
+class TestDoctorRules:
+    """D011 slo-burn / D012 queue-backlog — fires / doesn't-fire
+    pairs, matching the D001-D010 test convention."""
+
+    def _burn_points(self):
+        return [{"t": NOW, "objective": "warm-p50", "window_s": 600,
+                 "good_frac": 0.0, "target_frac": 0.5, "met": False,
+                 "burn_rate": 2.0, "burn_alert": True}]
+
+    def test_d011_fires_on_burn_alert_points(self):
+        recs = [_req(NOW - i, wall=9.0, queue_wait=8.5)
+                for i in range(6)]
+        for i, r in enumerate(recs):
+            r["id"] = f"r{i}"
+        rep = doctor.diagnose(doctor.TelemetryView(
+            target="t", series={"slo": self._burn_points()},
+            records=recs))
+        assert rep["rules_fired"] == ["D011"]
+        f = rep["findings"][0]
+        assert f["severity"] == "warn"
+        assert f["remedy"]["dominant_phase"] == "queue_wait_s"
+        assert "workers" in f["action"]
+
+    def test_d011_fires_on_slo_record(self):
+        rec = {"kind": "slo", "name": "e", "t": NOW,
+               "windows_s": [60, 600], "burn_alerts": ["warm-p50"],
+               "objectives": [{"name": "warm-p50", "met": False,
+                               "burn_rate": 2.0,
+                               "budget_remaining": 0.0}]}
+        rep = doctor.diagnose(doctor.TelemetryView(
+            target="t", records=[rec]))
+        assert "D011" in rep["rules_fired"]
+
+    def test_d011_quiet_on_healthy_slo(self):
+        pts = [{"t": NOW, "objective": "warm-p50", "window_s": 600,
+                "good_frac": 1.0, "target_frac": 0.5, "met": True,
+                "burn_rate": 0.0, "burn_alert": False}]
+        rep = doctor.diagnose(doctor.TelemetryView(
+            target="t", series={"slo": pts}))
+        assert "D011" not in rep["rules_fired"]
+
+    def _svc_points(self, depths, warm=True):
+        return [{"t": NOW + i, "run_id": f"r{i}", "tenant": "t",
+                 "bucket": "b", "verdict": "true", "wait_s": 0.1,
+                 "serve_s": 0.1, "total_s": 0.2, "warm_hit": warm,
+                 "batch_n": 1, "queue_depth": d}
+                for i, d in enumerate(depths)]
+
+    def test_d012_warm_backlog_is_capacity(self):
+        rep = doctor.diagnose(doctor.TelemetryView(
+            target="t",
+            series={"service": self._svc_points(range(10))}))
+        assert rep["rules_fired"] == ["D012"]
+        assert "capacity" in rep["findings"][0]["action"]
+
+    def test_d012_cold_backlog_cross_links_d001(self):
+        rep = doctor.diagnose(doctor.TelemetryView(
+            target="t",
+            series={"service": self._svc_points(range(10),
+                                                warm=False)}))
+        f = rep["findings"][0]
+        assert f["rule"] == "D012"
+        assert "D001" in f["action"]
+        assert any(e.get("related_rule") == "D001"
+                   for e in f["evidence"])
+
+    def test_d012_quiet_on_flat_or_draining_queue(self):
+        flat = self._svc_points([0] * 10)
+        drain = self._svc_points([9, 8, 7, 6, 5, 4, 3, 2, 1, 0])
+        for pts in (flat, drain):
+            rep = doctor.diagnose(doctor.TelemetryView(
+                target="t", series={"service": pts}))
+            assert "D012" not in rep["rules_fired"]
+
+    def test_d012_quiet_below_min_points(self):
+        rep = doctor.diagnose(doctor.TelemetryView(
+            target="t",
+            series={"service": self._svc_points([0, 5, 9])}))
+        assert "D012" not in rep["rules_fired"]
+
+    def test_doctor_series_accepts_new_rule_ids(self, tmp_path):
+        pt = {"type": "sample", "series": "doctor", "t": NOW,
+              "rule": "D011", "severity": "warn", "target": "t",
+              "subject": None, "summary": "s", "where": "test"}
+        p = tmp_path / "d.jsonl"
+        p.write_text(json.dumps(pt) + "\n")
+        assert telemetry_lint.lint_jsonl_file(str(p)) == []
+        pt["rule"] = "D013"  # past the frozen catalog: drift
+        p.write_text(json.dumps(pt) + "\n")
+        assert telemetry_lint.lint_jsonl_file(str(p)) != []
