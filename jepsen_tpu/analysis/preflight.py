@@ -884,7 +884,8 @@ def plan_mesh(encs, *, n_devices: int,
               lanes_per_device: Optional[int] = None,
               platform: Optional[str] = None,
               axes=("keys",),
-              compile_budget: Optional[int] = None) -> dict:
+              compile_budget: Optional[int] = None,
+              shape_bucket: Optional[dict] = None) -> dict:
     """The mesh fan-out's plan report (`parallel/mesh.py`): one
     `mesh`-annotated plan node per (lane group x ladder bucket), each
     billed for `lanes_per_device` resident lanes — the per-SHARD cost
@@ -911,7 +912,11 @@ def plan_mesh(encs, *, n_devices: int,
         if not idxs:
             continue
         grp = [encs[i] for i in idxs]
-        bucket = shared_shape_bucket(grp)
+        # a caller-forced canonical bucket (the service plane) is the
+        # kernel that will actually run — admit THAT, not the smaller
+        # batch-derived one, so the gate and the executable agree
+        bucket = (dict(shape_bucket) if shape_bucket is not None
+                  else shared_shape_bucket(grp))
         # bill the CALLER's lane count verbatim: an explicit
         # lanes_per_device allocates that many resident lanes per
         # shard regardless of group size, and for the derived case a
@@ -960,7 +965,8 @@ def gate_mesh(encs, *, n_devices: int,
               lanes_per_device: Optional[int] = None,
               where: str = "parallel.mesh",
               platform: Optional[str] = None,
-              axes=("keys",)) -> Optional[dict]:
+              axes=("keys",),
+              shape_bucket: Optional[dict] = None) -> Optional[dict]:
     """Admission gate for the mesh fan-out: None when the mesh plan is
     admissible; else the report — the caller answers by STREAMING
     per-key kernels, so the decision actually delivered is a degrade
@@ -968,7 +974,8 @@ def gate_mesh(encs, *, n_devices: int,
     try:
         rep = plan_mesh(encs, n_devices=n_devices,
                         lanes_per_device=lanes_per_device,
-                        platform=platform, axes=axes)
+                        platform=platform, axes=axes,
+                        shape_bucket=shape_bucket)
     except Exception:  # noqa: BLE001 — an unplannable batch is the
         return None    # engines' problem, not the gate's
     if rep["verdict"] == "infeasible":

@@ -1118,20 +1118,29 @@ def _d012(view: TelemetryView) -> list:
         return []
     warm = [bool(p.get("warm_hit")) for p in window]
     warm_rate = sum(warm) / len(warm)
+    # backpressure vs backlog: sheds in the window mean the service
+    # is ALREADY refusing load (burn-driven admission control) — a
+    # deepening queue despite sheds is a capacity deficit, not a
+    # missing brake
+    sheds = sum(1 for p in window if p.get("shed"))
     base = max(0, len(pts) - len(window))
     idxs = [base + i for i in range(len(window))]
     ev = [evidence("service", "queue_depth", idxs, depths,
                    t=[p["t"] for p in window
                       if p.get("t") is not None],
-                   warm_rate=round(warm_rate, 3))]
+                   warm_rate=round(warm_rate, 3),
+                   shed_count=sheds)]
     if warm_rate >= QUEUE_WARM_SPLIT:
         action = ("the pool is warm but falling behind — add "
                   "service workers / devices, or raise max_batch so "
                   "coalescing amortizes harder (capacity)")
+        if sheds:
+            action += (f"; {sheds} shed(s) in the window: admission "
+                       "is already braking, the deficit is capacity")
     else:
         action = ("cold buckets are paying compiles inside the "
                   "serve path — warm ahead of traffic "
-                  "(aot.precompile_service_bucket / Service.rewarm)"
+                  "(aot.precompile_service_plan / Service.rewarm)"
                   "; see D001 compile-storm for the kernel-side "
                   "signature")
         ev.append(evidence("service", "warm_hit", idxs,
@@ -1140,7 +1149,8 @@ def _d012(view: TelemetryView) -> list:
         "D012", "warn",
         f"admission queue depth grew {depths[0]} -> {depths[-1]} "
         f"over {len(window)} request(s) at warm-hit rate "
-        f"{round(warm_rate, 2)}",
+        f"{round(warm_rate, 2)}"
+        + (f" with {sheds} shed(s)" if sheds else ""),
         evidence=ev, score=growth, action=action)]
 
 

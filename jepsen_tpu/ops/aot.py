@@ -317,6 +317,61 @@ def precompile_mesh_plan(shape_bucket: dict, mesh=None, *,
         n_keys=n_keys, chunk=chunk, model_name=model_name, save=save)
 
 
+def precompile_service_plan(shape_bucket: dict, *, bucket_key,
+                            model_name: Optional[str] = None,
+                            accel: bool = False,
+                            mesh_layout: Optional[dict] = None,
+                            save: bool = True) -> dict:
+    """ONE warm for the service plane: the serial ladder
+    (`precompile_service_bucket`) AND — when a mesh layout is given —
+    the lane-group plan (`precompile_mesh_plan`) for the SAME canonical
+    bucket, registered as a single fs_cache entry under
+    ("service-plan", model, key). The warmed executables must BE the
+    scheduled ones: `service._serve_batch` routes coalesced batches
+    through `check_mesh(shape_bucket=<canonical bucket>)` at exactly
+    this lane layout, so both the mesh path and the serial fallback
+    stay at zero recompiles against this one registry entry
+    (`Service.rewarm` replays it on restart). `mesh_layout` is
+    {"n_devices": int, "lanes_per_device": int, "chunk": int} —
+    lanes pinned to the service's FULL batch width (and the mesh to
+    its `n_devices` ceiling) so every batch of the bucket, whatever
+    its n, reuses one executable set. Returns
+    {"serial": {K: s}, "mesh": {K: s} | None}."""
+    import time as _time_mod
+
+    out: dict = {"serial": precompile_service_bucket(
+        shape_bucket, accel=accel), "mesh": None}
+    layout = None
+    if mesh_layout:
+        from ..parallel.batched import default_mesh
+        mesh = default_mesh(
+            n_devices=mesh_layout.get("n_devices"))
+        nd = int(mesh.devices.size)
+        if nd >= 2:
+            out["mesh"] = precompile_mesh_plan(
+                shape_bucket, mesh,
+                lanes_per_device=int(mesh_layout["lanes_per_device"]),
+                chunk=int(mesh_layout.get("chunk") or 1024),
+                model_name=str(model_name or "any"), save=False)
+            layout = {"n_devices": nd,
+                      "lanes_per_device":
+                          int(mesh_layout["lanes_per_device"]),
+                      "chunk": int(mesh_layout.get("chunk") or 1024),
+                      "axes": [str(a) for a in mesh.axis_names]}
+    if save:
+        try:
+            from .. import fs_cache
+            keystr = "-".join(str(k) for k in tuple(bucket_key))
+            fs_cache.save_data(
+                ("service-plan", str(model_name), keystr),
+                {"bucket": shape_bucket, "key": list(bucket_key),
+                 "model": model_name, "mesh": layout,
+                 "t": round(_time_mod.time(), 3)})
+        except Exception:  # noqa: BLE001 — the registry is a warm-up
+            pass           # accelerant, never a correctness gate
+    return out
+
+
 def precompile_cached_mesh_plans(mesh=None) -> list:
     """Re-warm every mesh plan earlier traffic registered in fs_cache
     (`precompile_mesh_plan(save=True)`): the service restart path —

@@ -85,12 +85,18 @@ def shared_shape_bucket(encs: Sequence[Encoded]) -> Optional[dict]:
     }
 
 
-def default_mesh(axis: str = "keys"):
-    """A 1-D mesh over every visible device."""
+def default_mesh(axis: str = "keys", n_devices: Optional[int] = None):
+    """A 1-D mesh over every visible device — or the first
+    `n_devices` of them: a lane group never needs more shards than
+    lanes, and surplus shards are not free (their inert lanes still
+    compute every lockstep round), so width-bounded callers like the
+    service pass their batch ceiling here."""
     import jax
     from jax.sharding import Mesh
 
     devs = np.asarray(jax.devices())
+    if n_devices:
+        devs = devs[:int(n_devices)]
     return Mesh(devs, (axis,))
 
 
@@ -202,6 +208,28 @@ def _batch_capacities(bk: int, W: int, n_pad: int, L: int = 0):
 
 
 @functools.lru_cache(maxsize=16)
+def _raw_batched(n_pad: int, ic_pad: int, W: int, S: int, O: int,
+                 K: int, H: int, B: int, chunk: int, probes: int,
+                 L: int = 0, accel: bool = False,
+                 batched: bool = False):
+    """The UNJITTED (init_fn, chunk_fn) pair for one shape bucket —
+    shared by the vmap path below and the mesh scheduler's shard_map
+    wrapper (parallel/mesh.py), so both transforms trace the exact
+    same kernel closure. With `batched` (narrow kernel only), the
+    returned chunk_fn natively carries the lane axis inside its round
+    loop — `wgl32.chunk_fn_batched` — instead of needing an outer
+    vmap."""
+    if W <= 32:
+        from ..ops.wgl32 import _build_search32
+        return _build_search32(n_pad, ic_pad, S, O, K, H, B, chunk,
+                               probes, W=W, accel=accel,
+                               batched=batched)
+    from ..ops.wgln import _build_searchN
+    return _build_searchN(n_pad, ic_pad, S, O, K, H, B, chunk,
+                          probes, W=W, L=L, accel=accel)
+
+
+@functools.lru_cache(maxsize=16)
 def _compiled_batched(n_pad: int, ic_pad: int, W: int, S: int, O: int,
                       K: int, H: int, B: int, chunk: int, probes: int,
                       L: int = 0, accel: bool = False):
@@ -213,16 +241,8 @@ def _compiled_batched(n_pad: int, ic_pad: int, W: int, S: int, O: int,
     single-history path gets, now on the mesh-sharded batch."""
     import jax
 
-    if W <= 32:
-        from ..ops.wgl32 import _build_search32
-        init_fn, chunk_fn = _build_search32(n_pad, ic_pad, S, O,
-                                            K, H, B, chunk, probes,
-                                            W=W, accel=accel)
-    else:
-        from ..ops.wgln import _build_searchN
-        init_fn, chunk_fn = _build_searchN(n_pad, ic_pad, S, O,
-                                           K, H, B, chunk, probes,
-                                           W=W, L=L, accel=accel)
+    init_fn, chunk_fn = _raw_batched(n_pad, ic_pad, W, S, O, K, H, B,
+                                     chunk, probes, L=L, accel=accel)
     vinit = jax.vmap(init_fn)
     vchunk = jax.jit(jax.vmap(chunk_fn), donate_argnums=(1,))
     return vinit, vchunk

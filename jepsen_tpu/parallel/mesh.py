@@ -9,9 +9,11 @@ key finishes. This module is the middle path the north star's
 shape-bucketed padded lanes (`shared_shape_bucket` generalized from
 one host bucket to per-device lane groups), the lane batch is laid out
 over the (hosts, chips) mesh with a `NamedSharding` — each device owns
-a contiguous block of `lanes_per_device` slots — and driven in
-lockstep vmap rounds. Between polls a HOST scheduler spends the
-telemetry PRs 9/12 already record:
+a contiguous block of `lanes_per_device` slots — and driven through a
+`shard_map`-wrapped round loop: each shard free-runs its own lanes'
+rounds with ZERO per-round collectives (see `_mesh_compiled`), and
+devices only meet when the host reads the poll summary. Between polls
+a HOST scheduler spends the telemetry PRs 9/12 already record:
 
   * decided lanes are **retired** and their slots refilled from the
     owning shard's pending queue (the lane's carry is reset in place —
@@ -60,7 +62,7 @@ from ..ops import adapt as _adapt
 from ..ops.encode import INF, Encoded
 from .batched import (_annotate_shard, _backend_ready_or_fallback,
                       _batch_capacities, _compiled_batched,
-                      _oracle_fallback, default_mesh,
+                      _oracle_fallback, _raw_batched, default_mesh,
                       shared_shape_bucket)
 
 # Lane slots per device: the active window is n_devices x this many
@@ -127,16 +129,63 @@ def kernel_params(bucket: dict, bk: int, chunk: int = 1024) -> dict:
 @functools.lru_cache(maxsize=16)
 def _mesh_compiled(n_pad: int, ic_pad: int, W: int, S: int, O: int,
                    K: int, H: int, B: int, chunk: int, probes: int,
-                   L: int, accel: bool):
+                   L: int, accel: bool, mesh=None):
     """(jitted vinit, jitted vchunk) for one (shapes, K) bucket — the
-    SAME `_compiled_batched` builders the vmap path uses (shared lru
-    caches, shared executables), plus a jitted init so the scheduler's
-    carry resets stay recompile-free once warmed."""
+    SAME raw kernel builders the vmap path uses (shared lru caches),
+    plus a jitted init so the scheduler's carry resets stay
+    recompile-free once warmed.
+
+    With `mesh` (hashable `jax.sharding.Mesh`), the chunk kernel is
+    wrapped in `shard_map` instead of jit-of-vmap-over-NamedSharding.
+    Two pathologies die here, measured on a host-platform mesh where
+    one round of K=2 search costs ~60 us:
+
+      * GSPMD lockstep: lanes never interact, yet jit-of-vmap makes
+        the while-loop condition an all-reduce + device rendezvous
+        EVERY ROUND (~20 ms/round of pure sync). shard_map gives each
+        shard its own free-running local loop — zero collectives, the
+        host syncs ONCE per poll reading the summary.
+      * vmap-of-while_loop lockstep-with-select: the batching rule
+        re-materializes the whole batched carry every round (the
+        (lanes, H, 4) memo dominates, ~8 MB/lane/round of copy —
+        ~120x the round's real work). The narrow kernel's natively
+        batched chunk loop (`wgl32.chunk_fn_batched`) keeps the lane
+        axis inside ONE while_loop with per-lane halt masking, so a
+        decided lane costs a few selected words, not a memo copy.
+
+    The wide (wgln) branch still vmaps under shard_map — better than
+    GSPMD lockstep, one select-copy per round remains."""
     import jax
 
-    vinit, vchunk = _compiled_batched(n_pad, ic_pad, W, S, O, K, H, B,
-                                      chunk, probes, L=L, accel=accel)
-    return jax.jit(vinit), vchunk
+    if mesh is None:
+        vinit, vchunk = _compiled_batched(n_pad, ic_pad, W, S, O, K,
+                                          H, B, chunk, probes, L=L,
+                                          accel=accel)
+        return jax.jit(vinit), vchunk
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec
+
+    narrow = W <= 32
+    init_fn, chunk_fn = _raw_batched(n_pad, ic_pad, W, S, O, K, H, B,
+                                     chunk, probes, L=L, accel=accel,
+                                     batched=narrow)
+    axis = tuple(mesh.axis_names) if len(mesh.axis_names) > 1 \
+        else mesh.axis_names[0]
+    spec = PartitionSpec(axis)
+    inner = chunk_fn if narrow else jax.vmap(chunk_fn)
+    # check_rep off: no replicated outputs to prove, and the per-shard
+    # loop trip counts legitimately diverge
+    sharded = shard_map(inner, mesh=mesh,
+                        in_specs=(spec, spec), out_specs=spec,
+                        check_rep=False)
+    vchunk = jax.jit(sharded, donate_argnums=(1,))
+    # init lands PRE-SHARDED: each device memsets its own shard of
+    # the carry (the (bk, H, 4) memo dominates) instead of one device
+    # materializing the whole tree and a reshard copying it out
+    from jax.sharding import NamedSharding
+    jinit = jax.jit(jax.vmap(init_fn),
+                    out_shardings=NamedSharding(mesh, spec))
+    return jinit, vchunk
 
 
 @functools.lru_cache(maxsize=4)
@@ -204,6 +253,41 @@ def _record_run(summary: dict) -> None:
         _SNAP["rebuckets"] += int(summary.get("rebuckets") or 0)
         _SNAP["last"] = summary
         _SNAP["active"] = True
+
+
+# pre-zeroed carry pool: a full mesh carry is ~bk * H * 16 B of
+# zero-fill (tens of ms for a service-sized lane group) that every
+# batch would otherwise pay at dispatch. `warm_plan` stocks one per
+# plan and `_run_group` restocks after each healthy run, so a served
+# batch finds its fresh carry already built — the memset runs between
+# batches instead of inside the measured serve wall. Entries are
+# keyed by everything that picks the init executable (shapes, K,
+# mesh, bk); taking an entry transfers ownership (the scheduler
+# donates it to the first chunk call).
+_CARRY_POOL: dict = {}
+_CARRY_POOL_CAP = 2
+
+
+def _pool_key(p: dict, K: int, mesh, bk: int) -> tuple:
+    return (p["n_pad"], p["ic_pad"], p["W"], p["S"], p["O"], int(K),
+            p["H"], p["B"], p["chunk"], p["probes"], p["L"],
+            p["accel"], mesh, int(bk))
+
+
+def _pool_take(key: tuple):
+    with _LOCK:
+        return _CARRY_POOL.pop(key, None)
+
+
+def _pool_stock(key: tuple, build) -> None:
+    with _LOCK:
+        if key in _CARRY_POOL:
+            return
+    carry = build()  # async dispatch: the zero-fill runs off-thread
+    with _LOCK:
+        while len(_CARRY_POOL) >= _CARRY_POOL_CAP:
+            _CARRY_POOL.pop(next(iter(_CARRY_POOL)), None)
+        _CARRY_POOL[key] = carry
 
 
 # ---------------------------------------------------------------------------
@@ -301,7 +385,7 @@ def warm_plan(bucket: dict, *, n_devices: Optional[int] = None,
         jinit, vchunk = _mesh_compiled(
             p["n_pad"], p["ic_pad"], p["W"], p["S"], p["O"], k,
             p["H"], p["B"], p["chunk"], p["probes"], p["L"],
-            p["accel"])
+            p["accel"], mesh=mesh)
         carry = _reset_fn()(
             _shard_tree(shard, jinit(jnp.zeros(bk, jnp.int32))),
             _shard_tree(shard, jinit(jnp.zeros(bk, jnp.int32))),
@@ -319,6 +403,17 @@ def warm_plan(bucket: dict, *, n_devices: Optional[int] = None,
             _migrate_fn(b)(carries[a])[0])
         jax.block_until_ready(  # jaxlint: ok(J007)
             _migrate_fn(a)(carries[b])[0])
+    if mesh is not None:
+        # stock the carry pool: the first served batch starts at
+        # ladder[0] and should find its zeroed carry waiting
+        k0 = ladder[0]
+        jinit0, _ = _mesh_compiled(
+            p["n_pad"], p["ic_pad"], p["W"], p["S"], p["O"], k0,
+            p["H"], p["B"], p["chunk"], p["probes"], p["L"],
+            p["accel"], mesh=mesh)
+        _pool_stock(_pool_key(p, k0, mesh, bk),
+                    lambda: _shard_tree(shard, jinit0(
+                        jnp.zeros(bk, jnp.int32))))
     if save:
         try:
             from .. import fs_cache
@@ -351,7 +446,8 @@ class _GroupRun:
                  lanes_per_device: Optional[int], assign: str,
                  deadline: Optional[float], max_configs: int,
                  oracle_fallback: bool, key_indices, group: str,
-                 steal: bool = True):
+                 steal: bool = True,
+                 shape_bucket: Optional[dict] = None):
         self.encs = encs
         self.idxs = list(idxs)
         self.deadline = deadline
@@ -360,13 +456,19 @@ class _GroupRun:
         self.key_indices = key_indices
         self.group = group
         self.steal_enabled = steal
+        self.mesh = mesh
         self.nd = int(mesh.devices.size)
         self.devs_flat = list(mesh.devices.flat)
         self.labels = [_fleet.device_label(d) for d in self.devs_flat]
         self.s_d = int(lanes_per_device
                        or lanes_for(len(self.idxs), self.nd))
         self.bk = self.nd * self.s_d
-        self.bucket = shared_shape_bucket([encs[i] for i in self.idxs])
+        # a caller-forced bucket (the service plane's CANONICAL bucket,
+        # `service.bucket_for`) pins the executable to the one the warm
+        # path compiled; the derived bucket is the streamed default
+        self.bucket = (dict(shape_bucket) if shape_bucket is not None
+                       else shared_shape_bucket(
+                           [encs[i] for i in self.idxs]))
         self.params = kernel_params(self.bucket, self.bk, chunk)
         # per-shard pending queues: LPT by encoded op count (assign=
         # "block" keeps the caller's order in contiguous blocks — the
@@ -671,7 +773,9 @@ def check_mesh(model: Model, histories: Sequence[History], *,
                key_indices: Optional[Sequence[int]] = None,
                chunk: int = 1024,
                lanes_per_device: Optional[int] = None,
-               assign: str = "lpt", steal: bool = True
+               assign: str = "lpt", steal: bool = True,
+               shape_bucket: Optional[dict] = None,
+               n_devices: Optional[int] = None
                ) -> Optional[list]:
     """Check `histories` (all encodable — the caller host-decides the
     rest, as `check_batched` does) over the mesh with the lane-packing
@@ -688,7 +792,9 @@ def check_mesh(model: Model, histories: Sequence[History], *,
     if not _backend_ready_or_fallback(time_limit):
         return None
     if mesh is None:
-        mesh = default_mesh()
+        # width-bounded callers (the service) pin n_devices so the
+        # scheduled mesh matches the one their plans were warmed on
+        mesh = default_mesh(n_devices=n_devices)
     nd = int(mesh.devices.size)
     if nd < 2:
         return None
@@ -700,6 +806,18 @@ def check_mesh(model: Model, histories: Sequence[History], *,
                         if e.window_raw > 32])]
     groups = [(g, idxs) for g, idxs in groups if idxs]
 
+    # a forced canonical bucket only applies to a single-branch batch
+    # it actually covers: anything else degrades (None) rather than
+    # running a kernel the warm path never compiled
+    if shape_bucket is not None:
+        derived = shared_shape_bucket(list(encs))
+        forced_wide = int(shape_bucket["w_eff"]) > 32
+        covers = all(int(shape_bucket[k]) >= int(derived[k])
+                     for k in ("n_pad", "ic_eff", "S", "O", "w_eff"))
+        if (len(groups) != 1 or not covers
+                or forced_wide != (groups[0][0] == "wide")):
+            return None
+
     # admission: the mesh plan nodes (P001/P003) — an infeasible lane
     # group degrades the WHOLE request to the streamed path (whose own
     # per-group gate re-decides with per-key kernels)
@@ -709,7 +827,8 @@ def check_mesh(model: Model, histories: Sequence[History], *,
     bad = preflight.gate_mesh(
         list(encs), n_devices=nd, lanes_per_device=s_d_plan,
         where="parallel.mesh",
-        axes=tuple(str(a) for a in mesh.axis_names))
+        axes=tuple(str(a) for a in mesh.axis_names),
+        shape_bucket=shape_bucket)
     if bad is not None:
         return None
 
@@ -735,7 +854,7 @@ def check_mesh(model: Model, histories: Sequence[History], *,
                        max_configs=max_configs,
                        oracle_fallback=oracle_fallback,
                        key_indices=key_indices, group=gname,
-                       steal=steal)
+                       steal=steal, shape_bucket=shape_bucket)
         k_final = _run_group(gr, shard, status, mx, wd, dm, t0_all)
         run_summaries.append(gr.summary(k_final))
         for i, res in gr.results.items():
@@ -795,7 +914,8 @@ def _run_group(gr: _GroupRun, shard, status, mx, wd, dm,
     K = ladder[0]
     jinit, vchunk = _mesh_compiled(
         p["n_pad"], p["ic_pad"], p["W"], p["S"], p["O"], K,
-        p["H"], p["B"], p["chunk"], p["probes"], p["L"], p["accel"])
+        p["H"], p["B"], p["chunk"], p["probes"], p["L"], p["accel"],
+        mesh=gr.mesh)
     kern = "wgl32" if not p["L"] else "wgln"
     gr.pack_initial()
 
@@ -817,8 +937,20 @@ def _run_group(gr: _GroupRun, shard, status, mx, wd, dm,
         return _shard_tree(shard, jinit(jnp.zeros(gr.bk, jnp.int32)))
 
     consts = upload()
-    carry = fresh_init()
-    init_carry = fresh_init()
+    # the starting carry usually comes pre-zeroed from the pool
+    # (stocked by warm_plan / the previous run); jinit0 pins the
+    # ladder[0] executable for the end-of-run restock even if the
+    # scheduler rebuckets jinit mid-run
+    jinit0 = jinit
+    pool_key = (_pool_key(p, K, gr.mesh, gr.bk)
+                if gr.mesh is not None else None)
+    carry = _pool_take(pool_key) if pool_key is not None else None
+    if carry is None:
+        carry = fresh_init()
+    # the reset template is only needed once a slot REFILLS; built
+    # lazily because a full carry is ~H*16 B of zero-fill per lane —
+    # pure waste for batches that fit the initial slot window
+    init_carry = None
 
     hb = wd.register("wgl-mesh", device=f"mesh[{gr.nd}]",
                      grace_s=300.0)
@@ -986,8 +1118,9 @@ def _run_group(gr: _GroupRun, shard, status, mx, wd, dm,
                     jinit, vchunk = _mesh_compiled(
                         p["n_pad"], p["ic_pad"], p["W"], p["S"],
                         p["O"], switch_to, p["H"], p["B"], p["chunk"],
-                        p["probes"], p["L"], p["accel"])
-                    init_carry = fresh_init()
+                        p["probes"], p["L"], p["accel"],
+                        mesh=gr.mesh)
+                    init_carry = None  # stale shape: rebuild at next refill
                     gr.rebuckets += 1
                     gr._event({"event": "rebucket",
                                "poll": n_polls - 1,
@@ -1001,6 +1134,8 @@ def _run_group(gr: _GroupRun, shard, status, mx, wd, dm,
 
             if refill_mask.any():
                 consts = upload()
+                if init_carry is None:
+                    init_carry = fresh_init()
                 carry = _reset_fn()(carry, init_carry,
                                     jnp.asarray(refill_mask))
 
@@ -1037,4 +1172,12 @@ def _run_group(gr: _GroupRun, shard, status, mx, wd, dm,
                     res, key_index=gr._ki(i),
                     device=gr.labels[d], device_index=d,
                     engine="none", t0=_time.monotonic(), wall_s=0.0)
+    if pool_key is not None and not (stalled or timed_out):
+        # off-thread: the ~bk*H*16 B zero-fill belongs to the NEXT
+        # batch, not this one's serve wall (dispatching it inline
+        # costs ~14 ms of the measured round set)
+        threading.Thread(
+            target=_pool_stock, daemon=True,
+            args=(pool_key, lambda: _shard_tree(
+                shard, jinit0(jnp.zeros(gr.bk, jnp.int32))))).start()
     return K

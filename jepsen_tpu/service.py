@@ -29,15 +29,26 @@ is that composition, built so the measurement plane IS the skeleton:
     `shared_shape_bucket` fix (PR 9), applied to serving;
   * **warm-dispatch** — the resident worker pool holds warm jitted
     ladders across requests: a bucket's first batch pays
-    `aot.precompile_service_bucket` once (recorded in `fs_cache`
-    under ("service-plan", ...) so `rewarm()` restores the warm set
-    after a process restart — cold-start disappears across
-    restarts), every later same-bucket request is a warm hit;
-  * **search / respond** — `ops/wgl.check` (or the elle checkers)
-    with the service registry/tracer installed, then a
-    `kind="service-request"` ledger record carrying verdict, phase
-    walls, device-seconds (the per-tenant billing unit), warm-hit
-    and batch attribution.
+    `aot.precompile_service_plan` once — the serial ladder AND the
+    mesh lane-group plan, ONE fs_cache entry under
+    ("service-plan", ...) so `rewarm()` restores the whole warm set
+    (WGL and Elle) after a process restart — every later same-bucket
+    request is a warm hit;
+  * **search / respond** — a coalesced batch routes through the mesh
+    scheduler as ONE lane group (`check_mesh` at the canonical
+    bucket: N requests, one round set, per-request {shard, slot}
+    coordinates on results; <2 devices or an infeasible plan records
+    a degrade and falls back to the serial `ops/wgl.check` loop),
+    then a `kind="service-request"` ledger record carrying verdict,
+    phase walls, device-seconds (the per-tenant billing unit),
+    warm-hit and batch attribution — plus one `service_batch` series
+    point per batch with the routing decision.
+
+  Backpressure closes the SLO loop: when slo.py's multi-window burn
+  alert fires, `submit` sheds new arrivals (cause "shed", structured
+  503 + Retry-After via web.py) for `shed_hold_s` instead of
+  queueing them into a burning p95; sheds are excluded from the SLO
+  objectives like the other admission rejections.
 
 Surfaces: a linted `service` metrics series (one point per request:
 queue depth, wait/serve/total wall, warm-hit, batch fill, verdict) +
@@ -215,7 +226,10 @@ class Service:
                  max_queue: int = 256, max_batch: int = 8,
                  slo_engine: Optional[slo_mod.Engine] = None,
                  slo_every_s: float = 30.0,
-                 default_time_limit: float = 60.0):
+                 default_time_limit: float = 60.0,
+                 mesh_serving: bool = True,
+                 mesh_min_batch: int = 2,
+                 shed_hold_s: float = 30.0):
         self.store_root = store_root
         self.ledger = ledger_mod.Ledger(store_root)
         # the service owns an ENABLED registry by default: a request
@@ -231,6 +245,18 @@ class Service:
         self.max_queue = int(max_queue)
         self.max_batch = max(1, int(max_batch))
         self.default_time_limit = float(default_time_limit)
+        # mesh routing: a coalesced same-bucket batch of >=
+        # mesh_min_batch WGL requests serves as ONE check_mesh lane
+        # group instead of N serial searches (mode on the
+        # service_batch series; kill switch for A/B and repro)
+        self.mesh_serving = bool(mesh_serving)
+        self.mesh_min_batch = max(2, int(mesh_min_batch))
+        # backpressure: while an SLO burn alert is live, new arrivals
+        # shed (structured 503 + retry-after) for shed_hold_s instead
+        # of queueing into a burning p95
+        self.shed_hold_s = float(shed_hold_s)
+        self._shed_until = 0.0
+        self._shed_info: Optional[dict] = None
         self.slo = slo_engine if slo_engine is not None \
             else slo_mod.Engine(ledger=self.ledger)
         self.slo_every_s = float(slo_every_s)
@@ -250,7 +276,8 @@ class Service:
         self._stop = False
         self._threads: list = []
         self._stats = {"submitted": 0, "served": 0, "rejected": 0,
-                       "warm_hits": 0, "batches": 0, "errors": 0}
+                       "warm_hits": 0, "batches": 0, "errors": 0,
+                       "shed": 0, "mesh_batches": 0, "degrades": 0}
         if rewarm:
             self.rewarm()
 
@@ -365,6 +392,48 @@ class Service:
                     out["cause"] = req.result.get("cause")
             return out
 
+    # -- backpressure -------------------------------------------------
+    def shedding(self) -> Optional[dict]:
+        """The active shed window, None when admitting normally.
+        Opened by `_note_slo` when the SLO engine's multi-window burn
+        trips (env JEPSEN_TPU_SLO_BURN_X), closed when a later
+        evaluation comes back clean or the hold expires. While open,
+        `submit` rejects new arrivals with cause "shed" and a
+        retry-after — load must drain the burning budget, not deepen
+        it (the 503 path in web.py; sheds are excluded from the SLO
+        objectives like the other admission rejections)."""
+        with self._lock:
+            if self._shed_info is None:
+                return None
+            left = self._shed_until - time.monotonic()
+            if left <= 0:
+                self._shed_info = None
+                return None
+            return dict(self._shed_info,
+                        retry_after_s=round(left, 3))
+
+    def _note_slo(self, report) -> None:
+        """Couple admission to the error budget: a report with live
+        burn alerts opens (or extends) the shed window; a clean one
+        closes it immediately rather than waiting out the hold."""
+        if not isinstance(report, dict):
+            return
+        burning = [str(a.get("objective")) for a in
+                   (report.get("alerts") or [])]
+        with self._lock:
+            if burning:
+                fresh = self._shed_info is None
+                self._shed_until = (time.monotonic()
+                                    + self.shed_hold_s)
+                self._shed_info = {"burning": burning,
+                                   "hold_s": self.shed_hold_s}
+            else:
+                fresh = False
+                self._shed_info = None
+        if fresh:
+            self._emit(None, "shedding", burning=burning,
+                       hold_s=self.shed_hold_s)
+
     # -- admission ----------------------------------------------------
     def tenant_usage(self, tenant: str,
                      window_s: Optional[float] = None) -> float:
@@ -438,6 +507,15 @@ class Service:
         req.phases["admit_s"] = round(time.monotonic() - t0, 6)
         with self._lock:
             self._stats["submitted"] += 1
+        # burn-driven shed: checked FIRST (cheapest) — while the SLO
+        # budget burns, new load is the problem, not the work
+        shed = self.shedding()
+        if shed is not None:
+            with self._lock:
+                self._stats["shed"] += 1
+            out = self._reject(req, ctx, "shed", detail=shed)
+            out["retry_after_s"] = shed["retry_after_s"]
+            return out
         # tenant quota: billed from the ledger aggregates, enforced
         # BEFORE any encode/preflight work
         if self.quota_device_s is not None:
@@ -645,7 +723,232 @@ class Service:
         for req in batch:
             if warm_s:
                 req.phases["warm_s"] = warm_s
-            self._serve_one(req, warm_hit, len(batch))
+        # routing: a coalesced same-bucket batch is ONE mesh lane
+        # group (the canonical bucket IS the lane-group key) — N
+        # requests, one round set. mode "serial" = never eligible
+        # (policy/shape); "degrade" = should have meshed but the mesh
+        # declined (<2 devices, infeasible plan): a recorded decision
+        mode, detail = self._mesh_route(batch)
+        if mode == "mesh":
+            if not self._serve_batch_mesh(batch, warm_hit):
+                mode, detail = "degrade", {"cause": "mesh-declined"}
+        if mode != "mesh":
+            for req in batch:
+                self._serve_one(req, warm_hit, len(batch))
+        self._record_batch(key, batch, mode, detail)
+
+    # -- mesh routing -------------------------------------------------
+    def _device_count(self) -> int:
+        from . import util
+        try:
+            if not util.backend_ready(5.0):
+                return 1
+            import jax
+            return int(jax.local_device_count())
+        except Exception:  # noqa: BLE001 — no backend, no mesh
+            return 1
+
+    def _mesh_layout(self) -> Optional[dict]:
+        """The PINNED lane layout mesh-routed batches run — and warm
+        — at: lanes sized for a FULL batch (`max_batch`) regardless
+        of any one batch's n, so every batch of a bucket reuses ONE
+        executable set; an under-full batch leaves slots inert
+        (slot_key -1), which costs padded FLOPs, never a recompile.
+        None when mesh serving is off, killed by env, or <2
+        devices."""
+        if not self.mesh_serving:
+            return None
+        try:
+            from .parallel import mesh as mesh_mod
+            if not mesh_mod.enabled():
+                return None
+            nd = self._device_count()
+            if nd < 2:
+                return None
+            # never more shards than the batch has lanes: a surplus
+            # shard's inert lane still computes every lockstep round,
+            # so width beyond max_batch costs serve time for nothing
+            nd = min(nd, max(2, self.max_batch))
+            return {"n_devices": nd,
+                    "lanes_per_device": mesh_mod.lanes_for(
+                        self.max_batch, nd),
+                    "chunk": 1024}
+        except Exception:  # noqa: BLE001
+            return None
+
+    def _mesh_route(self, batch: list) -> tuple:
+        """(mode, detail) for one coalesced batch: "mesh" when it can
+        run as one lane group, "serial" when it never could (too
+        small, non-WGL, unencodable, mixed models, mesh disabled),
+        "degrade" when it SHOULD have meshed but cannot right now
+        (<2 devices) — degrades are recorded routing decisions, not
+        defaults."""
+        if not self.mesh_serving or len(batch) < self.mesh_min_batch:
+            return "serial", {"cause": "policy"}
+        if any(r.checker != "wgl" or r.enc is None
+               or r.bucket is None for r in batch):
+            return "serial", {"cause": "not-meshable"}
+        if len({r.model_name for r in batch}) != 1:
+            return "serial", {"cause": "mixed-models"}
+        try:
+            from .parallel import mesh as mesh_mod
+            if not mesh_mod.enabled():
+                return "serial", {"cause": "mesh-disabled"}
+        except Exception:  # noqa: BLE001
+            return "serial", {"cause": "mesh-unavailable"}
+        nd = self._device_count()
+        if nd < 2:
+            return "degrade", {"cause": "single-device",
+                               "n_devices": nd}
+        return "mesh", {"n_devices": nd}
+
+    def _serve_batch_mesh(self, batch: list, warm_hit: bool) -> bool:
+        """Serve the whole coalesced batch as ONE `check_mesh` lane-
+        packed round set at the CANONICAL bucket (the warmed
+        executables ARE the scheduled ones — `shape_bucket=` pins the
+        kernel the warm path compiled, `lanes_per_device` pins the
+        batch width). False when the mesh declined (backend init
+        timeout, infeasible preflight plan, canonical bucket not
+        covering): the caller serves serially and records the
+        degrade."""
+        req0 = batch[0]
+        layout = self._mesh_layout()
+        if layout is None:
+            return False
+        tl = max(float(r.params.get("time_limit")
+                       or self.default_time_limit) for r in batch)
+        t_serve0 = time.monotonic()
+        try:
+            from .parallel import mesh as mesh_mod
+            with self.tracer.span(
+                    "mesh-batch", parent=req0.params.get("_ctx"),
+                    attrs={"bucket": _key_str(req0.bucket_key),
+                           "batch_n": len(batch)}):
+                results = mesh_mod.check_mesh(
+                    req0.model, [r.history for r in batch],
+                    encs=[r.enc for r in batch],
+                    time_limit=tl,
+                    lanes_per_device=layout["lanes_per_device"],
+                    chunk=layout["chunk"],
+                    shape_bucket=req0.bucket,
+                    n_devices=layout["n_devices"])
+        except Exception as e:  # noqa: BLE001 — a mesh crash
+            # degrades the batch, never fails it
+            fleet.record_fault(fleet.fault_event(
+                e, stage="service-mesh"), mx=self.mx)
+            return False
+        if results is None or any(r is None for r in results):
+            return False
+        for req, res in zip(batch, results):
+            self._finish_mesh_member(req, res, warm_hit,
+                                     len(batch), t_serve0)
+        return True
+
+    def _finish_mesh_member(self, req: _Request, res: dict,
+                            warm_hit: bool, batch_n: int,
+                            t_serve0: float) -> None:
+        """Per-member bookkeeping for a mesh-served batch with the
+        lane's OWN walls: serve_s is the shard's wall (slot load ->
+        retire), so a lane retired at round r never bills rounds
+        r+1..R as serve time; everything before the lane started —
+        including sibling rounds the member waited out — lands in
+        queue_wait_s, the same attribution the serial path uses for
+        in-batch waits."""
+        ctx = req.params.get("_ctx")
+        shard = res.get("shard") or {}
+        lane_t0 = float(shard.get("t0") or t_serve0)
+        now_m = time.monotonic()
+        lane_wall = shard.get("wall_s")
+        req.warm_hit = warm_hit
+        req.batch_n = batch_n
+        req.wait_s = round(
+            max(lane_t0 - req.t_mono
+                - (req.phases.get("warm_s") or 0.0), 0.0), 6)
+        req.phases["queue_wait_s"] = req.wait_s
+        req.serve_s = round(float(
+            lane_wall if lane_wall is not None
+            else now_m - t_serve0), 6)
+        req.phases["search_s"] = req.serve_s
+        # spans backdated to the lane's real window (the serial path
+        # backdates queue-wait the same way): epoch = now - (mono_now
+        # - mono_stamp)
+        lane_epoch = time.time() - (now_m - lane_t0)
+        with self.tracer.span("queue-wait", parent=ctx,
+                              attrs={"run_id": req.id}) as sp:
+            if sp is not None:
+                sp.start_s = req.t_epoch
+        if sp is not None:
+            sp.end_s = lane_epoch
+        with self.tracer.span(
+                "search", parent=ctx,
+                attrs={"run_id": req.id, "checker": req.checker,
+                       "warm_hit": warm_hit, "mode": "mesh"}) as sp:
+            pass
+        if sp is not None:
+            sp.start_s = lane_epoch
+            sp.end_s = lane_epoch + req.serve_s
+        self._emit(req, "serving", wait_s=req.wait_s,
+                   warm_hit=warm_hit, batch_n=batch_n, mode="mesh",
+                   mesh=res.get("mesh"))
+        t_done = time.monotonic()
+        req.total_s = round(t_done - req.t_mono, 6)
+        req.result = res
+        req.state = "done"
+        with self._lock:
+            self._stats["served"] += 1
+            if warm_hit:
+                self._stats["warm_hits"] += 1
+        with self.tracer.span("respond", parent=ctx,
+                              attrs={"run_id": req.id}):
+            req.phases["respond_s"] = round(
+                time.monotonic() - t_done, 6)
+            self._record(req)
+        self._emit(req, "done",
+                   verdict=_verdict_str(res.get("valid?")),
+                   cause=res.get("cause"), wall_s=req.total_s,
+                   warm_hit=warm_hit)
+
+    def _record_batch(self, key, batch: list, mode: str,
+                      detail: Optional[dict]) -> None:
+        """One `service_batch` series point per coalesced batch: the
+        routing decision (mode mesh|serial|degrade), the round count,
+        and the mesh shard map — the batch-level complement of the
+        per-request `service` series."""
+        rounds = 0
+        shards: dict = {}
+        for req in batch:
+            res = req.result or {}
+            rounds = max(rounds, int(
+                (res.get("util") or {}).get("rounds") or 0))
+            dev = (res.get("shard") or {}).get("device")
+            if mode == "mesh" and dev:
+                shards[str(dev)] = shards.get(str(dev), 0) + 1
+        with self._lock:
+            if mode == "mesh":
+                self._stats["mesh_batches"] += 1
+            elif mode == "degrade":
+                self._stats["degrades"] += 1
+        try:
+            if self.mx.enabled:
+                self.mx.series(
+                    "service_batch",
+                    "per-batch routing telemetry of the checker "
+                    "service (doc/OBSERVABILITY.md \"Service & SLO "
+                    "plane\")").append({
+                        "bucket": _key_str(key),
+                        "batch_n": len(batch),
+                        "mode": mode,
+                        "rounds": int(rounds),
+                        "shards": shards,
+                        "cause": (detail or {}).get("cause")})
+                self.mx.counter(
+                    "service_batch_modes_total",
+                    "coalesced batches by routing mode").inc(
+                    mode=mode)
+        except Exception:  # noqa: BLE001
+            pass
+        self._emit(None, "batch", bucket=_key_str(key),
+                   batch_n=len(batch), mode=mode, rounds=rounds)
 
     def _warm_bucket(self, req: _Request) -> bool:
         """Pay the bucket's ladder compiles ONCE, ahead of its first
@@ -656,14 +959,62 @@ class Service:
         unencodable — the process jit cache is the warm set there);
         False only when the precompile itself failed, so the caller
         retries instead of mislabeling the bucket warm."""
-        if not self.warm_ladder or req.bucket is None:
+        if not self.warm_ladder:
+            return True
+        if req.bucket is None:
+            if req.checker in ("elle-append", "elle-wr"):
+                return self._warm_elle_bucket(req)
             return True
         try:
             from .ops import aot
-            compile_s = aot.precompile_service_bucket(
-                req.bucket, accel=self._accel())
+            # ONE registry entry per canonical bucket covers BOTH
+            # serving paths: the serial ladder and — at the pinned
+            # lane layout — the mesh lane-group plan, so whichever
+            # way _serve_batch routes, the executables it schedules
+            # are the ones this warm compiled
+            compile_s = aot.precompile_service_plan(
+                req.bucket, bucket_key=req.bucket_key,
+                model_name=req.model_name, accel=self._accel(),
+                mesh_layout=self._mesh_layout(), save=True)
         except Exception as e:  # noqa: BLE001 — a failed warm-up
             # degrades to in-band compiles, never a failed request
+            fleet.record_fault(fleet.fault_event(
+                e, stage="service-warm"), mx=self.mx)
+            return False
+        self._emit(req, "warmed", bucket=_key_str(req.bucket_key),
+                   compile_s=compile_s)
+        return True
+
+    def _warm_elle_bucket(self, req: _Request) -> bool:
+        """Elle's warm path: derive the closure shape bucket the same
+        way the checker will (build the first request's tensors),
+        warm the kernels, and register the bucket under the SAME
+        ("service-plan", ...) namespace — so `rewarm()` restores
+        Elle warmth across restarts too, not just WGL. A history the
+        builder cannot shape (BuildUnsupported) marks the bucket warm
+        with nothing compiled: the per-request path degrades the
+        same way, so there is nothing to warm."""
+        try:
+            from .elle import build as build_mod
+            from .elle import tpu as elle_tpu
+            hist = req.history
+            oks = [op for op in hist
+                   if op.is_ok and op.f in ("txn", None)
+                   and op.value]
+            infos = [op for op in hist
+                     if op.is_info and op.f in ("txn", None)
+                     and op.value]
+            if req.checker == "elle-append":
+                bt = build_mod.build_append(hist, oks, infos)
+            else:
+                bt = build_mod.build_wr(hist, oks, infos)
+            eb = elle_tpu.shape_bucket_for(bt.tensors)
+        except Exception:  # noqa: BLE001 — unshapeable history:
+            return True    # nothing to warm, not a warm failure
+        try:
+            from .ops import aot
+            compile_s = aot.precompile_elle_closure(eb)
+        except Exception as e:  # noqa: BLE001
             fleet.record_fault(fleet.fault_event(
                 e, stage="service-warm"), mx=self.mx)
             return False
@@ -673,9 +1024,13 @@ class Service:
             from . import fs_cache
             keystr = "-".join(str(k) for k in req.bucket_key)
             fs_cache.save_data(
-                ("service-plan", str(req.model_name), keystr),
-                {"bucket": req.bucket, "key": list(req.bucket_key),
-                 "model": req.model_name, "t": round(time.time(), 3)})
+                ("service-plan", str(req.checker), keystr),
+                {"elle_bucket": {"n": eb.get("n"),
+                                 "trim": list(eb["trim"]),
+                                 "dense": eb.get("dense")},
+                 "key": list(req.bucket_key),
+                 "checker": req.checker,
+                 "t": round(time.time(), 3)})
         except Exception:  # noqa: BLE001 — the plan registry is an
             pass           # optimization, not a correctness need
         return True
@@ -684,23 +1039,48 @@ class Service:
         """The restart warm path: re-compile every bucket plan earlier
         traffic registered in fs_cache (("service-plan", ...)), so a
         fresh process answers its first same-bucket request warm.
-        Returns the warmed plans; stale/unreadable entries skip."""
+        WGL entries replay BOTH halves of the unified plan (serial
+        ladder + mesh lane group, when the recorded mesh layout still
+        matches the live device count); Elle entries replay the
+        closure kernels. Stale/unreadable entries skip."""
         from . import fs_cache
         try:
             plans = fs_cache.list_data(("service-plan",))
         except Exception:  # noqa: BLE001
             return []
         out = []
+        layout = self._mesh_layout() if self.mesh_serving else None
         for plan in plans:
-            if not isinstance(plan, dict) or "bucket" not in plan:
+            if not isinstance(plan, dict):
                 continue
+            key = tuple(plan.get("key") or ())
             try:
                 from .ops import aot
-                compile_s = aot.precompile_service_bucket(
-                    plan["bucket"], accel=self._accel())
+                if "elle_bucket" in plan:
+                    compile_s = aot.precompile_elle_closure(
+                        plan["elle_bucket"])
+                elif "bucket" in plan:
+                    want = plan.get("mesh")
+                    mesh_layout = None
+                    if (isinstance(want, dict) and layout
+                            and int(want.get("n_devices") or 0)
+                            == int(layout["n_devices"])):
+                        # the recorded layout only warms executables
+                        # the live mesh will actually schedule
+                        mesh_layout = {
+                            "lanes_per_device": int(
+                                want.get("lanes_per_device")
+                                or layout["lanes_per_device"]),
+                            "chunk": int(want.get("chunk") or 1024)}
+                    compile_s = aot.precompile_service_plan(
+                        plan["bucket"], bucket_key=key or ("?",),
+                        model_name=plan.get("model"),
+                        accel=self._accel(),
+                        mesh_layout=mesh_layout, save=False)
+                else:
+                    continue
             except Exception:  # noqa: BLE001 — one stale plan must
                 continue       # not block the others' warm-up
-            key = tuple(plan.get("key") or ())
             if key:
                 with self._lock:
                     self._warm[key] = {"t": time.time(),
@@ -796,6 +1176,7 @@ class Service:
         raises."""
         res = req.result or {}
         verdict = _verdict_str(res.get("valid?"))
+        shed = res.get("cause") == "shed"
         with self._lock:
             depth = sum(len(q) for q in self._queues.values())
         try:
@@ -807,6 +1188,7 @@ class Service:
                    "checker": req.checker,
                    "warm_hit": bool(req.warm_hit),
                    "batch_n": int(req.batch_n),
+                   "shed": shed,
                    "bucket": _key_str(req.bucket_key),
                    "wall_s": round(req.total_s or 0.0, 4),
                    "phases": {k: round(float(v), 6)
@@ -843,6 +1225,7 @@ class Service:
                         "total_s": float(req.total_s or 0.0),
                         "warm_hit": bool(req.warm_hit),
                         "batch_n": int(req.batch_n),
+                        "shed": shed,
                         "queue_depth": int(depth)})
                 self.mx.counter(
                     "service_requests_total",
@@ -880,8 +1263,9 @@ class Service:
             return
         self._last_slo = now
         try:
-            self.slo.evaluate_and_publish(mx=self.mx,
-                                          led=self.ledger)
+            rep = self.slo.evaluate_and_publish(mx=self.mx,
+                                                led=self.ledger)
+            self._note_slo(rep)
         except Exception:  # noqa: BLE001 — the objectives outrank
             pass           # their scheduler
 
@@ -911,6 +1295,7 @@ class Service:
                 "warm_buckets": warm, **stats,
                 "warm_rate": (round(stats["warm_hits"] / served, 4)
                               if served else None),
+                "shedding": self.shedding() is not None,
                 "recent": recent}
 
 
@@ -948,6 +1333,7 @@ def snapshot() -> dict:
         return {"active": False, "workers": 0, "queued": 0,
                 "buckets": {}, "warm_buckets": 0, "submitted": 0,
                 "served": 0, "rejected": 0, "warm_hits": 0,
-                "batches": 0, "errors": 0, "warm_rate": None,
-                "recent": []}
+                "batches": 0, "errors": 0, "shed": 0,
+                "mesh_batches": 0, "degrades": 0, "warm_rate": None,
+                "shedding": False, "recent": []}
     return svc.snapshot()

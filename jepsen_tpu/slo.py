@@ -71,8 +71,11 @@ MIN_EVENTS = 4
 # of the error budget before the alert fires.
 DEFAULT_BURN_X = 2.0
 
-# Admission outcomes that never count against an objective.
-_ADMISSION_CAUSES = ("preflight", "quota", "malformed-request")
+# Admission outcomes that never count against an objective. "shed" is
+# the backpressure loop closing: a burn alert sheds new arrivals
+# (service.Service), and counting those 503s against availability
+# would make the shed itself deepen the burn that caused it.
+_ADMISSION_CAUSES = ("preflight", "quota", "malformed-request", "shed")
 
 
 def burn_threshold() -> float:

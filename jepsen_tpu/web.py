@@ -1110,16 +1110,21 @@ class Handler(BaseHTTPRequestHandler):
     def log_message(self, fmt, *args):  # route through logging
         log.debug("%s " + fmt, self.address_string(), *args)
 
-    def _send(self, code: int, ctype: str, body: bytes):
+    def _send(self, code: int, ctype: str, body: bytes,
+              headers: Optional[dict] = None):
         self.send_response(code)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, str(v))
         self.end_headers()
         self.wfile.write(body)
 
-    def _send_json(self, code: int, obj) -> None:
+    def _send_json(self, code: int, obj,
+                   headers: Optional[dict] = None) -> None:
         self._send(code, "application/json",
-                   json.dumps(obj, default=str).encode())
+                   json.dumps(obj, default=str).encode(),
+                   headers=headers)
 
     def _404(self):
         self._send(404, "text/plain", b"404 not found")
@@ -1159,6 +1164,15 @@ class Handler(BaseHTTPRequestHandler):
                 return
             out = dict(out)
             out["watch"] = f"/runs/{out['id']}/events"
+            if out.get("cause") == "shed":
+                # burn-driven backpressure: a structured 503 with the
+                # service's retry hint — the client backs off instead
+                # of re-queueing into a burning error budget
+                retry = max(1, int(round(float(
+                    out.get("retry_after_s") or 1.0))))
+                self._send_json(503, out,
+                                headers={"Retry-After": retry})
+                return
             self._send_json(202, out)
         except BrokenPipeError:
             pass

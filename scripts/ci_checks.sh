@@ -34,6 +34,9 @@ JAX_PLATFORMS=cpu python scripts/doctor_smoke.py
 echo "== service/SLO plane smoke =="
 JAX_PLATFORMS=cpu python scripts/service_smoke.py
 
+echo "== mesh-routed service load smoke =="
+JAX_PLATFORMS=cpu python scripts/service_load.py --smoke
+
 echo "== tier-1 pytest =="
 JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider

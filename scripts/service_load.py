@@ -1,0 +1,468 @@
+#!/usr/bin/env python
+"""Traffic generator + sustained-load proof for the mesh-routed
+service plane (jepsen_tpu/service.py).
+
+Two modes:
+
+  * **--smoke** — the CI gate (scripts/ci_checks.sh): deterministic,
+    on 8 fake CPU devices. Proves the PR-16 routing contract:
+      - a coalesced batch of 4 warm same-bucket requests serves as
+        ONE `check_mesh` lane-group round set (one `service_batch`
+        point, mode "mesh", per-request {shard, slot} coordinates),
+        at ZERO XLA recompiles under CompileGuard, with verdict
+        parity against the serial path on the SAME histories and a
+        measured warm mesh batch wall under the serial batch wall;
+      - the unified ("service-plan", ...) registry carries BOTH the
+        WGL bucket (with its mesh layout) and the Elle closure
+        bucket, so `Service.rewarm()` warms both across restarts;
+      - a seeded SLO burn sheds new arrivals: POST /check answers a
+        structured 503 with Retry-After and cause "shed", the shed
+        is excluded from the availability objective like the other
+        admission rejections, and admission recovers when the burn
+        clears;
+      - everything emitted (`service`/`service_batch` series,
+        `kind="service-request"` records) lints clean.
+
+  * **default** — sustained mixed load: a seeded WGL + Elle request
+    mix (10k-op WGL / 3k-txn Elle by default) at `--rate` req/s for
+    `--duration` seconds against an in-process service, with
+    `/slo` + `/devices` (via the embedded web server) as the
+    dashboard. After a warm-up pass the steady state runs under a
+    CompileGuard, so a recompile inside the measured window fails
+    the run — the "pinned warm p50, zero recompiles" proof.
+
+Exit 0 clean, 1 on any violation.
+"""
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+import urllib.error
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _force_devices(n: int = 8) -> None:
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n}"
+        ).strip()
+
+
+def build_histories(synth, *, wgl_ops: int, elle_txns: int,
+                    wgl_pool: int = 4, elle_pool: int = 2,
+                    seed: int = 100) -> dict:
+    """Seeded history pools — built once, reused across the run (a
+    10k-op history per request would make the GENERATOR the
+    bottleneck)."""
+    return {
+        "wgl": [synth.cas_register_history(wgl_ops, n_procs=4,
+                                           seed=seed + i)
+                for i in range(wgl_pool)],
+        "elle": [synth.list_append_history(elle_txns, n_procs=5,
+                                           seed=seed + 50 + i)
+                 for i in range(elle_pool)],
+    }
+
+
+def make_payload(pools: dict, rng, *, elle_frac: float,
+                 tenants: list, time_limit: float = 120.0) -> dict:
+    tenant = tenants[rng.randrange(len(tenants))]
+    if rng.random() < elle_frac:
+        h = pools["elle"][rng.randrange(len(pools["elle"]))]
+        return {"checker": "elle-append", "tenant": tenant,
+                "history": h, "params": {"time_limit": time_limit}}
+    h = pools["wgl"][rng.randrange(len(pools["wgl"]))]
+    return {"checker": "wgl", "model": "cas-register",
+            "tenant": tenant, "history": h,
+            "params": {"time_limit": time_limit}}
+
+
+def run_load(svc, pools: dict, *, rate: float, duration_s: float,
+             elle_frac: float, tenants: list, seed: int) -> list:
+    """Submit the seeded mix at `rate` req/s for `duration_s`;
+    returns each submit()'s outcome dict (including sheds and
+    rejections — the generator never retries, backoff is the
+    client's contract)."""
+    import random
+    rng = random.Random(seed)
+    n = max(1, int(rate * duration_s))
+    outs = []
+    t0 = time.monotonic()
+    for i in range(n):
+        delay = t0 + i / rate - time.monotonic()
+        if delay > 0:
+            time.sleep(delay)
+        try:
+            outs.append(svc.submit(make_payload(
+                pools, rng, elle_frac=elle_frac, tenants=tenants)))
+        except ValueError as e:
+            outs.append({"state": "error", "error": str(e)})
+    return outs
+
+
+def drain(svc, outs: list, timeout: float = 600.0) -> list:
+    deadline = time.monotonic() + timeout
+    infos = []
+    for o in outs:
+        rid = o.get("id")
+        if rid is None or o.get("state") == "rejected":
+            infos.append(o)
+            continue
+        while time.monotonic() < deadline:
+            info = svc.get(rid)
+            if info and info["state"] in ("done", "rejected"):
+                infos.append(info)
+                break
+            time.sleep(0.02)
+        else:
+            raise RuntimeError(f"run {rid} never finished")
+    return infos
+
+
+# ---------------------------------------------------------------------------
+# the CI smoke
+# ---------------------------------------------------------------------------
+
+def smoke() -> int:
+    from jepsen_tpu import fs_cache, ledger, metrics, synth, web
+    from jepsen_tpu import service as service_mod
+    from jepsen_tpu import slo as slo_mod
+    from jepsen_tpu.analysis import guards
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import telemetry_lint
+
+    failures = []
+
+    def check(cond, msg):
+        print(("ok   " if cond else "FAIL ") + msg)
+        if not cond:
+            failures.append(msg)
+
+    tmp = tempfile.mkdtemp(prefix="service-load-smoke-")
+    fs_cache.DIR = os.path.join(tmp, "cache")
+    store = os.path.join(tmp, "store")
+    slo_mod._reset()
+    svc = service_mod.Service(store, workers=1, slo_every_s=3600.0,
+                              max_batch=4)
+    svc.start()
+
+    def submit_wgl(h):
+        return svc.submit({"model": "cas-register", "tenant": "load",
+                           "history": h})
+
+    def wait_done(rid, timeout=300.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            info = svc.get(rid)
+            if info and info["state"] in ("done", "rejected"):
+                return info
+            time.sleep(0.005)
+        raise RuntimeError(f"run {rid} never finished")
+
+    # seed 23 is deliberately absent: its history carries a wider op
+    # alphabet (table O=64), landing it in a DIFFERENT canonical
+    # bucket — these four genuinely coalesce.
+    hs = [synth.cas_register_history(500, n_procs=4, seed=s)
+          for s in (21, 22, 24, 25)]
+
+    # -- warm the bucket (serial ladder + mesh lane-group plan) -----
+    i0 = wait_done(submit_wgl(
+        synth.cas_register_history(480, n_procs=4, seed=20))["id"])
+    check(i0["verdict"] in (True, False),
+          "cold request decides (and warms the unified plan)")
+    plans = fs_cache.list_data(("service-plan",))
+    wgl_plans = [p for p in plans
+                 if isinstance(p, dict) and "bucket" in p]
+    check(len(wgl_plans) == 1 and
+          isinstance(wgl_plans[0].get("mesh"), dict),
+          "ONE service-plan entry carries bucket + mesh layout "
+          f"(found {len(wgl_plans)}, mesh="
+          f"{wgl_plans[0].get('mesh') if wgl_plans else None})")
+
+    def timed_batch():
+        """Hold the queue, coalesce the 4 same-bucket requests, then
+        release and time admission-to-last-verdict."""
+        svc.hold(True)
+        outs = [submit_wgl(h) for h in hs]
+        t0 = time.monotonic()
+        svc.hold(False)
+        infos = [wait_done(o["id"]) for o in outs]
+        return time.monotonic() - t0, outs, infos
+
+    # Each path is timed as the min of TWO warm batches: on a 1-core
+    # CI host a single ~0.1 s wall carries scheduler + poll jitter of
+    # the same order as the mesh-vs-serial margin; min-of-2 under one
+    # zero-compile guard keeps the comparison honest and stable.
+    # -- serial baseline: same 4 histories, mesh routing off --------
+    svc.mesh_serving = False
+    with guards.CompileGuard(max_compiles=0,
+                             name="load-serial") as g_serial:
+        serial_runs = [timed_batch() for _ in range(2)]
+    serial_wall = min(w for w, _, _ in serial_runs)
+    serial_infos = serial_runs[-1][2]
+    check(g_serial.compiles == 0,
+          "warm serial batches add ZERO XLA compiles")
+    serial_verdicts = [i["verdict"] for i in serial_infos]
+
+    # -- mesh route: ONE lane-group round set, zero recompiles ------
+    svc.mesh_serving = True
+    with guards.CompileGuard(max_compiles=0,
+                             name="load-mesh") as g_mesh:
+        mesh_runs = [timed_batch() for _ in range(2)]
+    mesh_wall = min(w for w, _, _ in mesh_runs)
+    last_mesh_wall, outs, mesh_infos = mesh_runs[-1]
+    check(g_mesh.compiles == 0,
+          "warm mesh batches add ZERO XLA compiles (the warmed "
+          "executables ARE the scheduled ones)")
+    bpts = svc.mx.series("service_batch").points
+    mesh_pts = [p for p in bpts if p["mode"] == "mesh"]
+    check(len(mesh_pts) == len(mesh_runs)
+          and all(p["batch_n"] == 4 for p in mesh_pts),
+          f"each warm batch of 4 coalesced requests served as ONE "
+          f"mesh lane-group round set (batch points: "
+          f"{[(p['mode'], p['batch_n']) for p in bpts]})")
+    check(bool(mesh_pts) and all(
+              p["rounds"] >= 1 and sum(p["shards"].values()) == 4
+              for p in mesh_pts),
+          f"each round set retired all 4 lanes over the mesh "
+          f"(rounds={[p['rounds'] for p in mesh_pts]}, "
+          f"shards={mesh_pts[-1]['shards'] if mesh_pts else '?'})")
+    with svc._lock:
+        mesh_results = [svc._runs[o['id']].result for o in outs]
+    check(all(isinstance((r or {}).get("mesh"), dict)
+              and "shard" in r["mesh"] and "slot" in r["mesh"]
+              for r in mesh_results),
+          "every mesh-served result carries its {shard, slot} "
+          "coordinates")
+    mesh_verdicts = [i["verdict"] for i in mesh_infos]
+    check(mesh_verdicts == serial_verdicts,
+          f"mesh verdicts match the serial path "
+          f"({mesh_verdicts} == {serial_verdicts})")
+    check(mesh_wall < serial_wall,
+          f"warm mesh batch wall beats serial "
+          f"({mesh_wall:.3f}s < {serial_wall:.3f}s)")
+
+    # -- lane-level wait/serve attribution --------------------------
+    pts = {p["run_id"]: p for p in svc.mx.series("service").points}
+    mesh_serves = [pts[o["id"]]["serve_s"] for o in outs]
+    check(all(0 < s <= last_mesh_wall + 0.1 for s in mesh_serves),
+          f"mesh members bill their OWN lane wall as serve_s "
+          f"({[round(s, 3) for s in mesh_serves]})")
+
+    # -- Elle joins the warm registry -------------------------------
+    eh = synth.list_append_history(200, n_procs=5, seed=70)
+    ei = wait_done(svc.submit({"checker": "elle-append",
+                               "tenant": "load", "history": eh})["id"])
+    check(ei["verdict"] in (True, False),
+          "elle-append request decides")
+    elle_plans = [p for p in fs_cache.list_data(("service-plan",))
+                  if isinstance(p, dict) and "elle_bucket" in p]
+    check(len(elle_plans) == 1,
+          "elle closure bucket registered under (\"service-plan\", "
+          f"...) ({len(elle_plans)} entr(y/ies))")
+
+    # -- burn-triggered shed: structured 503 + Retry-After ----------
+    server = web.serve(host="127.0.0.1", port=0, store_root=store,
+                       service=svc)
+    threading.Thread(target=server.serve_forever,
+                     daemon=True).start()
+    base = f"http://127.0.0.1:{server.server_port}"
+    now = time.time()
+    burn_led = ledger.Ledger(os.path.join(tmp, "burn-store"))
+    for i in range(8):
+        burn_led.record({
+            "kind": "service-request", "name": "service:seeded",
+            "t": now - 2 * i, "verdict": True, "tenant": "load",
+            "warm_hit": True, "batch_n": 1, "shed": False,
+            "device_s": 0.5, "wall_s": 9.0,
+            "phases": {"queue_wait_s": 8.2, "search_s": 0.7,
+                       "respond_s": 0.1}})
+    burn_rep = slo_mod.Engine(
+        burn_led, windows_s=(60.0, 600.0)).evaluate(now=now)
+    check(bool(burn_rep["alerts"]),
+          f"seeded slow traffic trips the multi-window burn "
+          f"({[a['objective'] for a in burn_rep['alerts']]})")
+    svc._note_slo(burn_rep)
+    check(svc.shedding() is not None,
+          "burn alert opens the shed window")
+    body = json.dumps({"model": "cas-register", "tenant": "load",
+                       "history": [op.to_dict() for op in hs[0]]}
+                      ).encode()
+    req = urllib.request.Request(
+        base + "/check", data=body,
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=30):
+            shed_status, shed_out, retry_after = 202, {}, None
+    except urllib.error.HTTPError as e:
+        shed_status = e.code
+        retry_after = e.headers.get("Retry-After")
+        shed_out = json.loads(e.read())
+    check(shed_status == 503 and shed_out.get("cause") == "shed",
+          f"shed answers a structured 503 (status={shed_status}, "
+          f"cause={shed_out.get('cause')!r})")
+    check(retry_after is not None and int(retry_after) >= 1,
+          f"503 carries Retry-After ({retry_after!r})")
+
+    # -- sheds are excluded from the SLO objectives -----------------
+    rep = svc.slo.evaluate_and_publish(mx=svc.mx, led=svc.ledger)
+    avail = next(o for o in rep["objectives"]
+                 if o["name"] == "availability")
+    longest = avail["windows"][-1]
+    shed_recs = [r for r in svc.ledger.query(kind="service-request")
+                 if r.get("cause") == "shed"]
+    check(len(shed_recs) >= 1,
+          f"shed landed as an attributed service-request record "
+          f"({len(shed_recs)})")
+    check(longest["n"] + len(shed_recs)
+          <= rep["requests"] and longest["met"] is not False,
+          f"availability excludes sheds (n={longest['n']} of "
+          f"{rep['requests']} records, met={longest['met']})")
+
+    # -- the shed clears with the burn ------------------------------
+    svc._note_slo({"alerts": []})
+    check(svc.shedding() is None,
+          "a clean SLO report closes the shed window")
+    out = svc.submit({"model": "cas-register", "tenant": "load",
+                      "history": hs[0]})
+    check(out["state"] in ("queued",),
+          "admission recovers once the burn clears")
+    wait_done(out["id"])
+
+    # -- everything emitted lints clean -----------------------------
+    art = os.path.join(tmp, "artifacts")
+    os.makedirs(art, exist_ok=True)
+    mpath = os.path.join(art, "service_load_metrics.jsonl")
+    svc.mx.export_jsonl(mpath)
+    paths = [mpath, os.path.join(store, "ledger", "index.jsonl")]
+    rc = telemetry_lint.main(paths)
+    check(rc == 0,
+          "service/service_batch series + records lint clean")
+
+    svc.close()
+    server.shutdown()
+    print(f"\nservice_load smoke: "
+          f"{'CLEAN' if not failures else f'{len(failures)} FAILURE(S)'}"
+          f" (mesh {mesh_wall:.3f}s vs serial {serial_wall:.3f}s)")
+    return 1 if failures else 0
+
+
+# ---------------------------------------------------------------------------
+# sustained load (the tentpole's proof run; not part of CI)
+# ---------------------------------------------------------------------------
+
+def sustained(args) -> int:
+    from jepsen_tpu import fs_cache, synth, web
+    from jepsen_tpu import service as service_mod
+    from jepsen_tpu import slo as slo_mod
+    from jepsen_tpu.analysis import guards
+
+    tmp = args.store or tempfile.mkdtemp(prefix="service-load-")
+    if args.isolate_cache:
+        fs_cache.DIR = os.path.join(tmp, "cache")
+    slo_mod._reset()
+    svc = service_mod.Service(tmp, workers=args.workers,
+                              slo_every_s=5.0,
+                              max_batch=args.max_batch)
+    svc.start()
+    server = web.serve(host="127.0.0.1", port=args.port,
+                       store_root=tmp, service=svc)
+    threading.Thread(target=server.serve_forever,
+                     daemon=True).start()
+    print(f"dashboard: http://127.0.0.1:{server.server_port}/slo  "
+          f"+  /devices  +  /status.json")
+
+    pools = build_histories(synth, wgl_ops=args.wgl_ops,
+                            elle_txns=args.elle_txns,
+                            seed=args.seed)
+    tenants = [f"tenant-{i}" for i in range(args.tenants)]
+
+    # warm-up pass: one request per pool history pays every compile
+    # OUTSIDE the measured window
+    print("warming ...")
+    warm_outs = [svc.submit({"checker": "wgl",
+                             "model": "cas-register",
+                             "tenant": tenants[0], "history": h})
+                 for h in pools["wgl"][:1]]
+    warm_outs += [svc.submit({"checker": "elle-append",
+                              "tenant": tenants[0], "history": h})
+                  for h in pools["elle"][:1]]
+    drain(svc, warm_outs)
+
+    print(f"sustained: {args.rate} req/s x {args.duration}s "
+          f"(elle_frac={args.elle_frac})")
+    with guards.CompileGuard(name="service-load") as g:
+        outs = run_load(svc, pools, rate=args.rate,
+                        duration_s=args.duration,
+                        elle_frac=args.elle_frac, tenants=tenants,
+                        seed=args.seed)
+        infos = drain(svc, outs)
+    rep = svc.slo.evaluate_and_publish(mx=svc.mx, led=svc.ledger)
+    snap = svc.snapshot()
+    summary = {
+        "submitted": len(outs),
+        "done": sum(1 for i in infos if i.get("state") == "done"),
+        "rejected": sum(1 for i in infos
+                        if i.get("state") == "rejected"),
+        "shed": snap["shed"], "mesh_batches": snap["mesh_batches"],
+        "degrades": snap["degrades"], "batches": snap["batches"],
+        "warm_rate": snap["warm_rate"],
+        "steady_state_compiles": g.compiles,
+        "slo_met": rep.get("met"),
+        "burning": [a["objective"] for a in rep.get("alerts") or []],
+    }
+    for o in rep.get("objectives") or []:
+        w = (o.get("windows") or [{}])[-1]
+        summary[f"slo:{o['name']}"] = {
+            "observed": w.get("observed"), "met": w.get("met"),
+            "n": w.get("n")}
+    print(json.dumps(summary, indent=2, default=str))
+    svc.close()
+    server.shutdown()
+    ok = (summary["steady_state_compiles"] == 0
+          and summary["done"] > 0)
+    print("sustained load: " + ("CLEAN" if ok else "FAILED "
+          "(recompiles in the measured window or nothing served)"))
+    return 0 if ok else 1
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--smoke", action="store_true",
+                    help="run the deterministic CI gate")
+    ap.add_argument("--rate", type=float, default=2.0)
+    ap.add_argument("--duration", type=float, default=60.0)
+    ap.add_argument("--elle-frac", type=float, default=0.23,
+                    help="fraction of requests that are elle-append")
+    ap.add_argument("--tenants", type=int, default=3)
+    ap.add_argument("--seed", type=int, default=42)
+    ap.add_argument("--wgl-ops", type=int, default=10_000)
+    ap.add_argument("--elle-txns", type=int, default=3_000)
+    ap.add_argument("--workers", type=int, default=1)
+    ap.add_argument("--max-batch", type=int, default=8)
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--store", default=None)
+    ap.add_argument("--isolate-cache", action="store_true")
+    args = ap.parse_args()
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ.setdefault("JEPSEN_TPU_NO_CACHE", "1")
+    if args.smoke:
+        _force_devices(8)
+    import jax
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    return smoke() if args.smoke else sustained(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -154,7 +154,8 @@ def main() -> int:
         burn_led.record({
             "kind": "service-request", "name": "service:seeded",
             "t": now - 2 * i, "verdict": True, "tenant": "smoke",
-            "warm_hit": True, "batch_n": 1, "device_s": 0.5,
+            "warm_hit": True, "batch_n": 1, "shed": False,
+            "device_s": 0.5,
             "wall_s": 9.0,
             "phases": {"queue_wait_s": 8.2, "search_s": 0.7,
                        "respond_s": 0.1}})

@@ -118,7 +118,15 @@ SERIES_SCHEMAS = {
     "service": {"run_id": str, "tenant": str, "bucket": str,
                 "verdict": str, "wait_s": NUM, "serve_s": NUM,
                 "total_s": NUM, "warm_hit": bool, "batch_n": int,
-                "queue_depth": int},
+                "shed": bool, "queue_depth": int},
+    # one point per coalesced batch (jepsen_tpu/service.py
+    # _record_batch): the routing decision — mode "mesh" served the
+    # batch as ONE check_mesh lane-group round set, "serial" was
+    # never eligible, "degrade" should have meshed but fell back
+    # (<2 devices / infeasible plan); rounds the lane-group poll
+    # count (0 for serial), shards the {device: lanes} map
+    "service_batch": {"bucket": str, "batch_n": int, "mode": str,
+                      "rounds": int, "shards": dict},
     # the SLO engine (jepsen_tpu/slo.py): one point per objective per
     # evaluation — good_frac over the longest rolling window,
     # burn_rate in error-budget multiples (1.0 = consuming exactly
@@ -192,6 +200,12 @@ def lint_line(obj: dict, where: str) -> list:
             errors += _check_doctor_enums(
                 obj.get("rule"), obj.get("severity"),
                 f"{where} [doctor]")
+        if obj.get("series") == "service_batch" and not errors \
+                and obj.get("mode") not in ("mesh", "serial",
+                                            "degrade"):
+            errors.append(f"{where} [service_batch]: mode must be "
+                          f"mesh|serial|degrade, got "
+                          f"{obj.get('mode')!r}")
     elif typ == "histogram" and not errors:
         buckets, counts = obj["buckets"], obj["bucket_counts"]
         if len(buckets) != len(counts):
@@ -387,6 +401,10 @@ def lint_ledger_file(path: str) -> list:
             if not isinstance(obj.get("warm_hit"), bool):
                 errs.append(f"{where}: service-request needs bool "
                             "'warm_hit'")
+            if not isinstance(obj.get("shed"), bool):
+                errs.append(f"{where}: service-request needs bool "
+                            "'shed' (burn-driven backpressure "
+                            "attribution)")
             ph = obj.get("phases")
             if not isinstance(ph, dict):
                 errs.append(f"{where}: service-request needs the "

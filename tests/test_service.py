@@ -45,6 +45,9 @@ def _service(root, **kw):
     kw.setdefault("workers", 1)
     kw.setdefault("warm_ladder", False)
     kw.setdefault("slo_every_s", 3600.0)
+    # mesh routing off by default: the legacy admission/serve tests
+    # assert serial-path semantics; TestMeshRoute & co. opt back in
+    kw.setdefault("mesh_serving", False)
     return service_mod.Service(str(root), **kw)
 
 
@@ -365,6 +368,164 @@ class TestRewarm:
         info = _wait(svc2, out2["id"])
         assert info["warm_hit"] is True
         svc2.close()
+
+
+# --- mesh routing -----------------------------------------------------------
+
+def _held_batch(svc, hs):
+    svc.hold(True)
+    outs = [_post(svc, h) for h in hs]
+    svc.hold(False)
+    return outs, [_wait(svc, o["id"]) for o in outs]
+
+
+@pytest.fixture(scope="module")
+def mesh_served(tmp_path_factory):
+    """One service over the conftest 8-device mesh; the SAME four
+    same-bucket histories served twice — mesh routing off (the
+    serial baseline) then on (one lane-group round set) — shared by
+    the parity/telemetry assertions so the kernels compile once."""
+    root = tmp_path_factory.mktemp("mesh-store")
+    prev_dir = fs_cache.DIR
+    fs_cache.DIR = str(tmp_path_factory.mktemp("mesh-cache"))
+    svc = service_mod.Service(
+        str(root), workers=1, warm_ladder=False,
+        slo_every_s=3600.0, max_batch=4, mesh_serving=False)
+    hs = [_hist(seed=s) for s in (31, 32, 33, 34)]
+    s_outs, s_infos = _held_batch(svc, hs)
+    svc.mesh_serving = True
+    m_outs, m_infos = _held_batch(svc, hs)
+    yield svc, (s_outs, s_infos), (m_outs, m_infos)
+    svc.close()
+    fs_cache.DIR = prev_dir
+    service_mod.set_default(None)
+
+
+class TestMeshRoute:
+    def test_verdict_parity_with_serial(self, mesh_served):
+        _svc, (_, s_infos), (_, m_infos) = mesh_served
+        assert [i["verdict"] for i in m_infos] == \
+            [i["verdict"] for i in s_infos]
+        assert all(i["verdict"] is True for i in m_infos)
+
+    def test_one_lane_group_round_set(self, mesh_served):
+        svc, _s, _m = mesh_served
+        pts = svc.mx.series("service_batch").points
+        assert [p["mode"] for p in pts] == ["serial", "mesh"]
+        mp = pts[-1]
+        assert mp["batch_n"] == 4 and mp["rounds"] >= 1
+        assert sum(mp["shards"].values()) == 4
+        assert svc.snapshot()["mesh_batches"] == 1
+
+    def test_results_carry_mesh_coordinates(self, mesh_served):
+        svc, _s, (m_outs, _) = mesh_served
+        with svc._lock:
+            results = [svc._runs[o["id"]].result for o in m_outs]
+        for r in results:
+            assert isinstance(r.get("mesh"), dict)
+            assert "shard" in r["mesh"] and "slot" in r["mesh"]
+
+    def test_batch_series_lints(self, mesh_served, tmp_path):
+        svc, _s, _m = mesh_served
+        path = str(tmp_path / "mesh_metrics.jsonl")
+        svc.mx.export_jsonl(path)
+        assert telemetry_lint.lint_jsonl_file(path) == []
+
+
+class TestMeshDegrade:
+    def test_single_device_degrades_to_serial(self, tmp_path,
+                                              monkeypatch):
+        svc = _service(tmp_path, mesh_serving=True, max_batch=4)
+        monkeypatch.setattr(svc, "_device_count", lambda: 1)
+        _outs, infos = _held_batch(
+            svc, [_hist(seed=s) for s in (35, 36)])
+        assert all(i["verdict"] is True for i in infos)
+        pts = svc.mx.series("service_batch").points
+        assert pts[-1]["mode"] == "degrade"
+        assert pts[-1]["cause"] == "single-device"
+        assert svc.snapshot()["degrades"] == 1
+        svc.close()
+
+    def test_infeasible_plan_degrades(self, tmp_path, monkeypatch):
+        """check_mesh returning None (preflight-infeasible plan, not
+        an error) must fall back to the serial path and record the
+        routing decision as a degrade."""
+        from jepsen_tpu.parallel import mesh as pmesh
+        svc = _service(tmp_path, mesh_serving=True, max_batch=4)
+        monkeypatch.setattr(pmesh, "check_mesh",
+                            lambda *a, **k: None)
+        _outs, infos = _held_batch(
+            svc, [_hist(seed=s) for s in (37, 38)])
+        assert all(i["verdict"] is True for i in infos)
+        pts = svc.mx.series("service_batch").points
+        assert pts[-1]["mode"] == "degrade"
+        assert pts[-1]["cause"] == "mesh-declined"
+        svc.close()
+
+
+class TestMeshAttribution:
+    def test_lane_serve_bills_own_wall_only(self, tmp_path):
+        """A lane that retires at round r never bills the sibling
+        rounds r+1..R as serve time: serve_s is the shard's OWN wall
+        and everything before the lane started is queue_wait_s."""
+        svc = _service(tmp_path)
+        svc.hold(True)
+        outs = [_post(svc, _hist(seed=s)) for s in (51, 52)]
+        with svc._lock:
+            reqs = [svc._runs[o["id"]] for o in outs]
+        t0 = time.monotonic()
+        walls = [0.05, 0.4]
+        for sl, (req, w) in enumerate(zip(reqs, walls)):
+            res = {"valid?": True,
+                   "shard": {"t0": t0 + 0.01, "wall_s": w,
+                             "device": "TFRT_CPU_0"},
+                   "mesh": {"shard": 0, "slot": sl}}
+            svc._finish_mesh_member(req, res, True, 2, t0)
+        assert reqs[0].serve_s == pytest.approx(walls[0])
+        assert reqs[1].serve_s == pytest.approx(walls[1])
+        assert reqs[0].serve_s < walls[1]
+        for req in reqs:
+            assert req.phases["search_s"] == req.serve_s
+            assert req.phases["queue_wait_s"] >= 0.0
+            assert req.state == "done"
+        with svc._cv:
+            svc._queues.clear()
+        svc.hold(False)
+        svc.close()
+
+
+class TestShed:
+    def test_burn_sheds_with_retry_after_and_recovers(
+            self, tmp_path):
+        svc = _service(tmp_path, shed_hold_s=30.0)
+        svc._note_slo({"alerts": [{"objective": "warm-p50"}]})
+        assert svc.shedding() is not None
+        out = _post(svc, _hist(seed=41), tenant="t")
+        assert out["state"] == "rejected"
+        assert out["cause"] == "shed"
+        assert float(out["retry_after_s"]) > 0
+        # sheds are admission rejections: excluded from every SLO
+        # objective, never counted against availability
+        rec = svc.ledger.get(out["id"])
+        assert rec["shed"] is True
+        for obj in slo_mod.default_objectives():
+            assert obj.good(rec) is None
+        # a clean report closes the window immediately
+        svc._note_slo({"alerts": []})
+        assert svc.shedding() is None
+        out2 = _post(svc, _hist(seed=42), tenant="t")
+        assert out2["state"] == "queued"
+        assert _wait(svc, out2["id"])["verdict"] is True
+        svc.close()
+
+    def test_no_shed_below_threshold(self, tmp_path):
+        svc = _service(tmp_path)
+        assert svc.shedding() is None
+        out = _post(svc, _hist(seed=43))
+        assert out["state"] == "queued"
+        assert _wait(svc, out["id"])["verdict"] is True
+        assert svc.snapshot()["shed"] == 0
+        svc.close()
 
 
 # --- the web front door -----------------------------------------------------
