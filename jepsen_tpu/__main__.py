@@ -128,6 +128,12 @@ def doctor_cmd() -> dict:
         Opt("no_record", default=False,
             help="Read-only: skip banking the kind=\"doctor\" "
                  "ledger record"),
+        Opt("watch", default=False,
+            help="Keep re-diagnosing whenever the store's ledger "
+                 "index changes (read-only; Ctrl-C to stop)"),
+        Opt("interval", metavar="SECONDS", default=2.0, parse=float,
+            help="--watch throttle: at most one diagnosis per this "
+                 "many seconds"),
     ]
 
     def run(parsed):
@@ -139,12 +145,43 @@ def doctor_cmd() -> dict:
                                 "[run_id|latest|bench] [OPTIONS ...]"}}
 
 
+def autopilot_cmd() -> dict:
+    """`python -m jepsen_tpu autopilot <run_id|latest|bench>` —
+    offline replay of the autopilot's frozen policy table against a
+    banked run: print which remedies the supervisor WOULD execute
+    (decide step only — no actuators run, nothing is banked)."""
+    spec = [
+        Opt("help", short="-h", help="Print out this message and exit"),
+        Opt("target", metavar="TARGET",
+            help="run_id | latest | bench (also accepted as a bare "
+                 "positional argument; default latest)"),
+        Opt("root", metavar="DIR",
+            help="Repo root for bench artifacts (default: cwd)"),
+        Opt("store", metavar="DIR",
+            help="Store root holding the ledger (default: "
+                 "<root>/store)"),
+        Opt("json", default=False,
+            help="Emit the decisions + policy table as JSON"),
+    ]
+
+    def run(parsed):
+        from . import autopilot as autopilot_mod
+        return autopilot_mod.cli_main(parsed.options,
+                                      parsed.arguments)
+
+    return {"autopilot": {"opt_spec": spec, "run": run,
+                          "usage": "Usage: python -m jepsen_tpu "
+                                   "autopilot [run_id|latest|bench] "
+                                   "[OPTIONS ...]"}}
+
+
 COMMANDS = {
     **cli.single_test_cmd({"test_fn": demo_test, "opt_spec": DEMO_OPTS}),
     **cli.test_all_cmd({"tests_fn": demo_tests, "opt_spec": DEMO_OPTS}),
     **cli.serve_cmd(),
     **preflight_cmd(),
     **doctor_cmd(),
+    **autopilot_cmd(),
 }
 
 

@@ -527,6 +527,11 @@ def serve_cmd() -> dict:
         Opt("quota_device_s", metavar="SECONDS", parse=float,
             help="Per-tenant device-seconds quota over the rolling "
                  "window (with --service; default: unlimited)"),
+        Opt("autopilot", default=False,
+            help="Run the verify-or-revert control loop "
+                 "(jepsen_tpu/autopilot.py) over the service: "
+                 "doctor/SLO findings execute their remedies, every "
+                 "action banked and verified (with --service)"),
     ]
 
     def run(parsed: Parsed):
@@ -537,7 +542,8 @@ def serve_cmd() -> dict:
             from .service import Service
             svc = Service(o["store_root"],
                           workers=o.get("workers") or 1,
-                          quota_device_s=o.get("quota_device_s"))
+                          quota_device_s=o.get("quota_device_s"),
+                          autopilot=bool(o.get("autopilot")))
         server = web.serve(host=o["host"], port=o["port"],
                            store_root=o["store_root"], service=svc)
         if svc is not None:
@@ -555,11 +561,14 @@ def serve_cmd() -> dict:
         print(f"Device observatory: {base}/devices "
               f"· occupancy: {base}/occupancy "
               f"· doctor: {base}/doctor "
-              f"· slo: {base}/slo")
+              f"· slo: {base}/slo "
+              f"· autopilot: {base}/autopilot")
         if svc is not None:
             print(f"Checker service: POST {base}/check "
                   f"· events: {base}/events "
-                  f"({svc.workers} worker(s))")
+                  f"({svc.workers} worker(s))"
+                  + (" · autopilot ON"
+                     if svc.autopilot_enabled else ""))
         try:
             server.serve_forever()
         except KeyboardInterrupt:

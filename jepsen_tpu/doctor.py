@@ -1377,7 +1377,13 @@ def cli_main(options: dict, arguments: Optional[list] = None) -> int:
     """`python -m jepsen_tpu doctor <run_id|latest|bench>` — diagnose
     a recorded run (ledger id or "latest") or the bench round's
     artifacts ("bench"), print (or --json) the ranked findings, and
-    bank the diagnosis in the doctor planes."""
+    bank the diagnosis in the doctor planes. `--watch` re-diagnoses
+    whenever the store's ledger index changes (TTL-throttled by
+    `--interval`, default 2s); watch passes are read-only — they
+    never bank, so their own output cannot re-trigger them — and
+    run-id targets share the `/runs/<id>.json` per-record diagnosis
+    cache with the web panel (an unchanged record is a dict lookup,
+    not a re-read)."""
     target = None
     for a in arguments or []:
         target = a
@@ -1385,6 +1391,14 @@ def cli_main(options: dict, arguments: Optional[list] = None) -> int:
     target = target or options.get("target") or "bench"
     root = options.get("root") or os.getcwd()
     store_root = options.get("store") or os.path.join(root, "store")
+    if options.get("watch"):
+        return _watch(dict(options, no_record=True), target, root,
+                      store_root)
+    return _cli_once(options, target, root, store_root)
+
+
+def _cli_once(options: dict, target: str, root: str,
+              store_root: str) -> int:
     try:
         if target == "bench":
             view = bench_view(root)
@@ -1410,3 +1424,48 @@ def cli_main(options: dict, arguments: Optional[list] = None) -> int:
             for f in report.get("findings") or []):
         return 1
     return 0
+
+
+def _watch(options: dict, target: str, root: str,
+           store_root: str) -> int:
+    """The `doctor --watch` loop: poll the store ledger's index
+    signature (Ledger.index_signature — the same (mtime_ns, size) key
+    the web caches use) and re-diagnose only when it changed AND the
+    TTL elapsed; a churning index costs one diagnosis per interval,
+    an idle one costs a stat(2) per poll. Ctrl-C exits cleanly."""
+    interval = max(0.5, float(options.get("interval") or 2.0))
+    led = ledger_mod.Ledger(store_root)
+    last_sig: object = ("never",)   # always diagnose the first pass
+    last_t = 0.0
+    try:
+        while True:
+            sig = led.index_signature()
+            now = time.time()
+            if sig != last_sig and (now - last_t) >= interval:
+                last_sig, last_t = sig, now
+                print(f"-- doctor watch {target} @ "
+                      f"{time.strftime('%H:%M:%S')} --")
+                if target not in ("bench", "latest") \
+                        and not options.get("json"):
+                    # an explicit run id rides the /runs/<id>.json
+                    # per-record cache shared with the web panel
+                    from . import web as web_mod
+                    dc = web_mod.doctor_for_record(store_root,
+                                                   target)
+                    if dc is None:
+                        print(f"doctor: no record {target!r} yet")
+                    else:
+                        print(f"healthy={dc.get('healthy')} "
+                              f"rules_fired="
+                              f"{dc.get('rules_fired')}")
+                        for f in dc.get("findings") or []:
+                            print(f"  [{f.get('severity')}] "
+                                  f"{f.get('rule')} "
+                                  f"{f.get('name')}: "
+                                  f"{f.get('summary')}")
+                else:
+                    _cli_once(options, target, root, store_root)
+            time.sleep(min(interval, 0.5))
+    except KeyboardInterrupt:
+        print("doctor: watch stopped")
+        return 0

@@ -89,16 +89,23 @@ def device_label(dev) -> str:
 
 def fault_event(exc: BaseException, *, device: Optional[str] = None,
                 key_index: Optional[int] = None,
-                stage: str = "device-worker") -> dict:
+                stage: str = "device-worker",
+                context: Optional[dict] = None) -> dict:
     """A device fault as a structured fleet event: type, message, the
     worker traceback (bounded), and where it happened — instead of the
-    old `f"error: {e}"` string that threw the stack away."""
-    return {"type": type(exc).__name__,
-            "error": str(exc)[:300],
-            "stage": stage,
-            "device": device,
-            "key_index": key_index,
-            "traceback": traceback.format_exc()[-FAULT_TB_LIMIT:]}
+    old `f"error: {e}"` string that threw the stack away. `context`
+    merges extra attribution keys into the event (the autopilot's
+    failed actuators stamp stage="autopilot" plus the policy rule and
+    action that was being applied, so the doctor can diagnose its own
+    supervisor); the envelope keys always win."""
+    out = dict(context or {})
+    out.update({"type": type(exc).__name__,
+                "error": str(exc)[:300],
+                "stage": stage,
+                "device": device,
+                "key_index": key_index,
+                "traceback": traceback.format_exc()[-FAULT_TB_LIMIT:]})
+    return out
 
 
 def _fault_point(event: dict) -> dict:

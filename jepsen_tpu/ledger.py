@@ -191,6 +191,20 @@ class Ledger:
     def record_path(self, run_id: str) -> str:
         return os.path.join(self.records_dir, f"{run_id}.json")
 
+    def index_signature(self) -> Optional[tuple]:
+        """The index file's (mtime_ns, size) identity — the ONE
+        change-detection key every ledger-watching cache uses
+        (web.py's /status, /doctor and /slo caches; `doctor --watch`;
+        the autopilot's replay throttle). None when the index does
+        not exist yet — callers treat that as "nothing recorded"."""
+        if not self.index_path:
+            return None
+        try:
+            st = os.stat(self.index_path)
+        except OSError:
+            return None
+        return (st.st_mtime_ns, st.st_size)
+
     # -- writing ------------------------------------------------------
     def record(self, entry: dict) -> Optional[str]:
         """Append one run record; returns its id (None when disabled

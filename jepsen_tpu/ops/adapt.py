@@ -44,6 +44,8 @@ device, no jax import.
 from __future__ import annotations
 
 import os
+import threading
+import time
 from dataclasses import dataclass, field
 from typing import Optional
 
@@ -111,6 +113,51 @@ class Decision:
     reason: str
 
 
+# ---------------------------------------------------------------------------
+# ladder pin — the autopilot's D002/D003 actuator
+# ---------------------------------------------------------------------------
+# A module-level pin that every live Policy consults per poll: while a
+# pin is set, the policy forces one rebucket to the pinned capacity
+# (reason "pinned" on the wgl_adapt series) and then HOLDS there —
+# the hysteresis machinery is bypassed, so a fill-collapsed or
+# thrashing ladder settles immediately. `unpin_ladder` is the
+# rollback half of the autopilot's verify-or-revert contract
+# (jepsen_tpu/autopilot.py): reverting the action restores normal
+# hysteresis on the very next poll. The pin is process-global on
+# purpose — the supervisor acts on the service process, and a pin
+# scoped to one Policy instance would miss the next search's fresh
+# Policy.
+
+_PIN_LOCK = threading.Lock()
+_PIN: Optional[dict] = None
+
+
+def pin_ladder(k: int, reason: str = "autopilot") -> dict:
+    """Pin every live (and future) Policy to bucket `k`. Returns the
+    pin record {k, reason, t}; re-pinning replaces the prior pin."""
+    global _PIN
+    pin = {"k": int(k), "reason": str(reason),
+           "t": round(time.time(), 3)}
+    with _PIN_LOCK:
+        _PIN = pin
+    return pin
+
+
+def unpin_ladder() -> Optional[dict]:
+    """Clear the pin (the autopilot's rollback); returns the pin that
+    was cleared, None when none was set."""
+    global _PIN
+    with _PIN_LOCK:
+        pin, _PIN = _PIN, None
+    return pin
+
+
+def ladder_pin() -> Optional[dict]:
+    """The active pin record, None when the ladder floats freely."""
+    with _PIN_LOCK:
+        return _PIN
+
+
 @dataclass
 class Policy:
     """Hysteresis bucket selection from per-poll occupancy inputs.
@@ -140,6 +187,12 @@ class Policy:
         self.ladder = tuple(sorted(set(int(k) for k in self.ladder)))
         if not self.ladder:
             raise ValueError("empty ladder")
+        # an active pin outranks the caller's start bucket: a fresh
+        # Policy (the next search / the next service batch) starts AT
+        # the pinned capacity instead of rediscovering the collapse
+        pin = ladder_pin()
+        if pin is not None and int(pin["k"]) in self.ladder:
+            self.start_k = int(pin["k"])
         self.level = (self.ladder.index(self.start_k)
                       if self.start_k in self.ladder else 0)
 
@@ -156,6 +209,17 @@ class Policy:
                 backlog: int) -> Decision:
         k = self.k
         top = len(self.ladder) - 1
+        # an autopilot pin outranks every signal EXCEPT backlog
+        # pressure (a pin must not turn a False verdict into
+        # "backlog-overflow"): force one switch to the pinned bucket,
+        # then hold there until unpinned
+        pin = ladder_pin()
+        if pin is not None and int(pin["k"]) in self.ladder \
+                and backlog < max(1, self.backlog_cap // 8):
+            lvl = self.ladder.index(int(pin["k"]))
+            if lvl != self.level:
+                return self._switch(lvl, "pinned")
+            return Decision(False, k, "pinned")
         # overflow prevention outranks everything: a backlog within
         # 1/8 of capacity risks turning a False verdict into
         # "backlog-overflow"/unknown — take the whole top beam now

@@ -259,9 +259,12 @@ def precompile_service_bucket(shape_bucket: dict, *,
     scheduled ones) and backend-compile every ladder bucket. After
     this returns, any `wgl.check(shape_bucket=bucket)` over the same
     canonical bucket stays at ZERO recompiles — the service warm path
-    (jepsen_tpu/service.py) and its restart re-warm both use it;
-    scripts/service_smoke.py carries the CompileGuard proof. Returns
-    {K: compile_seconds}."""
+    (jepsen_tpu/service.py) and its restart re-warm both use it, and
+    it is the autopilot's D001 compile-storm actuator (the
+    "warm-bucket" row of jepsen_tpu/autopilot.py's policy table warms
+    the offending canonical bucket through this path and verifies at
+    zero further compiles); scripts/service_smoke.py carries the
+    CompileGuard proof. Returns {K: compile_seconds}."""
     from . import wgl as wgl_mod
 
     b = shape_bucket

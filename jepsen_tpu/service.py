@@ -104,6 +104,9 @@ ELLE_TXN_QUANTUM = 1024
 # request count (TRIM_EVERY completions trigger one rotation).
 RUNS_CAP = 512
 EVENTS_CAP = 1024
+# resize_workers ceiling: a pool-grow request past this is rejected
+# (the autopilot banks the rejection as a structured fault)
+POOL_MAX = 16
 SPANS_CAP = 4096
 SERIES_CAP = 4096
 TRIM_EVERY = 256
@@ -229,7 +232,9 @@ class Service:
                  default_time_limit: float = 60.0,
                  mesh_serving: bool = True,
                  mesh_min_batch: int = 2,
-                 shed_hold_s: float = 30.0):
+                 shed_hold_s: float = 30.0,
+                 autopilot: bool = False,
+                 autopilot_every_s: float = 5.0):
         self.store_root = store_root
         self.ledger = ledger_mod.Ledger(store_root)
         # the service owns an ENABLED registry by default: a request
@@ -257,6 +262,11 @@ class Service:
         self.shed_hold_s = float(shed_hold_s)
         self._shed_until = 0.0
         self._shed_info: Optional[dict] = None
+        # autopilot: the verify-or-revert control loop (autopilot.py)
+        # — opt-in; start() spawns the supervisor thread
+        self.autopilot_enabled = bool(autopilot)
+        self.autopilot_every_s = float(autopilot_every_s)
+        self._autopilot = None
         self.slo = slo_engine if slo_engine is not None \
             else slo_mod.Engine(ledger=self.ledger)
         self.slo_every_s = float(slo_every_s)
@@ -275,6 +285,7 @@ class Service:
         self._hold = False
         self._stop = False
         self._threads: list = []
+        self._retire = 0  # workers resize_workers asked to exit
         self._stats = {"submitted": 0, "served": 0, "rejected": 0,
                        "warm_hits": 0, "batches": 0, "errors": 0,
                        "shed": 0, "mesh_batches": 0, "degrades": 0}
@@ -293,10 +304,20 @@ class Service:
                                      daemon=True)
                 t.start()
                 self._threads.append(t)
+        if self.autopilot_enabled and self._autopilot is None:
+            from . import autopilot as autopilot_mod
+            self._autopilot = autopilot_mod.Supervisor(
+                autopilot_mod.ServiceHost(self),
+                every_s=self.autopilot_every_s, where="service",
+                mx=self.mx, ledger=self.ledger).start()
+            autopilot_mod.set_default(self._autopilot)
         set_default(self)
         return self
 
     def close(self, timeout: float = 5.0) -> None:
+        if self._autopilot is not None:
+            self._autopilot.close(timeout=timeout)
+            self._autopilot = None
         with self._cv:
             self._stop = True
             self._cv.notify_all()
@@ -321,6 +342,63 @@ class Service:
             self._hold = bool(flag)
             if not flag:
                 self._cv.notify_all()
+
+    def resize_workers(self, n: int) -> dict:
+        """Resize the resident worker pool (the autopilot's D012
+        capacity actuator, but callable by anyone). Growing spawns
+        threads immediately; shrinking retires surplus workers at
+        their next dequeue tick — in-flight batches always finish.
+        Raises ValueError when the request leaves [1, POOL_MAX]; a
+        rejected resize is the caller's structured fault."""
+        n = int(n)
+        if not 1 <= n <= POOL_MAX:
+            raise ValueError(f"pool resize rejected: workers {n} "
+                             f"outside [1, {POOL_MAX}]")
+        with self._cv:
+            prev = self.workers
+            self.workers = n
+            if self._threads:
+                self._threads = [t for t in self._threads
+                                 if t.is_alive()]
+                live = len(self._threads) - self._retire
+                if n > live:
+                    for _ in range(n - live):
+                        t = threading.Thread(
+                            target=self._worker_loop,
+                            name=f"service-worker-"
+                                 f"{len(self._threads)}",
+                            daemon=True)
+                        t.start()
+                        self._threads.append(t)
+                elif n < live:
+                    self._retire += live - n
+                self._cv.notify_all()
+        self._emit(None, "pool-resize", workers_from=prev,
+                   workers_to=n)
+        return {"from": prev, "to": n}
+
+    def open_shed(self, burning: list, hold_s: Optional[float] = None,
+                  source: str = "autopilot") -> dict:
+        """Open the admission shed window explicitly — the
+        autopilot's pre-shed actuator: the error budget is draining
+        toward empty, so brake BEFORE `_note_slo`'s multi-window
+        alert would force the same brake harder and later."""
+        hold = float(hold_s if hold_s is not None
+                     else self.shed_hold_s)
+        names = [str(b) for b in burning]
+        with self._lock:
+            self._shed_until = time.monotonic() + hold
+            self._shed_info = {"burning": names, "hold_s": hold,
+                               "source": source}
+        self._emit(None, "shedding", burning=names, hold_s=hold,
+                   source=source)
+        return {"burning": names, "hold_s": hold}
+
+    def close_shed(self) -> None:
+        """Close the shed window (an open_shed rollback; `_note_slo`
+        also closes it on the next clean evaluation)."""
+        with self._lock:
+            self._shed_info = None
 
     # -- events -------------------------------------------------------
     def _emit(self, req: Optional[_Request], event: str,
@@ -631,6 +709,11 @@ class Service:
     def _next_batch(self) -> Optional[list]:
         with self._cv:
             while not self._stop:
+                if self._retire > 0:
+                    # resize_workers shrank the pool: this worker
+                    # takes the retirement (empty batch = exit)
+                    self._retire -= 1
+                    return []
                 if not self._hold:
                     key = self._pick_key_locked()
                     if key is not None:
@@ -655,6 +738,8 @@ class Service:
     def _worker_loop(self) -> None:
         while not self._stop:
             batch = self._next_batch()
+            if batch == []:  # retired by resize_workers
+                break
             if not batch:
                 continue
             try:
@@ -893,7 +978,6 @@ class Service:
         t_done = time.monotonic()
         req.total_s = round(t_done - req.t_mono, 6)
         req.result = res
-        req.state = "done"
         with self._lock:
             self._stats["served"] += 1
             if warm_hit:
@@ -903,6 +987,8 @@ class Service:
             req.phases["respond_s"] = round(
                 time.monotonic() - t_done, 6)
             self._record(req)
+        # "done" only after banking — same visibility rule as _finish
+        req.state = "done"
         self._emit(req, "done",
                    verdict=_verdict_str(res.get("valid?")),
                    cause=res.get("cause"), wall_s=req.total_s,
@@ -1150,7 +1236,6 @@ class Service:
         req.serve_s = round(t_done - t_serve0, 6)
         req.total_s = round(t_done - req.t_mono, 6)
         req.result = res
-        req.state = "done"
         with self._lock:
             self._stats["served"] += 1
             if warm_hit:
@@ -1164,6 +1249,9 @@ class Service:
             req.phases["respond_s"] = round(
                 time.monotonic() - t_done, 6)
             self._record(req)
+        # "done" only after banking: a poller that sees the terminal
+        # state must also see the service point and ledger record
+        req.state = "done"
         self._emit(req, "done",
                    verdict=_verdict_str(res.get("valid?")),
                    cause=res.get("cause"), wall_s=req.total_s,

@@ -305,15 +305,21 @@ def counter_events(tracks: dict, pid: int = 2) -> list:
     return events
 
 
-def instant_events(instants: list, pid: int = 3) -> list:
+def instant_events(instants: list, pid: int = 3,
+                   default_lane: str = "doctor findings") -> list:
     """`trace_event` "i" (instant) annotations from
-    [{"t": epoch_seconds, "name": str}, ...] — one labeled marker per
-    point, in their own process lane (pid 3, "annotations") so they
-    never rename a span or counter row. The doctor's diagnosis plane
-    uses these to mark the offending rounds a finding's evidence
-    points at (`doctor.perfetto_instants`); malformed entries are
-    skipped, never a sunk export."""
+    [{"t": epoch_seconds, "name": str, "lane": str?}, ...] — one
+    labeled marker per point, in their own process lane (pid 3,
+    "annotations") so they never rename a span or counter row. Each
+    distinct `lane` value gets its own named thread row inside that
+    process — the doctor's offending-round markers
+    (`doctor.perfetto_instants`, the default lane) and the
+    autopilot's action markers (`autopilot.perfetto_instants`, lane
+    "autopilot actions") render as separate labeled strips instead of
+    interleaving. Malformed entries are skipped, never a sunk
+    export."""
     events: list = []
+    lanes: dict = {}
     for inst in instants or []:
         try:
             ts = float(inst["t"]) * 1e6
@@ -324,12 +330,16 @@ def instant_events(instants: list, pid: int = 3) -> list:
             events.append({"ph": "M", "name": "process_name",
                            "pid": pid, "tid": 0,
                            "args": {"name": "annotations"}})
+        lane = str(inst.get("lane") or default_lane)
+        tid = lanes.get(lane)
+        if tid is None:
+            tid = lanes[lane] = len(lanes) + 1
             events.append({"ph": "M", "name": "thread_name",
-                           "pid": pid, "tid": 1,
-                           "args": {"name": "doctor findings"}})
+                           "pid": pid, "tid": tid,
+                           "args": {"name": lane}})
         events.append({"ph": "i", "s": "g", "name": name,
                        "cat": "annotation", "ts": ts,
-                       "pid": pid, "tid": 1})
+                       "pid": pid, "tid": tid})
     return events
 
 

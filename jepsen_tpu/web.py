@@ -267,6 +267,18 @@ def status_snapshot(store_root: str) -> dict:
         snap.setdefault("slo",       # depend on the SLO plane
                         {"checked": 0, "alerts_total": 0,
                          "burning": [], "last": None})
+    # autopilot plane (autopilot.py): the serving process's
+    # supervisor wins; a mirror from another process keeps its own
+    # block, and the idle stub keeps the documented schema answerable
+    try:
+        from . import autopilot as autopilot_mod
+        apt = autopilot_mod.snapshot()
+        if apt.get("active") or apt.get("steps") \
+                or "autopilot" not in snap:
+            snap["autopilot"] = apt
+    except Exception:  # noqa: BLE001 — the status answer must not
+        snap.setdefault("autopilot",  # depend on the autopilot plane
+                        {"active": False})
     # history, not just the live run: the last N ledger entries ride
     # every status answer so the fleet dashboard shows what the fleet
     # has DONE, not only what it is doing
@@ -444,6 +456,7 @@ def render_status(store_root: str) -> bytes:
                  "<a href='/devices'>devices</a> &middot; "
                  "<a href='/doctor'>doctor</a> &middot; "
                  "<a href='/slo'>slo</a> &middot; "
+                 "<a href='/autopilot'>autopilot</a> &middot; "
                  "<a href='/runs'>run ledger</a></p>")
     return _page("status", "".join(parts))
 
@@ -757,6 +770,7 @@ def render_doctor(store_root: str) -> bytes:
             f"({_esc(ph.get('dominant_share'))} of traced wall)</p>")
     parts.append("<p><a href='/status.json'>status.json</a> (the "
                  "`doctor` block) &middot; "
+                 "<a href='/autopilot'>autopilot</a> &middot; "
                  "<a href='/runs'>run ledger</a></p>")
     return _page("doctor", "".join(parts))
 
@@ -879,6 +893,138 @@ def render_slo(store_root: str) -> bytes:
     return _page("slo", "".join(parts))
 
 
+# autopilot action-history verdict colors ride the shared palette
+_AP_VERDICT_COLORS = {"verified": VALID_COLORS[True],
+                      "reverted": VALID_COLORS[False]}
+
+
+def render_autopilot(store_root: str) -> bytes:
+    """The auto-refreshing /autopilot panel (doc/OBSERVABILITY.md
+    "Autopilot plane"): the frozen policy table, live quarantines,
+    in-flight actions awaiting their verify deadline, and the action
+    history with verdicts. Falls back to the store's banked
+    `kind="autopilot-action"` records when no supervisor is live in
+    this process — the panel answers for finished runs too."""
+    s = status_snapshot(store_root)
+    apt = s.get("autopilot") or {}
+    parts = ["<meta http-equiv='refresh' content='2'>",
+             "<a href='/'>jepsen_tpu</a> / "
+             "<a href='/status'>status</a> / autopilot",
+             "<h1>autopilot"
+             f" &middot; {'live' if apt.get('active') else 'idle'}"
+             "</h1>"]
+    counts = apt.get("counts") or {}
+    if counts:
+        parts.append(
+            "<p>" + " &middot; ".join(
+                f"{_esc(k)}: {_esc(counts.get(k, 0))}"
+                for k in ("decision", "apply", "verify", "revert",
+                          "suppress")) + "</p>")
+    quarantined = apt.get("quarantined") or {}
+    if quarantined:
+        qrows = "".join(
+            f"<tr><td>{_esc(rule)}</td><td>{_esc(q.get('action'))}"
+            f"</td><td>{_esc(q.get('reason'))}</td>"
+            f"<td>{_esc(_fmt_epoch(q.get('t')))}</td></tr>"
+            for rule, q in sorted(quarantined.items()))
+        parts.append(
+            f"<p style='background:{VALID_COLORS[False]};padding:6px'>"
+            f"QUARANTINED: <b>{_esc(sorted(quarantined))}</b> — "
+            "reverted this run; further firings are suppressed, "
+            "never silently retried</p>"
+            "<table><thead><tr><th>rule</th><th>action</th>"
+            "<th>reason</th><th>since</th></tr></thead><tbody>"
+            + qrows + "</tbody></table>")
+    pending = apt.get("pending") or []
+    if pending:
+        parts.append(
+            "<p>in flight: " + ", ".join(
+                f"{_esc(p.get('rule'))} {_esc(p.get('action'))} "
+                f"(verify in {_esc(p.get('deadline_in_s'))}s)"
+                for p in pending) + "</p>")
+    # policy table — the frozen rule->action contract
+    policy = apt.get("policy")
+    if not policy:
+        from . import autopilot as autopilot_mod
+        policy = autopilot_mod.policy_rows()
+    prow = "".join(
+        f"<tr><td>{_esc(p.get('rule'))}</td>"
+        f"<td>{_esc(p.get('action'))}</td>"
+        f"<td>{_esc(p.get('metric'))} ({_esc(p.get('direction'))}, "
+        f"x{_esc(p.get('improve_x'))}"
+        + (f", abs {_esc(p.get('abs_ok'))}"
+           if p.get("abs_ok") is not None else "")
+        + f")</td><td>{_esc(p.get('description'))}</td></tr>"
+        for p in policy)
+    parts.append(
+        "<h2>policy table</h2>"
+        "<table><thead><tr><th>trigger</th><th>action</th>"
+        "<th>verify</th><th>what</th></tr></thead><tbody>"
+        + prow + "</tbody></table>")
+    # action history: the live supervisor's window, else the store's
+    # banked records (finished runs answer too)
+    actions = apt.get("actions") or []
+    source = "live"
+    if not actions:
+        source = "ledger"
+        try:
+            led = ledger_mod.Ledger(store_root)
+            for rec in led.query(kind="autopilot-action",
+                                 newest_first=True, limit=32):
+                actions.append(
+                    {"t": rec.get("t"), "event": rec.get("event"),
+                     "rule": rec.get("rule"),
+                     "action": rec.get("action"),
+                     "subject": (rec.get("finding") or {}).get(
+                         "subject"),
+                     "before": (rec.get("baseline") or {}).get(
+                         "value"),
+                     "after": rec.get("metric_after"),
+                     "verdict": rec.get("verdict"),
+                     "reason": rec.get("reason")})
+        except Exception:  # noqa: BLE001 — a torn ledger never
+            pass           # breaks the live panel
+    if actions:
+        arows = []
+        shown = (list(reversed(list(actions)[-32:]))
+                 if source == "live" else list(actions))
+        for a in shown:  # newest first either way
+            color = _AP_VERDICT_COLORS.get(a.get("verdict"),
+                                           VALID_COLORS[None])
+            arows.append(
+                f"<tr><td>{_esc(_fmt_epoch(a.get('t')))}</td>"
+                f"<td>{_esc(a.get('event'))}</td>"
+                f"<td>{_esc(a.get('rule'))}</td>"
+                f"<td>{_esc(a.get('action'))}</td>"
+                f"<td>{_esc(a.get('subject') or '')}</td>"
+                f"<td>{_esc(a.get('before'))} &rarr; "
+                f"{_esc(a.get('after'))}</td>"
+                f"<td style='background:{color}'>"
+                f"{_esc(a.get('verdict') or '')}"
+                + (f" ({_esc(a.get('reason'))})"
+                   if a.get("reason") else "") + "</td></tr>")
+        parts.append(
+            f"<h2>action history ({source})</h2>"
+            "<table><thead><tr><th>t</th><th>event</th><th>rule</th>"
+            "<th>action</th><th>subject</th><th>metric</th>"
+            "<th>verdict</th></tr></thead><tbody>"
+            + "".join(arows) + "</tbody></table>")
+    else:
+        parts.append(
+            "<p>no actions yet — the supervisor banks every "
+            "decision/apply/verify/revert/suppress as "
+            "<code>kind=\"autopilot-action\"</code> records (start "
+            "the service with <code>--autopilot</code>, or replay a "
+            "banked run: <code>python -m jepsen_tpu autopilot "
+            "latest</code>)</p>")
+    parts.append("<p><a href='/status.json'>status.json</a> (the "
+                 "`autopilot` block) &middot; "
+                 "<a href='/doctor'>doctor</a> &middot; "
+                 "<a href='/slo'>slo</a> &middot; "
+                 "<a href='/runs'>run ledger</a></p>")
+    return _page("autopilot", "".join(parts))
+
+
 def _fmt_epoch(t) -> str:
     import time as _time
     try:
@@ -991,6 +1137,7 @@ def render_home(cache: _ValidityCache) -> bytes:
             "<a href='/devices'>devices</a> &middot; "
             "<a href='/doctor'>doctor</a> &middot; "
             "<a href='/slo'>slo</a> &middot; "
+            "<a href='/autopilot'>autopilot</a> &middot; "
             "<a href='/runs'>run ledger</a></p>"
             "<table><thead><tr><th>Name</th>"
             "<th>Time</th><th>Valid?</th><th>Results</th><th>History</th>"
@@ -1341,6 +1488,10 @@ class Handler(BaseHTTPRequestHandler):
             if uri == "/slo":
                 self._send(200, "text/html; charset=utf-8",
                            render_slo(self.cache.store_root))
+                return
+            if uri == "/autopilot":
+                self._send(200, "text/html; charset=utf-8",
+                           render_autopilot(self.cache.store_root))
                 return
             if uri == "/events":
                 self._serve_events()

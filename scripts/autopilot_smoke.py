@@ -1,0 +1,239 @@
+#!/usr/bin/env python
+"""Fast CI gate for the autopilot plane (jepsen_tpu/autopilot.py).
+
+Proves the closed loop end to end, plus the failure contract:
+
+  * **seeded storm -> warm -> verified** — a PR-9-style compile-storm
+    corpus banked in a real store ledger fires D001, the supervisor's
+    warm-bucket actuator precompiles a REAL canonical bucket through
+    `aot.precompile_service_bucket`, and the next pass verifies: the
+    `recent_compiles` probe since the action reads zero, so the
+    action settles `verdict="verified"` (and a CompileGuard proves
+    the bucket actually went warm — re-warming compiles nothing);
+  * **un-fixable finding -> revert + quarantine** — a seeded finding
+    whose metric never improves is rolled back (the rollback runs),
+    the rule is quarantined for the run, and a re-fire is recorded
+    as `suppressed` — never silently retried (the actuator runs
+    exactly once);
+  * **offline replay parity** — `autopilot.replay` over the same
+    banked diagnosis names exactly the rules the live supervisor
+    decided on;
+  * **every artifact lint-clean** — the `autopilot` series points and
+    the `kind="autopilot-action"` ledger records both pass
+    scripts/telemetry_lint.py.
+
+~20 s on a CI cpu (one real ladder precompile). Exit 0 clean, 1 on
+any violation.
+"""
+
+import os
+import sys
+import tempfile
+import time
+from types import SimpleNamespace
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _enc(n=100, ic=4, S=16, O=32):
+    import numpy as np
+    z = np.full(n, 100, dtype=np.int32)
+    return SimpleNamespace(
+        window_raw=10, inv=z, ret=z,
+        sufminret=np.full(n + 1, 100, dtype=np.int32),
+        inv_info=np.full(ic, 100, dtype=np.int32),
+        table=np.zeros((S, O), dtype=np.int32))
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    from jepsen_tpu import autopilot, doctor, ledger, metrics
+    from jepsen_tpu import service as service_mod
+    from jepsen_tpu.analysis import guards
+    from jepsen_tpu.ops import aot
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import telemetry_lint
+
+    failures = []
+
+    def check(cond, msg):
+        print(("ok   " if cond else "FAIL ") + msg)
+        if not cond:
+            failures.append(msg)
+
+    _, bucket = service_mod.bucket_for(_enc())
+
+    class StoreHost(autopilot.Host):
+        """Diagnose a store's banked records; warm through the real
+        AOT path; probe compiles from the same ledger."""
+        name = "smoke-store"
+
+        def __init__(self, led):
+            self.led = led
+            self.warms = 0
+
+        def diagnose(self):
+            return doctor.diagnose(doctor.TelemetryView(
+                target="pr9-replay", platform="cpu",
+                records=self.led.query()))
+
+        def probe(self, metric, since=None):
+            if metric != "recent_compiles":
+                return None
+            total = 0
+            for rec in self.led.query(since=since):
+                c = rec.get("compiles")
+                if isinstance(c, int) and not isinstance(c, bool):
+                    total += c
+            return float(total)
+
+        def actuate(self, entry, finding):
+            walls = aot.precompile_service_bucket(bucket)
+            self.warms += 1
+            return {"bucket": finding.get("subject"),
+                    "ladder": sorted(walls)}, None
+
+    with tempfile.TemporaryDirectory() as td:
+        led = ledger.Ledger(td)
+        reg = metrics.Registry()
+
+        # -- seed the PR-9 compile-storm corpus in the store --------
+        for i in range(50):
+            led.record({"kind": "independent", "name": f"key-{i}",
+                        "compiles": 1,
+                        "shapes": {"K": 16, "W_pad": 7}})
+        led.record({"kind": "preflight", "name": "indep",
+                    "verdict": "feasible", "rules": [],
+                    "preflight": {"verdict": "feasible",
+                                  "buckets": [16]}})
+        time.sleep(0.05)  # the storm stays strictly before t_applied
+
+        host = StoreHost(led)
+        sup = autopilot.Supervisor(host, every_s=60.0,
+                                   verify_after_s=0.05,
+                                   where="smoke", mx=reg, ledger=led)
+        report = host.diagnose()
+        top = (report.get("findings") or [{}])[0]
+        check(top.get("rule") == "D001",
+              f"seeded storm fires D001 as top "
+              f"(got {report.get('rules_fired')})")
+
+        out1 = sup.step()
+        check(out1["applied"] == ["D001"],
+              f"autopilot applies warm-bucket for D001 "
+              f"(applied {out1['applied']})")
+        check(host.warms == 1,
+              f"the warm actuator ran the real AOT path "
+              f"({host.warms} warm(s))")
+
+        # the bucket actually went warm: re-warming compiles nothing
+        with guards.CompileGuard(max_compiles=0,
+                                 name="autopilot-smoke") as g:
+            aot.precompile_service_bucket(bucket)
+        check(g.compiles == 0,
+              f"warmed bucket re-warms at zero compiles "
+              f"(got {g.compiles})")
+
+        time.sleep(0.1)  # past the verify deadline
+        out2 = sup.step()
+        check("D001" in out2["verified"],
+              f"next pass verifies: compiles since the action drop "
+              f"to zero (verified {out2['verified']})")
+        snap = sup.snapshot()
+        check(snap["counts"].get("verify") == 1
+              and not snap["quarantined"],
+              f"verified action never quarantines "
+              f"(counts {snap['counts']})")
+
+        # -- offline replay parity ----------------------------------
+        decided = autopilot.replay(report)
+        check([d["rule"] for d in decided] == out1["decisions"],
+              f"offline replay names the live decisions "
+              f"({[d['rule'] for d in decided]} vs "
+              f"{out1['decisions']})")
+
+        # -- un-fixable finding -> revert + quarantine --------------
+        class BadHost(autopilot.Host):
+            name = "smoke-bad"
+
+            def __init__(self):
+                self.applied = 0
+                self.rolled = 0
+
+            def diagnose(self):
+                return {"findings": [{
+                    "rule": "D003", "name": "ladder-thrash",
+                    "severity": "warn",
+                    "summary": "seeded un-fixable thrash",
+                    "subject": "ladder", "score": 5.0,
+                    "evidence": [{"series": "wgl_adapt",
+                                  "field": "to_K",
+                                  "indices": [0, 1],
+                                  "values": [2, 512]}]}]}
+
+            def probe(self, metric, since=None):
+                return 10.0  # never improves
+
+            def actuate(self, entry, finding):
+                self.applied += 1
+
+                def rollback():
+                    self.rolled += 1
+
+                return {"k": 512}, rollback
+
+        bad = BadHost()
+        bsup = autopilot.Supervisor(bad, every_s=60.0,
+                                    verify_after_s=0.0,
+                                    where="smoke", mx=reg,
+                                    ledger=led)
+        bsup.step(now=1000.0)
+        b2 = bsup.step(now=1001.0)
+        check(b2["reverted"] == ["D003"] and bad.rolled == 1,
+              f"un-fixable action reverts and the rollback runs "
+              f"(reverted {b2['reverted']}, rolled {bad.rolled})")
+        check("D003" in bsup.quarantined(),
+              f"reverted rule is quarantined for the run "
+              f"({bsup.quarantined()})")
+        b3 = bsup.step(now=1002.0)
+        check(b3["suppressed"] == ["D003"] and bad.applied == 1,
+              f"re-fire is suppressed, never silently retried "
+              f"(suppressed {b3['suppressed']}, "
+              f"applied {bad.applied}x)")
+
+        # -- every artifact lint-clean ------------------------------
+        mpath = os.path.join(td, "autopilot_metrics.jsonl")
+        reg.export_jsonl(mpath)
+        errs = telemetry_lint.lint_jsonl_file(mpath)
+        check(not errs, f"autopilot series lint-clean ({errs[:3]})")
+        rec_errs = []
+        for fn in sorted(os.listdir(led.records_dir)):
+            rec_errs += telemetry_lint.lint_ledger_file(
+                os.path.join(led.records_dir, fn))
+        rec_errs += telemetry_lint.lint_ledger_file(led.index_path)
+        check(not rec_errs,
+              f"kind=autopilot-action ledger records lint-clean "
+              f"({rec_errs[:3]})")
+        n_ap = len(led.query(kind="autopilot-action"))
+        check(n_ap >= 8,
+              f"every lifecycle event banked a ledger record "
+              f"({n_ap} autopilot-action record(s))")
+
+        # action markers land in their own Perfetto lane
+        inst = sup.perfetto_instants()
+        check(inst and all(i["lane"] == "autopilot actions"
+                           for i in inst),
+              f"Perfetto instants ride the 'autopilot actions' lane "
+              f"({len(inst)} marker(s))")
+
+    print(f"autopilot smoke: {len(failures)} failure(s)")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

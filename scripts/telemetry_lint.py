@@ -133,6 +133,12 @@ SERIES_SCHEMAS = {
     # the budget), met the window verdict
     "slo": {"objective": str, "window_s": NUM, "good_frac": NUM,
             "target_frac": NUM, "met": bool, "burn_rate": NUM},
+    # the autopilot control loop (jepsen_tpu/autopilot.py): one point
+    # per lifecycle event — event in {decision, apply, verify,
+    # revert, suppress}, rule a catalog id (or "burn" for the SLO
+    # pre-shed gate), action the policy-table actuator name
+    "autopilot": {"event": str, "rule": str, "action": str,
+                  "where": str, "metric": str},
 }
 
 # doctor.py's rule catalog + severity levels — duplicated here as the
@@ -141,6 +147,14 @@ SERIES_SCHEMAS = {
 # follow it)
 DOCTOR_RULE_IDS = {f"D{i:03d}" for i in range(1, 13)}
 DOCTOR_SEVERITIES = {"critical", "warn", "info"}
+
+# autopilot.py's lifecycle enum + trigger ids — the policy table fires
+# on doctor catalog rules plus the synthetic "burn" SLO gate; the
+# verdict on a settled action is verified or reverted, nothing else
+AUTOPILOT_EVENTS = {"decision", "apply", "verify", "revert",
+                    "suppress"}
+AUTOPILOT_RULE_IDS = DOCTOR_RULE_IDS | {"burn"}
+AUTOPILOT_VERDICTS = {"verified", "reverted"}
 
 # the bench diagnosis report (bench._export_doctor ->
 # artifacts/telemetry/doctor.json)
@@ -206,6 +220,16 @@ def lint_line(obj: dict, where: str) -> list:
             errors.append(f"{where} [service_batch]: mode must be "
                           f"mesh|serial|degrade, got "
                           f"{obj.get('mode')!r}")
+        if obj.get("series") == "autopilot" and not errors:
+            if obj.get("event") not in AUTOPILOT_EVENTS:
+                errors.append(
+                    f"{where} [autopilot]: event must be one of "
+                    f"{sorted(AUTOPILOT_EVENTS)}, got "
+                    f"{obj.get('event')!r}")
+            if obj.get("rule") not in AUTOPILOT_RULE_IDS:
+                errors.append(
+                    f"{where} [autopilot]: rule must be a catalog "
+                    f"id or 'burn', got {obj.get('rule')!r}")
     elif typ == "histogram" and not errors:
         buckets, counts = obj["buckets"], obj["bucket_counts"]
         if len(buckets) != len(counts):
@@ -444,6 +468,45 @@ def lint_ledger_file(path: str) -> list:
             if not isinstance(obj.get("burn_alerts"), list):
                 errs.append(f"{where}: slo record needs the "
                             "'burn_alerts' list")
+        if obj.get("kind") == "autopilot-action":
+            # autopilot action records (jepsen_tpu/autopilot.py):
+            # every lifecycle event banks rule/action/event
+            # attribution; applied/settled events carry the baseline
+            # metric window, settled ones the verdict enum
+            if obj.get("event") not in AUTOPILOT_EVENTS:
+                errs.append(
+                    f"{where}: autopilot-action 'event' should be "
+                    f"one of {sorted(AUTOPILOT_EVENTS)}, got "
+                    f"{obj.get('event')!r}")
+            if obj.get("rule") not in AUTOPILOT_RULE_IDS:
+                errs.append(
+                    f"{where}: autopilot-action 'rule' should be a "
+                    f"catalog id or 'burn', got {obj.get('rule')!r}")
+            if not isinstance(obj.get("action"), str):
+                errs.append(f"{where}: autopilot-action needs a str "
+                            "'action'")
+            if not isinstance(obj.get("params"), dict):
+                errs.append(f"{where}: autopilot-action needs the "
+                            "'params' object")
+            if obj.get("event") in ("apply", "verify", "revert"):
+                bl = obj.get("baseline")
+                if not isinstance(bl, dict) \
+                        or not isinstance(bl.get("metric"), str):
+                    errs.append(
+                        f"{where}: autopilot-action "
+                        f"{obj.get('event')} needs the 'baseline' "
+                        "object with its 'metric' name")
+            v = obj.get("verdict", None)
+            if v is not None and v not in AUTOPILOT_VERDICTS:
+                errs.append(
+                    f"{where}: autopilot-action 'verdict' should be "
+                    f"one of {sorted(AUTOPILOT_VERDICTS)}, got "
+                    f"{v!r}")
+            if obj.get("event") in ("verify", "revert") \
+                    and v not in AUTOPILOT_VERDICTS:
+                errs.append(
+                    f"{where}: a settled autopilot-action "
+                    f"({obj.get('event')}) must carry its verdict")
         if obj.get("kind") == "multichip":
             # mesh dryrun records (devices.multichip_record): device
             # count + per-device attribution are the record's point

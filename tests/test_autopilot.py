@@ -1,0 +1,521 @@
+"""Autopilot tests (jepsen_tpu/autopilot.py): the verify-or-revert
+contract against fabricated hosts (verified / reverted+quarantined /
+suppressed / apply-failure faults / per-rule quarantine isolation),
+the policy predicate + burn gate, offline replay parity against a
+ledger-banked PR-9-style compile-storm corpus, the /status.json
+`autopilot` block + /autopilot panel, schema lint (good AND drifted),
+the service actuator substrate (resize_workers / open_shed / ladder
+pin), and the Perfetto lane routing. Pure host-side — no device
+work; the real-AOT closed loop runs in scripts/autopilot_smoke.py."""
+
+import json
+import os
+import sys
+import time
+
+import pytest
+
+from jepsen_tpu import autopilot as ap
+from jepsen_tpu import doctor, fleet, ledger, metrics, trace, web
+from jepsen_tpu.ops import adapt
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "scripts"))
+import telemetry_lint  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _isolate():
+    ap._reset()
+    adapt.unpin_ladder()
+    yield
+    ap._reset()
+    adapt.unpin_ladder()
+
+
+def _finding(rule="D001", subject="W=7,K=16", **kw):
+    f = {"rule": rule, "name": "compile-storm", "severity": "warn",
+         "summary": f"seeded {rule}", "subject": subject,
+         "score": 9.0, "evidence": []}
+    f.update(kw)
+    return f
+
+
+class OneRuleHost(ap.Host):
+    """Fires one rule; the metric improves once `actuate` ran."""
+
+    def __init__(self, rule="D001", before=50.0, after=0.0):
+        self.rule, self.before, self.after = rule, before, after
+        self.applied = 0
+        self.rolled = 0
+
+    def diagnose(self):
+        return {"findings": [_finding(self.rule)]}
+
+    def probe(self, metric, since=None):
+        return self.after if self.applied else self.before
+
+    def actuate(self, entry, finding):
+        self.applied += 1
+
+        def rollback():
+            self.rolled += 1
+
+        return {"subject": finding.get("subject")}, rollback
+
+
+class StuckHost(OneRuleHost):
+    """The actuator runs but the metric never improves."""
+
+    def probe(self, metric, since=None):
+        return self.before
+
+
+class TestPolicyPredicate:
+    def test_down_abs_ok(self):
+        e = ap.PolicyRule("D001", "a", "m", improve_x=0.5, abs_ok=0.0)
+        assert e.improved(50.0, 0.0)
+        assert e.improved(50.0, 20.0)      # ratio path
+        assert not e.improved(50.0, 40.0)
+
+    def test_up_direction(self):
+        e = ap.PolicyRule("D002", "a", "m", direction="up",
+                          improve_x=1.2, abs_ok=0.8)
+        assert e.improved(0.3, 0.9)        # abs path
+        assert e.improved(0.3, 0.4)        # ratio path
+        assert not e.improved(0.3, 0.31)
+
+    def test_unprobeable_after_never_verifies(self):
+        e = ap.PolicyRule("D001", "a", "m", abs_ok=0.0)
+        assert not e.improved(50.0, None)
+
+    def test_missing_baseline_needs_abs(self):
+        e = ap.PolicyRule("D001", "a", "m", improve_x=0.5,
+                          abs_ok=None)
+        assert not e.improved(None, 1.0)
+
+    def test_table_is_frozen_rows(self):
+        rules = [e.rule for e in ap.POLICY]
+        assert rules == ["D001", "D002", "D003", "D005", "D012",
+                         "burn"]
+        with pytest.raises(AttributeError):
+            ap.POLICY[0].improve_x = 99.0
+
+
+class TestBurnGate:
+    def test_alerting_objective_fires(self):
+        rep = {"objectives": [
+            {"name": "warm-p50", "burn_alert": True,
+             "budget": {"remaining_frac": 0.9},
+             "windows": [{"burn_rate": 4.0}]}]}
+        f = ap.burn_finding(rep)
+        assert f and f["rule"] == "burn"
+        assert "warm-p50" in f["objectives"]
+
+    def test_draining_budget_fires_before_alert(self):
+        rep = {"objectives": [
+            {"name": "availability", "burn_alert": False,
+             "budget": {"remaining_frac": 0.4},
+             "windows": [{"burn_rate": 1.5}]}]}
+        assert ap.burn_finding(rep) is not None
+
+    def test_healthy_budget_is_silent(self):
+        rep = {"objectives": [
+            {"name": "availability", "burn_alert": False,
+             "budget": {"remaining_frac": 0.95},
+             "windows": [{"burn_rate": 0.2}]}]}
+        assert ap.burn_finding(rep) is None
+        assert ap.burn_finding(None) is None
+
+
+class TestVerifyOrRevert:
+    def test_improving_action_verifies(self):
+        host = OneRuleHost()
+        sup = ap.Supervisor(host, verify_after_s=0.0)
+        out1 = sup.step(now=100.0)
+        assert out1["applied"] == ["D001"]
+        out2 = sup.step(now=101.0)
+        assert "D001" in out2["verified"]
+        assert sup.quarantined() == {}
+        events = [h["event"] for h in sup.history()]
+        assert events[:3] == ["decision", "apply", "verify"]
+
+    def test_failing_verify_reverts_and_quarantines(self):
+        host = StuckHost()
+        sup = ap.Supervisor(host, verify_after_s=0.0)
+        sup.step(now=100.0)
+        out2 = sup.step(now=101.0)
+        assert out2["reverted"] == ["D001"]
+        assert host.rolled == 1
+        q = sup.quarantined()
+        assert q["D001"]["reason"] == "verify-failed"
+        # re-fire is suppressed, never retried
+        out3 = sup.step(now=102.0)
+        assert out3["suppressed"] == ["D001"]
+        assert host.applied == 1
+
+    def test_unprobeable_after_reverts(self):
+        class Blind(OneRuleHost):
+            def probe(self, metric, since=None):
+                return 50.0 if not self.applied else None
+
+        sup = ap.Supervisor(Blind(), verify_after_s=0.0)
+        sup.step(now=100.0)
+        out2 = sup.step(now=101.0)
+        assert out2["reverted"] == ["D001"]
+
+    def test_one_in_flight_action_per_rule(self):
+        host = OneRuleHost()
+        sup = ap.Supervisor(host, verify_after_s=60.0)
+        sup.step(now=100.0)
+        sup.step(now=101.0)  # deadline not reached: no second apply
+        assert host.applied == 1
+
+    def test_apply_failure_faults_and_quarantines(self):
+        class Broken(OneRuleHost):
+            def actuate(self, entry, finding):
+                raise RuntimeError("precompile failed")
+
+        mx = metrics.Registry()
+        sup = ap.Supervisor(Broken(), verify_after_s=0.0, mx=mx)
+        out = sup.step(now=100.0)
+        assert out["reverted"] == ["D001"]
+        assert "D001" in sup.quarantined()
+        assert sup.quarantined()["D001"]["reason"].startswith(
+            "apply-failed")
+        # satellite contract: the failure is a structured fleet
+        # fault with stage + rule/action attribution
+        pts = mx.series("fleet_faults").points
+        assert pts and pts[-1]["stage"] == "autopilot"
+        assert pts[-1]["rule"] == "D001"
+        assert pts[-1]["action"] == "warm-bucket"
+
+    def test_quarantine_isolates_per_rule(self):
+        class TwoRules(ap.Host):
+            def __init__(self):
+                self.applied = []
+
+            def diagnose(self):
+                return {"findings": [_finding("D001"),
+                                     _finding("D003",
+                                              subject="ladder")]}
+
+            def probe(self, metric, since=None):
+                # D001's metric never improves; D003's always does
+                return 50.0 if metric == "recent_compiles" else 0.0
+
+            def actuate(self, entry, finding):
+                self.applied.append(entry.rule)
+                return {}, None
+
+        host = TwoRules()
+        sup = ap.Supervisor(host, verify_after_s=0.0)
+        sup.step(now=100.0)
+        out2 = sup.step(now=101.0)
+        assert out2["reverted"] == ["D001"]
+        assert "D003" in out2["verified"]
+        out3 = sup.step(now=102.0)
+        # D001 quarantined and suppressed; D003 keeps acting
+        assert out3["suppressed"] == ["D001"]
+        assert "D003" in out3["applied"]
+        assert list(sup.quarantined()) == ["D001"]
+
+
+class TestBanking:
+    def test_series_and_records_lint_clean(self, tmp_path):
+        mx = metrics.Registry()
+        led = ledger.Ledger(str(tmp_path))
+        sup = ap.Supervisor(StuckHost(), verify_after_s=0.0,
+                            where="test", mx=mx, ledger=led)
+        sup.step(now=100.0)
+        sup.step(now=101.0)
+        sup.step(now=102.0)
+        mpath = str(tmp_path / "m.jsonl")
+        mx.export_jsonl(mpath)
+        assert telemetry_lint.lint_jsonl_file(mpath) == []
+        assert telemetry_lint.lint_ledger_file(led.index_path) == []
+        recs = led.query(kind="autopilot-action")
+        events = sorted(r["event"] for r in recs)
+        # step 2 reverts AND suppresses the still-live finding;
+        # step 3 suppresses again
+        assert events == ["apply", "decision", "revert", "suppress",
+                          "suppress"]
+        rev = next(r for r in recs if r["event"] == "revert")
+        assert rev["verdict"] == "reverted"
+        assert rev["quarantined"] is True
+        assert rev["baseline"]["metric"] == "recent_compiles"
+        assert rev["rollback"] == "applied"
+        assert rev["finding"]["rule"] == "D001"
+
+    def test_counters_by_event(self):
+        mx = metrics.Registry()
+        sup = ap.Supervisor(OneRuleHost(), verify_after_s=0.0, mx=mx)
+        sup.step(now=100.0)
+        sup.step(now=101.0)
+        snap = sup.snapshot()
+        assert snap["counts"]["decision"] == 2
+        assert snap["counts"]["verify"] == 1
+
+    def test_banking_never_raises_without_sinks(self):
+        # disabled ambient defaults: recording must be a no-op
+        sup = ap.Supervisor(OneRuleHost(), verify_after_s=0.0)
+        sup.step(now=100.0)
+        out = sup.step(now=101.0)
+        assert "D001" in out["verified"]
+
+
+class TestLintDrift:
+    def _line(self, **kw):
+        obj = {"type": "sample", "series": "autopilot",
+               "t": 100.0, "event": "apply", "rule": "D001",
+               "action": "warm-bucket", "where": "test",
+               "metric": "recent_compiles"}
+        obj.update(kw)
+        return obj
+
+    def test_good_series_line_passes(self):
+        assert telemetry_lint.lint_line(self._line(), "x") == []
+        assert telemetry_lint.lint_line(
+            self._line(rule="burn", event="suppress"), "x") == []
+
+    def test_drifted_event_fails(self):
+        errs = telemetry_lint.lint_line(
+            self._line(event="applied"), "x")
+        assert errs and "event" in errs[0]
+
+    def test_drifted_rule_fails(self):
+        errs = telemetry_lint.lint_line(self._line(rule="D099"), "x")
+        assert errs
+
+    def test_record_drift_fails(self, tmp_path):
+        bad = {"schema": 1, "id": "r1", "kind": "autopilot-action",
+               "name": "autopilot-D001", "t": 100.0,
+               "event": "verify", "rule": "D001",
+               "action": "warm-bucket", "params": {}}
+        p = tmp_path / "r1.json"
+        p.write_text(json.dumps(bad))
+        errs = telemetry_lint.lint_ledger_file(str(p))
+        # a settled event without baseline or verdict is drift
+        assert any("baseline" in e for e in errs)
+        assert any("verdict" in e for e in errs)
+
+
+class TestReplay:
+    def _banked_storm(self, tmp_path):
+        led = ledger.Ledger(str(tmp_path))
+        for i in range(50):
+            led.record({"kind": "independent", "name": f"key-{i}",
+                        "compiles": 1,
+                        "shapes": {"K": 16, "W_pad": 7}})
+        led.record({"kind": "preflight", "name": "indep",
+                    "verdict": "feasible", "rules": [],
+                    "preflight": {"verdict": "feasible",
+                                  "buckets": [16]}})
+        return led
+
+    def test_parity_with_live_decisions(self, tmp_path):
+        led = self._banked_storm(tmp_path)
+        report = doctor.diagnose(doctor.TelemetryView(
+            target="pr9-replay", platform="cpu",
+            records=led.query()))
+
+        class StoreHost(ap.Host):
+            def __init__(self):
+                self.warmed = False
+
+            def diagnose(self):
+                return report
+
+            def probe(self, metric, since=None):
+                return 0.0 if self.warmed else 50.0
+
+            def actuate(self, entry, finding):
+                self.warmed = True
+                return {}, None
+
+        sup = ap.Supervisor(StoreHost(), verify_after_s=0.0)
+        live = sup.step(now=100.0)
+        decided = ap.replay(report)
+        assert [d["rule"] for d in decided] == live["decisions"]
+        assert decided[0]["action"] == "warm-bucket"
+        assert decided[0]["subject"]  # the storm's worst subject
+
+    def test_replay_is_pure(self, tmp_path):
+        led = self._banked_storm(tmp_path)
+        report = doctor.diagnose(doctor.TelemetryView(
+            target="pr9-replay", records=led.query()))
+        n_before = len(led.query())
+        out = ap.replay(report)
+        assert out and len(led.query()) == n_before
+
+    def test_burn_rides_replay(self):
+        slo_rep = {"objectives": [
+            {"name": "warm-p50", "burn_alert": True,
+             "budget": {"remaining_frac": 0.1},
+             "windows": [{"burn_rate": 5.0}]}]}
+        out = ap.replay({"findings": []}, slo_rep)
+        assert [d["rule"] for d in out] == ["burn"]
+        assert out[0]["action"] == "pre-shed"
+
+    def test_cli_json(self, tmp_path, capsys):
+        led = self._banked_storm(tmp_path)
+        led.record({"kind": "checker", "name": "run-x",
+                    "platform": "cpu", "compiles": 0})
+        rc = ap.cli_main({"store": str(tmp_path), "json": True},
+                         ["latest"])
+        assert rc == 0
+        out = json.loads(capsys.readouterr().out)
+        assert "decisions" in out and "policy" in out
+        assert [p["rule"] for p in out["policy"]] == [
+            e.rule for e in ap.POLICY]
+
+    def test_cli_missing_target(self, tmp_path):
+        assert ap.cli_main({"store": str(tmp_path)},
+                           ["nope"]) == 254
+
+
+class TestStatusSurface:
+    def test_idle_stub(self, tmp_path):
+        snap = web.status_snapshot(str(tmp_path / "store"))
+        assert snap["autopilot"] == {
+            "active": False, "steps": 0, "counts": {},
+            "quarantined": {}, "pending": [], "actions": []}
+
+    def test_live_block(self, tmp_path):
+        sup = ap.Supervisor(StuckHost(), verify_after_s=0.0)
+        ap.set_default(sup)
+        sup.step(now=100.0)
+        sup.step(now=101.0)
+        snap = web.status_snapshot(str(tmp_path / "store"))
+        blk = snap["autopilot"]
+        assert blk["steps"] == 2
+        assert "D001" in blk["quarantined"]
+        assert blk["counts"]["revert"] == 1
+        assert [p["rule"] for p in blk["policy"]] == [
+            e.rule for e in ap.POLICY]
+
+    def test_panel_renders_quarantine_and_history(self, tmp_path):
+        sup = ap.Supervisor(StuckHost(), verify_after_s=0.0)
+        ap.set_default(sup)
+        sup.step(now=100.0)
+        sup.step(now=101.0)
+        html = web.render_autopilot(
+            str(tmp_path / "store")).decode()
+        assert "QUARANTINED" in html
+        assert "policy table" in html
+        assert "reverted" in html
+
+    def test_panel_falls_back_to_banked_records(self, tmp_path):
+        led = ledger.Ledger(str(tmp_path))
+        sup = ap.Supervisor(OneRuleHost(), verify_after_s=0.0,
+                            ledger=led)
+        sup.step(now=100.0)
+        sup.step(now=101.0)
+        # no live supervisor installed: the panel reads the store
+        html = web.render_autopilot(str(tmp_path)).decode()
+        assert "ledger" in html and "warm-bucket" in html
+
+    def test_perfetto_lane(self):
+        sup = ap.Supervisor(OneRuleHost(), verify_after_s=0.0)
+        ap.set_default(sup)
+        sup.step(now=100.0)
+        inst = ap.perfetto_instants()
+        assert inst and all(
+            i["lane"] == "autopilot actions" for i in inst)
+        events = trace.instant_events(inst)
+        lanes = {e["args"]["name"] for e in events
+                 if e.get("name") == "thread_name"}
+        assert "autopilot actions" in lanes
+
+
+class TestServiceActuatorSubstrate:
+    def test_resize_workers_grow_and_shrink(self, tmp_path):
+        from jepsen_tpu.service import Service
+        svc = Service(str(tmp_path / "store"), workers=2)
+        svc.start()
+        try:
+            assert svc.resize_workers(4) == {"from": 2, "to": 4}
+            time.sleep(0.1)
+            assert sum(t.is_alive() for t in svc._threads) == 4
+            assert svc.resize_workers(1) == {"from": 4, "to": 1}
+            deadline = time.monotonic() + 3.0
+            while time.monotonic() < deadline:
+                if sum(t.is_alive() for t in svc._threads) == 1:
+                    break
+                time.sleep(0.05)
+            assert sum(t.is_alive() for t in svc._threads) == 1
+        finally:
+            svc.close()
+
+    def test_resize_workers_rejects_out_of_range(self, tmp_path):
+        from jepsen_tpu.service import Service, POOL_MAX
+        svc = Service(str(tmp_path / "store"))
+        with pytest.raises(ValueError):
+            svc.resize_workers(0)
+        with pytest.raises(ValueError):
+            svc.resize_workers(POOL_MAX + 1)
+
+    def test_open_close_shed(self, tmp_path):
+        from jepsen_tpu.service import Service
+        svc = Service(str(tmp_path / "store"))
+        svc.open_shed(["warm-p50"], hold_s=30.0)
+        shed = svc.shedding()
+        assert shed and shed["burning"] == ["warm-p50"]
+        assert shed["source"] == "autopilot"
+        svc.close_shed()
+        assert svc.shedding() is None
+
+    def test_service_autopilot_flag_spawns_supervisor(self,
+                                                     tmp_path):
+        from jepsen_tpu.service import Service
+        svc = Service(str(tmp_path / "store"), autopilot=True,
+                      autopilot_every_s=600.0)
+        svc.start()
+        try:
+            assert svc._autopilot is not None
+            assert svc._autopilot.active
+            assert ap.get_default() is svc._autopilot
+        finally:
+            svc.close()
+        assert not (svc._autopilot and svc._autopilot.active)
+
+    def test_ladder_pin_forces_switch_and_unpin_releases(self):
+        pol = adapt.Policy(ladder=adapt.LADDER32, n_ok=64,
+                           backlog_cap=1024)
+        assert pol.k == adapt.LADDER32[0]
+        adapt.pin_ladder(512, reason="autopilot-D003")
+        d = pol.observe(explored=10, rounds_delta=1,
+                        explored_delta=10, frontier=1, backlog=0)
+        assert d.switch and d.to_k == 512
+        assert d.reason == "pinned"
+        # held while pinned
+        d2 = pol.observe(explored=20, rounds_delta=1,
+                         explored_delta=10, frontier=1, backlog=0)
+        assert not d2.switch and d2.reason == "pinned"
+        adapt.unpin_ladder()
+        assert adapt.ladder_pin() is None
+
+    def test_pin_is_start_bucket_for_new_policies(self):
+        adapt.pin_ladder(64)
+        pol = adapt.Policy(ladder=adapt.LADDER32, n_ok=64,
+                           backlog_cap=1024)
+        assert pol.k == 64
+
+    def test_backlog_pressure_outranks_pin(self):
+        adapt.pin_ladder(2)
+        pol = adapt.Policy(ladder=adapt.LADDER32, n_ok=64,
+                           backlog_cap=64, start_k=2)
+        d = pol.observe(explored=10, rounds_delta=1,
+                        explored_delta=10, frontier=1, backlog=60)
+        assert d.reason != "pinned"
+
+    def test_fault_event_context_rides_under_envelope(self):
+        ev = fleet.fault_event(RuntimeError("boom"),
+                               stage="autopilot",
+                               context={"rule": "D001",
+                                        "action": "warm-bucket",
+                                        "stage": "spoofed"})
+        assert ev["stage"] == "autopilot"  # envelope wins
+        assert ev["rule"] == "D001"
+        assert ev["action"] == "warm-bucket"
