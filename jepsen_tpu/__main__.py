@@ -175,6 +175,38 @@ def autopilot_cmd() -> dict:
                                    "[OPTIONS ...]"}}
 
 
+def fleet_cmd() -> dict:
+    """`python -m jepsen_tpu fleet <roots...>` — the fleet
+    observatory (jepsen_tpu/observatory): federate N replicas' store
+    ledgers into one snapshot (liveness heartbeats, request-weighted
+    fleet SLO beside the per-replica breakdown, D013-D015 findings),
+    reassemble a request's cross-process journey, or write a merged
+    Perfetto trace with one process track per replica. Strictly
+    read-only over every store."""
+    spec = [
+        Opt("help", short="-h", help="Print out this message and exit"),
+        Opt("discover", metavar="DIR",
+            help="Discover store roots in/around this directory "
+                 "(used when no roots are given; default: ./store)"),
+        Opt("journey", metavar="RUN_ID",
+            help="Reassemble one request's cross-process journey "
+                 "and print it as JSON (exit 1 when not found)"),
+        Opt("perfetto", metavar="PATH",
+            help="Write the merged fleet Perfetto trace here"),
+        Opt("json", default=False,
+            help="Emit the full fleet snapshot as JSON"),
+    ]
+
+    def run(parsed):
+        from . import observatory as observatory_mod
+        return observatory_mod.cli_main(parsed.options,
+                                        parsed.arguments)
+
+    return {"fleet": {"opt_spec": spec, "run": run,
+                      "usage": "Usage: python -m jepsen_tpu fleet "
+                               "[store_roots ...] [OPTIONS ...]"}}
+
+
 COMMANDS = {
     **cli.single_test_cmd({"test_fn": demo_test, "opt_spec": DEMO_OPTS}),
     **cli.test_all_cmd({"tests_fn": demo_tests, "opt_spec": DEMO_OPTS}),
@@ -182,6 +214,7 @@ COMMANDS = {
     **preflight_cmd(),
     **doctor_cmd(),
     **autopilot_cmd(),
+    **fleet_cmd(),
 }
 
 

@@ -89,6 +89,15 @@ EVENTS = ("decision", "apply", "verify", "revert", "suppress")
 # groups instants by their `lane` key).
 PERFETTO_LANE = "autopilot actions"
 
+# Quarantine persistence: every quarantine/clear banks a
+# `kind="autopilot-quarantine"` ledger record, and a fresh Supervisor
+# replays them so a rule that failed verification stays quarantined
+# across service restarts (a restart must not silently re-arm an
+# actuator the last run proved harmful). The escape hatch: set this
+# env truthy (or `serve --clear-quarantine`) to start clean — the
+# clear itself is banked, never silent.
+CLEAR_QUARANTINE_ENV = "JEPSEN_TPU_AUTOPILOT_CLEAR_QUARANTINE"
+
 # Pre-shed trigger: an objective whose error budget has burned down
 # to this remaining fraction (or is already burn-alerting) opens the
 # shed window before the budget empties.
@@ -559,8 +568,10 @@ class Supervisor:
         self._counts = {e: 0 for e in EVENTS}
         self._steps = 0
         self._seq = 0
+        self._qseq = 0
         self._stop_ev = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self._rehydrate_quarantine()
 
     # -- lifecycle ----------------------------------------------------
     def start(self) -> "Supervisor":
@@ -744,10 +755,105 @@ class Supervisor:
 
     def _quarantine_rule(self, entry: PolicyRule, now: float,
                          action_id: str, reason: str) -> None:
-        with self._lock:
-            self._quarantine[entry.rule] = {
-                "t": round(now, 3), "reason": str(reason),
+        info = {"t": round(now, 3), "reason": str(reason),
                 "action": entry.action, "action_id": action_id}
+        with self._lock:
+            self._quarantine[entry.rule] = info
+        self._bank_quarantine("quarantine", entry.rule, info)
+
+    def _ledger_for_bank(self):
+        return (self._ledger if self._ledger is not None
+                else ledger_mod.get_default())
+
+    def _bank_quarantine(self, event: str, rule: str,
+                         info: Optional[dict] = None) -> None:
+        """One `kind="autopilot-quarantine"` ledger record per
+        quarantine transition — the durable half of the quarantine
+        set. `event` is "quarantine" or "clear"; rehydration replays
+        these in time order, the per-process `n` sequence breaking
+        same-millisecond ties (record `t` rounds to 1 ms — a
+        quarantine and its clear can land inside one tick, and the
+        random id suffix must not decide which one "wins" the
+        replay). Never raises."""
+        try:
+            with self._lock:
+                self._qseq += 1
+                n = self._qseq
+            rec = {"kind": "autopilot-quarantine",
+                   "name": f"autopilot-{rule}",
+                   "event": event, "rule": str(rule),
+                   "n": n, "where": self.where}
+            if info:
+                rec.update({"reason": info.get("reason"),
+                            "action": info.get("action"),
+                            "action_id": info.get("action_id")})
+            self._ledger_for_bank().record(rec)
+        except Exception:  # noqa: BLE001 — persistence must never
+            pass           # hurt the control loop
+
+    def clear_quarantine(self, rules=None) -> list:
+        """Release quarantined rules (all, or the given subset) and
+        bank each release — the explicit escape hatch (`serve
+        --clear-quarantine` routes here via CLEAR_QUARANTINE_ENV).
+        Returns the released rule ids."""
+        with self._lock:
+            targets = [r for r in (rules if rules is not None
+                                   else list(self._quarantine))
+                       if r in self._quarantine]
+            for r in targets:
+                self._quarantine.pop(r, None)
+        for r in targets:
+            self._bank_quarantine("clear", r)
+        return targets
+
+    def _rehydrate_quarantine(self) -> None:
+        """Replay the store's `kind="autopilot-quarantine"` records
+        (time-ordered: quarantine sets, clear releases) so a restart
+        resumes with the quarantine the last run banked. With
+        CLEAR_QUARANTINE_ENV truthy the replayed set is discarded AND
+        the discard is banked, so the next restart starts clean too.
+        Never raises."""
+        try:
+            led = self._ledger_for_bank()
+            recs = led.query(kind="autopilot-quarantine")
+        except Exception:  # noqa: BLE001
+            return
+        # query order is (t, id) — id suffixes are random, so break
+        # same-millisecond ties with the banked sequence instead
+        # (stable: equal keys keep the query order)
+        recs = sorted(recs, key=lambda r: (r.get("t") or 0,
+                                           r.get("n") or 0))
+        # resume the sequence past everything replayed, so records
+        # this process banks (the env-clear discards included) sort
+        # after the replayed ones even inside the same millisecond
+        with self._lock:
+            self._qseq = max([self._qseq]
+                             + [r["n"] for r in recs
+                                if isinstance(r.get("n"), int)])
+        restored: dict = {}
+        for rec in recs:
+            rule = rec.get("rule")
+            if not rule:
+                continue
+            if rec.get("event") == "clear":
+                restored.pop(str(rule), None)
+            elif rec.get("event") == "quarantine":
+                restored[str(rule)] = {
+                    "t": rec.get("t"),
+                    "reason": rec.get("reason"),
+                    "action": rec.get("action"),
+                    "action_id": rec.get("action_id"),
+                    "restored": True}
+        if not restored:
+            return
+        if os.environ.get(CLEAR_QUARANTINE_ENV, "").strip() \
+                not in ("", "0", "false"):
+            for rule in sorted(restored):
+                self._bank_quarantine("clear", rule)
+            return
+        with self._lock:
+            for rule, info in restored.items():
+                self._quarantine.setdefault(rule, info)
 
     def _bank(self, event: str, entry: PolicyRule, now: float, *,
               finding: Optional[dict] = None,

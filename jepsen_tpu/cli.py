@@ -30,6 +30,7 @@ mutable parser objects.
 from __future__ import annotations
 
 import logging
+import os
 import re
 import sys
 import traceback
@@ -532,6 +533,15 @@ def serve_cmd() -> dict:
                  "(jepsen_tpu/autopilot.py) over the service: "
                  "doctor/SLO findings execute their remedies, every "
                  "action banked and verified (with --service)"),
+        Opt("clear_quarantine", default=False,
+            help="Discard the autopilot quarantine persisted in "
+                 "this store's ledger instead of rehydrating it "
+                 "(with --service --autopilot; the clear itself is "
+                 "banked)"),
+        Opt("replica_id", metavar="ID",
+            help="Fleet replica identity banked on heartbeats "
+                 "(with --service; default: env "
+                 "JEPSEN_TPU_REPLICA_ID, else host-pid)"),
     ]
 
     def run(parsed: Parsed):
@@ -540,10 +550,17 @@ def serve_cmd() -> dict:
         svc = None
         if o.get("service"):
             from .service import Service
+            if o.get("clear_quarantine"):
+                # env, not a kwarg: the Supervisor is constructed
+                # inside Service.start() — the escape hatch must be
+                # visible wherever rehydration happens
+                os.environ["JEPSEN_TPU_AUTOPILOT_CLEAR_QUARANTINE"] \
+                    = "1"
             svc = Service(o["store_root"],
                           workers=o.get("workers") or 1,
                           quota_device_s=o.get("quota_device_s"),
-                          autopilot=bool(o.get("autopilot")))
+                          autopilot=bool(o.get("autopilot")),
+                          replica_id=o.get("replica_id"))
         server = web.serve(host=o["host"], port=o["port"],
                            store_root=o["store_root"], service=svc)
         if svc is not None:
@@ -562,7 +579,8 @@ def serve_cmd() -> dict:
               f"· occupancy: {base}/occupancy "
               f"· doctor: {base}/doctor "
               f"· slo: {base}/slo "
-              f"· autopilot: {base}/autopilot")
+              f"· autopilot: {base}/autopilot "
+              f"· fleet: {base}/fleet")
         if svc is not None:
             print(f"Checker service: POST {base}/check "
                   f"· events: {base}/events "

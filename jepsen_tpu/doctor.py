@@ -11,7 +11,7 @@ at scale (JVM Knossos "times out" with no attribution); a system built
 to fix that should diagnose itself. This module closes the telemetry
 into diagnoses:
 
-  * a **rule catalog** D001-D012 over the recorded series and ledger
+  * a **rule catalog** D001-D015 over the recorded series and ledger
     records — each rule correlates planes (e.g. D001 joins
     CompileGuard counts against preflight's planned buckets; D005
     joins `fleet_shards` walls into `fleet.summarize`'s rebucket
@@ -64,6 +64,17 @@ Rule catalog (doc/OBSERVABILITY.md "Diagnosis plane"):
                                warm-hit rate splits the diagnosis
                                (warm -> capacity, cold -> compile
                                storm, cross-linking D001)
+  D013 replica-down            a fleet replica's heartbeat stream
+                               went silent past its own cadence
+                               (evaluated by observatory.py over the
+                               federated view, not by `diagnose`)
+  D014 replica-skew            cross-replica load / warm-rate skew —
+                               the router-affinity oracle for ROADMAP
+                               item 2 (observatory.py)
+  D015 warm-divergence         a bucket warm on one live replica but
+                               missing from another's warm registry —
+                               the steal/rewarm signal
+                               (observatory.py)
 
 Thresholds are single-sourced from the planes that own them
 (`occupancy.TARGET_FILL`, `devices.HBM_DRIFT_X` via `drift`,
@@ -96,7 +107,18 @@ RULES = {
     "D010": "oracle-fallback-burst",
     "D011": "slo-burn",
     "D012": "queue-backlog",
+    # fleet rules: evaluated by observatory.py over the FEDERATED view
+    # (they need N replicas' ledgers, which a single-process
+    # TelemetryView never has), registered here so findings, lint and
+    # the autopilot share ONE rule catalog
+    "D013": "replica-down",
+    "D014": "replica-skew",
+    "D015": "warm-divergence",
 }
+
+# Rules `diagnose` itself evaluates (single-process planes); the
+# fleet rules above are observatory.py's.
+LOCAL_RULES = tuple(f"D{i:03d}" for i in range(1, 13))
 
 SEVERITIES = ("critical", "warn", "info")
 _SEVERITY_RANK = {"critical": 3, "warn": 2, "info": 1}
@@ -1183,7 +1205,7 @@ def diagnose(view: TelemetryView) -> dict:
               "t": round(time.time(), 3),
               "healthy": not findings,
               "findings": findings,
-              "rules_evaluated": sorted(RULES),
+              "rules_evaluated": sorted(LOCAL_RULES),
               "rules_fired": sorted({f["rule"] for f in findings}),
               "phases": phase_profile(view.spans)}
     if errors:
@@ -1248,7 +1270,7 @@ def record_report(report: dict, *, where: str,
         if mx.enabled:
             series = mx.series(
                 "doctor", "diagnosis findings from the run doctor "
-                          "(rule catalog D001-D012)")
+                          "(rule catalog D001-D015)")
             for f in findings:
                 series.append({"rule": f["rule"],
                                "severity": f["severity"],

@@ -53,12 +53,19 @@ import os
 import secrets
 import threading
 import time
+import zlib
 from typing import Any, Iterator, Optional
 
 LEDGER_DIR = "ledger"
 RECORDS_DIR = "records"
 INDEX_FILE = "index.jsonl"
 SCHEMA = 1
+
+# index_signature folds a CRC of this many trailing index bytes into
+# its change key: an index line is ~100-300 bytes, so the window always
+# covers (at least the tail of) the newest append while keeping the
+# signature read O(1) regardless of index size.
+_SIG_TAIL_BYTES = 256
 
 # Fields promoted from a result dict's util block into the record's
 # util summary (the full per-chunk timeseries stays in the run's own
@@ -192,18 +199,32 @@ class Ledger:
         return os.path.join(self.records_dir, f"{run_id}.json")
 
     def index_signature(self) -> Optional[tuple]:
-        """The index file's (mtime_ns, size) identity — the ONE
-        change-detection key every ledger-watching cache uses
+        """The index file's (mtime_ns, size, tail_crc) identity — the
+        ONE change-detection key every ledger-watching cache uses
         (web.py's /status, /doctor and /slo caches; `doctor --watch`;
-        the autopilot's replay throttle). None when the index does
-        not exist yet — callers treat that as "nothing recorded"."""
+        the autopilot's replay throttle; the fleet observatory's
+        federated tail). None when the index does not exist yet —
+        callers treat that as "nothing recorded". The tail CRC covers
+        the final `_SIG_TAIL_BYTES` bytes: on filesystems with coarse
+        mtime granularity two same-size rewrites inside one tick would
+        alias under (mtime_ns, size) alone, and the whole point of the
+        key is that aliasing means a stale cache. Still O(1): one stat
+        plus one bounded read, never a scan of the index."""
         if not self.index_path:
             return None
         try:
             st = os.stat(self.index_path)
         except OSError:
             return None
-        return (st.st_mtime_ns, st.st_size)
+        tail_crc = 0
+        try:
+            with open(self.index_path, "rb") as fh:
+                if st.st_size > _SIG_TAIL_BYTES:
+                    fh.seek(st.st_size - _SIG_TAIL_BYTES)
+                tail_crc = zlib.crc32(fh.read(_SIG_TAIL_BYTES))
+        except OSError:
+            pass  # raced a rotation: (mtime, size) still discriminate
+        return (st.st_mtime_ns, st.st_size, tail_crc)
 
     # -- writing ------------------------------------------------------
     def record(self, entry: dict) -> Optional[str]:

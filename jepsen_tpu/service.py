@@ -64,6 +64,8 @@ scripts/service_smoke.py.
 
 from __future__ import annotations
 
+import os
+import socket
 import threading
 import time
 from collections import deque
@@ -112,6 +114,34 @@ SERIES_CAP = 4096
 TRIM_EVERY = 256
 
 _CHECKERS = ("wgl", "elle-append", "elle-wr")
+
+# Replica heartbeat cadence (seconds). Every serving process banks a
+# periodic `kind="replica-heartbeat"` ledger record — the fleet
+# observatory's liveness + inventory signal (observatory.py, doctor
+# D013-D015). Overridable per-process via JEPSEN_TPU_HEARTBEAT_S;
+# <= 0 disables the writer entirely.
+HEARTBEAT_EVERY_S = 2.0
+
+
+def heartbeat_interval() -> float:
+    """Default heartbeat cadence (env JEPSEN_TPU_HEARTBEAT_S wins)."""
+    raw = os.environ.get("JEPSEN_TPU_HEARTBEAT_S")
+    if raw:
+        try:
+            return float(raw)
+        except ValueError:
+            pass
+    return HEARTBEAT_EVERY_S
+
+
+def default_replica_id() -> str:
+    """This process's fleet identity: env JEPSEN_TPU_REPLICA_ID when
+    set (the smoke harness and any orchestrator pin stable names),
+    else host-pid — unique per process, stable for its lifetime."""
+    rid = os.environ.get("JEPSEN_TPU_REPLICA_ID")
+    if rid:
+        return str(rid)
+    return f"{socket.gethostname()}-{os.getpid()}"
 
 
 class _Request:
@@ -234,7 +264,9 @@ class Service:
                  mesh_min_batch: int = 2,
                  shed_hold_s: float = 30.0,
                  autopilot: bool = False,
-                 autopilot_every_s: float = 5.0):
+                 autopilot_every_s: float = 5.0,
+                 replica_id: Optional[str] = None,
+                 heartbeat_every_s: Optional[float] = None):
         self.store_root = store_root
         self.ledger = ledger_mod.Ledger(store_root)
         # the service owns an ENABLED registry by default: a request
@@ -267,6 +299,16 @@ class Service:
         self.autopilot_enabled = bool(autopilot)
         self.autopilot_every_s = float(autopilot_every_s)
         self._autopilot = None
+        # fleet identity + heartbeat: periodic kind="replica-heartbeat"
+        # ledger records are the observatory's liveness/inventory feed
+        self.replica_id = str(replica_id) if replica_id \
+            else default_replica_id()
+        self.heartbeat_every_s = float(heartbeat_every_s) \
+            if heartbeat_every_s is not None else heartbeat_interval()
+        self._hb_stop = threading.Event()
+        self._hb_thread: Optional[threading.Thread] = None
+        self._hb_count = 0
+        self._hb_devices: Optional[int] = None
         self.slo = slo_engine if slo_engine is not None \
             else slo_mod.Engine(ledger=self.ledger)
         self.slo_every_s = float(slo_every_s)
@@ -311,6 +353,13 @@ class Service:
                 every_s=self.autopilot_every_s, where="service",
                 mx=self.mx, ledger=self.ledger).start()
             autopilot_mod.set_default(self._autopilot)
+        if self.heartbeat_every_s > 0 and self._hb_thread is None:
+            self._hb_stop.clear()
+            hb = threading.Thread(target=self._heartbeat_loop,
+                                  name="service-heartbeat",
+                                  daemon=True)
+            hb.start()
+            self._hb_thread = hb
         set_default(self)
         return self
 
@@ -318,6 +367,10 @@ class Service:
         if self._autopilot is not None:
             self._autopilot.close(timeout=timeout)
             self._autopilot = None
+        if self._hb_thread is not None:
+            self._hb_stop.set()
+            self._hb_thread.join(timeout=timeout)
+            self._hb_thread = None
         with self._cv:
             self._stop = True
             self._cv.notify_all()
@@ -669,7 +722,6 @@ class Service:
     def _reject(self, req: _Request, ctx, cause: str,
                 result: Optional[dict] = None,
                 detail: Optional[dict] = None) -> dict:
-        req.state = "rejected"
         req.result = result if result is not None else {
             "valid?": "unknown", "cause": cause, **(detail or {})}
         req.result.setdefault("cause", cause)
@@ -678,12 +730,16 @@ class Service:
         with self._lock:
             self._runs[req.id] = req
             self._trim_runs_locked()
-            self._stats["rejected"] += 1
-        self._emit(req, "rejected", cause=req.result["cause"])
         with self.tracer.span("respond", parent=ctx,
                               attrs={"run_id": req.id,
                                      "cause": req.result["cause"]}):
             self._record(req)
+        # terminal flip + counter only after banking — the same
+        # heartbeat-visibility rule the finish paths follow
+        with self._lock:
+            self._stats["rejected"] += 1
+        req.state = "rejected"
+        self._emit(req, "rejected", cause=req.result["cause"])
         return {"id": req.id, "state": "rejected",
                 "verdict": "unknown", "cause": req.result["cause"]}
 
@@ -786,7 +842,8 @@ class Service:
                 with self.tracer.span(
                         "warm-dispatch", parent=ctx0,
                         attrs={"bucket": _key_str(key),
-                               "batch_n": len(batch)}):
+                               "batch_n": len(batch),
+                               "run_ids": [r.id for r in batch]}):
                     warmed = self._warm_bucket(batch[0])
                 warm_s = round(time.monotonic() - t_dispatch, 6)
                 if warmed:
@@ -908,7 +965,8 @@ class Service:
             with self.tracer.span(
                     "mesh-batch", parent=req0.params.get("_ctx"),
                     attrs={"bucket": _key_str(req0.bucket_key),
-                           "batch_n": len(batch)}):
+                           "batch_n": len(batch),
+                           "run_ids": [r.id for r in batch]}):
                 results = mesh_mod.check_mesh(
                     req0.model, [r.history for r in batch],
                     encs=[r.enc for r in batch],
@@ -978,16 +1036,19 @@ class Service:
         t_done = time.monotonic()
         req.total_s = round(t_done - req.t_mono, 6)
         req.result = res
-        with self._lock:
-            self._stats["served"] += 1
-            if warm_hit:
-                self._stats["warm_hits"] += 1
         with self.tracer.span("respond", parent=ctx,
                               attrs={"run_id": req.id}):
             req.phases["respond_s"] = round(
                 time.monotonic() - t_done, 6)
             self._record(req)
-        # "done" only after banking — same visibility rule as _finish
+        # "done" AND the served/warm counters only after banking —
+        # same visibility rule as _finish: a heartbeat snapshotting
+        # served=N must never precede the N-th request's record in
+        # the ledger index
+        with self._lock:
+            self._stats["served"] += 1
+            if warm_hit:
+                self._stats["warm_hits"] += 1
         req.state = "done"
         self._emit(req, "done",
                    verdict=_verdict_str(res.get("valid?")),
@@ -1026,6 +1087,7 @@ class Service:
                         "mode": mode,
                         "rounds": int(rounds),
                         "shards": shards,
+                        "run_ids": [r.id for r in batch],
                         "cause": (detail or {}).get("cause")})
                 self.mx.counter(
                     "service_batch_modes_total",
@@ -1236,10 +1298,6 @@ class Service:
         req.serve_s = round(t_done - t_serve0, 6)
         req.total_s = round(t_done - req.t_mono, 6)
         req.result = res
-        with self._lock:
-            self._stats["served"] += 1
-            if warm_hit:
-                self._stats["warm_hits"] += 1
         with self.tracer.span("respond", parent=ctx,
                               attrs={"run_id": req.id}):
             # respond covers everything after the search returned:
@@ -1249,8 +1307,15 @@ class Service:
             req.phases["respond_s"] = round(
                 time.monotonic() - t_done, 6)
             self._record(req)
-        # "done" only after banking: a poller that sees the terminal
-        # state must also see the service point and ledger record
+        # "done" AND the served/warm counters only after banking: a
+        # poller that sees the terminal state must also see the
+        # service point and ledger record, and a replica-heartbeat
+        # snapshotting served=N must never be banked ahead of the
+        # N-th request's record in the index
+        with self._lock:
+            self._stats["served"] += 1
+            if warm_hit:
+                self._stats["warm_hits"] += 1
         req.state = "done"
         self._emit(req, "done",
                    verdict=_verdict_str(res.get("valid?")),
@@ -1357,6 +1422,96 @@ class Service:
         except Exception:  # noqa: BLE001 — the objectives outrank
             pass           # their scheduler
 
+    # -- replica heartbeat --------------------------------------------
+    def _heartbeat_loop(self) -> None:
+        while not self._hb_stop.is_set():
+            self._heartbeat_once()
+            self._hb_stop.wait(self.heartbeat_every_s)
+
+    def _heartbeat_once(self) -> Optional[str]:
+        """Bank ONE `kind="replica-heartbeat"` ledger record (identity,
+        liveness cadence, queue/served counters, warm-bucket inventory,
+        autopilot state) and mirror the in-memory span/series windows
+        under `<root>/service/` so the fleet observatory — a different
+        process — can federate this replica without touching it.
+
+        Ordering contract (the PR 17 race rule, extended to this
+        writer): everything reported here is snapshotted under the
+        service lock, and the finish/reject paths advance their
+        counters and terminal states only AFTER the request's own
+        record hits the index — so a heartbeat claiming served=N can
+        never be banked ahead of the N-th service-request record.
+        Never raises; returns the banked record id (None on failure
+        or a disabled ledger)."""
+        now = time.time()
+        with self._lock:
+            depth = sum(len(q) for q in self._queues.values())
+            stats = dict(self._stats)
+            warm = sorted(_key_str(k) for k in self._warm)
+            workers = self.workers
+            shedding = now < self._shed_until
+        apt = None
+        sup = self._autopilot
+        if sup is not None:
+            try:
+                apt = {"active": True,
+                       "quarantined": sorted(sup.quarantined())}
+            except Exception:  # noqa: BLE001
+                apt = {"active": True, "quarantined": []}
+        if self._hb_devices is None:
+            self._hb_devices = self._device_count()
+        served = stats["served"]
+        rec = {"kind": "replica-heartbeat", "t": round(now, 3),
+               "name": f"replica:{self.replica_id}",
+               "replica": self.replica_id,
+               "host": socket.gethostname(),
+               "pid": int(os.getpid()),
+               "devices": int(self._hb_devices),
+               "every_s": float(self.heartbeat_every_s),
+               "workers": int(workers),
+               "queued": int(depth),
+               "submitted": int(stats["submitted"]),
+               "served": int(served),
+               "rejected": int(stats["rejected"]),
+               "shed": int(stats["shed"]),
+               "warm_rate": (round(stats["warm_hits"] / served, 4)
+                             if served else None),
+               "warm_buckets": warm,
+               "shedding": bool(shedding)}
+        if apt is not None:
+            rec["autopilot"] = apt
+        rid = None
+        try:
+            rid = self.ledger.record(rec)
+        except Exception:  # noqa: BLE001 — liveness reporting must
+            pass           # never hurt serving
+        with self._lock:
+            self._hb_count += 1
+        self._export_telemetry()
+        return rid
+
+    def _export_telemetry(self) -> None:
+        """Mirror the rotating span/series windows to
+        `<store_root>/service/{trace,metrics}.jsonl` (tmp + atomic
+        replace, so a federated reader never sees a torn file). This
+        is what makes cross-process request journeys possible: the
+        observatory reads these files — it never reaches into the
+        serving process. Never raises."""
+        if not self.store_root:
+            return
+        d = os.path.join(self.store_root, "service")
+        try:
+            os.makedirs(d, exist_ok=True)
+            for fname, export in (
+                    ("trace.jsonl", self.tracer.export),
+                    ("metrics.jsonl", self.mx.export_jsonl)):
+                path = os.path.join(d, fname)
+                tmp = f"{path}.tmp"
+                export(tmp)
+                os.replace(tmp, path)
+        except Exception:  # noqa: BLE001
+            pass
+
     # -- status -------------------------------------------------------
     def snapshot(self) -> dict:
         """The `/status.json` `service` block."""
@@ -1379,6 +1534,8 @@ class Service:
             active = bool(self._threads) and not self._stop
         served = stats["served"]
         return {"active": active, "workers": self.workers,
+                "replica": self.replica_id,
+                "heartbeats": self._hb_count,
                 "queued": depth, "buckets": buckets,
                 "warm_buckets": warm, **stats,
                 "warm_rate": (round(stats["warm_hits"] / served, 4)
@@ -1418,7 +1575,8 @@ def snapshot() -> dict:
     instance's snapshot, or the explicit inactive stub."""
     svc = _default
     if svc is None:
-        return {"active": False, "workers": 0, "queued": 0,
+        return {"active": False, "workers": 0, "replica": None,
+                "heartbeats": 0, "queued": 0,
                 "buckets": {}, "warm_buckets": 0, "submitted": 0,
                 "served": 0, "rejected": 0, "warm_hits": 0,
                 "batches": 0, "errors": 0, "shed": 0,

@@ -222,14 +222,19 @@ class Tracer:
 # engine thread gets its own row), spans as "X" complete events in
 # microseconds, annotations as "i" instant events.
 
-def perfetto_events(spans: list, service: str = "jepsen_tpu") -> list:
+def perfetto_events(spans: list, service: str = "jepsen_tpu",
+                    pid: int = 1) -> list:
     """`trace_event` dicts from span dicts (the `Span.to_json` /
     exported-JSONL shape). Unfinished spans (no end time) are emitted
     with zero duration rather than dropped — a crashed run's last open
-    span is exactly the interesting one."""
+    span is exactly the interesting one. `pid` names the process
+    track: the default single-process export owns pid 1; the fleet
+    observatory's merged export gives each replica its own pid so N
+    processes render as N labeled tracks (counters/instants keep
+    pids 2/3)."""
     events: list = []
     lanes: dict = {}
-    pid = 1
+    pid = int(pid)
     events.append({"ph": "M", "name": "process_name", "pid": pid,
                    "tid": 0, "args": {"name": str(service)}})
     for sp in spans:

@@ -893,6 +893,139 @@ def render_slo(store_root: str) -> bytes:
     return _page("slo", "".join(parts))
 
 
+# /fleet federates sibling stores (observatory.py). Cached on the
+# federation set + a short TTL rather than the index signatures alone:
+# heartbeat ages (and so D013) are time-dependent even when no replica
+# appends, so an unchanged fleet must still re-evaluate — the
+# FederatedLedger underneath reuses its per-root record caches, so a
+# re-evaluation of an idle fleet is stat()s + arithmetic.
+_FLEET_CACHE: dict = {}
+_FLEET_CACHE_TTL_S = 1.0
+_FLEET_LOCK = threading.Lock()
+
+
+def _fleet_snapshot(store_root: str) -> Optional[dict]:
+    """The federated snapshot /fleet renders: roots from
+    JEPSEN_TPU_FLEET_ROOTS when set, else discovery around this
+    store (the serving replica sees its siblings). None when nothing
+    federates."""
+    from . import observatory as obs_mod
+    roots = obs_mod.roots_from_env(store_root)
+    if not roots:
+        return None
+    key = tuple(roots)
+    with _FLEET_LOCK:
+        cached = _FLEET_CACHE.get(store_root)
+        if cached is not None and cached[0] == key \
+                and time.monotonic() - cached[2] < _FLEET_CACHE_TTL_S:
+            return cached[1]
+        fed = cached[3] if cached is not None and cached[0] == key \
+            else obs_mod.FederatedLedger(roots)
+    snap = obs_mod.fleet_snapshot(fed)
+    with _FLEET_LOCK:
+        _FLEET_CACHE[store_root] = (key, snap, time.monotonic(), fed)
+    return snap
+
+
+def render_fleet(store_root: str) -> bytes:
+    """The auto-refreshing /fleet panel (doc/OBSERVABILITY.md "Fleet
+    plane"): every federated replica's liveness + warm inventory, the
+    merged fleet SLO beside the per-replica verdicts, and the
+    D013-D015 findings."""
+    snap = _fleet_snapshot(store_root)
+    parts = ["<meta http-equiv='refresh' content='2'>",
+             "<a href='/'>jepsen_tpu</a> / "
+             "<a href='/status'>status</a> / fleet",
+             "<h1>fleet observatory</h1>"]
+    if snap is None:
+        parts.append(
+            "<p>nothing to federate — no sibling store roots found. "
+            "Set <code>JEPSEN_TPU_FLEET_ROOTS</code> (path-separated "
+            "store roots) or run replicas whose stores share this "
+            "store's parent directory.</p>")
+        return _page("fleet", "".join(parts))
+    parts.append(
+        f"<p>{len(snap['replicas'])} replica(s) &middot; "
+        f"{_esc(snap['live'])} live &middot; "
+        f"{len(snap['down'])} down &middot; "
+        f"{_esc(snap['requests'])} request(s) in window</p>")
+    rows = []
+    for rid, info in sorted((snap.get("replicas") or {}).items()):
+        down = info.get("down")
+        state = ("down" if down is True else
+                 "live" if down is False else "unknown")
+        color = (VALID_COLORS[False] if down is True else
+                 VALID_COLORS[True] if down is False else
+                 VALID_COLORS[None])
+        rows.append(
+            f"<tr><td>{_esc(rid)}</td>"
+            f"<td style='background:{color}'>{state}</td>"
+            f"<td>{_esc(info.get('age_s'))}s</td>"
+            f"<td>{_esc(info.get('queued'))}</td>"
+            f"<td>{_esc(info.get('served'))}</td>"
+            f"<td>{_esc(info.get('warm_rate'))}</td>"
+            f"<td>{len(info.get('warm_buckets') or [])}</td>"
+            f"<td>{_esc(info.get('devices'))}</td></tr>")
+    parts.append(
+        "<table><thead><tr><th>replica</th><th>state</th>"
+        "<th>age</th><th>queued</th><th>served</th><th>warm rate</th>"
+        "<th>warm buckets</th><th>devices</th></tr></thead><tbody>"
+        + "".join(rows) + "</tbody></table>")
+    findings = snap.get("findings") or []
+    if findings:
+        items = "".join(
+            f"<li><b>{_esc(f.get('rule'))}</b> "
+            f"[{_esc(f.get('severity'))}] {_esc(f.get('summary'))}"
+            "</li>" for f in findings)
+        parts.append(f"<h2>fleet findings</h2><ul>{items}</ul>")
+    else:
+        parts.append("<p>no fleet findings</p>")
+    fc = (snap.get("slo") or {}).get("fleet")
+    if fc and fc.get("objectives"):
+        srows = []
+        for o in fc["objectives"]:
+            met = o.get("met")
+            color = (VALID_COLORS[True] if met is True else
+                     VALID_COLORS[False] if met is False else
+                     VALID_COLORS[None])
+            srows.append(
+                f"<tr><td>{_esc(o.get('name'))}</td>"
+                f"<td>n={_esc(o.get('n'))}</td>"
+                f"<td>{_esc(o.get('good_frac'))} vs "
+                f"{_esc(o.get('target_frac'))}</td>"
+                f"<td style='background:{color}'>{_esc(met)}</td>"
+                f"<td>{_esc(o.get('burn_rate'))}x</td></tr>")
+        parts.append(
+            "<h2>fleet SLO (request-weighted, merged ledgers)</h2>"
+            "<table><thead><tr><th>objective</th><th>n</th>"
+            "<th>good frac</th><th>met</th><th>burn</th></tr>"
+            "</thead><tbody>" + "".join(srows) + "</tbody></table>")
+        per = (snap.get("slo") or {}).get("per_replica") or {}
+        prow = []
+        for rid, rep in sorted(per.items()):
+            if not rep:
+                continue
+            met = rep.get("met")
+            color = (VALID_COLORS[True] if met is True else
+                     VALID_COLORS[False] if met is False else
+                     VALID_COLORS[None])
+            alerts = [a.get("objective")
+                      for a in (rep.get("alerts") or [])]
+            prow.append(
+                f"<tr><td>{_esc(rid)}</td>"
+                f"<td style='background:{color}'>{_esc(met)}</td>"
+                f"<td>{_esc(alerts)}</td></tr>")
+        if prow:
+            parts.append(
+                "<h3>per replica</h3><table><thead><tr>"
+                "<th>replica</th><th>met</th><th>alerts</th></tr>"
+                "</thead><tbody>" + "".join(prow) + "</tbody></table>")
+    parts.append("<p><a href='/fleet.json'>fleet.json</a> &middot; "
+                 "<a href='/slo'>this replica's slo</a> &middot; "
+                 "<a href='/status'>status</a></p>")
+    return _page("fleet", "".join(parts))
+
+
 # autopilot action-history verdict colors ride the shared palette
 _AP_VERDICT_COLORS = {"verified": VALID_COLORS[True],
                       "reverted": VALID_COLORS[False]}
@@ -1492,6 +1625,19 @@ class Handler(BaseHTTPRequestHandler):
             if uri == "/autopilot":
                 self._send(200, "text/html; charset=utf-8",
                            render_autopilot(self.cache.store_root))
+                return
+            if uri == "/fleet":
+                self._send(200, "text/html; charset=utf-8",
+                           render_fleet(self.cache.store_root))
+                return
+            if uri == "/fleet.json":
+                snap = _fleet_snapshot(self.cache.store_root)
+                if snap is None:
+                    snap = {"schema": 1, "roots": [], "replicas": {},
+                            "live": 0, "down": [], "requests": 0,
+                            "findings": []}
+                self._send(200, "application/json",
+                           json.dumps(snap, default=str).encode())
                 return
             if uri == "/events":
                 self._serve_events()

@@ -40,6 +40,9 @@ JAX_PLATFORMS=cpu python scripts/service_load.py --smoke
 echo "== autopilot smoke =="
 JAX_PLATFORMS=cpu python scripts/autopilot_smoke.py
 
+echo "== fleet observatory smoke =="
+JAX_PLATFORMS=cpu python scripts/fleet_smoke.py
+
 echo "== tier-1 pytest =="
 JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider

@@ -1,0 +1,275 @@
+#!/usr/bin/env python
+"""Two-process fleet-observatory smoke (scripts/ci_checks.sh).
+
+Boots TWO real `python -m jepsen_tpu serve --service` replicas (cpu,
+fast heartbeats) over sibling stores under one parent, drives a mixed
+WGL/Elle load across both, and proves the PR's end-to-end claims:
+
+  - every replica banks `kind="replica-heartbeat"` records and both
+    show up live — with per-replica counters and a fleet SLO block —
+    in the MERGED `/fleet.json` served by replica 1 (the federation
+    env points each web surface at both stores);
+  - a request served by replica 2 reassembles as a cross-process
+    journey (ledger record + admit/respond spans + series points read
+    from r2's exported `service/{trace,metrics}.jsonl`) in THIS
+    process, and the merged Perfetto export gives each replica its
+    own process track;
+  - killing replica 2 flips it to D013 replica-down within one
+    heartbeat interval of the silence threshold;
+  - the whole observatory pass (snapshot + journey + perfetto) is
+    READ-ONLY: a (path, mtime_ns, size) walk of the dead replica's
+    store is byte-identical before and after;
+  - everything banked lints clean under scripts/telemetry_lint.py.
+
+Exit 0 clean, 1 on any violation.
+"""
+
+import json
+import os
+import shutil
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "scripts"))
+
+HEARTBEAT_S = 0.5
+
+_failures = []
+
+
+def check(cond, msg):
+    tag = "ok" if cond else "FAIL"
+    print(f"  [{tag}] {msg}")
+    if not cond:
+        _failures.append(msg)
+    return bool(cond)
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def get_json(url: str, timeout: float = 10.0):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def post_check(base: str, payload: dict) -> dict:
+    req = urllib.request.Request(
+        f"{base}/check", data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=30) as r:
+        return json.loads(r.read())
+
+
+def wait_for(pred, timeout: float, what: str, every: float = 0.1):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            v = pred()
+        except Exception:  # noqa: BLE001 — still booting
+            v = None
+        if v:
+            return v
+        time.sleep(every)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def spawn_replica(rid: str, root: str, port: int,
+                  fleet_roots: str) -> subprocess.Popen:
+    env = {**os.environ,
+           "JAX_PLATFORMS": "cpu",
+           "PYTHONUNBUFFERED": "1",
+           "JEPSEN_TPU_HEARTBEAT_S": str(HEARTBEAT_S),
+           "JEPSEN_TPU_FLEET_ROOTS": fleet_roots}
+    return subprocess.Popen(
+        [sys.executable, "-m", "jepsen_tpu", "serve",
+         "--service", "--host", "127.0.0.1",
+         "--port", str(port), "--store-root", root,
+         "--replica-id", rid],
+        cwd=REPO, env=env,
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+
+
+def store_fingerprint(root: str) -> list:
+    out = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames.sort()
+        for f in sorted(filenames):
+            p = os.path.join(dirpath, f)
+            st = os.stat(p)
+            out.append((os.path.relpath(p, root),
+                        st.st_mtime_ns, st.st_size))
+    return out
+
+
+def main() -> int:
+    from jepsen_tpu import observatory as obs
+    from jepsen_tpu import synth
+    import telemetry_lint
+
+    tmp = tempfile.mkdtemp(prefix="fleet-smoke-")
+    roots = [os.path.join(tmp, "r1"), os.path.join(tmp, "r2")]
+    ports = [free_port(), free_port()]
+    bases = [f"http://127.0.0.1:{p}" for p in ports]
+    fleet_roots = os.pathsep.join(roots)
+    procs = []
+    try:
+        print("== boot: two serve --service replicas ==")
+        for rid, root, port in zip(("r1", "r2"), roots, ports):
+            procs.append(spawn_replica(rid, root, port, fleet_roots))
+        for base in bases:
+            wait_for(lambda b=base: get_json(f"{b}/status.json"),
+                     60.0, f"{base}/status.json")
+        print(f"  up: {bases[0]} (r1), {bases[1]} (r2)")
+
+        print("== mixed load across both replicas ==")
+
+        def ops(h):  # POST bodies carry op dicts, not History objects
+            return [op.to_dict() for op in h]
+
+        h_small = ops(synth.cas_register_history(80, n_procs=4,
+                                                 seed=7))
+        h_big = ops(synth.cas_register_history(300, n_procs=4,
+                                               seed=8))
+        h_elle = ops(synth.list_append_history(60, n_procs=5,
+                                               seed=9))
+        submitted = []  # (replica index, run id)
+        for i, base in enumerate(bases):
+            for tenant, h in (("acme", h_small), ("umbrella", h_big)):
+                out = post_check(base, {
+                    "model": "cas-register", "tenant": tenant,
+                    "history": h})
+                submitted.append((i, out["id"]))
+        out = post_check(bases[1], {
+            "checker": "elle-append", "tenant": "acme",
+            "history": h_elle})
+        submitted.append((1, out["id"]))
+        for i, rid in submitted:
+            rec = wait_for(
+                lambda b=bases[i], r=rid:
+                    get_json(f"{b}/runs/{r}.json"),
+                240.0, f"run {rid} banked on replica {i + 1}")
+            check(rec.get("kind") == "service-request",
+                  f"run {rid[:18]}… banked as service-request")
+
+        print("== merged /fleet.json from replica 1 ==")
+        snap = wait_for(
+            lambda: (lambda s: s if s.get("live") == 2 else None)(
+                get_json(f"{bases[0]}/fleet.json")),
+            30.0, "both replicas live in /fleet.json")
+        check(set(snap["replicas"]) == {"r1", "r2"},
+              f"replicas federated: {sorted(snap['replicas'])}")
+        check(snap["down"] == [], "no replica down under load")
+        check(snap["requests"] >= len(submitted),
+              f"fleet SLO window sees {snap['requests']} requests "
+              f"(>= {len(submitted)} submitted)")
+        fleet_slo = (snap.get("slo") or {}).get("fleet") or {}
+        check(bool(fleet_slo.get("objectives")),
+              "fleet SLO objectives evaluated over the merged stream")
+        per = (snap.get("slo") or {}).get("per_replica") or {}
+        check(set(per) == {"r1", "r2"},
+              "per-replica SLO breakdown beside the fleet block")
+        r2_served = snap["replicas"]["r2"]["served"]
+        check(r2_served >= 3,
+              f"r2 heartbeat counters advance (served={r2_served})")
+
+        print("== cross-process journey (request served by r2) ==")
+        r2_run = next(rid for i, rid in submitted if i == 1)
+        # let r2's next heartbeat export the spans/series mirrors
+        time.sleep(2 * HEARTBEAT_S)
+        fed = obs.FederatedLedger(roots)
+        doc = obs.journey(fed, r2_run)
+        check(doc["found"], f"journey found for {r2_run[:18]}…")
+        check(doc["replica"] == "r2", "journey attributed to r2")
+        check(doc["complete"],
+              "journey complete: record + admit + respond spans")
+        types = {(h["type"], h["name"]) for h in doc["hops"]}
+        check(("span", "admit") in types
+              and ("span", "respond") in types,
+              f"span hops reassembled from r2's trace export "
+              f"({doc['n_hops']} hops)")
+        check(any(t == "series" for t, _ in types),
+              "series hops reassembled from r2's metrics export")
+        pf_path = os.path.join(tmp, "fleet-perfetto.json")
+        pf = obs.fleet_perfetto(fed, path=pf_path)
+        pids = {e["pid"] for e in pf["traceEvents"]}
+        check(len(pids) == 2,
+              f"merged perfetto: one process track per replica "
+              f"({len(pf['traceEvents'])} events)")
+
+        print("== kill r2 -> D013 within one heartbeat interval ==")
+        procs[1].send_signal(signal.SIGKILL)
+        procs[1].wait(timeout=10)
+        t_kill = time.monotonic()
+        # silence threshold is DOWN_GAP_X x cadence; D013 must fire
+        # within one further heartbeat interval of slack
+        deadline = (obs.DOWN_GAP_X * HEARTBEAT_S) + HEARTBEAT_S
+        snap = wait_for(
+            lambda: (lambda s: s if s.get("down") == ["r2"] else
+                     None)(get_json(f"{bases[0]}/fleet.json")),
+            deadline + 5.0, "r2 reported down")
+        waited = time.monotonic() - t_kill
+        check(waited <= deadline + 2.5,
+              f"D013 within budget ({waited:.2f}s <= "
+              f"{deadline + 2.5:.2f}s incl. poll+cache slack)")
+        check("D013" in snap["rules_fired"],
+              f"rules fired: {snap['rules_fired']}")
+        d013 = [f for f in snap["findings"] if f["rule"] == "D013"]
+        check(bool(d013) and d013[0]["severity"] == "critical",
+              "D013 replica-down finding is critical")
+        check(snap["replicas"]["r1"]["served"] >= 2,
+              "r1 still live and serving")
+
+        print("== read-only proof over the dead replica's store ==")
+        before = store_fingerprint(roots[1])
+        snap2 = obs.fleet_snapshot(obs.FederatedLedger(roots))
+        doc2 = obs.journey(obs.FederatedLedger(roots), r2_run)
+        obs.fleet_perfetto(obs.FederatedLedger(roots),
+                           path=os.path.join(tmp, "pf2.json"))
+        after = store_fingerprint(roots[1])
+        check(before == after and len(before) > 0,
+              f"full observatory pass wrote nothing into r2's store "
+              f"({len(before)} files unchanged)")
+        check(snap2["down"] == ["r2"] and doc2["complete"],
+              "snapshot + journey still correct over the dead store")
+
+        print("== telemetry lint over everything banked ==")
+        lint_errs = []
+        for root in roots:
+            idx = os.path.join(root, "ledger", "index.jsonl")
+            lint_errs += telemetry_lint.lint_ledger_file(idx)
+            mpath = os.path.join(root, "service", "metrics.jsonl")
+            if os.path.isfile(mpath):
+                lint_errs += telemetry_lint.lint_jsonl_file(mpath)
+        for e in lint_errs[:10]:
+            print(f"    lint: {e}")
+        check(lint_errs == [], "ledgers + exported series lint clean")
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+                try:
+                    p.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    if _failures:
+        print(f"FLEET SMOKE: FAIL ({len(_failures)} violation(s))")
+        return 1
+    print("FLEET SMOKE: PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

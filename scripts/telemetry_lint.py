@@ -126,7 +126,8 @@ SERIES_SCHEMAS = {
     # (<2 devices / infeasible plan); rounds the lane-group poll
     # count (0 for serial), shards the {device: lanes} map
     "service_batch": {"bucket": str, "batch_n": int, "mode": str,
-                      "rounds": int, "shards": dict},
+                      "rounds": int, "shards": dict,
+                      "run_ids": list},
     # the SLO engine (jepsen_tpu/slo.py): one point per objective per
     # evaluation — good_frac over the longest rolling window,
     # burn_rate in error-budget multiples (1.0 = consuming exactly
@@ -139,13 +140,20 @@ SERIES_SCHEMAS = {
     # pre-shed gate), action the policy-table actuator name
     "autopilot": {"event": str, "rule": str, "action": str,
                   "where": str, "metric": str},
+    # the fleet observatory (jepsen_tpu/observatory.py): one point per
+    # federated snapshot — replica/live/down counts, requests in the
+    # merged SLO window, findings the D013-D015 pass produced. Only an
+    # EXPLICITLY passed registry gets these (federation is read-only
+    # over the replica stores).
+    "fleet": {"replicas": int, "live": int, "down": int,
+              "requests": int, "findings": int},
 }
 
 # doctor.py's rule catalog + severity levels — duplicated here as the
 # lint contract (this script is import-light on purpose: schema drift
 # in doctor.py must FAIL against this frozen enum, not silently
 # follow it)
-DOCTOR_RULE_IDS = {f"D{i:03d}" for i in range(1, 13)}
+DOCTOR_RULE_IDS = {f"D{i:03d}" for i in range(1, 16)}
 DOCTOR_SEVERITIES = {"critical", "warn", "info"}
 
 # autopilot.py's lifecycle enum + trigger ids — the policy table fires
@@ -517,6 +525,52 @@ def lint_ledger_file(path: str) -> list:
             if not isinstance(obj.get("per_device"), dict):
                 errs.append(f"{where}: multichip record needs the "
                             "'per_device' attribution object")
+        if obj.get("kind") == "replica-heartbeat":
+            # liveness beacons (jepsen_tpu/service.py heartbeat loop):
+            # replica identity plus the snapshot the fleet observatory
+            # federates — counters, warm registry, shed state
+            if not isinstance(obj.get("replica"), str):
+                errs.append(f"{where}: replica-heartbeat needs a str "
+                            "'replica'")
+            if not isinstance(obj.get("host"), str):
+                errs.append(f"{where}: replica-heartbeat needs a str "
+                            "'host'")
+            for fld in ("pid", "devices", "workers", "queued",
+                        "submitted", "served", "rejected", "shed"):
+                v = obj.get(fld)
+                if not isinstance(v, int) or isinstance(v, bool):
+                    errs.append(f"{where}: replica-heartbeat {fld!r} "
+                                "should be int")
+            es = obj.get("every_s")
+            if not isinstance(es, NUM) or isinstance(es, bool):
+                errs.append(f"{where}: replica-heartbeat 'every_s' "
+                            "should be numeric")
+            wr = obj.get("warm_rate", None)
+            if wr is not None and (not isinstance(wr, NUM)
+                                   or isinstance(wr, bool)):
+                errs.append(f"{where}: replica-heartbeat 'warm_rate' "
+                            "should be numeric or null")
+            if not isinstance(obj.get("warm_buckets"), list):
+                errs.append(f"{where}: replica-heartbeat needs the "
+                            "'warm_buckets' list")
+            if not isinstance(obj.get("shedding"), bool):
+                errs.append(f"{where}: replica-heartbeat needs bool "
+                            "'shedding'")
+        if obj.get("kind") == "autopilot-quarantine":
+            # quarantine persistence (jepsen_tpu/autopilot.py): each
+            # quarantine/clear flip banks the rule so a restarted
+            # supervisor rehydrates the set instead of re-learning it
+            if obj.get("event") not in ("quarantine", "clear"):
+                errs.append(
+                    f"{where}: autopilot-quarantine 'event' should "
+                    f"be quarantine/clear, got {obj.get('event')!r}")
+            if obj.get("rule") not in AUTOPILOT_RULE_IDS:
+                errs.append(
+                    f"{where}: autopilot-quarantine 'rule' should be "
+                    f"a catalog id or 'burn', got {obj.get('rule')!r}")
+            if not isinstance(obj.get("where"), str):
+                errs.append(f"{where}: autopilot-quarantine needs a "
+                            "str 'where'")
         hb = obj.get("hbm", None)
         if hb is not None:
             # measured-HBM blocks (devices.py) on any record kind —

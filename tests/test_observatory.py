@@ -1,0 +1,522 @@
+"""Fleet-observatory tests (jepsen_tpu/observatory.py): the hardened
+index signature, federated-read parity (one root == the local read;
+two roots merge `(t, id)`-ordered with provenance), merged fleet SLO
+arithmetic vs hand-merged records, the D013/D014/D015 fleet rules,
+cross-process request journeys over a real (tiny) Service, the
+heartbeat write-ordering contract, quarantine persistence across
+Supervisor restarts, and the CLI/lint surfaces. Everything here is
+host-side and single-process — the true two-process federation runs
+in scripts/fleet_smoke.py."""
+
+import json
+import os
+import sys
+import time
+
+import pytest
+
+from jepsen_tpu import autopilot as autopilot_mod
+from jepsen_tpu import fs_cache, synth
+from jepsen_tpu import ledger as ledger_mod
+from jepsen_tpu import observatory as obs
+from jepsen_tpu import service as service_mod
+from jepsen_tpu import slo as slo_mod
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "scripts"))
+import telemetry_lint  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _isolate(monkeypatch, tmp_path):
+    monkeypatch.setattr(fs_cache, "DIR",
+                        str(tmp_path / "fs-cache-iso"))
+    monkeypatch.delenv(obs.FLEET_ROOTS_ENV, raising=False)
+    monkeypatch.delenv(autopilot_mod.CLEAR_QUARANTINE_ENV,
+                       raising=False)
+    prev = service_mod.set_default(None)
+    slo_mod._reset()
+    yield
+    service_mod.set_default(prev)
+    slo_mod._reset()
+
+
+def _bank(led, kind, t, **extra):
+    rec = {"kind": kind, "t": t, "name": extra.pop("name", kind)}
+    rec.update(extra)
+    return led.record(rec)
+
+
+def _request(led, t, *, verdict=True, wall=0.05, tenant="a",
+             cause=None):
+    return _bank(led, "service-request", t, verdict=verdict,
+                 tenant=tenant, checker="wgl", warm_hit=False,
+                 batch_n=1, shed=False, bucket="b0",
+                 wall_s=wall, cause=cause,
+                 phases={"serve_s": wall}, op_count=10,
+                 device_s=0.0)
+
+
+def _heartbeat(led, t, rid, *, served=5, warm_rate=0.8,
+               warm_buckets=("b0",), every_s=2.0, **extra):
+    rec = {"kind": "replica-heartbeat", "t": t,
+           "name": f"replica:{rid}", "replica": rid, "host": "h",
+           "pid": 123, "devices": 1, "every_s": every_s,
+           "workers": 1, "queued": 0, "submitted": served,
+           "served": served, "rejected": 0, "shed": 0,
+           "warm_rate": warm_rate,
+           "warm_buckets": list(warm_buckets), "shedding": False}
+    rec.update(extra)
+    return led.record(rec)
+
+
+# --- index_signature hardening ----------------------------------------------
+
+class TestIndexSignature:
+    def test_three_tuple_and_changes_on_append(self, tmp_path):
+        led = ledger_mod.Ledger(str(tmp_path / "s"))
+        assert led.index_signature() is None
+        _bank(led, "run", 1.0)
+        sig1 = led.index_signature()
+        assert isinstance(sig1, tuple) and len(sig1) == 3
+        _bank(led, "run", 2.0)
+        assert led.index_signature() != sig1
+
+    def test_same_size_same_mtime_different_content(self, tmp_path):
+        # the coarse-mtime alias the tail CRC exists for: two
+        # same-length rewrites inside one mtime tick must still
+        # produce distinct signatures
+        led = ledger_mod.Ledger(str(tmp_path / "s"))
+        _bank(led, "run", 1.0)
+        path = led.index_path
+        st = os.stat(path)
+        with open(path, "rb") as fh:
+            original = fh.read()
+        flipped = original.replace(b'"run"', b'"rUn"', 1)
+        assert len(flipped) == len(original) and flipped != original
+        sig_a = led.index_signature()
+        with open(path, "wb") as fh:
+            fh.write(flipped)
+        os.utime(path, ns=(st.st_atime_ns, st.st_mtime_ns))
+        sig_b = led.index_signature()
+        assert sig_b[:2] == sig_a[:2]   # mtime+size DO alias...
+        assert sig_b != sig_a           # ...the CRC does not
+
+    def test_tail_read_is_bounded(self, tmp_path):
+        # O(1) contract: the signature reads at most _SIG_TAIL_BYTES
+        # no matter how long the index grows
+        led = ledger_mod.Ledger(str(tmp_path / "s"))
+        for i in range(50):
+            _bank(led, "run", float(i))
+        size = os.stat(led.index_path).st_size
+        assert size > ledger_mod._SIG_TAIL_BYTES
+        sig = led.index_signature()
+        assert sig[1] == size
+
+
+# --- FederatedLedger parity + merge -----------------------------------------
+
+class TestFederatedLedger:
+    def test_single_root_parity(self, tmp_path):
+        led = ledger_mod.Ledger(str(tmp_path / "s"))
+        for i in range(6):
+            _bank(led, "run" if i % 2 else "service-request",
+                  float(i), verdict=True)
+        fed = obs.FederatedLedger([str(tmp_path / "s")])
+        assert fed.query() == led.query()
+        assert fed.query(kind="service-request") == \
+            led.query(kind="service-request")
+        assert fed.query(limit=3, newest_first=True) == \
+            led.query(limit=3, newest_first=True)
+        assert fed.query(since=2.5, until=4.5) == \
+            led.query(since=2.5, until=4.5)
+
+    def test_two_root_merge_order_and_provenance(self, tmp_path):
+        a = ledger_mod.Ledger(str(tmp_path / "a"))
+        b = ledger_mod.Ledger(str(tmp_path / "b"))
+        _bank(a, "run", 1.0)
+        _bank(b, "run", 2.0)
+        _bank(a, "run", 3.0)
+        _heartbeat(a, 3.5, "rep-a")
+        _heartbeat(b, 3.5, "rep-b")
+        fed = obs.FederatedLedger([a.store_root, b.store_root])
+        pairs = fed.query_with_replica(kind="run")
+        assert [p[1]["t"] for p in pairs] == [1.0, 2.0, 3.0]
+        assert [p[0] for p in pairs] == ["rep-a", "rep-b", "rep-a"]
+        # records come back verbatim — provenance never leaks in
+        assert "replica" not in pairs[0][1]
+
+    def test_replica_of_falls_back_to_basename(self, tmp_path):
+        led = ledger_mod.Ledger(str(tmp_path / "quiet"))
+        _bank(led, "run", 1.0)
+        fed = obs.FederatedLedger([led.store_root])
+        assert fed.replica_of(fed.roots[0]) == "quiet"
+
+    def test_cache_reuses_until_signature_changes(self, tmp_path):
+        led = ledger_mod.Ledger(str(tmp_path / "s"))
+        _bank(led, "run", 1.0)
+        fed = obs.FederatedLedger([led.store_root])
+        first = fed.records_for(fed.roots[0])
+        assert len(first) == 1
+        assert len(fed.records_for(fed.roots[0])) == 1
+        _bank(led, "run", 2.0)
+        assert len(fed.records_for(fed.roots[0])) == 2
+
+    def test_discover_finds_sibling_stores(self, tmp_path):
+        for name in ("r1", "r2"):
+            _bank(ledger_mod.Ledger(str(tmp_path / name)), "run", 1.0)
+        (tmp_path / "not-a-store").mkdir()
+        roots = obs.discover(str(tmp_path / "r1"))
+        assert sorted(os.path.basename(r) for r in roots) == \
+            ["r1", "r2"]
+
+
+# --- fleet SLO: merged arithmetic -------------------------------------------
+
+class TestFleetSlo:
+    def test_merge_matches_hand_merged_engine(self, tmp_path):
+        now = time.time()
+        a = ledger_mod.Ledger(str(tmp_path / "a"))
+        b = ledger_mod.Ledger(str(tmp_path / "b"))
+        for i in range(6):
+            _request(a, now - 5 - i, verdict=True, wall=0.01)
+        for i in range(6):
+            _request(b, now - 5 - i, verdict=(i % 2 == 0),
+                     wall=2.0)
+        fed = obs.FederatedLedger([a.store_root, b.store_root])
+        block = obs.fleet_slo(fed, now=now)
+        assert block["requests"] == 12
+        eng = slo_mod.Engine()
+        merged = a.query(kind="service-request") \
+            + b.query(kind="service-request")
+        by_hand = eng.evaluate(now=now, records=merged)
+        fleet = block["fleet"]
+        for got, want in zip(fleet["objectives"],
+                             by_hand["objectives"]):
+            assert got["name"] == want["name"]
+            assert got["windows"] == want["windows"]
+        # and the per-replica breakdown keeps each root's own slice
+        per = block["per_replica"]
+        assert set(per) == {"a", "b"}
+        assert per["a"]["requests"] == 6
+        assert per["b"]["requests"] == 6
+
+    def test_fleet_weighs_by_traffic_not_replicas(self, tmp_path):
+        # one busy unhealthy replica must dominate a quiet healthy one
+        now = time.time()
+        a = ledger_mod.Ledger(str(tmp_path / "a"))
+        b = ledger_mod.Ledger(str(tmp_path / "b"))
+        for i in range(16):
+            # undecided (not an admission reject): burns availability
+            _request(a, now - 5 - i * 0.1, verdict="unknown",
+                     cause="fault")
+        _request(b, now - 5, verdict=True)
+        block = obs.fleet_slo(obs.FederatedLedger([a.store_root, b.store_root]),
+                              now=now)
+        avail = [o for o in block["fleet_compact"]["objectives"]
+                 if o["name"] == "availability"]
+        assert avail and avail[0]["good_frac"] < 0.2
+
+
+# --- fleet doctor: D013 / D014 / D015 ---------------------------------------
+
+class TestFleetFindings:
+    def test_d013_fires_on_silence_only(self, tmp_path):
+        now = time.time()
+        led = ledger_mod.Ledger(str(tmp_path / "a"))
+        _heartbeat(led, now - 10.0, "r1", every_s=2.0)
+        fed = obs.FederatedLedger([led.store_root])
+        hb = obs.heartbeats(fed, now=now)
+        assert hb["r1"]["down"] is True
+        findings = obs.fleet_findings(hb, now=now)
+        assert [f["rule"] for f in findings] == ["D013"]
+        assert findings[0]["severity"] == "critical"
+        # fresh beat at the same cadence: quiet
+        _heartbeat(led, now - 1.0, "r1", every_s=2.0)
+        fed2 = obs.FederatedLedger([led.store_root])
+        hb2 = obs.heartbeats(fed2, now=now)
+        assert hb2["r1"]["down"] is False
+        assert obs.fleet_findings(hb2, now=now) == []
+
+    def test_d013_respects_replicas_own_cadence(self, tmp_path):
+        # a slow-beat replica is judged against ITS advertised every_s
+        now = time.time()
+        led = ledger_mod.Ledger(str(tmp_path / "a"))
+        _heartbeat(led, now - 10.0, "slow", every_s=30.0)
+        hb = obs.heartbeats(obs.FederatedLedger([led.store_root]), now=now)
+        assert hb["slow"]["down"] is False
+
+    def test_never_beaten_root_is_unknown_not_down(self, tmp_path):
+        led = ledger_mod.Ledger(str(tmp_path / "quiet"))
+        _bank(led, "run", 1.0)
+        hb = obs.heartbeats(obs.FederatedLedger([led.store_root]))
+        assert hb["quiet"]["down"] is None
+        assert obs.fleet_findings(hb) == []
+
+    def _two_live(self, tmp_path, now, **kw_b):
+        a = ledger_mod.Ledger(str(tmp_path / "a"))
+        b = ledger_mod.Ledger(str(tmp_path / "b"))
+        _heartbeat(a, now - 0.5, "r1", served=20, warm_rate=0.9,
+                   warm_buckets=("b0",))
+        _heartbeat(b, now - 0.5, "r2",
+                   **{"served": 20, "warm_rate": 0.9,
+                      "warm_buckets": ("b0",), **kw_b})
+        fed = obs.FederatedLedger([a.store_root, b.store_root])
+        return obs.heartbeats(fed, now=now)
+
+    def test_d014_load_skew(self, tmp_path):
+        now = time.time()
+        hb = self._two_live(tmp_path, now, served=2)
+        rules = [f["rule"] for f in obs.fleet_findings(hb, now=now)]
+        assert "D014" in rules and "D013" not in rules
+
+    def test_d014_warm_rate_skew(self, tmp_path):
+        now = time.time()
+        hb = self._two_live(tmp_path, now, warm_rate=0.1)
+        found = [f for f in obs.fleet_findings(hb, now=now)
+                 if f["rule"] == "D014"]
+        assert found and "warm-rate" in found[0]["summary"]
+
+    def test_d015_divergence(self, tmp_path):
+        now = time.time()
+        hb = self._two_live(tmp_path, now, warm_buckets=("b1",))
+        found = [f for f in obs.fleet_findings(hb, now=now)
+                 if f["rule"] == "D015"]
+        assert len(found) == 2  # b0 cold on r2, b1 cold on r1
+        assert all(f["severity"] == "info" for f in found)
+
+    def test_balanced_fleet_is_quiet(self, tmp_path):
+        now = time.time()
+        hb = self._two_live(tmp_path, now)
+        assert obs.fleet_findings(hb, now=now) == []
+
+    def test_rules_are_in_doctor_catalog(self):
+        from jepsen_tpu import doctor
+        for r in ("D013", "D014", "D015"):
+            assert r in doctor.RULES
+            assert r not in doctor.LOCAL_RULES
+
+
+# --- the snapshot + CLI + lint surfaces -------------------------------------
+
+class TestSnapshotSurfaces:
+    def test_snapshot_shape_and_lint(self, tmp_path):
+        now = time.time()
+        led = ledger_mod.Ledger(str(tmp_path / "a"))
+        _heartbeat(led, now - 0.5, "r1")
+        _request(led, now - 2.0)
+        snap = obs.fleet_snapshot([led.store_root], now=now)
+        assert snap["schema"] == 1
+        assert snap["live"] == 1 and snap["down"] == []
+        assert snap["requests"] == 1
+        assert snap["rules_evaluated"] == ["D013", "D014", "D015"]
+        json.dumps(snap, default=str)  # JSON-able end to end
+        # every banked record (heartbeat included) lints clean
+        assert telemetry_lint.lint_ledger_file(led.index_path) == []
+
+    def test_fleet_series_point_lints(self, tmp_path):
+        from jepsen_tpu import metrics as metrics_mod
+        led = ledger_mod.Ledger(str(tmp_path / "a"))
+        _heartbeat(led, time.time(), "r1")
+        mx = metrics_mod.Registry(enabled=True)
+        obs.fleet_snapshot([led.store_root], mx=mx)
+        path = str(tmp_path / "m.jsonl")
+        mx.export_jsonl(path)
+        assert telemetry_lint.lint_jsonl_file(path) == []
+        pts = [p for p in mx.series("fleet").points]
+        assert pts and pts[-1]["replicas"] == 1
+
+    def test_cli_paths(self, tmp_path, capsys):
+        led = ledger_mod.Ledger(str(tmp_path / "a"))
+        _heartbeat(led, time.time(), "r1")
+        assert obs.cli_main({"json": True}, [led.store_root]) == 0
+        snap = json.loads(capsys.readouterr().out)
+        assert snap["replicas"]["r1"]["root"] == led.store_root
+        assert obs.cli_main({}, [led.store_root]) == 0
+        assert "r1" in capsys.readouterr().out
+        # discovery walks the dir AND its parent's children — use a
+        # nest whose whole neighborhood is store-free
+        assert obs.cli_main(
+            {"discover": str(tmp_path / "none" / "empty")}, []) == 2
+        assert obs.cli_main({"journey": "nope"}, [led.store_root]) == 1
+
+    def test_web_fleet_json(self, tmp_path, monkeypatch):
+        from jepsen_tpu import web
+        led = ledger_mod.Ledger(str(tmp_path / "a"))
+        _heartbeat(led, time.time(), "r1")
+        monkeypatch.setenv(obs.FLEET_ROOTS_ENV, led.store_root)
+        web._FLEET_CACHE.clear()
+        snap = web._fleet_snapshot(led.store_root)
+        assert snap and "r1" in snap["replicas"]
+        body = web.render_fleet(led.store_root)
+        assert b"r1" in body and b"/fleet.json" in body
+
+
+# --- journeys + ordering over a real Service --------------------------------
+
+def _service(root, **kw):
+    kw.setdefault("workers", 1)
+    kw.setdefault("warm_ladder", False)
+    kw.setdefault("slo_every_s", 3600.0)
+    kw.setdefault("mesh_serving", False)
+    kw.setdefault("heartbeat_every_s", 0.0)  # beat by hand
+    kw.setdefault("replica_id", "test-rep")
+    return service_mod.Service(str(root), **kw)
+
+
+def _wait(svc, rid, timeout=120.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        info = svc.get(rid)
+        if info and info["state"] in ("done", "rejected"):
+            return info
+        time.sleep(0.02)
+    raise AssertionError(f"run {rid} never finished")
+
+
+class TestJourney:
+    def test_cross_file_journey_reassembles(self, tmp_path):
+        root = tmp_path / "store"
+        svc = _service(root).start()
+        try:
+            h = synth.cas_register_history(80, n_procs=4, seed=3)
+            rid = svc.submit({"model": "cas-register",
+                              "history": h})["id"]
+            _wait(svc, rid)
+            hb_id = svc._heartbeat_once()  # exports + banks the beat
+            assert hb_id is not None
+        finally:
+            svc.close()
+        fed = obs.FederatedLedger([str(root)])
+        doc = obs.journey(fed, rid)
+        assert doc["found"] and doc["complete"]
+        assert doc["replica"] == "test-rep"
+        kinds = {(h["type"], h["name"]) for h in doc["hops"]}
+        assert ("record", "service-request") in kinds
+        assert ("span", "admit") in kinds
+        assert ("span", "respond") in kinds
+        assert ("series", "service") in kinds
+        ts = [h["t"] for h in doc["hops"]]
+        assert ts == sorted(ts)
+        # unknown ids stay not-found, never half-assembled
+        miss = obs.journey(fed, "no-such-run")
+        assert not miss["found"] and miss["hops"] == []
+
+    def test_fleet_perfetto_one_pid_per_replica(self, tmp_path):
+        root = tmp_path / "store"
+        svc = _service(root).start()
+        try:
+            h = synth.cas_register_history(80, n_procs=4, seed=4)
+            rid = svc.submit({"model": "cas-register",
+                              "history": h})["id"]
+            _wait(svc, rid)
+            svc._heartbeat_once()
+        finally:
+            svc.close()
+        fed = obs.FederatedLedger([str(root)])
+        out = str(tmp_path / "fleet.json")
+        doc = obs.fleet_perfetto(fed, path=out)
+        events = doc["traceEvents"]
+        assert events
+        pids = {e["pid"] for e in events}
+        assert pids == {obs.REPLICA_PID_BASE}
+        names = [e for e in events
+                 if e.get("ph") == "M"
+                 and e.get("name") == "process_name"]
+        assert any("test-rep" in str(e["args"]["name"])
+                   for e in names)
+        with open(out) as fh:
+            assert json.load(fh)["traceEvents"]
+
+    def test_heartbeat_ordering_contract(self, tmp_path):
+        # satellite 3: the request's OWN record must hit the index
+        # before the served counter moves or the state flips — so a
+        # heartbeat claiming served=N can never be banked ahead of
+        # the N-th service-request record
+        root = tmp_path / "store"
+        svc = _service(root).start()
+        seen = {}
+        orig = svc.ledger.record
+
+        def spy(rec):
+            if rec.get("kind") == "service-request":
+                with svc._lock:
+                    seen["served_at_bank"] = svc._stats["served"]
+                info = svc.get(rec["id"])
+                seen["state_at_bank"] = info and info["state"]
+            return orig(rec)
+
+        svc.ledger.record = spy
+        try:
+            h = synth.cas_register_history(80, n_procs=4, seed=5)
+            rid = svc.submit({"model": "cas-register",
+                              "history": h})["id"]
+            _wait(svc, rid)
+            svc._heartbeat_once()
+        finally:
+            svc.close()
+        assert seen["served_at_bank"] == 0
+        assert seen["state_at_bank"] not in ("done", "rejected")
+        led = ledger_mod.Ledger(str(root))
+        hb = led.query(kind="replica-heartbeat")[-1]
+        assert hb["served"] == 1
+        assert telemetry_lint.lint_ledger_file(led.index_path) == []
+
+
+# --- quarantine persistence (satellite 1) -----------------------------------
+
+_RULE = autopilot_mod.PolicyRule(
+    rule="D001", action="warm_bucket", metric="recent_compiles",
+    description="test row")
+
+
+class TestQuarantinePersistence:
+    def _quarantine_one(self, led):
+        sup = autopilot_mod.Supervisor(autopilot_mod.Host(),
+                                       ledger=led)
+        sup._quarantine_rule(_RULE, time.time(), "ap-0001",
+                             reason="verify-failed")
+        assert "D001" in sup.quarantined()
+        return sup
+
+    def test_restart_rehydrates(self, tmp_path):
+        led = ledger_mod.Ledger(str(tmp_path / "s"))
+        self._quarantine_one(led)
+        sup2 = autopilot_mod.Supervisor(autopilot_mod.Host(),
+                                        ledger=led)
+        q = sup2.quarantined()
+        assert "D001" in q and q["D001"].get("restored") is True
+        assert telemetry_lint.lint_ledger_file(led.index_path) == []
+
+    def test_clear_is_durable(self, tmp_path):
+        led = ledger_mod.Ledger(str(tmp_path / "s"))
+        sup = self._quarantine_one(led)
+        assert sup.clear_quarantine() == ["D001"]
+        sup2 = autopilot_mod.Supervisor(autopilot_mod.Host(),
+                                        ledger=led)
+        assert sup2.quarantined() == {}
+
+    def test_env_escape_hatch_clears_durably(self, tmp_path,
+                                             monkeypatch):
+        led = ledger_mod.Ledger(str(tmp_path / "s"))
+        self._quarantine_one(led)
+        monkeypatch.setenv(autopilot_mod.CLEAR_QUARANTINE_ENV, "1")
+        sup2 = autopilot_mod.Supervisor(autopilot_mod.Host(),
+                                        ledger=led)
+        assert sup2.quarantined() == {}
+        # the discard was BANKED: the next restart (env unset) starts
+        # clean too
+        monkeypatch.delenv(autopilot_mod.CLEAR_QUARANTINE_ENV)
+        sup3 = autopilot_mod.Supervisor(autopilot_mod.Host(),
+                                        ledger=led)
+        assert sup3.quarantined() == {}
+
+    def test_no_ledger_stays_in_memory_only(self, tmp_path):
+        # unit-style Supervisors (no ledger, NULL default) keep the
+        # old per-run semantics — nothing to replay, nothing banked
+        sup = autopilot_mod.Supervisor(autopilot_mod.Host())
+        sup._quarantine_rule(_RULE, time.time(), "ap-0001",
+                             reason="verify-failed")
+        sup2 = autopilot_mod.Supervisor(autopilot_mod.Host())
+        assert sup2.quarantined() == {}

@@ -403,6 +403,6 @@ class TestDoctorRules:
         p = tmp_path / "d.jsonl"
         p.write_text(json.dumps(pt) + "\n")
         assert telemetry_lint.lint_jsonl_file(str(p)) == []
-        pt["rule"] = "D013"  # past the frozen catalog: drift
+        pt["rule"] = "D016"  # past the frozen catalog: drift
         p.write_text(json.dumps(pt) + "\n")
         assert telemetry_lint.lint_jsonl_file(str(p)) != []
