@@ -50,6 +50,8 @@ headline only), JEPSEN_TPU_BENCH_TOTAL_S (default 780, global wall
 budget — extra configs that would start too close to it are recorded
 as skipped; SIGTERM mid-run still emits the partial JSON line),
 JEPSEN_TPU_BENCH_KEYS / _PER_KEY (independent config, default 100x2000),
+JEPSEN_TPU_BENCH_ELLE_TXNS (sharded elle config size, default 2000 —
+CI-sized stand-in for the 100k fleet bucket),
 JEPSEN_TPU_BENCH_REGRESSION_X (default 1.5 — flag a config whose wall
 exceeds this multiple of its best same-platform prior round; the trend
 report lands in artifacts/telemetry/regressions.json +
@@ -404,10 +406,13 @@ def run_extras(budget: float, deadline: float) -> dict:
     from jepsen_tpu.elle import tpu as elle_tpu_mod
     from jepsen_tpu.ops import aot as aot_mod
 
-    def _warm_elle(hist, build_fn, **build_kw):
+    def _warm_elle(hist, build_fn, kernels=None, **build_kw):
         # split ops the same way the checkers do, build the tensors,
         # and backend-compile their shape bucket — ONE helper so the
-        # warm bucket can never drift from the measured shape
+        # warm bucket can never drift from the measured shape.
+        # `kernels` pins the compile set (the sharded config warms
+        # ("trim", "sharded") explicitly; the default lets the
+        # platform pick).
         try:
             oks = [op for op in hist
                    if op.is_ok and op.f in ("txn", None) and op.value]
@@ -416,7 +421,8 @@ def run_extras(budget: float, deadline: float) -> dict:
                      and op.value]
             tensors = build_fn(hist, oks, infos, **build_kw).tensors
             aot_mod.precompile_elle_closure(
-                elle_tpu_mod.shape_bucket_for(tensors))
+                elle_tpu_mod.shape_bucket_for(tensors),
+                kernels=kernels)
         except Exception:  # noqa: BLE001 — warm-up is best-effort;
             pass           # the measured run still decides correctly
 
@@ -514,6 +520,64 @@ def run_extras(budget: float, deadline: float) -> dict:
     _warm_elle(hist_a8, elle_build_mod.build_append,
                additional_graphs=("realtime",))
     run("elle_append_8k", None, None, checker=elle_append_8k, need=60)
+
+    # The fleet config: an env-scaled stand-in for the 100k bucket.
+    # JEPSEN_TPU_BENCH_ELLE_TXNS sizes it (default 2000, CI-sized;
+    # point it at 100_000 on a real fleet). The sharded engine is
+    # FORCED so the column-blocked closure runs even where the auto
+    # route keeps packed — on a one-chip fleet the force degrades to
+    # packed and the ratio reads ~1.0, which is itself the signal.
+    # Verdict/anomaly parity runs against host, and the packed row
+    # gives speedup_vs_packed. Warm-up stays outside the measured
+    # window like every other elle config.
+    n_elle = int(os.environ.get("JEPSEN_TPU_BENCH_ELLE_TXNS", "2000"))
+    hist_sh = synth.list_append_history(n_elle, n_procs=5, seed=7)
+
+    def elle_append_sharded():
+        from jepsen_tpu.elle import append as elle_append_mod
+        t0 = time.monotonic()
+        res = elle_append_mod.check(hist_sh,
+                                    additional_graphs=("realtime",),
+                                    cycle_backend="sharded")
+        dev_wall = time.monotonic() - t0
+        out = _elle_entry(res, hist_sh)
+        util = res.get("cycle-util") or {}
+        out["closure_row"] = {"verdict": res["valid?"],
+                              "wall_s": round(dev_wall, 2),
+                              "engine": res.get("cycle-engine"),
+                              "n_shards": util.get("n_shards"),
+                              "util": util}
+        t0 = time.monotonic()
+        res_p = elle_append_mod.check(hist_sh,
+                                      additional_graphs=("realtime",),
+                                      cycle_backend="packed")
+        packed_wall = time.monotonic() - t0
+        out["packed_row"] = {"verdict": res_p["valid?"],
+                             "wall_s": round(packed_wall, 2),
+                             "engine": res_p.get("cycle-engine")}
+        out["speedup_vs_packed"] = round(
+            packed_wall / max(dev_wall, 1e-9), 1)
+        t0 = time.monotonic()
+        res_h = elle_append_mod.check(hist_sh,
+                                      additional_graphs=("realtime",),
+                                      cycle_backend="host")
+        host_wall = time.monotonic() - t0
+        out["host_row"] = {"verdict": res_h["valid?"],
+                           "wall_s": round(host_wall, 2)}
+        out["speedup_vs_host"] = round(
+            host_wall / max(dev_wall, 1e-9), 1)
+        if (res["valid?"] != res_h["valid?"]
+                or res["valid?"] != res_p["valid?"]):
+            out["cause"] = (f"ENGINE DISAGREEMENT: sharded="
+                            f"{res['valid?']} packed={res_p['valid?']}"
+                            f" host={res_h['valid?']}")
+        return out
+
+    _warm_elle(hist_sh, elle_build_mod.build_append,
+               kernels=("trim", "sharded"),
+               additional_graphs=("realtime",))
+    run(f"elle_append_sharded_{n_elle}", None, None,
+        checker=elle_append_sharded, need=60)
 
     # independent 100 keys x 2k ops, batch-checked over the device mesh
     n_keys = int(os.environ.get("JEPSEN_TPU_BENCH_KEYS", "100"))

@@ -481,6 +481,62 @@ def plan_wgl(model=None, history=None, *, enc=None,
 # Elle: route + closure capacity plan
 # ---------------------------------------------------------------------------
 
+def _fleet_shards(w: int) -> tuple:
+    """(n_shards, assumed?) for the sharded closure's word-column
+    split, init-safe (planning must never trigger a backend init): an
+    explicit JEPSEN_TPU_ELLE_SHARDS pin wins; an ALREADY-initialized
+    backend is asked for its device count; otherwise one v5e host's 8
+    chips are ASSUMED — and labeled, so a report built before init
+    says which half of its bill is measured."""
+    import os
+
+    from ..parallel.mesh import word_shard_count
+    pin = os.environ.get("JEPSEN_TPU_ELLE_SHARDS")
+    if pin:
+        return word_shard_count(w, int(pin)), False
+    try:
+        from .. import devices as devices_mod
+        if devices_mod._backend_up():
+            import jax
+            return word_shard_count(w, len(jax.devices())), False
+    except Exception:  # noqa: BLE001 — fall through to the assumption
+        pass
+    return word_shard_count(w, 8), True
+
+
+def plan_elle_sharded(*, n_txns: int, n_shards: Optional[int] = None,
+                      platform: Optional[str] = None) -> dict:
+    """The mesh-sharded closure's plan node for `n_txns`: shard count
+    (from the fleet unless pinned), per-shard live bytes — ONE
+    gathered row-set copy plus 2/n_shards writable column blocks, the
+    exact working set of elle/tpu.cycle_queries_sharded — and the
+    all_gather bytes each squaring iteration moves. Pure host
+    arithmetic; `platform` is accepted for symmetry with the other
+    planners but the bill is shape-only."""
+    import math
+
+    from ..elle import tpu as elle_tpu
+
+    n = int(n_txns)
+    n_sub = len(elle_tpu.SUBSETS)
+    n_pad = elle_tpu._round_up(
+        max(elle_tpu._bucket(max(n, 2)), n + 2), 128)
+    iters = max(1, math.ceil(math.log2(max(n_pad, 2))))
+    assumed = False
+    if n_shards is None:
+        n_shards, assumed = _fleet_shards(n_pad // 32)
+    ns = max(1, int(n_shards))
+    bitset = n_sub * n_pad * (n_pad // 32) * 4
+    per_shard = int(bitset * (1.0 + 2.0 / ns))
+    return {"kernel": "sharded", "n_pad": n_pad, "iters": iters,
+            "n_shards": ns, "shards_assumed": assumed,
+            "shard_words": (n_pad // 32) // ns,
+            "per_shard_bytes": per_shard,
+            "gather_bytes_per_iter": int(bitset),
+            "hbm_bytes": per_shard,
+            "capacity": elle_tpu.SHARDED_MAX_N}
+
+
 def plan_elle(*, n_txns: int, edges: Optional[int] = None,
               rw_edges: Optional[int] = None, backend: str = "auto",
               platform: Optional[str] = None,
@@ -488,9 +544,14 @@ def plan_elle(*, n_txns: int, edges: Optional[int] = None,
     """Enumerate the cycle-engine plan an Elle check over `n_txns`
     graph nodes would take: the `ops/route.elle_cycle_route` decision
     (when `backend="auto"`), the kernel the shape selector would pick
-    (trim on cpu-XLA, bf16-vs-packed by cost on an accelerator), the
-    closure's padded shapes and peak live bytes, and the capacity
-    rules that fire. Edge counts default to the append-builder's
+    (trim on cpu-XLA, bf16-vs-packed-vs-sharded by cost on an
+    accelerator), the closure's padded shapes and peak live bytes,
+    and the capacity rules that fire. Past a single-chip cap the plan
+    carries a `plan_elle_sharded` node (n_shards, per-shard bytes,
+    all_gather bytes per iteration): when the fleet and the per-shard
+    bill allow, P002 fires as a DEGRADE onto the sharded kernel
+    instead of rejecting — `dense_100k` becomes degrade(sharded) on
+    any fleet with >= 2 word shards. Edge counts default to the append-builder's
     typical density (~4 edges and ~1 rw edge per txn), labeled as
     estimates. Pure host arithmetic: no graph build, no backend
     compile, no device byte."""
@@ -511,6 +572,10 @@ def plan_elle(*, n_txns: int, edges: Optional[int] = None,
     from ..elle import tpu as elle_tpu
     packed_cap = elle_tpu.PACKED_MAX_N
     bf16_cap = elle_tpu.DEFAULT_MAX_N
+    sharded_cap = elle_tpu.SHARDED_MAX_N
+    n_pad = elle_tpu._round_up(
+        max(elle_tpu._bucket(max(n, 2)), n + 2), 128)
+    n_shards, shards_assumed = _fleet_shards(n_pad // 32)
 
     engine = backend
     route_reason = None
@@ -518,7 +583,8 @@ def plan_elle(*, n_txns: int, edges: Optional[int] = None,
         device_ok = importlib.util.find_spec("jax") is not None
         engine, route_reason = elle_cycle_route(
             n=n, e=e, rw_edges=rw, accel=accel, device_ok=device_ok,
-            packed_cap=packed_cap)
+            packed_cap=packed_cap, sharded_cap=sharded_cap,
+            n_shards=n_shards)
 
     if engine in ("host", "host-fallback"):
         verdict, suggestion = _verdict(rules)
@@ -533,13 +599,26 @@ def plan_elle(*, n_txns: int, edges: Optional[int] = None,
                 "suggestion": suggestion}
 
     # -- kernel selection (mirror device_cycle_search) ------------------
-    forced = backend in ("tpu", "packed", "trim")
+    forced = backend in ("tpu", "packed", "trim", "sharded")
     if forced:
         kernel = "bf16" if backend == "tpu" else backend
         sel = {"why": f"forced {kernel}"}
+    elif engine == "sharded":
+        # the router pinned the kernel: only the sharded layout holds
+        # the bitset at this n
+        kernel, sel = "sharded", {"why": route_reason}
     elif accel:
         if lower:
             kernel, sel = elle_tpu._squaring_select(n)
+        elif n > packed_cap:
+            if n <= sharded_cap and n_shards >= 2:
+                kernel, sel = "sharded", {
+                    "why": f"n {n} > packed cap {packed_cap}; "
+                           f"{n_shards}-shard word columns (static)"}
+            else:
+                kernel, sel = "packed", {
+                    "why": f"n {n} > packed cap {packed_cap} and no "
+                           f"shardable fleet ({n_shards} shards)"}
         elif n > bf16_cap:
             kernel, sel = "packed", {
                 "why": f"n {n} > bf16 cap {bf16_cap}"}
@@ -552,29 +631,83 @@ def plan_elle(*, n_txns: int, edges: Optional[int] = None,
 
     # -- padded shapes + capacity + bytes -------------------------------
     n_sub = len(elle_tpu.SUBSETS)
-    n_pad = elle_tpu._round_up(
-        max(elle_tpu._bucket(max(n, 2)), n + 2), 128)
     iters = max(1, math.ceil(math.log2(max(n_pad, 2))))
-    cap = bf16_cap if kernel == "bf16" else packed_cap
+    cap = {"bf16": bf16_cap,
+           "sharded": sharded_cap}.get(kernel, packed_cap)
+    budget = device_memory_budget(plat)
+    orig_kernel, orig_cap = kernel, cap
+    sharded_node = None
+    if kernel == "sharded" or n > cap:
+        sharded_node = plan_elle_sharded(n_txns=n, n_shards=n_shards,
+                                         platform=plat)
+        sharded_node["shards_assumed"] = shards_assumed
     if n > cap:
-        rules.append(_rule(
-            "P002", f"n {n} over the {kernel} closure capacity {cap}",
-            suggestion="host Tarjan/BFS, or shard the bitset words "
-                       "across the mesh (ROADMAP item 3)"))
+        # past a single-chip cap the mesh-sharded layout is the one
+        # dense remedy: degrade onto it when the fleet and its
+        # per-shard bill allow, reject naming it when they don't
+        # only kernels whose executed path falls through to the
+        # sharded closure may degrade onto it (packed and trim do;
+        # a forced bf16 request host-falls-back instead)
+        fits = (kernel in ("packed", "trim") and n <= sharded_cap
+                and n_shards >= 2
+                and sharded_node["per_shard_bytes"] <= budget)
+        if fits:
+            rules.append(_rule(
+                "P002",
+                f"n {n} over the {kernel} closure capacity {cap}: "
+                f"degrading to the mesh-sharded closure "
+                f"({n_shards} word-column shards"
+                f"{', assumed fleet' if shards_assumed else ''}, "
+                f"{sharded_node['per_shard_bytes'] / 1e9:.2f} GB "
+                f"per shard)",
+                suggestion="sharded closure selected "
+                           "(backend=\"sharded\" pins it); widen "
+                           "the fleet for smaller shards",
+                severity="degrade"))
+            kernel = "sharded"
+            cap = sharded_cap
+            sel = {"why": f"degrade(sharded): {sel.get('why')}",
+                   "n_shards": n_shards}
+        elif kernel == "sharded":
+            rules.append(_rule(
+                "P002",
+                f"n {n} over the sharded closure capacity {cap}: "
+                "past it the gathered row set alone blows a chip",
+                suggestion="host Tarjan/BFS (chunked closure is "
+                           "ROADMAP item 4's 1M residue)"))
+        else:
+            why_not = (f"n {n} over the sharded cap {sharded_cap}"
+                       if n > sharded_cap else
+                       f"fleet yields only {n_shards} word shard(s)"
+                       if n_shards < 2 else
+                       f"per-shard "
+                       f"{sharded_node['per_shard_bytes'] / 1e9:.2f}"
+                       f" GB over the "
+                       f"{budget / 1e9:.2f} GB budget")
+            rules.append(_rule(
+                "P002",
+                f"n {n} over the {kernel} closure capacity {cap} "
+                f"and the mesh-sharded remedy does not hold it "
+                f"({why_not})",
+                suggestion="host Tarjan/BFS, or widen the fleet so "
+                           "the sharded word columns fit "
+                           "(backend=\"sharded\")"))
     if kernel == "bf16":
         cell = 2.0            # bf16
     elif kernel == "packed":
         cell = 1.0 / 8.0      # one bit per pair, uint32 words
     else:
-        cell = 0.0            # trim never materializes N^2
-    if cell:
+        cell = 0.0            # trim/sharded: billed below
+    if kernel == "sharded":
+        # per-shard bill: the gather buffer + 2/n_shards local blocks
+        hbm = sharded_node["per_shard_bytes"]
+    elif cell:
         hbm = int(CLOSURE_LIVE_FACTOR * n_sub * n_pad * n_pad * cell)
     else:
         # trim: padded neighbor gathers, O((E + N) x S)
         n_pad_t = elle_tpu._round_up(elle_tpu._bucket(max(n, 2)), 128)
         d_est = elle_tpu._bucket(max(4, (2 * e) // max(n, 1)))
         hbm = int(3 * n_pad_t * d_est * n_sub * 4)
-    budget = device_memory_budget(plat)
     if hbm > budget:
         if backend == "auto":
             # the router said device but the cost side disagrees —
@@ -591,12 +724,34 @@ def plan_elle(*, n_txns: int, edges: Optional[int] = None,
             # included: device_cycle_search runs whatever kernel the
             # shape selector picks) — an over-budget closure would
             # OOM, so reject it statically
+            per = " per shard" if kernel == "sharded" else ""
             rules.append(_rule(
-                "P001", f"{kernel} closure peak {hbm / 1e9:.2f} GB "
-                        f"exceeds the {budget / 1e9:.2f} GB device "
-                        "budget",
-                suggestion="host Tarjan/BFS, or shard/chunk the "
-                           "closure through HBM (ROADMAP item 3)"))
+                "P001", f"{kernel} closure peak {hbm / 1e9:.2f} GB"
+                        f"{per} exceeds the {budget / 1e9:.2f} GB "
+                        "device budget",
+                suggestion="host Tarjan/BFS, or widen the fleet so "
+                           "the sharded word columns fit "
+                           "(backend=\"sharded\")"
+                if kernel == "sharded" else
+                "host Tarjan/BFS, or shard the bitset words across "
+                "the mesh (backend=\"sharded\")"))
+
+    if kernel == "sharded":
+        # a degrade keeps the rejected single-chip node in the plan
+        # beside its sharded remedy (its bill is what P002 priced);
+        # a routed/forced sharded pick plans the one node it runs
+        plan = ([{"kernel": orig_kernel,
+                  "n_pad": n_pad, "iters": iters,
+                  "hbm_bytes": int(CLOSURE_LIVE_FACTOR * n_sub
+                                   * n_pad * n_pad
+                                   * (2.0 if orig_kernel == "bf16"
+                                      else 0.125)),
+                  "capacity": orig_cap}, sharded_node]
+                if orig_kernel != "sharded"
+                else [sharded_node])
+    else:
+        plan = [{"kernel": kernel, "n_pad": n_pad, "iters": iters,
+                 "hbm_bytes": hbm, "capacity": cap}]
 
     verdict, suggestion = _verdict(rules)
     return {
@@ -604,10 +759,11 @@ def plan_elle(*, n_txns: int, edges: Optional[int] = None,
         "engine": "device", "backend": backend,
         "route": {"engine": "device", "reason": route_reason},
         "shapes": {"n": n, "e": e, "rw": rw, "n_pad": n_pad,
-                   "iters": iters, "estimated": estimated},
+                   "iters": iters, "estimated": estimated,
+                   "n_shards": n_shards,
+                   "shards_assumed": shards_assumed},
         "kernel": kernel, "select": sel,
-        "plan": [{"kernel": kernel, "n_pad": n_pad, "iters": iters,
-                  "hbm_bytes": hbm, "capacity": cap}],
+        "plan": plan,
         "hbm": {"peak_bytes": hbm, "budget_bytes": budget},
         "rules": rules, "verdict": verdict, "suggestion": suggestion,
     }
@@ -1046,10 +1202,13 @@ def _cli_elle(n_txns: int, execute: bool) -> dict:
 
 
 def _cli_dense_100k() -> dict:
-    """The synthetic oversized request: a 100k-txn dense closure,
-    rejected statically — zero graph build, zero backend compiles,
-    zero device execution (the smoke proves it under a CompileGuard
-    zero-compile budget)."""
+    """The synthetic oversized request: a 100k-txn dense closure.
+    Statically — zero graph build, zero backend compiles, zero device
+    execution (the smoke proves it under a CompileGuard zero-compile
+    budget) — the single-chip bill is rejected and the plan DEGRADES
+    onto the mesh-sharded column layout whenever the fleet yields
+    >= 2 word shards whose per-shard bill fits the budget; with no
+    shardable fleet the old infeasible verdict stands."""
     rep = plan_elle(n_txns=100_000, backend="packed")
     _register(rep, "cli.dense_100k", ledger_name="preflight-dense-100k")
     return {"report": rep}
@@ -1061,7 +1220,7 @@ def _engines_match(rep: dict, res: dict) -> bool:
     if planned == "host":
         return ran in ("host", "host-fallback")
     kernel = (res.get("util") or {}).get("kernel")
-    return ran in ("device", "tpu", "trim", "packed") \
+    return ran in ("device", "tpu", "trim", "packed", "sharded") \
         and (rep.get("kernel") in (None, kernel))
 
 

@@ -732,45 +732,68 @@ def _obj(kvs: Interner, kv_id: int, INIT):
 def _wr_realtime_evidence(history, keys, kvs, INIT):
     """wr._realtime_evidence as columns: per key, the running
     latest-completed final value (strictly-max completion time, first
-    writer kept on ties) versus each op's first observation."""
+    writer kept on ties) versus each op's first observation.
+
+    The encode is element-row flat (the treatment append's encoder
+    got): ONE append per R/W micro-op — no per-op first/final dicts,
+    no Python sweep — then numpy does the rest: the sweep rank is a
+    stable argsort of invocation times, and each op's first/final
+    observation per key falls out of one lexsort over (pair, key,
+    mop-position) as the group's first/last element. Past ~1M
+    micro-ops the old per-op dict loop was the build's floor; this
+    keeps the wr evidence derivation on the vectorized path the rest
+    of the builder already runs."""
     pairs = [(inv, comp) for inv, comp in history.pairs()
              if comp is not None and comp.is_ok and comp.value]
     if not pairs:
         return None
     if not _times_ok([p[0] for p in pairs] + [p[1] for p in pairs]):
         raise BuildUnsupported("non-integer op times")
-    order = sorted(range(len(pairs)), key=lambda i: pairs[i][0].time)
-    rows_k, rows_i, rows_first, rows_final = [], [], [], []
-    rows_inv, rows_comp = [], []
-    for sweep_i, pi in enumerate(order):
-        inv, comp = pairs[pi]
-        first: dict = {}
-        final: dict = {}
-        for f, k, v in comp.value:
+    # flat element-row encode: the interner adds are the only Python
+    # left (ids must come from the build's shared Interner instances)
+    e_p, e_kid, e_kv, e_pos = [], [], [], []
+    inv_t = np.empty(len(pairs), np.int64)
+    comp_t = np.empty(len(pairs), np.int64)
+    kadd, vadd = keys.add, kvs.add
+    for p, (inv, comp) in enumerate(pairs):
+        inv_t[p] = inv.time
+        comp_t[p] = comp.time
+        for pos, (f, k, v) in enumerate(comp.value):
             if f == R:
-                cur = kvs.add((k, INIT)) if v is None else kvs.add((k, v))
+                cur = vadd((k, INIT)) if v is None else vadd((k, v))
             elif f == W:
-                cur = kvs.add((k, v))
+                cur = vadd((k, v))
             else:
                 continue
-            kid = keys.add(k)
-            first.setdefault(kid, cur)
-            final[kid] = cur
-        for kid in final:
-            rows_k.append(kid)
-            rows_i.append(sweep_i)
-            rows_first.append(first[kid])
-            rows_final.append(final[kid])
-            rows_inv.append(inv.time)
-            rows_comp.append(comp.time)
-    if not rows_k:
+            e_p.append(p)
+            e_kid.append(kadd(k))
+            e_kv.append(cur)
+            e_pos.append(pos)
+    if not e_p:
         return None
-    rk = np.asarray(rows_k, np.int64)
-    ri = np.asarray(rows_i, np.int64)
-    rf = np.asarray(rows_first, np.int64)
-    rl = np.asarray(rows_final, np.int64)
-    rt_inv = np.asarray(rows_inv, np.int64)
-    rt_comp = np.asarray(rows_comp, np.int64)
+    ep = np.asarray(e_p, np.int64)
+    ekid = np.asarray(e_kid, np.int64)
+    ekv = np.asarray(e_kv, np.int64)
+    epos = np.asarray(e_pos, np.int64)
+    # sweep rank = stable sort by invocation time (host sweep order)
+    order = np.argsort(inv_t, kind="stable")
+    sweep = np.empty(len(pairs), np.int64)
+    sweep[order] = np.arange(len(pairs))
+    # first/final observation per (op, key): group rows by
+    # (pair, key) in mop order; the group's first element is `first`,
+    # its last is `final` — exactly the old dicts, without them
+    o2 = np.lexsort((epos, ekid, ep))
+    p_s, kid_s, kv_s = ep[o2], ekid[o2], ekv[o2]
+    newgrp = np.r_[True, (p_s[1:] != p_s[:-1])
+                   | (kid_s[1:] != kid_s[:-1])]
+    last_idx = np.r_[np.flatnonzero(newgrp)[1:] - 1, len(p_s) - 1]
+    grp_p = p_s[newgrp]
+    rk = kid_s[newgrp]
+    ri = sweep[grp_p]
+    rf = kv_s[newgrp]
+    rl = kv_s[last_idx]
+    rt_inv = inv_t[grp_p]
+    rt_comp = comp_t[grp_p]
     n = len(rk)
     order2 = np.lexsort((ri, rk))
     k_s = rk[order2]

@@ -103,6 +103,15 @@ def _bucket(n: int) -> int:
     return max(1, 1 << (int(n) - 1).bit_length())
 
 
+def _n_pad_for(n: int) -> int:
+    """The dense kernels' shared row padding: pow2 bucket, room for
+    the two query-pad sentinels, rounded to the 128 sublane multiple.
+    One derivation for cycle_queries / cycle_queries_packed /
+    cycle_queries_sharded / shape_bucket_for — the sharded==packed
+    bit-identity contract needs all of them on the SAME n_pad."""
+    return _round_up(max(_bucket(max(n, 2)), n + 2), 128)
+
+
 def make_closure_kernel(n_pad: int, n_sub: int, iters: int, dtype):
     """The closure-by-squaring kernel as a plain traceable function —
     shared by the runtime path below and the AOT TPU-evidence path
@@ -325,6 +334,13 @@ def cycle_queries(g: DepGraph,
 
 
 PACKED_MAX_N = 32768
+
+# the column-sharded closure's own row cap: at 131072 the full packed
+# bitset is S * N^2 / 8 = 6.4 GB — one gathered copy per shard plus
+# 2/n_shards local blocks fits a 16 GiB chip from 4 shards up. Past
+# this even the gather buffer alone blows a v5e, so the cap is a row
+# count, not a fleet question.
+SHARDED_MAX_N = 131072
 
 
 def _hbm_mark():
@@ -606,6 +622,278 @@ def cycle_queries_packed(g, subsets: Sequence[frozenset] = SUBSETS,
             "rw_edges": rw_edges, "rw_closed": closed, "util": util}
 
 
+# -- sharded closure: word columns across the mesh --------------------------
+
+def make_sharded_closure_kernel(n_pad: int, n_sub: int, iters: int,
+                                n_shards: int, axis: str = "words"):
+    """make_packed_closure_kernel past single-chip HBM: the
+    (S, N, N/32) word-column axis is sharded across a 1-D device mesh
+    — each shard owns a contiguous block of W/n_shards word columns
+    and ONE `all_gather` per squaring iteration exchanges the row set
+    (the full packed reach), so every shard can test its rows'
+    out-neighbor bits over ALL columns while writing only its own
+    column block. Per-shard live bytes are the gather buffer plus two
+    local blocks =~ bitset * (1 + 2/n_shards), vs CLOSURE_LIVE_FACTOR
+    full copies unsharded — the bill preflight.plan_elle_sharded
+    reproduces.
+
+    Convergence is decided GLOBALLY: per-shard popcounts are
+    psum-reduced over the mesh axis before the repeat-count compare,
+    so every shard runs the identical trip count even when an
+    iteration only flips bits inside one shard's column block (a
+    per-shard compare would deadlock the collective schedule — the
+    cross-shard-cycle regression in tests/test_elle_sharded.py).
+    Outputs (labels, closed, counts, iters_run) are BIT-IDENTICAL to
+    the unsharded packed kernel's: same n_pad, same 32-column block
+    schedule, same popcount convergence — pinned by the CI elle
+    smoke's sharded==packed section."""
+    import jax
+    import jax.numpy as jnp
+
+    W = n_pad // 32
+    if W % n_shards:
+        raise ValueError(f"W {W} not divisible by {n_shards} shards")
+    w_loc = W // n_shards
+    word_idx = np.arange(n_pad, dtype=np.int32) // 32
+    bit_idx = (np.arange(n_pad, dtype=np.int32) % 32).astype(np.uint32)
+
+    def kernel(r_loc, q_src, q_dst):
+        counts0 = jnp.zeros((iters, n_sub), jnp.int32)
+
+        def square(r):
+            # the ONE collective per squaring iteration: every shard
+            # rematerializes the full row set to enumerate j-bits
+            full = jax.lax.all_gather(r, axis, axis=2, tiled=True)
+
+            def blk(acc, jb):
+                rows_j = jax.lax.dynamic_slice(
+                    r, (0, jb * 32, 0), (n_sub, 32, w_loc))
+                word_i = jax.lax.dynamic_slice(
+                    full, (0, 0, jb), (n_sub, n_pad, 1))[..., 0]
+                # intentional bounded unroll: exactly the 32 bits
+                # of one packed word per block
+                for k in range(32):  # jaxlint: ok(J006)
+                    bit = (word_i >> jnp.uint32(k)) & jnp.uint32(1)
+                    acc = acc | (bit[:, :, None]
+                                 * rows_j[:, k][:, None, :])
+                return acc, None
+            out, _ = jax.lax.scan(blk, jnp.zeros_like(r),
+                                  jnp.arange(W))
+            return out
+
+        def cond(st):
+            _, _, i, changed = st
+            return (i < iters) & changed
+
+        def step(st):
+            r, cnt, i, _ = st
+            r2 = square(r)
+            c_loc = jnp.sum(
+                jax.lax.population_count(r2).astype(jnp.int32),
+                axis=(1, 2))
+            # the early-exit must compare GLOBAL reach counts: a
+            # per-shard compare would let a shard whose column block
+            # went quiet leave the loop while a neighbor still grows
+            # bits — divergent trip counts under a collective
+            c = jax.lax.psum(c_loc, axis)
+            prev = jnp.where(i > 0, cnt[jnp.maximum(i - 1, 0)],
+                             jnp.full((n_sub,), -1, jnp.int32))
+            cnt = cnt.at[i].set(c)
+            return r2, cnt, i + 1, jnp.any(c != prev)
+
+        reach_loc, counts, iters_run, _ = jax.lax.while_loop(
+            cond, step, (r_loc, counts0, jnp.int32(0),
+                         jnp.asarray(True)))
+
+        # labels + rw answers need the FULL closure: one final gather,
+        # then the packed kernel's label scan verbatim — replicated
+        # work on every shard, identical inputs -> identical outputs
+        reach = jax.lax.all_gather(reach_loc, axis, axis=2,
+                                   tiled=True)
+        cols32 = jnp.arange(32, dtype=jnp.int32)
+
+        def lab_blk(lab, jb):
+            bits_ij = (jax.lax.dynamic_slice(
+                reach, (0, 0, jb), (n_sub, n_pad, 1))[..., 0][:, :, None]
+                >> cols32[None, None, :].astype(jnp.uint32)) \
+                & jnp.uint32(1)                          # (S, N, 32)
+            rows_j = jax.lax.dynamic_slice(
+                reach, (0, jb * 32, 0), (n_sub, 32, W))  # (S, 32, W)
+            bits_ji = (jnp.take(rows_j, jnp.asarray(word_idx), axis=2)
+                       >> bit_idx[None, None, :]) & jnp.uint32(1)
+            mutual = (bits_ij & jnp.moveaxis(bits_ji, 1, 2)) > 0
+            jcol = jb * 32 + cols32
+            cand = jnp.min(jnp.where(mutual, jcol[None, None, :],
+                                     n_pad), axis=2)
+            return jnp.minimum(lab, cand), None
+
+        labels, _ = jax.lax.scan(
+            lab_blk, jnp.full((n_sub, n_pad), n_pad, jnp.int32),
+            jnp.arange(W))
+
+        words = reach[:, q_dst, q_src // 32]             # (S, Q)
+        closed = ((words >> (q_src % 32).astype(jnp.uint32))
+                  & jnp.uint32(1)) > 0
+        return labels, closed, counts, iters_run
+
+    return kernel
+
+
+@lru_cache(maxsize=16)
+def _compiled_sharded(n_pad: int, q_pad: int, n_sub: int, iters: int,
+                      n_shards: int):
+    """AOT-compiled sharded closure: the shard_map program plus the
+    mesh it is laid out over, so the runtime path and the AOT warm
+    path (aot.precompile_elle_closure) hit ONE executable per
+    (shape, shard count) bucket — the zero-recompile warm contract."""
+    import time as _t
+
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from ..parallel.mesh import words_mesh
+
+    mesh = words_mesh(n_shards)
+    kernel = make_sharded_closure_kernel(n_pad, n_sub, iters, n_shards)
+    spec_r = PartitionSpec(None, None, "words")
+    spec_0 = PartitionSpec()
+    sharded = shard_map(kernel, mesh=mesh,
+                        in_specs=(spec_r, spec_0, spec_0),
+                        out_specs=(spec_0, spec_0, spec_0, spec_0),
+                        check_rep=False)
+    specs = (jax.ShapeDtypeStruct((n_sub, n_pad, n_pad // 32),
+                                  jnp.uint32,
+                                  sharding=NamedSharding(mesh, spec_r)),
+             jax.ShapeDtypeStruct((q_pad,), jnp.int32,
+                                  sharding=NamedSharding(mesh, spec_0)),
+             jax.ShapeDtypeStruct((q_pad,), jnp.int32,
+                                  sharding=NamedSharding(mesh, spec_0)))
+    t0 = _t.monotonic()
+    compiled = jax.jit(sharded).lower(*specs).compile()
+    return compiled, mesh, _t.monotonic() - t0
+
+
+def cycle_queries_sharded(g, subsets: Sequence[frozenset] = SUBSETS,
+                          rw_type: int = RW,
+                          max_n: int = SHARDED_MAX_N,
+                          n_shards: Optional[int] = None
+                          ) -> Optional[dict]:
+    """cycle_queries_packed past single-chip HBM: same host-assembled
+    packed r0, same result envelope, word columns sharded across the
+    "words" mesh. Each device receives ONLY its column block
+    (device_put against the mesh sharding — the full bitset never
+    lives on one chip), and per-shard HBM is billed up front by
+    preflight.plan_elle_sharded. Returns None over capacity or when
+    the fleet yields fewer than 2 shards (the caller falls back to
+    packed/host); pass n_shards explicitly to pin a layout — tests
+    pin n_shards=1 to run this path on a single device."""
+    nodes, n, src, dst, w, q_src, q_dst, rw_edges = \
+        _graph_arrays(g, subsets, rw_type)
+    if n > max_n:
+        return None
+    n_sub = len(subsets)
+    n_pad = _n_pad_for(n)
+    Wn = n_pad // 32
+    forced = n_shards is not None
+    if n_shards is None:
+        from ..parallel.mesh import word_shard_count
+        n_shards = word_shard_count(Wn)
+    if n_shards < 1 or Wn % n_shards \
+            or (n_shards < 2 and not forced):
+        return None
+
+    r0 = np.zeros((n_sub, n_pad, Wn), np.uint32)
+    eye = np.arange(n_pad)
+    np.bitwise_or.at(r0, (slice(None), eye, eye // 32),
+                     np.uint32(1) << (eye % 32).astype(np.uint32))
+    for si in range(n_sub):
+        m = w[si] > 0
+        if m.any():
+            np.bitwise_or.at(
+                r0[si], (src[m], dst[m] // 32),
+                np.uint32(1) << (dst[m] % 32).astype(np.uint32))
+
+    q_pad = _bucket(max(len(q_src), 1))
+
+    def pad(a, size, fill):
+        out = np.full(size, fill, np.int32)
+        out[:len(a)] = a
+        return out
+
+    q_src_p = pad(q_src, q_pad, n_pad - 1)
+    q_dst_p = pad(q_dst, q_pad, n_pad - 2)
+    iters = max(1, math.ceil(math.log2(n_pad)))
+    kernel, mesh, compile_s = _compiled_sharded(
+        n_pad, q_pad, n_sub, iters, n_shards)
+
+    import time as _t
+
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from ..analysis import guards as _guards
+    from .. import watchdog as _watchdog
+    t0 = _t.monotonic()
+    _guards.note_transfer("h2d", r0.nbytes + q_src_p.nbytes
+                          + q_dst_p.nbytes,
+                          what="elle-closure-inputs")
+    # pre-sharded placement: each device holds its 1/n_shards column
+    # block; only the kernel's all_gather ever materializes the full
+    # row set, and only transiently inside the squaring loop
+    r0_d = jax.device_put(r0, NamedSharding(
+        mesh, PartitionSpec(None, None, "words")))
+    qs_d = jax.device_put(q_src_p,
+                          NamedSharding(mesh, PartitionSpec()))
+    qd_d = jax.device_put(q_dst_p,
+                          NamedSharding(mesh, PartitionSpec()))
+    wd = _watchdog.get_default()
+    dm, dmark = _hbm_mark()
+    with wd.watch("elle-closure", device="tpu", stall_s=300.0) as hb:
+        wd.beat(hb, edges=int(len(src)), n=n, n_pad=n_pad,
+                iters=iters, kernel="sharded", n_shards=n_shards)
+        labels, closed, iter_counts, iters_run = kernel(
+            r0_d, qs_d, qd_d)
+        jax.block_until_ready((labels, closed, iter_counts, iters_run))
+    kernel_s = _t.monotonic() - t0
+    iters_run = max(1, int(iters_run))
+    iter_counts = np.asarray(iter_counts)[:iters_run]
+    iter_reach = [[int(v) for v in row] for row in iter_counts]
+    widest = iter_counts[:, -1]
+    converged_at = int(iters_run)
+    for i in range(1, iters_run):
+        if widest[i] == widest[i - 1]:
+            converged_at = i
+            break
+    gops = 2.0 * n_sub * iters_run * float(n_pad) ** 2 * Wn / 1e9
+    util = {"kernel": "sharded", "n_pad": n_pad, "iters": iters,
+            "iters_run": iters_run,
+            "iters_reclaimed": int(iters) - iters_run,
+            "n_shards": int(n_shards),
+            "shard_words": Wn // n_shards,
+            "gather_bytes": int(r0.nbytes),
+            "per_shard_bytes": int(r0.nbytes
+                                   + 2 * r0.nbytes // n_shards),
+            "kernel_s": round(kernel_s, 4),
+            "compile_s": round(compile_s, 3),
+            "achieved_gops": round(gops / max(kernel_s, 1e-9), 2),
+            "closure_bytes": int(r0.nbytes),
+            "iter_reach": iter_reach,
+            "converged_at": converged_at,
+            "reach_density": round(
+                float(widest[-1]) / float(n_pad) ** 2, 6)}
+    _hbm_close(util, dm, dmark)
+    _record_closure(util, len(src), n)
+    labels = np.asarray(labels)[:, :n]
+    closed = np.asarray(closed)[:, :len(rw_edges)]
+    _guards.note_transfer("d2h", labels.nbytes + closed.nbytes
+                          + iter_counts.nbytes,
+                          what="elle-closure-outputs")
+    return {"sccs": _sccs_from_labels(labels, nodes, n, len(subsets)),
+            "rw_edges": rw_edges, "rw_closed": closed, "util": util}
+
+
 # -- trim closure: peel-to-core cycle detection + interval jumps ------------
 
 def make_trim_kernel(n_pad: int, d_in: int, d_out: int, n_sub: int,
@@ -626,12 +914,25 @@ def make_trim_kernel(n_pad: int, d_in: int, d_out: int, n_sub: int,
         below its inv_evt — per-subset min/argmin plus masked
         second-min scalars (second-min so a zero-duration op whose
         completion event precedes its own invocation cannot keep
-        itself alive);
+        itself alive). The threshold pool is ANCHORED: only live
+        nodes that already have non-realtime in-support (edge or
+        process), plus inverted ops (comp < inv — the self-support
+        hazard), contribute their comp to the in-threshold
+        (symmetrically their inv to the out-threshold). Among normal
+        ops a transitive realtime-support chain descends strictly in
+        comp (comp_k < inv_j <= comp_j) and so terminates at an
+        anchored or inverted node — the anchored rule has EXACTLY the
+        same greatest fixpoint as pooling over all live nodes, but a
+        realtime-only chain that the all-live pool peels one node per
+        round collapses in a single round: round count drops from
+        O(realtime span) to the edge-peel depth, O(log N) on the
+        long-span adversarial corpora (tests/test_elle_tpu.py pins
+        both the parity and the round bound);
       * process chains via per-process segment-min/max positions
         (strict compares, so self never qualifies).
 
     Work per round is O((E + N) x S) elementwise — no O(N^2)
-    anywhere — and rounds are bounded by the peel depth (~N /
+    anywhere — and rounds are bounded by the edge-peel depth (~N /
     concurrency width for real histories; the safety bound is n_pad).
     Valid histories end with EMPTY cores: the device verdict alone
     answers all four queries and the host never builds a DepGraph; a
@@ -649,25 +950,6 @@ def make_trim_kernel(n_pad: int, d_in: int, d_out: int, n_sub: int,
         def peel(live):
             has_in = jnp.any(live[in_neigh, :] & in_mask, axis=1)
             has_out = jnp.any(live[out_neigh, :] & out_mask, axis=1)
-            if use_rt:
-                comp_live = jnp.where(live, comp_e[:, None], BIGI)
-                minc1 = jnp.min(comp_live, axis=0)
-                minc_at = jnp.argmin(comp_live, axis=0)
-                minc2 = jnp.min(
-                    jnp.where(rows == minc_at[None, :], BIGI,
-                              comp_live), axis=0)
-                inv_live = jnp.where(live, inv_e[:, None], -BIGI)
-                maxi1 = jnp.max(inv_live, axis=0)
-                maxi_at = jnp.argmax(inv_live, axis=0)
-                maxi2 = jnp.max(
-                    jnp.where(rows == maxi_at[None, :], -BIGI,
-                              inv_live), axis=0)
-                in_thr = jnp.where(rows == minc_at[None, :],
-                                   minc2[None, :], minc1[None, :])
-                out_thr = jnp.where(rows == maxi_at[None, :],
-                                    maxi2[None, :], maxi1[None, :])
-                has_in = has_in | (inv_e[:, None] > in_thr)
-                has_out = has_out | (comp_e[:, None] < out_thr)
             if use_proc:
                 pp_in = jnp.where(live, ppos[:, None], BIGI)
                 minpp = jax.ops.segment_min(pp_in, proc,
@@ -678,6 +960,36 @@ def make_trim_kernel(n_pad: int, d_in: int, d_out: int, n_sub: int,
                 has_in = has_in | (ppos[:, None] > minpp[proc, :])
                 has_out = has_out | ((ppos[:, None] < maxpp[proc, :])
                                      & (ppos[:, None] >= 0))
+            if use_rt:
+                # anchored threshold pool (the interval scan): only
+                # nodes with non-realtime support this round — plus
+                # inverted ops, which could support themselves — can
+                # anchor a realtime chain. Same fixpoint as pooling
+                # over ALL live nodes (transitive rt support among
+                # normal ops descends strictly in comp and lands on
+                # an anchor), but whole rt chains peel per round
+                # instead of one node per round.
+                inverted = (comp_e < inv_e)[:, None]
+                pool_in = live & (has_in | inverted)
+                comp_pool = jnp.where(pool_in, comp_e[:, None], BIGI)
+                minc1 = jnp.min(comp_pool, axis=0)
+                minc_at = jnp.argmin(comp_pool, axis=0)
+                minc2 = jnp.min(
+                    jnp.where(rows == minc_at[None, :], BIGI,
+                              comp_pool), axis=0)
+                pool_out = live & (has_out | inverted)
+                inv_pool = jnp.where(pool_out, inv_e[:, None], -BIGI)
+                maxi1 = jnp.max(inv_pool, axis=0)
+                maxi_at = jnp.argmax(inv_pool, axis=0)
+                maxi2 = jnp.max(
+                    jnp.where(rows == maxi_at[None, :], -BIGI,
+                              inv_pool), axis=0)
+                in_thr = jnp.where(rows == minc_at[None, :],
+                                   minc2[None, :], minc1[None, :])
+                out_thr = jnp.where(rows == maxi_at[None, :],
+                                    maxi2[None, :], maxi1[None, :])
+                has_in = has_in | (inv_e[:, None] > in_thr)
+                has_out = has_out | (comp_e[:, None] < out_thr)
             return live & has_in & has_out
 
         def cond(st):
@@ -805,12 +1117,21 @@ def shape_bucket_for(g) -> dict:
     trim = trim_shapes(n, _bucket(max(d_in, 4)),
                        _bucket(max(d_out, 4)), n_procs, use_rt,
                        use_proc)
+    # the sharded bucket carries NO n_shards: the shard count is
+    # resolved from the live fleet at warm/run time
+    # (mesh.word_shard_count), so bucket derivation never queries
+    # devices and the same plan record rewarming on a different fleet
+    # width still lands on the executable that fleet can run
     return {"n": n,
             "trim": trim,
             "dense": {"n_pad": n_pad,
                       "e_pad": _bucket(max(len(edges), 1)),
                       "q_pad": _bucket(max(n_rw, 1)),
-                      "iters": max(1, math.ceil(math.log2(n_pad)))}}
+                      "iters": max(1, math.ceil(math.log2(n_pad)))},
+            "sharded": {"n_pad": n_pad,
+                        "q_pad": _bucket(max(n_rw, 1)),
+                        "iters": max(1, math.ceil(math.log2(n_pad))),
+                        "w": n_pad // 32}}
 
 
 def trim_cycle_search(g, max_n: int = PACKED_MAX_N) -> Optional[dict]:
@@ -1021,13 +1342,44 @@ def _squaring_select(n: int) -> tuple:
     cached per bucket by occupancy.cost_for). Past the bf16 capacity
     cap, packed is the only dense option; below it, packed wins when
     the bf16 closure's live working set stops fitting the HBM-comfort
-    budget."""
+    budget. Past PACKED_MAX_N no single chip holds the closure at
+    all: the mesh-sharded column layout is selected when the fleet
+    yields >= 2 word shards AND the analytic per-shard working set
+    (gather buffer + 2/n_shards local blocks, cross-checked against
+    the packed lowering's cost_analysis via occupancy.per_shard_cost)
+    fits a chip's HBM; otherwise packed is returned so the caller's
+    capacity check — and the host fallback behind it — fires."""
     import jax
     import jax.numpy as jnp
 
     from .. import occupancy as occupancy_mod
     from ..util import safe_backend
 
+    if n > PACKED_MAX_N:
+        from ..ops import aot as aot_mod
+        from ..parallel.mesh import word_shard_count
+
+        n_pad_s = _n_pad_for(n)
+        ns = word_shard_count(n_pad_s // 32)
+        bitset = len(SUBSETS) * float(n_pad_s) ** 2 / 8.0
+        per_shard = bitset * (1.0 + 2.0 / ns)
+        budget = getattr(aot_mod, "V5E_PEAK_HBM_BYTES", 1.6e10)
+        c_pk = occupancy_mod.cost_cached(("elle-packed", n_pad_s))
+        sel = {"n_shards": ns,
+               "per_shard_bytes": int(per_shard),
+               "gather_bytes_per_iter": int(bitset),
+               "budget_bytes": int(budget),
+               "cost_model": occupancy_mod.per_shard_cost(c_pk, ns)
+               if c_pk else None}
+        if n <= SHARDED_MAX_N and ns >= 2 and per_shard <= budget:
+            sel["why"] = (f"n {n} > packed cap {PACKED_MAX_N}; "
+                          f"{ns}-shard columns fit "
+                          f"{per_shard:.2e} <= {budget:.2e}")
+            return "sharded", sel
+        sel["why"] = (f"n {n} over packed cap and sharded layout "
+                      f"does not fit ({ns} shards, "
+                      f"{per_shard:.2e} per shard)")
+        return "packed", sel
     if n > DEFAULT_MAX_N:
         return "packed", {"why": f"n {n} > bf16 cap {DEFAULT_MAX_N}"}
     n_pad = _round_up(max(_bucket(n), n + 2), 128)
@@ -1082,9 +1434,11 @@ def device_cycle_search(g, max_n: int = PACKED_MAX_N,
     itself — always on a cpu/XLA backend (measured here: ONE squaring
     at n_pad 3072 costs ~0.5 s on one core; the whole trim fixpoint
     runs in tens of ms) — while an accelerator keeps the dense
-    closures on the MXU/VPU with bf16-vs-packed decided by
-    Lowered.cost_analysis (`_squaring_select`). Returns None over
-    capacity."""
+    closures on the MXU/VPU with bf16-vs-packed-vs-sharded decided by
+    Lowered.cost_analysis (`_squaring_select`; past PACKED_MAX_N the
+    mesh-sharded column layout is the only dense option, and a
+    sharded pick on a too-narrow fleet falls back to packed when n
+    still fits one chip). Returns None over capacity."""
     from ..util import safe_backend
 
     n = int(np.asarray(g.nodes).shape[0])
@@ -1100,7 +1454,7 @@ def device_cycle_search(g, max_n: int = PACKED_MAX_N,
         sel = {"why": f"forced {kernel}"}
 
     if kernel == "trim":
-        res = trim_cycle_search(g, max_n=max_n)
+        res = trim_cycle_search(g, max_n=min(max_n, PACKED_MAX_N))
         if res is not None:
             res["util"]["select"] = sel
             return res
@@ -1110,15 +1464,31 @@ def device_cycle_search(g, max_n: int = PACKED_MAX_N,
             # bucket, or n past capacity) the squaring costs minutes
             # per subset there — the host oracle is the right engine
             return None
-        kernel, sel = "packed", {"why": "over trim capacity"}
+        if n > PACKED_MAX_N:
+            kernel, sel = "sharded", {"why": "over trim capacity; "
+                                             "sharded columns"}
+        else:
+            kernel, sel = "packed", {"why": "over trim capacity"}
 
     s0, s1, s2 = SUBSETS
     # the dense kernels read only .nodes/.edges, which GraphTensors
     # provides directly — the labeled DepGraph materializes lazily
     # below, and only when something actually needs explaining
-    qres = (cycle_queries(g, max_n=min(max_n, DEFAULT_MAX_N))
-            if kernel == "bf16"
-            else cycle_queries_packed(g, max_n=max_n))
+    if kernel == "sharded":
+        qres = cycle_queries_sharded(
+            g, max_n=max(max_n, SHARDED_MAX_N))
+        if qres is None and n <= PACKED_MAX_N:
+            # fleet too narrow to shard (< 2 word shards): the
+            # single-chip packed kernel still covers this n
+            kernel = "packed"
+            sel = dict(sel,
+                       fallback="sharded unavailable; packed covers n")
+            qres = cycle_queries_packed(
+                g, max_n=min(max_n, PACKED_MAX_N))
+    elif kernel == "bf16":
+        qres = cycle_queries(g, max_n=min(max_n, DEFAULT_MAX_N))
+    else:
+        qres = cycle_queries_packed(g, max_n=min(max_n, PACKED_MAX_N))
     if qres is None:
         return None
     out = {"engine": "device", "util": dict(qres["util"])}
@@ -1156,6 +1526,10 @@ def standard_cycle_search(g, backend: str = "host",
       "tpu"     the original bf16 dense closure, engine "tpu" —
                 kept verbatim as the MULTICHIP evidence path.
       "packed"  the uint32 bitset closure (capacity PACKED_MAX_N).
+      "sharded" the mesh-sharded bitset closure: word columns split
+                across the "words" device axis, capacity
+                SHARDED_MAX_N (falls back to packed when the fleet
+                yields < 2 shards and n still fits one chip).
       "trim"    the peel-to-core trim kernel.
       "device"  kernel picked per shape (device_cycle_search).
       "auto"    ops/route.elle_cycle_route decides host vs device
@@ -1175,31 +1549,46 @@ def standard_cycle_search(g, backend: str = "host",
         rw = int(np.sum(edges[:, 2] == RW)) if len(edges) else 0
         plat = safe_backend()
         accel = plat not in (None, "cpu")
+        n_route = int(np.asarray(g.nodes).shape[0])
+        ns_route = 0
+        if accel:
+            try:
+                import jax
+
+                from ..parallel.mesh import word_shard_count
+                ns_route = word_shard_count(
+                    _n_pad_for(n_route) // 32, len(jax.devices()))
+            except Exception:  # noqa: BLE001 — no fleet, no shards
+                ns_route = 0
         backend, route_reason = elle_cycle_route(
-            n=int(np.asarray(g.nodes).shape[0]), e=int(len(edges)),
+            n=n_route, e=int(len(edges)),
             rw_edges=rw, accel=accel,
             device_ok=_device_available(require_accel=accel),
-            packed_cap=PACKED_MAX_N)
+            packed_cap=PACKED_MAX_N, sharded_cap=SHARDED_MAX_N,
+            n_shards=ns_route)
         engine = backend
     if backend == "device":
-        res = device_cycle_search(g, max_n=max(max_n, PACKED_MAX_N))
+        res = device_cycle_search(g, max_n=max(max_n, SHARDED_MAX_N))
         if res is None:
             backend = engine = "host-fallback"  # over capacity
         else:
             if route_reason:
                 res["route_reason"] = route_reason
             return res
-    if backend in ("trim", "packed"):
-        res = device_cycle_search(g, max_n=max(max_n, PACKED_MAX_N),
+    if backend in ("trim", "packed", "sharded"):
+        res = device_cycle_search(g, max_n=max(max_n, SHARDED_MAX_N),
                                   kernel=backend)
         if res is None:
             backend = engine = "host-fallback"
         else:
             # a forced trim request can still fall through to packed
-            # (degree past the gather bucket on an accelerator) —
-            # only claim the forced engine when it actually ran
+            # (degree past the gather bucket on an accelerator), and
+            # a sharded request to packed (fleet too narrow) — only
+            # claim the forced engine when it actually ran
             if res["util"].get("kernel", backend) == backend:
                 res["engine"] = backend
+            if route_reason:
+                res["route_reason"] = route_reason
             return res
     if backend == "tpu":
         dep = g.to_depgraph() if hasattr(g, "to_depgraph") else g

@@ -149,6 +149,25 @@ def cost_cached(key: tuple) -> Optional[dict]:
     return _COST_CACHE.get(key)
 
 
+def per_shard_cost(cost: Optional[dict], n_shards: int
+                   ) -> Optional[dict]:
+    """A whole-kernel per-round cost scaled to ONE shard of the
+    mesh-sharded Elle closure's word-column layout: flops split
+    evenly (each shard squares its own column block), bytes scaled by
+    (1 + 2/n_shards)/3 — the gathered full row set is read once per
+    shard regardless of the split, while the two writable blocks
+    (local r + local accumulator) shrink with it. Used by
+    elle/tpu._squaring_select to sanity-check the analytic per-shard
+    HBM bill against the compiler's own packed-closure numbers."""
+    if not cost or n_shards < 1:
+        return None
+    ns = int(n_shards)
+    return {"flops": cost.get("flops", 0.0) / ns,
+            "bytes_accessed": cost.get("bytes_accessed", 0.0)
+            * (1.0 + 2.0 / ns) / 3.0,
+            "n_shards": ns}
+
+
 def _cost_fill(key: tuple, lower_fn) -> Optional[dict]:
     out: Optional[dict] = None
     try:
@@ -298,6 +317,12 @@ def perfetto_counter_tracks(registry) -> dict:
                              series, devices.py) — one counter lane
                              per device, so a mesh run's memory
                              trajectory renders per chip
+      elle gather bytes    — the sharded Elle closure's per-iteration
+                             all_gather volume (`elle_closure` series
+                             points with kernel == "sharded"): spikes
+                             here against the hbm lanes above show
+                             whether a 100k closure is collective- or
+                             bandwidth-bound
 
     Points ride their metrics `t` wall-clock stamps, so the counter
     graphs line up with the phase spans in ui.perfetto.dev."""
@@ -316,6 +341,7 @@ def perfetto_counter_tracks(registry) -> dict:
         add("wgl_chunks", "frontier", "wgl frontier")
         add("wgl_chunks", "backlog", "wgl backlog")
         add("wgl_batched_chunks", "live_keys", "batched live keys")
+        add("elle_closure", "gather_bytes", "elle gather bytes")
         n_sched = 0
         sched_vals = []
         for p in registry.series("mesh_sched").points:

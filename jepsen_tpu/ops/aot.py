@@ -422,10 +422,15 @@ def precompile_elle_closure(shape_bucket: dict,
     CompileGuard proof in tests/test_elle_build.py).
 
     `shape_bucket` is elle/tpu.shape_bucket_for(tensors) — or any dict
-    with the same {"trim": ..., "dense": ...} layout. `kernels`
-    defaults to the platform's plausible picks: ("trim",) plus, on an
-    accelerator, the cost-analysis squaring choice. Returns
-    {kernel: compile_seconds}."""
+    with the same {"trim": ..., "dense": ...} layout (the "sharded"
+    sub-bucket rides along for shapes past the single-chip caps; its
+    shard count is NOT stored in the bucket but resolved from the
+    LIVE fleet here, so one plan record rewarms correctly on any
+    replica's fleet width — a too-narrow fleet simply skips the
+    sharded compile instead of building an executable it cannot run).
+    `kernels` defaults to the platform's plausible picks: ("trim",)
+    plus, on an accelerator, the cost-analysis squaring choice.
+    Returns {kernel: compile_seconds}."""
     from ..elle import tpu as elle_tpu
     from ..util import safe_backend
 
@@ -453,6 +458,15 @@ def precompile_elle_closure(shape_bucket: dict,
             _fn, compile_s = elle_tpu._compiled(
                 d["n_pad"], d["e_pad"], d["q_pad"],
                 len(elle_tpu.SUBSETS), d["iters"])
+        elif k == "sharded":
+            d = shape_bucket.get("sharded") or shape_bucket["dense"]
+            from ..parallel.mesh import word_shard_count
+            ns = word_shard_count(d.get("w", d["n_pad"] // 32))
+            if ns < 1:
+                continue
+            _fn, _mesh, compile_s = elle_tpu._compiled_sharded(
+                d["n_pad"], d["q_pad"], len(elle_tpu.SUBSETS),
+                d["iters"], ns)
         else:
             raise ValueError(f"unknown elle kernel {k!r}")
         out[k] = round(compile_s, 3)

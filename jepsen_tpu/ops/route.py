@@ -140,6 +140,8 @@ def check_routed(model: Model, history: History,
 def elle_cycle_route(*, n: int, e: int, rw_edges: int,
                      accel: bool, device_ok: bool,
                      packed_cap: int = 32768,
+                     sharded_cap: int = 131072,
+                     n_shards: int = 0,
                      cpu_cap: int = 16384,
                      min_n: int = 384,
                      min_host_work: int = 2_000_000) -> tuple:
@@ -156,23 +158,41 @@ def elle_cycle_route(*, n: int, e: int, rw_edges: int,
     is a host-work model against a capacity check:
 
       * no usable jax backend           -> host
-      * n > packed closure capacity     -> host (dense closure cannot
-                                           hold the graph; Tarjan can)
+      * n > packed closure capacity     -> "sharded" when an
+                                           accelerator fleet yields
+                                           >= 2 word-column shards and
+                                           n fits the sharded cap —
+                                           the mesh-sharded closure is
+                                           the only engine that holds
+                                           the bitset at all; host
+                                           Tarjan otherwise (on
+                                           XLA-cpu the sharded
+                                           squaring never pays)
       * small graph AND small BFS bill  -> host (kernel dispatch +
                                            compile-cache lookup costs
                                            more than it saves)
       * otherwise                       -> device; elle/tpu.py picks
                                            the kernel per shape
-                                           (bf16 / packed / prop).
+                                           (bf16 / packed / sharded).
 
-    Returns (backend, reason) with backend in {"host", "device"}."""
+    Returns (backend, reason) with backend in {"host", "device",
+    "sharded"} — "sharded" pins the kernel (the shape demands it);
+    "device" leaves the kernel pick to elle/tpu per shape."""
     host_work = rw_edges * max(e, 1)
     if not device_ok:
         return ("host", "no usable jax backend (missing or init "
                         "timed out); host Tarjan/BFS")
     if n > packed_cap:
+        if accel and n <= sharded_cap and n_shards >= 2:
+            return ("sharded",
+                    f"n {n} over packed closure capacity "
+                    f"{packed_cap}; {n_shards}-shard word columns "
+                    f"across the mesh hold it")
         return ("host", f"n {n} over packed closure capacity "
-                        f"{packed_cap}; host Tarjan/BFS")
+                        f"{packed_cap}"
+                        + (f" and no shardable fleet "
+                           f"({n_shards} shards)" if accel else "")
+                        + "; host Tarjan/BFS")
     if not accel and n > cpu_cap:
         # past this the trim kernel's peel rounds (bounded by n_pad)
         # stop paying for themselves on a single XLA-cpu core, and

@@ -1181,3 +1181,41 @@ def _run_group(gr: _GroupRun, shard, status, mx, wd, dm,
             args=(pool_key, lambda: _shard_tree(
                 shard, jinit0(jnp.zeros(gr.bk, jnp.int32))))).start()
     return K
+
+
+# -- word-column sharding (the Elle closure's lane-group layout) ------------
+
+def word_shard_count(w: int, n_devices: Optional[int] = None) -> int:
+    """How many mesh shards the packed Elle closure's word-column axis
+    splits into: the largest power of two that (a) divides W = N/32
+    exactly — a ragged block would break the packed kernel's
+    32-column scan and with it the bit-identity contract — and (b)
+    fits the visible device fleet. This is the ONE derivation shared
+    by the sharded kernel (`elle/tpu.cycle_queries_sharded`), its
+    preflight bill (`analysis/preflight.plan_elle_sharded`), and the
+    AOT warm path (`ops/aot.precompile_elle_closure`): a divergent
+    count anywhere would compile a never-used executable set. n_pad is
+    a multiple of 128, so W is a multiple of 4 and any fleet of >= 4
+    devices gets at least 4 shards. Returns 1 (unsharded) when the
+    fleet or W admits nothing more."""
+    if n_devices is None:
+        try:
+            import jax
+            n_devices = len(jax.devices())
+        except Exception:  # noqa: BLE001 — no backend: no sharding
+            return 1
+    w = int(w)
+    nd = max(1, int(n_devices))
+    ns = 1
+    while ns * 2 <= nd and w % (ns * 2) == 0:
+        ns *= 2
+    return ns
+
+
+def words_mesh(n_shards: int):
+    """The 1-D "words" mesh the sharded Elle closure lays its word
+    columns over — `default_mesh` on a dedicated axis name, bounded to
+    the shard count `word_shard_count` derived."""
+    from .batched import default_mesh
+
+    return default_mesh(axis="words", n_devices=int(n_shards))

@@ -1175,7 +1175,10 @@ class Service:
                 ("service-plan", str(req.checker), keystr),
                 {"elle_bucket": {"n": eb.get("n"),
                                  "trim": list(eb["trim"]),
-                                 "dense": eb.get("dense")},
+                                 "dense": eb.get("dense"),
+                                 # shard count resolved at rewarm
+                                 # from THAT replica's fleet
+                                 "sharded": eb.get("sharded")},
                  "key": list(req.bucket_key),
                  "checker": req.checker,
                  "t": round(time.time(), 3)})

@@ -17,7 +17,11 @@ and fails loudly when a routing or kernel regression lands:
   * the auto route must pick the device engine at the capacity
     config's shape (the r05 `elle_append_8k: engine host` bug);
   * a warmed shape bucket must re-check at ZERO XLA recompiles
-    (aot.precompile_elle_closure, the service warm path).
+    (aot.precompile_elle_closure, the service warm path);
+  * sharded closure (fake 8-device mesh): the column-blocked kernel
+    must be bit-identical to packed, the forced sharded route must
+    ADMIT an over-packed-capacity shape the packed plan rejected, and
+    a warmed sharded plan must re-run at zero recompiles.
 
 ~60 s on a CI cpu. Exit 0 clean, 1 on any violation.
 """
@@ -31,6 +35,14 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 
 def main() -> int:
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    # fake 8-way fleet (the mesh_smoke pattern, BEFORE jax imports):
+    # the sharded sections need real lane groups to split word
+    # columns over
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
     import random
 
     import numpy as np
@@ -131,6 +143,86 @@ def main() -> int:
     check(res8["cycle-engine"] == "device",
           "warmed capacity-shape auto-routes to device at zero "
           "recompiles")
+
+    # -- sharded closure: bit-equality on the fake 8-way mesh --------
+    import jax
+    check(len(jax.devices()) == 8,
+          f"fake 8-device fleet up (got {len(jax.devices())})")
+    sh_ok = True
+    sh_shards = 0
+    for seed in range(3):
+        rng = random.Random(100 + seed)
+        g = DepGraph()
+        # n_pad lands on 256/512 here, so the 8-shard split divides
+        # the word columns evenly (W % 8 == 0)
+        n = rng.randrange(160, 400)
+        for i in range(n):
+            g.add_node(i)
+        for _ in range(rng.randrange(2 * n, 6 * n)):
+            g.add_edge(rng.randrange(n), rng.randrange(n),
+                       rng.choice([WW, WR, RW, REALTIME, PROCESS]))
+        r_pk = elle_tpu.cycle_queries_packed(g)
+        r_sh = elle_tpu.cycle_queries_sharded(g, n_shards=8)
+        if r_sh is None:
+            sh_ok = False
+            continue
+        sh_shards = r_sh["util"]["n_shards"]
+        sh_ok &= all(
+            set(map(tuple, r_pk["sccs"][i]))
+            == set(map(tuple, r_sh["sccs"][i])) for i in range(3))
+        sh_ok &= np.array_equal(np.asarray(r_pk["rw_closed"]),
+                                np.asarray(r_sh["rw_closed"]))
+        sh_ok &= (r_pk["util"]["iter_reach"]
+                  == r_sh["util"]["iter_reach"])
+        sh_ok &= (r_pk["util"]["iters_run"]
+                  == r_sh["util"]["iters_run"])
+    check(sh_ok and sh_shards == 8,
+          f"sharded closure bit-identical to packed across "
+          f"{sh_shards} shards (sccs + rw_closed + iter_reach + "
+          f"iters_run)")
+
+    # -- over-capacity shape: sharded admits what packed rejected ----
+    from jepsen_tpu.analysis import preflight
+    from jepsen_tpu.ops.route import elle_cycle_route
+    eng, why = elle_cycle_route(
+        n=100_000, e=400_000, rw_edges=4096, accel=True,
+        device_ok=True, packed_cap=elle_tpu.PACKED_MAX_N,
+        sharded_cap=elle_tpu.SHARDED_MAX_N, n_shards=8)
+    check(eng == "sharded",
+          f"route holds 100k on the mesh (got {eng}: {why})")
+    rep100 = preflight.plan_elle(n_txns=100_000, backend="packed")
+    check(rep100["verdict"] == "degrade"
+          and rep100.get("kernel") == "sharded",
+          f"preflight degrades the 100k packed plan to sharded "
+          f"(got {rep100['verdict']}/{rep100.get('kernel')})")
+    gate = preflight.gate_elle(100_000, backend="packed",
+                               where="elle_smoke")
+    check(gate is None, "gate admits the 100k bucket instead of "
+                        "rejecting it")
+    gate_1m = preflight.gate_elle(1_000_000, backend="packed",
+                                  where="elle_smoke")
+    check(gate_1m is not None,
+          "gate still rejects past SHARDED_MAX_N (1M txns)")
+
+    # -- warm sharded plan → zero recompiles -------------------------
+    histS = synth.list_append_history(900, seed=5)
+    oksS = [op for op in histS
+            if op.is_ok and op.f in ("txn", None) and op.value]
+    infosS = [op for op in histS
+              if op.is_info and op.f in ("txn", None) and op.value]
+    btS = build.build_append(histS, oksS, infosS,
+                             additional_graphs=("realtime",))
+    bucketS = elle_tpu.shape_bucket_for(btS.tensors)
+    repS = aot.precompile_elle_closure(bucketS, kernels=("sharded",))
+    check("sharded" in repS,
+          f"precompile_elle_closure compiled the sharded bucket "
+          f"{repS}")
+    with guards.CompileGuard(max_compiles=0):
+        r_warm = elle_tpu.cycle_queries_sharded(
+            btS.tensors.to_depgraph())
+    check(r_warm is not None
+          and r_warm["util"].get("kernel") == "sharded",
+          "warmed sharded plan re-runs at ZERO recompiles")
 
     print("elle_smoke:", "PASS" if not failures
           else f"{len(failures)} FAILURES")

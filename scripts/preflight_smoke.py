@@ -9,10 +9,12 @@ Three invariants, each cheap enough for every CI run:
      visited are a subset; pack bit matches; the per-round byte
      prediction matches the executed check's cost_analysis within
      10%).
-  2. **oversized closure rejected statically** — a synthetic 100k-txn
-     dense-closure request is rejected (P001 + P002) with ZERO
-     backend compiles and zero device execution, proven under a
-     CompileGuard zero-compile budget.
+  2. **oversized closure planned statically** — a synthetic 100k-txn
+     dense-closure request now DEGRADES to the mesh-sharded column
+     layout (per-shard HBM under budget, gate admits), while a 1M-txn
+     request past SHARDED_MAX_N is still rejected (P001 + P002) —
+     both with ZERO backend compiles and zero device execution,
+     proven under a CompileGuard zero-compile budget.
   3. **warm path zero-recompile** — after one real check has warmed
      the shape bucket, running the preflight gate + a re-check stays
      at zero compiles: the analyzer's cost lowering must never cost a
@@ -36,6 +38,13 @@ N_OPS = int(os.environ.get("JEPSEN_TPU_SMOKE_OPS", "2000"))
 
 
 def main() -> int:
+    # fake 8-way fleet (BEFORE jax imports): the sharded degrade in
+    # section 2 derives its shard count from the LIVE fleet width
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
     from jepsen_tpu import metrics as metrics_mod
     from jepsen_tpu import synth
     from jepsen_tpu.analysis import guards, preflight
@@ -63,19 +72,37 @@ def main() -> int:
           f"visited {par['buckets_visited']}, "
           f"bytes drift {par['drift_x']}x")
 
-    # -- 2. oversized closure rejected statically, zero compiles ------
+    # -- 2. oversized closure planned statically, zero compiles -------
+    # 100k: the packed plan degrades to the sharded column layout
+    # (per-shard HBM under budget) and the gate ADMITS it; 1M is past
+    # SHARDED_MAX_N and still rejected. Both decisions are static.
     with guards.CompileGuard(max_compiles=0,
                              name="preflight-static-reject"):
         dense = preflight.plan_elle(n_txns=100_000, backend="packed")
         gate = preflight.gate_elle(100_000, backend="packed",
                                    where="smoke")
+        huge = preflight.plan_elle(n_txns=1_000_000,
+                                   backend="packed")
+        gate_1m = preflight.gate_elle(1_000_000, backend="packed",
+                                      where="smoke")
     fired = [r["rule"] for r in dense["rules"]]
-    assert dense["verdict"] == "infeasible", dense
-    assert "P001" in fired and "P002" in fired, fired
-    assert gate is not None and gate["cause"] == "preflight", gate
-    print(f"2. 100k dense closure rejected statically: {fired}, "
-          f"peak {dense['hbm']['peak_bytes'] / 1e9:.1f} GB, "
-          "0 compiles (CompileGuard-proven)")
+    assert dense["verdict"] == "degrade", dense
+    assert dense.get("kernel") == "sharded", dense
+    assert "P002" in fired, fired
+    assert gate is None, gate
+    shard_node = [p for p in dense["plan"]
+                  if p.get("kernel") == "sharded"]
+    assert shard_node and shard_node[0]["per_shard_bytes"] \
+        == dense["hbm"]["peak_bytes"], dense["plan"]
+    fired_1m = [r["rule"] for r in huge["rules"]]
+    assert huge["verdict"] == "infeasible", huge
+    assert "P001" in fired_1m and "P002" in fired_1m, fired_1m
+    assert gate_1m is not None and gate_1m["cause"] == "preflight", \
+        gate_1m
+    print(f"2. 100k dense closure degrades to sharded "
+          f"({shard_node[0]['n_shards']} shards, per-shard "
+          f"{dense['hbm']['peak_bytes'] / 1e9:.1f} GB, gate admits); "
+          f"1M rejected {fired_1m}, 0 compiles (CompileGuard-proven)")
 
     # -- 3. warm path: gate + re-check at zero recompiles -------------
     with guards.CompileGuard(max_compiles=0, name="preflight-warm"):

@@ -80,6 +80,10 @@ SERIES_SCHEMAS = {
     "elle_closure": {"edges": int, "n": int, "iters_run": int,
                      "kernel_s": NUM, "compile_s": NUM,
                      "iter_reach": list},
+    # ^ points with kernel == "sharded" additionally carry the
+    # mesh-shard extension fields (n_shards, shard_words,
+    # gather_bytes, per_shard_bytes), checked conditionally in
+    # lint_line — other kernels never emit them
     # admission-control verdicts (analysis/preflight): one point per
     # gate/CLI decision — verdict in {feasible, degrade, infeasible},
     # rules the P-rule ids that fired
@@ -222,6 +226,25 @@ def lint_line(obj: dict, where: str) -> list:
             errors += _check_doctor_enums(
                 obj.get("rule"), obj.get("severity"),
                 f"{where} [doctor]")
+        if obj.get("series") == "elle_closure" and not errors:
+            sharded = obj.get("kernel") == "sharded"
+            for f in ("n_shards", "shard_words", "gather_bytes",
+                      "per_shard_bytes"):
+                if sharded and f not in obj:
+                    errors.append(
+                        f"{where} [elle_closure]: sharded-kernel "
+                        f"point missing {f!r}")
+                elif not sharded and f in obj:
+                    errors.append(
+                        f"{where} [elle_closure]: {f!r} only "
+                        f"belongs on sharded-kernel points, found "
+                        f"on {obj.get('kernel')!r}")
+                elif f in obj and (not isinstance(obj[f], int)
+                                   or isinstance(obj[f], bool)):
+                    errors.append(
+                        f"{where} [elle_closure]: field {f!r} "
+                        f"should be int, got "
+                        f"{type(obj[f]).__name__}")
         if obj.get("series") == "service_batch" and not errors \
                 and obj.get("mode") not in ("mesh", "serial",
                                             "degrade"):

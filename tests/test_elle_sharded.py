@@ -1,0 +1,140 @@
+"""Differential + unit tests for the mesh-sharded Elle closure
+(elle/tpu.py cycle_queries_sharded): the uint32 bitset closure's word
+columns split across the "words" mesh axis, one all_gather per
+squaring, globally-reduced convergence. conftest pins a fake 8-device
+cpu mesh, so every test here exercises real lane groups in-process.
+The kernel must be BIT-identical to the unsharded packed closure:
+same sccs, rw_closed, iter_reach, and iters_run."""
+
+import random
+
+import numpy as np
+import pytest
+
+from jepsen_tpu.elle import tpu as elle_tpu
+from jepsen_tpu.elle.graph import (PROCESS, REALTIME, RW, WR, WW,
+                                   DepGraph)
+
+
+def _random_graph(rng, n, e):
+    g = DepGraph()
+    for i in range(n):
+        g.add_node(i)
+    for _ in range(e):
+        g.add_edge(rng.randrange(n), rng.randrange(n),
+                   rng.choice([WW, WR, RW, REALTIME, PROCESS]))
+    return g
+
+
+def _assert_bit_identical(r_pk, r_sh):
+    assert r_sh is not None
+    for i in range(len(elle_tpu.SUBSETS)):
+        assert (set(map(tuple, r_pk["sccs"][i]))
+                == set(map(tuple, r_sh["sccs"][i])))
+    assert np.array_equal(np.asarray(r_pk["rw_closed"]),
+                          np.asarray(r_sh["rw_closed"]))
+    assert r_pk["rw_edges"] == r_sh["rw_edges"]
+    assert r_pk["util"]["iters_run"] == r_sh["util"]["iters_run"]
+    assert r_pk["util"]["iter_reach"] == r_sh["util"]["iter_reach"]
+
+
+def test_cross_shard_cycle_converges_like_unsharded():
+    # a cycle whose two nodes live in DIFFERENT shards' column blocks
+    # (words 0 and 5 of W=8 — one word per shard on the 8-way mesh)
+    # must converge to the same iters_run as the unsharded closure:
+    # the global psum convergence test, not a per-shard one, decides
+    g = DepGraph()
+    n = 200  # n_pad 256 -> W=8 -> 8 shards x one 32-column word
+    for i in range(n):
+        g.add_node(i)
+    assert 5 // 32 != 190 // 32  # distinct word columns -> shards
+    g.add_edge(5, 190, WW)
+    g.add_edge(190, 5, RW)
+    rng = random.Random(0)
+    for _ in range(300):  # acyclic filler: always low -> high
+        a, b = sorted(rng.sample(range(n), 2))
+        g.add_edge(a, b, rng.choice([WW, WR, REALTIME]))
+    r_pk = elle_tpu.cycle_queries_packed(g)
+    r_sh = elle_tpu.cycle_queries_sharded(g, n_shards=8)
+    assert r_sh["util"]["kernel"] == "sharded"
+    assert r_sh["util"]["n_shards"] == 8
+    _assert_bit_identical(r_pk, r_sh)
+    # the cross-shard cycle lands in the rw-bearing subset's sccs
+    assert any({5, 190} <= set(c) for c in r_sh["sccs"][2])
+
+
+@pytest.mark.parametrize("seed", range(2))
+def test_sharded_bit_identical_to_packed(seed):
+    rng = random.Random(seed)
+    g = _random_graph(rng, 170 + seed, 900)
+    r_pk = elle_tpu.cycle_queries_packed(g)
+    for ns in (8, 1):  # mesh split and degenerate single-shard
+        r_sh = elle_tpu.cycle_queries_sharded(g, n_shards=ns)
+        assert r_sh["util"]["n_shards"] == ns
+        assert r_sh["util"]["shard_words"] \
+            == r_sh["util"]["n_pad"] // 32 // ns
+        _assert_bit_identical(r_pk, r_sh)
+
+
+def test_sharded_over_capacity_returns_none():
+    g = _random_graph(random.Random(3), 16, 40)
+    assert elle_tpu.cycle_queries_sharded(g, max_n=8) is None
+
+
+def test_route_learns_sharded_engine():
+    from jepsen_tpu.ops.route import elle_cycle_route
+    kw = dict(e=400_000, rw_edges=4096, device_ok=True,
+              packed_cap=elle_tpu.PACKED_MAX_N,
+              sharded_cap=elle_tpu.SHARDED_MAX_N)
+    eng, why = elle_cycle_route(n=100_000, accel=True, n_shards=8,
+                                **kw)
+    assert eng == "sharded" and "shard" in why
+    # a fleet too narrow to split the words routes host, naming it
+    eng, why = elle_cycle_route(n=100_000, accel=True, n_shards=1,
+                                **kw)
+    assert eng == "host" and "shard" in why
+    # no accelerator: host, as before
+    eng, _why = elle_cycle_route(n=100_000, accel=False, n_shards=0,
+                                 **kw)
+    assert eng == "host"
+    # past even the sharded capacity: host
+    eng, _why = elle_cycle_route(n=200_000, accel=True, n_shards=8,
+                                 **kw)
+    assert eng == "host"
+
+
+def test_plan_elle_sharded_node_bills_per_shard():
+    from jepsen_tpu.analysis import preflight
+    node = preflight.plan_elle_sharded(n_txns=100_000, n_shards=8)
+    assert node["kernel"] == "sharded"
+    assert node["n_shards"] == 8
+    assert node["n_pad"] == 131072
+    assert node["shard_words"] == 131072 // 32 // 8
+    bitset = len(elle_tpu.SUBSETS) * 131072 * (131072 // 32) * 4
+    assert node["gather_bytes_per_iter"] == bitset
+    assert node["per_shard_bytes"] == bitset + 2 * bitset // 8
+    assert node["hbm_bytes"] == node["per_shard_bytes"]
+    assert node["capacity"] == elle_tpu.SHARDED_MAX_N
+
+
+def test_bucket_publishes_sharded_layout_without_shard_count():
+    # shape_bucket_for publishes the sharded sub-bucket WITHOUT a
+    # shard count: the count is resolved from the LIVE fleet at
+    # warm/rewarm time, so one persisted plan rewarms on any replica
+    from jepsen_tpu import synth
+    from jepsen_tpu.elle import build
+    from jepsen_tpu.ops import aot
+
+    h = synth.list_append_history(300, seed=3)
+    oks = [op for op in h
+           if op.is_ok and op.f in ("txn", None) and op.value]
+    infos = [op for op in h
+             if op.is_info and op.f in ("txn", None) and op.value]
+    bt = build.build_append(h, oks, infos,
+                            additional_graphs=("realtime",))
+    bucket = elle_tpu.shape_bucket_for(bt.tensors)
+    sh = bucket["sharded"]
+    assert sh["w"] == sh["n_pad"] // 32
+    assert "n_shards" not in sh
+    rep = aot.precompile_elle_closure(bucket, kernels=("sharded",))
+    assert "sharded" in rep

@@ -237,3 +237,147 @@ def test_closure_kernel_at_capacity():
                          cycle_backend="host")
     assert res["valid?"] == res_h["valid?"]
     assert res["anomaly-types"] == res_h["anomaly-types"]
+
+
+# ---------------------------------------------------------------------------
+# trim interval scan (anchored threshold pool): the O(span) -> O(log N)
+# reformulation of the realtime peel must keep the exact fixpoint
+# ---------------------------------------------------------------------------
+
+def _split_ops(h):
+    oks = [op for op in h
+           if op.is_ok and op.f in ("txn", None) and op.value]
+    infos = [op for op in h
+             if op.is_info and op.f in ("txn", None) and op.value]
+    return oks, infos
+
+
+def _old_rule_trim_core(tensors):
+    """numpy reference of the PRE-interval-scan peel: the realtime
+    threshold pool ranges over ALL live nodes (min completion /
+    max invocation with masked second extremum), exactly the kernel
+    rule this PR replaced. Returns (live fixpoint (n, n_sub),
+    single-peel rounds) — O(realtime span) rounds on serial chains,
+    which is the behavior the anchored pool collapses."""
+    from jepsen_tpu.elle.tpu import SUBSETS
+    nodes = np.asarray(tensors.nodes)
+    n = len(nodes)
+    edges = np.asarray(tensors.edges)
+    B = np.int64(2 ** 30)
+    inv_e = np.clip(np.asarray(tensors.inv_evt, np.int64), -B, B)
+    comp_e = np.clip(np.asarray(tensors.comp_evt, np.int64), -B, B)
+    use_rt = bool((np.asarray(tensors.comp_evt) < 2 ** 60).any())
+    n_sub = len(SUBSETS)
+    if len(edges):
+        id_of = {int(v): i for i, v in enumerate(nodes)}
+        src = np.array([id_of[int(s)] for s in edges[:, 0]])
+        dst = np.array([id_of[int(d)] for d in edges[:, 1]])
+        typ = edges[:, 2]
+        scatter = np.isin(typ, [WW, WR, RW])  # analytic scatter set
+    else:
+        src = dst = typ = np.zeros(0, np.int64)
+        scatter = np.zeros(0, bool)
+    rows = np.arange(n)
+    live = np.ones((n, n_sub), bool)
+    rounds = 0
+    while True:
+        new = live.copy()
+        for si, sub in enumerate(SUBSETS):
+            em = scatter & np.isin(typ, list(sub))
+            has_in = np.zeros(n, bool)
+            has_out = np.zeros(n, bool)
+            if em.any():
+                np.logical_or.at(has_in, dst[em], live[src[em], si])
+                np.logical_or.at(has_out, src[em], live[dst[em], si])
+            if use_rt:
+                comp_live = np.where(live[:, si], comp_e, B)
+                minc_at = int(np.argmin(comp_live))
+                masked = comp_live.copy()
+                masked[minc_at] = B
+                in_thr = np.where(rows == minc_at, masked.min(),
+                                  comp_live[minc_at])
+                inv_live = np.where(live[:, si], inv_e, -B)
+                maxi_at = int(np.argmax(inv_live))
+                masked = inv_live.copy()
+                masked[maxi_at] = -B
+                out_thr = np.where(rows == maxi_at, masked.max(),
+                                   inv_live[maxi_at])
+                has_in |= inv_e > in_thr
+                has_out |= comp_e < out_thr
+            new[:, si] = live[:, si] & has_in & has_out
+        rounds += 1
+        if (new == live).all():
+            return live, rounds
+        live = new
+
+
+def _serial_read_history(n_reads):
+    """Realtime-ONLY adversarial history: one seed append, then
+    n_reads strictly sequential single-read txns on distinct keys —
+    zero ww/wr/rw edges, one long realtime chain whose old peel
+    takes O(n_reads) rounds."""
+    from jepsen_tpu.history import Op
+    ops = [Op(type="invoke", f="txn", process=0,
+              value=[["append", "w", 1]], time=0),
+           Op(type="ok", f="txn", process=0,
+              value=[["append", "w", 1]], time=1)]
+    t = 2
+    for i in range(n_reads):
+        k = f"k{i}"
+        ops.append(Op(type="invoke", f="txn", process=0,
+                      value=[["r", k, None]], time=t))
+        ops.append(Op(type="ok", f="txn", process=0,
+                      value=[["r", k, []]], time=t + 1))
+        t += 2
+    h = History()
+    for i, o in enumerate(ops):
+        h.append(o.with_(index=i))
+    return h
+
+
+@pytest.mark.parametrize("corrupt", [0.0, 0.05])
+def test_trim_anchored_pool_same_core_as_all_live_pool(corrupt):
+    # parity on the existing trim corpora: the anchored pool's
+    # fixpoint (kernel) must equal the all-live pool's (numpy
+    # reference of the replaced rule), valid and anomalous alike
+    from jepsen_tpu import synth
+    from jepsen_tpu.elle import build
+    from jepsen_tpu.elle import tpu as elle_tpu
+
+    h = synth.list_append_history(240, n_procs=5, seed=9,
+                                  corrupt_p=corrupt)
+    oks, infos = _split_ops(h)
+    bt = build.build_append(h, oks, infos,
+                            additional_graphs=("realtime",))
+    res = elle_tpu.trim_cycle_search(bt.tensors)
+    assert res["util"]["kernel"] == "trim"
+    assert res["util"]["jumps"]["rt"] is True
+    live_old, _rounds = _old_rule_trim_core(bt.tensors)
+    assert res["util"]["core_sizes"] == \
+        [int(live_old[:, si].sum()) for si in range(live_old.shape[1])]
+
+
+def test_trim_interval_scan_collapses_long_realtime_chain():
+    # the adversarial history: the old rule's measured round count is
+    # O(N) (one chain node per round from each end) while the
+    # anchored-pool kernel stays within the logarithmic bound
+    import math
+
+    from jepsen_tpu.elle import build
+    from jepsen_tpu.elle import tpu as elle_tpu
+
+    n_reads = 600
+    h = _serial_read_history(n_reads)
+    oks, infos = _split_ops(h)
+    bt = build.build_append(h, oks, infos,
+                            additional_graphs=("realtime",))
+    n = int(np.asarray(bt.tensors.nodes).shape[0])
+    assert n >= n_reads
+    res = elle_tpu.trim_cycle_search(bt.tensors)
+    assert res["util"]["core_sizes"] == [0] * len(SUBSETS)
+    bound = 2 * math.ceil(math.log2(max(n, 2))) + 4
+    assert res["util"]["iters_run"] <= bound, \
+        (res["util"]["iters_run"], bound)
+    live_old, rounds_old = _old_rule_trim_core(bt.tensors)
+    assert not live_old.any()  # same (empty) core either way
+    assert rounds_old >= n_reads // 4  # the replaced rule was O(span)

@@ -172,15 +172,40 @@ class TestEllePlan:
         assert rep["engine"] == "host"
         assert rep["verdict"] == "feasible"
 
-    def test_dense_100k_rejected_with_zero_compiles(self):
+    def test_dense_100k_degrades_to_sharded_zero_compiles(self):
+        # the 100k packed plan no longer rejects: it degrades onto
+        # the mesh-sharded column layout (per-shard bill under
+        # budget on the 8-way fleet) and the gate ADMITS it — still
+        # a purely static decision
         with guards.CompileGuard(max_compiles=0, name="pf-100k"):
             rep = preflight.plan_elle(n_txns=100_000, backend="packed")
             gate = preflight.gate_elle(100_000, backend="packed",
                                        where="test")
         fired = [r["rule"] for r in rep["rules"]]
+        assert rep["verdict"] == "degrade"
+        assert rep["kernel"] == "sharded"
+        assert "P002" in fired
+        assert rep["hbm"]["peak_bytes"] <= rep["hbm"]["budget_bytes"]
+        assert rep["shapes"]["n_shards"] >= 2
+        # the plan carries BOTH nodes: the rejected packed bill and
+        # the per-shard sharded bill it degraded onto
+        kernels = [p["kernel"] for p in rep["plan"]]
+        assert kernels == ["packed", "sharded"]
+        assert rep["plan"][1]["per_shard_bytes"] \
+            < rep["plan"][0]["hbm_bytes"]
+        assert gate is None
+
+    def test_dense_1m_rejected_with_zero_compiles(self):
+        # past SHARDED_MAX_N the gathered row set alone blows a chip:
+        # still statically rejected, naming the sharded remedy's limit
+        with guards.CompileGuard(max_compiles=0, name="pf-1m"):
+            rep = preflight.plan_elle(n_txns=1_000_000,
+                                      backend="packed")
+            gate = preflight.gate_elle(1_000_000, backend="packed",
+                                       where="test")
+        fired = [r["rule"] for r in rep["rules"]]
         assert rep["verdict"] == "infeasible"
         assert "P001" in fired and "P002" in fired
-        assert rep["hbm"]["peak_bytes"] > rep["hbm"]["budget_bytes"]
         assert gate is not None and gate["cause"] == "preflight"
 
     def test_bf16_forced_over_cap(self):
@@ -222,8 +247,8 @@ class TestEllePlan:
         # the packed closure: rejected BEFORE the graph build, with
         # zero backend compiles and zero device execution
         from jepsen_tpu.elle import append as elle_append
-        from jepsen_tpu.elle.tpu import PACKED_MAX_N
-        n = PACKED_MAX_N + 8
+        from jepsen_tpu.elle.tpu import SHARDED_MAX_N
+        n = SHARDED_MAX_N + 8  # past even the sharded remedy's cap
         h = History([{"type": "ok", "f": "txn", "process": 0,
                       "time": i, "index": i,
                       "value": [["append", 0, i]]}
@@ -702,7 +727,8 @@ class TestCli:
                          ["preflight", "--config", "dense_100k"])
         out = capsys.readouterr().out
         assert rc == 0
-        assert "infeasible" in out
+        assert "degrade" in out
+        assert "sharded" in out
         assert "P002" in out
 
     def test_preflight_unknown_config(self):
