@@ -75,6 +75,7 @@ from typing import Callable, Optional
 from . import fleet
 from . import ledger as ledger_mod
 from . import metrics as metrics_mod
+from .analysis import lockwatch
 
 SCHEMA = 1
 
@@ -561,7 +562,7 @@ class Supervisor:
         self.where = str(where)
         self._mx = mx
         self._ledger = ledger
-        self._lock = threading.Lock()
+        self._lock = lockwatch.lock("autopilot")
         self._pending: dict = {}      # rule -> in-flight action
         self._quarantine: dict = {}   # rule -> {t, reason, action_id}
         self._history: deque = deque(maxlen=HISTORY_CAP)

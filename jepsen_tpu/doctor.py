@@ -11,7 +11,7 @@ at scale (JVM Knossos "times out" with no attribution); a system built
 to fix that should diagnose itself. This module closes the telemetry
 into diagnoses:
 
-  * a **rule catalog** D001-D015 over the recorded series and ledger
+  * a **rule catalog** D001-D016 over the recorded series and ledger
     records — each rule correlates planes (e.g. D001 joins
     CompileGuard counts against preflight's planned buckets; D005
     joins `fleet_shards` walls into `fleet.summarize`'s rebucket
@@ -75,6 +75,12 @@ Rule catalog (doc/OBSERVABILITY.md "Diagnosis plane"):
                                missing from another's warm registry —
                                the steal/rewarm signal
                                (observatory.py)
+  D016 lock-contention         a witnessed lock's acquire-wait p95
+                               (analysis/lockwatch.py `lockwatch`
+                               series, JEPSEN_TPU_LOCKWATCH=1) past
+                               the contention gate — the remedy names
+                               the lock to split or the blocking call
+                               to hoist (threadlint T003)
 
 Thresholds are single-sourced from the planes that own them
 (`occupancy.TARGET_FILL`, `devices.HBM_DRIFT_X` via `drift`,
@@ -114,11 +120,12 @@ RULES = {
     "D013": "replica-down",
     "D014": "replica-skew",
     "D015": "warm-divergence",
+    "D016": "lock-contention",
 }
 
 # Rules `diagnose` itself evaluates (single-process planes); the
 # fleet rules above are observatory.py's.
-LOCAL_RULES = tuple(f"D{i:03d}" for i in range(1, 13))
+LOCAL_RULES = tuple(f"D{i:03d}" for i in range(1, 13)) + ("D016",)
 
 SEVERITIES = ("critical", "warn", "info")
 _SEVERITY_RANK = {"critical": 3, "warn": 2, "info": 1}
@@ -166,11 +173,18 @@ QUEUE_BACKLOG_MIN_POINTS = 6
 QUEUE_BACKLOG_GROWTH = 4
 QUEUE_WARM_SPLIT = 0.6
 
+# D016: a lock's acquire-wait p95 (from the lockwatch witness series)
+# must clear both the absolute gate and the sample floor before it
+# counts as contention — brief spikes on a handful of acquires are
+# scheduling noise, not a hot lock.
+LOCK_CONTENTION_MIN_POINTS = 8
+LOCK_CONTENTION_WAIT_P95_S = 0.005
+
 # Series the view pulls from a registry / metrics JSONL export.
 SERIES_OF_INTEREST = (
     "wgl_rounds", "wgl_chunks", "wgl_adapt", "wgl_batched_lanes",
     "fleet_shards", "fleet_faults", "watchdog_stalls", "hbm",
-    "preflight", "service", "slo")
+    "preflight", "service", "slo", "lockwatch")
 
 # Bounds on what rides a finding (the full series stay in their
 # artifacts; evidence is for pointing, not re-exporting).
@@ -1176,8 +1190,55 @@ def _d012(view: TelemetryView) -> list:
         evidence=ev, score=growth, action=action)]
 
 
+def _d016(view: TelemetryView) -> list:
+    """Lock-contention: a witnessed lock's acquire-wait p95 past the
+    gate. The lockwatch series only exists under
+    JEPSEN_TPU_LOCKWATCH=1, so this rule is silent on uninstrumented
+    runs — and a hot lock usually means either too much work under it
+    (split the guarded state) or a blocking call that threadlint T003
+    should have flagged (hoist it outside the critical section)."""
+    pts = [p for p in view.series("lockwatch")
+           if p.get("event") == "acquire"
+           and isinstance(p.get("wait_s"), (int, float))]
+    if not pts:
+        return []
+    by_lock: dict = {}
+    for i, p in enumerate(pts):
+        by_lock.setdefault(str(p.get("lock")), []).append(
+            (i, float(p["wait_s"])))
+    out = []
+    for label, rows in sorted(by_lock.items()):
+        if len(rows) < LOCK_CONTENTION_MIN_POINTS:
+            continue
+        waits = sorted(w for _, w in rows)
+        p95 = waits[min(len(waits) - 1,
+                        int(0.95 * (len(waits) - 1)))]
+        if p95 < LOCK_CONTENTION_WAIT_P95_S:
+            continue
+        hot = sorted(rows, key=lambda r: r[1],
+                     reverse=True)[:MAX_EVIDENCE_POINTS]
+        out.append(finding(
+            "D016", "warn",
+            f"lock {label!r} acquire-wait p95 "
+            f"{round(p95 * 1e3, 2)}ms over {len(rows)} contended "
+            f"acquire(s) (gate "
+            f"{LOCK_CONTENTION_WAIT_P95_S * 1e3:g}ms)",
+            evidence=[evidence(
+                "lockwatch", "wait_s",
+                [i for i, _ in hot],
+                [round(w, 6) for _, w in hot],
+                lock=label)],
+            subject=label, score=p95,
+            action=(f"split the state guarded by {label!r} (or "
+                    "shorten its critical sections — a blocking "
+                    "call held under it is a threadlint T003 "
+                    "site); lockwatch's per-lock hold_p95_s says "
+                    "whether holders or queuers dominate")))
+    return out
+
+
 _RULE_FNS: tuple = (_d001, _d002, _d003, _d004, _d005, _d006, _d007,
-                    _d008, _d009, _d010, _d011, _d012)
+                    _d008, _d009, _d010, _d011, _d012, _d016)
 
 
 # ---------------------------------------------------------------------------
@@ -1270,7 +1331,7 @@ def record_report(report: dict, *, where: str,
         if mx.enabled:
             series = mx.series(
                 "doctor", "diagnosis findings from the run doctor "
-                          "(rule catalog D001-D015)")
+                          "(rule catalog D001-D016)")
             for f in findings:
                 series.append({"rule": f["rule"],
                                "severity": f["severity"],

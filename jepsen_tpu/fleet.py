@@ -52,6 +52,7 @@ import traceback
 from typing import Iterator, Optional
 
 from . import metrics as _metrics
+from .analysis import lockwatch
 
 # Structured fault events carry the worker traceback, bounded so a
 # pathological recursion error can't bloat results/JSONL.
@@ -432,7 +433,7 @@ class RunStatus:
         self.progress = (progress if progress is not None else
                          os.environ.get("JEPSEN_TPU_PROGRESS", "")
                          not in ("", "0"))
-        self._lock = threading.Lock()
+        self._lock = lockwatch.lock("fleet.status")
         self._t0 = time.monotonic()
         self._last_write = 0.0
         self._last_tick = 0.0

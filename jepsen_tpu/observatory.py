@@ -65,6 +65,7 @@ from . import doctor as doctor_mod
 from . import ledger as ledger_mod
 from . import slo as slo_mod
 from . import trace as trace_mod
+from .analysis import lockwatch
 
 SCHEMA = 1
 
@@ -176,6 +177,12 @@ class FederatedLedger:
                 self.roots.append(r)
         self._ledgers = {r: ledger_mod.Ledger(r) for r in self.roots}
         self._cache: dict = {}  # root -> (signature, [records])
+        # one FederatedLedger is shared by every web handler thread
+        # (/fleet, /fleet.json, SSE pollers): the signature cache is
+        # a plain dict, so its read-check-store must be serialized —
+        # a torn (sig, records) pair would alias one root's stale
+        # records under another's fresh signature
+        self._cache_lock = lockwatch.lock("observatory.cache")
 
     def signature(self) -> tuple:
         """The fleet-wide change key: per-root index signatures in
@@ -187,11 +194,17 @@ class FederatedLedger:
         """One root's records (filtered, `Ledger.query` semantics),
         from cache when the root's index signature is unchanged."""
         led = self._ledgers[root]
+        # signature BEFORE the read (threadlint T007): an append
+        # landing between query() and a later signature would alias
+        # the stale read under the fresh signature forever; this
+        # order merely refreshes one extra time on the next poll
         sig = led.index_signature()
-        cached = self._cache.get(root)
+        with self._cache_lock:
+            cached = self._cache.get(root)
         if cached is None or sig is None or cached[0] != sig:
             cached = (sig, led.query())
-            self._cache[root] = cached
+            with self._cache_lock:
+                self._cache[root] = cached
         return _apply_filters(cached[1], **filters)
 
     def query(self, **filters) -> list:

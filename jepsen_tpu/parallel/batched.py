@@ -28,6 +28,7 @@ from .. import fleet as _fleet
 from .. import metrics as _metrics
 from .. import occupancy as _occ
 from .. import watchdog as _watchdog
+from ..analysis import lockwatch
 from ..history import History
 from ..models.core import Model
 from ..ops import adapt as _adapt
@@ -561,7 +562,7 @@ def check_streamed(model: Model, histories: Sequence[History],
         d = load.index(min(load))
         queues[d].append(i)
         load[d] += est[i]
-    qlock = threading.Lock()
+    qlock = lockwatch.lock("batched.queue")
 
     def _claim(di):
         with qlock:

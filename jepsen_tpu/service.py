@@ -76,6 +76,7 @@ from . import ledger as ledger_mod
 from . import metrics as metrics_mod
 from . import slo as slo_mod
 from . import trace as trace_mod
+from .analysis import lockwatch
 
 SCHEMA = 1
 
@@ -313,7 +314,10 @@ class Service:
             else slo_mod.Engine(ledger=self.ledger)
         self.slo_every_s = float(slo_every_s)
         self._last_slo = 0.0
-        self._lock = threading.RLock()
+        # lockwatch.rlock is a plain threading.RLock unless
+        # JEPSEN_TPU_LOCKWATCH=1, when the witness profiles it and
+        # fails on observed lock-order cycles (doc/STATIC_ANALYSIS.md)
+        self._lock = lockwatch.rlock("service")
         self._cv = threading.Condition(self._lock)     # workers
         self._ev_cv = threading.Condition(self._lock)  # SSE readers
         self._queues: dict = {}   # bucket key -> deque[_Request]
@@ -336,6 +340,12 @@ class Service:
 
     # -- lifecycle ----------------------------------------------------
     def start(self) -> "Service":
+        # the worker spawn AND the supervisor/heartbeat claims happen
+        # in ONE locked section (threadlint T005): two concurrent
+        # start() calls used to race the unlocked `_autopilot is
+        # None` / `_hb_thread is None` checks and spawn duplicate
+        # supervisors. The claim is atomic; the (slow) Supervisor
+        # construction runs after, outside the lock.
         with self._lock:
             if self._threads:
                 return self
@@ -346,31 +356,44 @@ class Service:
                                      daemon=True)
                 t.start()
                 self._threads.append(t)
-        if self.autopilot_enabled and self._autopilot is None:
+            start_ap = self.autopilot_enabled \
+                and self._autopilot is None
+            start_hb = self.heartbeat_every_s > 0 \
+                and self._hb_thread is None
+        if start_ap:
             from . import autopilot as autopilot_mod
-            self._autopilot = autopilot_mod.Supervisor(
+            sup = autopilot_mod.Supervisor(
                 autopilot_mod.ServiceHost(self),
                 every_s=self.autopilot_every_s, where="service",
                 mx=self.mx, ledger=self.ledger).start()
-            autopilot_mod.set_default(self._autopilot)
-        if self.heartbeat_every_s > 0 and self._hb_thread is None:
+            with self._lock:
+                self._autopilot = sup
+            autopilot_mod.set_default(sup)
+        if start_hb:
             self._hb_stop.clear()
             hb = threading.Thread(target=self._heartbeat_loop,
                                   name="service-heartbeat",
                                   daemon=True)
             hb.start()
-            self._hb_thread = hb
+            with self._lock:
+                self._hb_thread = hb
         set_default(self)
         return self
 
     def close(self, timeout: float = 5.0) -> None:
-        if self._autopilot is not None:
-            self._autopilot.close(timeout=timeout)
-            self._autopilot = None
-        if self._hb_thread is not None:
+        # detach under the lock, join OUTSIDE it: the supervisor and
+        # heartbeat threads take the service lock on their way out,
+        # so joining them while holding it would deadlock (threadlint
+        # T003), and two concurrent close() calls must not both join
+        # (T005 on the old unlocked `is not None` checks)
+        with self._lock:
+            sup, self._autopilot = self._autopilot, None
+            hb, self._hb_thread = self._hb_thread, None
+        if sup is not None:
+            sup.close(timeout=timeout)
+        if hb is not None:
             self._hb_stop.set()
-            self._hb_thread.join(timeout=timeout)
-            self._hb_thread = None
+            hb.join(timeout=timeout)
         with self._cv:
             self._stop = True
             self._cv.notify_all()
@@ -379,6 +402,8 @@ class Service:
             t.join(timeout=timeout)
         with self._lock:
             self._threads = []
+        if lockwatch.enabled():
+            lockwatch.bank(self.ledger)
 
     @property
     def closed(self) -> bool:
@@ -1461,7 +1486,10 @@ class Service:
                        "quarantined": sorted(sup.quarantined())}
             except Exception:  # noqa: BLE001
                 apt = {"active": True, "quarantined": []}
-        if self._hb_devices is None:
+        # single-writer lazy init: only the heartbeat thread ever
+        # touches _hb_devices, and _device_count() is a device query
+        # that must not run under the service lock
+        if self._hb_devices is None:  # threadlint: ok(T005)
             self._hb_devices = self._device_count()
         served = stats["served"]
         rec = {"kind": "replica-heartbeat", "t": round(now, 3),
@@ -1536,7 +1564,7 @@ class Service:
                     "warm_hit": req.warm_hit})
             active = bool(self._threads) and not self._stop
         served = stats["served"]
-        return {"active": active, "workers": self.workers,
+        snap = {"active": active, "workers": self.workers,
                 "replica": self.replica_id,
                 "heartbeats": self._hb_count,
                 "queued": depth, "buckets": buckets,
@@ -1545,6 +1573,9 @@ class Service:
                               if served else None),
                 "shedding": self.shedding() is not None,
                 "recent": recent}
+        if lockwatch.enabled():
+            snap["lockwatch"] = lockwatch.report()
+        return snap
 
 
 def _verdict_str(v) -> str:

@@ -46,6 +46,8 @@ import threading
 import time
 from typing import Iterator, Optional
 
+from .analysis import lockwatch
+
 DEFAULT_STALL_S = 30.0
 
 # Heartbeat series sampling floor: beats can arrive at kHz on the cpu
@@ -111,7 +113,7 @@ class Watchdog:
         self.escalation = esc
         self.stalls: list = []
         self._sources: list = []
-        self._lock = threading.Lock()
+        self._lock = lockwatch.lock("watchdog")
         self._cancel_all = False
         self._cancel_reason: Optional[str] = None
         self._seq = 0
@@ -203,8 +205,9 @@ class Watchdog:
         next boundary."""
         if not self.enabled:
             return
-        self._cancel_all = True
-        self._cancel_reason = reason
+        with self._lock:
+            self._cancel_all = True
+            self._cancel_reason = reason
 
     # -- stall detection ----------------------------------------------
     def scan(self, now: Optional[float] = None) -> list:
@@ -226,13 +229,6 @@ class Watchdog:
                 limit += src.grace_s
             if src.stalled or age <= limit:
                 continue
-            with self._lock:
-                # check-and-set under the lock: the monitor thread and
-                # a caller's manual scan() must not both declare (and
-                # double-record) the same stall
-                if src.stalled:
-                    continue
-                src.stalled = True
             ev = {"type": "StallDetected",
                   "error": (f"no heartbeat from {src.name} for "
                             f"{age:.1f}s (threshold {limit}s)"),
@@ -244,16 +240,27 @@ class Watchdog:
                   "beats": src.beats,
                   "progress": dict(src.progress),
                   "escalation": self.escalation}
+            with self._lock:
+                # check-and-set under the lock: the monitor thread and
+                # a caller's manual scan() must not both declare (and
+                # double-record) the same stall — and the cancel flags
+                # + stall log mutate under the SAME critical section,
+                # so a concurrent soft_cancel()/scan() can neither
+                # tear the reason nor double-append the event
+                if src.stalled:
+                    continue
+                src.stalled = True
+                if self.escalation == "cancel":
+                    # run-wide soft-cancel: healthy loops wind down
+                    # with partial verdicts at their next boundary;
+                    # only the genuinely hung thread gets abandoned
+                    # by its waiter
+                    src.cancel = True
+                    self._cancel_all = True
+                    if self._cancel_reason is None:
+                        self._cancel_reason = f"stalled: {src.name}"
+                self.stalls.append(ev)
             src.stall_event = ev
-            if self.escalation == "cancel":
-                # run-wide soft-cancel: healthy loops wind down with
-                # partial verdicts at their next boundary; only the
-                # genuinely hung thread gets abandoned by its waiter
-                src.cancel = True
-                self._cancel_all = True
-                if self._cancel_reason is None:
-                    self._cancel_reason = f"stalled: {src.name}"
-            self.stalls.append(ev)
             events.append(ev)
             self._publish(ev)
         return events

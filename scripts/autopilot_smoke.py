@@ -48,12 +48,14 @@ def _enc(n=100, ic=4, S=16, O=32):
 
 def main() -> int:
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    # arm the lock-order witness before any Supervisor lock exists
+    os.environ.setdefault("JEPSEN_TPU_LOCKWATCH", "1")
     import jax
     jax.config.update("jax_platforms", "cpu")
 
     from jepsen_tpu import autopilot, doctor, ledger, metrics
     from jepsen_tpu import service as service_mod
-    from jepsen_tpu.analysis import guards
+    from jepsen_tpu.analysis import guards, lockwatch
     from jepsen_tpu.ops import aot
 
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
@@ -230,6 +232,11 @@ def main() -> int:
                            for i in inst),
               f"Perfetto instants ride the 'autopilot actions' lane "
               f"({len(inst)} marker(s))")
+
+    lw = lockwatch.report()
+    check(lw["enabled"] and lw["cycles"] == [],
+          f"lock-order witness observed zero cycles "
+          f"(locks={sorted(lw['locks'])})")
 
     print(f"autopilot smoke: {len(failures)} failure(s)")
     return 1 if failures else 0

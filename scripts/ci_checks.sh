@@ -1,14 +1,18 @@
 #!/usr/bin/env bash
-# One-gate CI: the three tier-1 checks in the order a fast failure is
-# cheapest — jax_lint (pure AST, seconds), telemetry_lint (schema
-# drift over artifacts/, seconds), then the tier-1 pytest line from
-# ROADMAP.md. Any failure exits non-zero; pytest runs on the cpu
-# backend so a wedged accelerator runtime can't hang the gate.
+# One-gate CI: the tier-1 checks in the order a fast failure is
+# cheapest — jax_lint + thread_lint (pure AST, seconds),
+# telemetry_lint (schema drift over artifacts/, seconds), then the
+# tier-1 pytest line from ROADMAP.md. Any failure exits non-zero;
+# pytest runs on the cpu backend so a wedged accelerator runtime
+# can't hang the gate.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
 echo "== jax_lint =="
 python scripts/jax_lint.py
+
+echo "== thread_lint =="
+python scripts/thread_lint.py
 
 echo "== telemetry_lint =="
 python scripts/telemetry_lint.py
@@ -31,8 +35,8 @@ JAX_PLATFORMS=cpu python scripts/device_telemetry_smoke.py
 echo "== diagnosis-plane smoke =="
 JAX_PLATFORMS=cpu python scripts/doctor_smoke.py
 
-echo "== service/SLO plane smoke =="
-JAX_PLATFORMS=cpu python scripts/service_smoke.py
+echo "== service/SLO plane smoke (lockwatch witness on) =="
+JAX_PLATFORMS=cpu JEPSEN_TPU_LOCKWATCH=1 python scripts/service_smoke.py
 
 echo "== mesh-routed service load smoke =="
 JAX_PLATFORMS=cpu python scripts/service_load.py --smoke

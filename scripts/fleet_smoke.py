@@ -90,6 +90,10 @@ def spawn_replica(rid: str, root: str, port: int,
            "JAX_PLATFORMS": "cpu",
            "PYTHONUNBUFFERED": "1",
            "JEPSEN_TPU_HEARTBEAT_S": str(HEARTBEAT_S),
+           # lock-order witness on in every replica: /status.json's
+           # service block then carries the lockwatch report, and the
+           # smoke asserts zero observed cycles under real fleet load
+           "JEPSEN_TPU_LOCKWATCH": "1",
            "JEPSEN_TPU_FLEET_ROOTS": fleet_roots}
     return subprocess.Popen(
         [sys.executable, "-m", "jepsen_tpu", "serve",
@@ -161,6 +165,17 @@ def main() -> int:
                 240.0, f"run {rid} banked on replica {i + 1}")
             check(rec.get("kind") == "service-request",
                   f"run {rid[:18]}… banked as service-request")
+
+        print("== lock-order witness: zero cycles per replica ==")
+        for i, base in enumerate(bases):
+            lw = (get_json(f"{base}/status.json")
+                  .get("service", {}).get("lockwatch"))
+            check(isinstance(lw, dict) and lw.get("enabled"),
+                  f"replica {i + 1} serves its lockwatch report")
+            if isinstance(lw, dict):
+                check(lw.get("cycles") == [],
+                      f"replica {i + 1} observed zero lock-order "
+                      f"cycles (locks={sorted(lw.get('locks', {}))})")
 
         print("== merged /fleet.json from replica 1 ==")
         snap = wait_for(

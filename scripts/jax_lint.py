@@ -30,13 +30,12 @@ that way.
 from __future__ import annotations
 
 import os
-import subprocess
 import sys
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO_ROOT)
 
-from jepsen_tpu.analysis import jaxlint  # noqa: E402
+from jepsen_tpu.analysis import gitscope, jaxlint  # noqa: E402
 
 DEFAULT_PATHS = (
     os.path.join(REPO_ROOT, "jepsen_tpu", "ops"),
@@ -45,41 +44,14 @@ DEFAULT_PATHS = (
     os.path.join(REPO_ROOT, "bench.py"),
 )
 
-
+# kept as module aliases for existing callers/tests; the single
+# implementation lives in jepsen_tpu.analysis.gitscope (shared with
+# scripts/thread_lint.py)
 def changed_files():
-    """Python files changed vs HEAD (staged, unstaged, untracked),
-    absolute paths. Returns None when git is unavailable/failing —
-    the caller must then lint the full paths rather than silently
-    passing an unknowable working tree."""
-    out: list = []
-    try:
-        diff = subprocess.run(
-            ["git", "diff", "--name-only", "HEAD"],
-            cwd=REPO_ROOT, capture_output=True, text=True, timeout=30)
-        untracked = subprocess.run(
-            ["git", "ls-files", "--others", "--exclude-standard"],
-            cwd=REPO_ROOT, capture_output=True, text=True, timeout=30)
-        if diff.returncode != 0 or untracked.returncode != 0:
-            return None
-        names = diff.stdout.splitlines() + untracked.stdout.splitlines()
-    except Exception:  # noqa: BLE001 — no git: signal the caller
-        return None
-    for name in names:
-        path = os.path.join(REPO_ROOT, name)
-        # a deleted tracked file still shows in the diff — nothing to
-        # lint there
-        if name.endswith(".py") and os.path.isfile(path):
-            out.append(path)
-    return out
+    return gitscope.changed_files(REPO_ROOT)
 
 
-def _under(path: str, roots) -> bool:
-    path = os.path.abspath(path)
-    for r in roots:
-        r = os.path.abspath(r)
-        if path == r or path.startswith(r + os.sep):
-            return True
-    return False
+_under = gitscope.under
 
 
 def main(argv=None) -> int:
@@ -107,20 +79,10 @@ def main(argv=None) -> int:
         return 0
     paths = argv or list(DEFAULT_PATHS)
     if changed_only:
-        scope = paths
-        changed = changed_files()
-        if changed is None:
-            # no usable git: a silent pass here would green-light an
-            # unknowable tree — lint the full scope instead
-            print("jax lint: git unavailable; --changed-only falls "
-                  "back to the full lint paths", file=sys.stderr)
-        else:
-            paths = [p for p in changed if _under(p, scope)]
-            if not paths:
-                if not quiet:
-                    print("jax lint: no changed files under the lint "
-                          "paths")
-                return 0
+        paths, done = gitscope.scope_changed(
+            paths, REPO_ROOT, quiet=quiet, label="jax lint")
+        if done:
+            return 0
     findings = jaxlint.lint_paths(paths)
     if rules is not None:
         findings = [f for f in findings if f.rule in rules]

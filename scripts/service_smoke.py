@@ -40,6 +40,10 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 def main() -> int:
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     os.environ.setdefault("JEPSEN_TPU_NO_CACHE", "1")
+    # arm the lock-order witness BEFORE any Service lock exists: every
+    # lockwatch.lock/rlock created below is then instrumented, and the
+    # smoke fails on any observed acquisition-order cycle
+    os.environ.setdefault("JEPSEN_TPU_LOCKWATCH", "1")
     import jax
     jax.config.update("jax_platforms", "cpu")
 
@@ -47,7 +51,7 @@ def main() -> int:
     from jepsen_tpu import service as service_mod
     from jepsen_tpu import slo as slo_mod
     from jepsen_tpu import synth, web
-    from jepsen_tpu.analysis import guards
+    from jepsen_tpu.analysis import guards, lockwatch
 
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     import telemetry_lint
@@ -201,6 +205,22 @@ def main() -> int:
 
     server.shutdown()
     svc.close()
+
+    # -- lock-order witness: profiled, cycle-free, banked, linted ---
+    lw = lockwatch.report()
+    check(lw["enabled"] and lw["locks"],
+          f"lockwatch witnessed {len(lw['locks'])} lock(s) "
+          f"({sorted(lw['locks'])})")
+    check(lw["cycles"] == [],
+          f"zero lock-order cycles observed (edges={lw['edges']})")
+    lw_recs = svc.ledger.query(kind="lockwatch")
+    check(len(lw_recs) == 1,
+          "Service.close() banked the kind=lockwatch record")
+    lw_paths = [svc.ledger.record_path(r["id"]) for r in lw_recs]
+    rc = telemetry_lint.main(
+        lw_paths or [os.path.join(store, "ledger", "index.jsonl")])
+    check(rc == 0, "lockwatch record lints clean")
+
     if failures:
         print(f"\nservice smoke: {len(failures)} FAILURE(S)")
         return 1

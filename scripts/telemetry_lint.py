@@ -151,14 +151,23 @@ SERIES_SCHEMAS = {
     # over the replica stores).
     "fleet": {"replicas": int, "live": int, "down": int,
               "requests": int, "findings": int},
+    # the lock-order witness (analysis/lockwatch.py, only under
+    # JEPSEN_TPU_LOCKWATCH=1): throttled per-lock samples — event in
+    # {acquire, release, cycle}, hold_s/wait_s always present (0.0
+    # when not applicable to the event)
+    "lockwatch": {"lock": str, "event": str, "hold_s": NUM,
+                  "wait_s": NUM},
 }
 
 # doctor.py's rule catalog + severity levels — duplicated here as the
 # lint contract (this script is import-light on purpose: schema drift
 # in doctor.py must FAIL against this frozen enum, not silently
 # follow it)
-DOCTOR_RULE_IDS = {f"D{i:03d}" for i in range(1, 16)}
+DOCTOR_RULE_IDS = {f"D{i:03d}" for i in range(1, 17)}
 DOCTOR_SEVERITIES = {"critical", "warn", "info"}
+
+# the lock witness event enum (analysis/lockwatch.py _emit)
+LOCKWATCH_EVENTS = {"acquire", "release", "cycle"}
 
 # autopilot.py's lifecycle enum + trigger ids — the policy table fires
 # on doctor catalog rules plus the synthetic "burn" SLO gate; the
@@ -251,6 +260,11 @@ def lint_line(obj: dict, where: str) -> list:
             errors.append(f"{where} [service_batch]: mode must be "
                           f"mesh|serial|degrade, got "
                           f"{obj.get('mode')!r}")
+        if obj.get("series") == "lockwatch" and not errors \
+                and obj.get("event") not in LOCKWATCH_EVENTS:
+            errors.append(f"{where} [lockwatch]: event must be one "
+                          f"of {sorted(LOCKWATCH_EVENTS)}, got "
+                          f"{obj.get('event')!r}")
         if obj.get("series") == "autopilot" and not errors:
             if obj.get("event") not in AUTOPILOT_EVENTS:
                 errors.append(
@@ -538,6 +552,50 @@ def lint_ledger_file(path: str) -> list:
                 errs.append(
                     f"{where}: a settled autopilot-action "
                     f"({obj.get('event')}) must carry its verdict")
+        if obj.get("kind") == "lockwatch":
+            # lock-witness summaries (analysis/lockwatch.py bank):
+            # the observed acquisition-order edge list, the cycle
+            # verdict, and per-lock hold/contention percentiles
+            edges = obj.get("edges")
+            if not isinstance(edges, list):
+                errs.append(f"{where}: lockwatch 'edges' should be "
+                            "a list")
+            else:
+                for j, e in enumerate(edges):
+                    if not (isinstance(e, list) and len(e) == 2
+                            and all(isinstance(x, str) for x in e)):
+                        errs.append(
+                            f"{where}: edges[{j}] should be an "
+                            "[outer, inner] pair of lock labels")
+            if not isinstance(obj.get("cycle"), bool):
+                errs.append(f"{where}: lockwatch record needs bool "
+                            "'cycle'")
+            if not isinstance(obj.get("cycles"), list):
+                errs.append(f"{where}: lockwatch 'cycles' should be "
+                            "a list")
+            locks = obj.get("locks")
+            if not isinstance(locks, dict):
+                errs.append(f"{where}: lockwatch record needs the "
+                            "per-lock 'locks' object")
+            else:
+                for label, row in locks.items():
+                    lw = f"{where}.locks[{label!r}]"
+                    if not isinstance(row, dict):
+                        errs.append(f"{lw}: entry is not an object")
+                        continue
+                    for fld in ("acquires", "contended"):
+                        v = row.get(fld)
+                        if not isinstance(v, int) \
+                                or isinstance(v, bool):
+                            errs.append(f"{lw}: {fld!r} should be "
+                                        "int")
+                    for fld in ("hold_p95_s", "wait_p95_s",
+                                "hold_max_s", "wait_max_s"):
+                        v = row.get(fld)
+                        if not isinstance(v, NUM) \
+                                or isinstance(v, bool):
+                            errs.append(f"{lw}: {fld!r} should be "
+                                        "numeric")
         if obj.get("kind") == "multichip":
             # mesh dryrun records (devices.multichip_record): device
             # count + per-device attribution are the record's point

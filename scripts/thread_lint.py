@@ -1,0 +1,103 @@
+#!/usr/bin/env python
+"""Thread-safety lint over the service host plane (CLI for
+analysis.threadlint).
+
+Flags the concurrency hazards that past PRs each found by hand in the
+threaded host modules — unlocked shared-field writes reachable from
+worker/heartbeat threads (T001), static lock-order inversions (T002),
+blocking calls (sleep/join/ledger-append/XLA compile) under a held
+lock (T003), leaked non-daemon threads (T004), unlocked
+check-then-act (T005), module globals mutated from thread context
+(T006), index-signature TOCTOU (T007), and loop-variable capture into
+thread closures (T008). Rule catalog + allowlist syntax:
+doc/STATIC_ANALYSIS.md (Plane 4). Runtime twin: analysis.lockwatch
+(JEPSEN_TPU_LOCKWATCH=1).
+
+Usage:
+    python scripts/thread_lint.py [--check] [--list-rules]
+                                  [--rules T001,T003] [--changed-only]
+                                  [paths...]
+    # no paths: lints the threaded host plane (service, fleet,
+    #           autopilot, observatory, watchdog, web,
+    #           parallel/batched, analysis/lockwatch)
+    # --rules        keep only the named rules' findings
+    # --changed-only lint only files changed vs git HEAD (plus
+    #                untracked), intersected with the lint paths —
+    #                the fast pre-commit loop (shared git scoping
+    #                with scripts/jax_lint.py: analysis.gitscope)
+    # exit 1 when findings remain after the inline allowlist
+    # (`# threadlint: ok(<rule>)`); --check only changes verbosity
+
+Wired into scripts/ci_checks.sh and tests/test_threadlint.py: the
+tree starts lint-clean and CI keeps it that way.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+from jepsen_tpu.analysis import gitscope, threadlint  # noqa: E402
+
+DEFAULT_PATHS = (
+    os.path.join(REPO_ROOT, "jepsen_tpu", "service.py"),
+    os.path.join(REPO_ROOT, "jepsen_tpu", "fleet.py"),
+    os.path.join(REPO_ROOT, "jepsen_tpu", "autopilot.py"),
+    os.path.join(REPO_ROOT, "jepsen_tpu", "observatory.py"),
+    os.path.join(REPO_ROOT, "jepsen_tpu", "watchdog.py"),
+    os.path.join(REPO_ROOT, "jepsen_tpu", "web.py"),
+    os.path.join(REPO_ROOT, "jepsen_tpu", "parallel", "batched.py"),
+    os.path.join(REPO_ROOT, "jepsen_tpu", "analysis", "lockwatch.py"),
+)
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    quiet = "--check" in argv
+    changed_only = "--changed-only" in argv
+    argv = [a for a in argv if a not in ("--check", "--changed-only")]
+    rules = None
+    if "--rules" in argv:
+        i = argv.index("--rules")
+        if i + 1 >= len(argv):
+            print("--rules needs a comma-separated rule list "
+                  "(e.g. --rules T001,T003)", file=sys.stderr)
+            return 254
+        rules = {r.strip() for r in argv[i + 1].split(",") if r.strip()}
+        unknown = rules - set(threadlint.RULES)
+        if unknown:
+            print(f"unknown rule(s): {sorted(unknown)} "
+                  f"(known: {sorted(threadlint.RULES)})",
+                  file=sys.stderr)
+            return 254
+        del argv[i:i + 2]
+    if "--list-rules" in argv:
+        for rule, name in sorted(threadlint.RULES.items()):
+            print(f"{rule}  {name}")
+        return 0
+    paths = argv or list(DEFAULT_PATHS)
+    if changed_only:
+        paths, done = gitscope.scope_changed(
+            paths, REPO_ROOT, quiet=quiet, label="thread lint")
+        if done:
+            return 0
+    findings = threadlint.lint_paths(paths)
+    if rules is not None:
+        findings = [f for f in findings if f.rule in rules]
+    for f in findings:
+        print(f, file=sys.stderr)
+    n_files = sum(
+        (len([x for x in os.listdir(p) if x.endswith(".py")])
+         if os.path.isdir(p) else 1)
+        for p in paths if os.path.exists(p))
+    if not quiet or findings:
+        print(f"thread lint: {n_files} file(s), "
+              f"{len(findings)} finding(s)")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

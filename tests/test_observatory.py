@@ -520,3 +520,59 @@ class TestQuarantinePersistence:
                              reason="verify-failed")
         sup2 = autopilot_mod.Supervisor(autopilot_mod.Host())
         assert sup2.quarantined() == {}
+
+
+# --- federated-cache races: the threadlint T007 regression -----------------
+
+class TestFederatedCacheRaces:
+    def test_signature_read_before_query(self, tmp_path, monkeypatch):
+        """The T007 order: index_signature() must run BEFORE query().
+        A signature taken after the read would alias a stale read
+        under a fresh signature forever when an append lands between
+        them; signature-first merely refreshes once more next poll."""
+        led = ledger_mod.Ledger(str(tmp_path / "a"))
+        _request(led, time.time())
+        fed = obs.FederatedLedger([led.store_root])
+        inner = fed._ledgers[fed.roots[0]]
+        calls = []
+        orig_sig = inner.index_signature
+        orig_query = inner.query
+        monkeypatch.setattr(
+            inner, "index_signature",
+            lambda: (calls.append("sig"), orig_sig())[1])
+        monkeypatch.setattr(
+            inner, "query",
+            lambda **kw: (calls.append("query"), orig_query(**kw))[1])
+        recs = fed.records_for(fed.roots[0])
+        assert len(recs) == 1
+        assert "query" in calls
+        assert calls.index("sig") < calls.index("query")
+
+    def test_concurrent_records_for_identical(self, tmp_path):
+        """Two web-handler threads hitting one FederatedLedger: the
+        cache's read-check-store runs under its lock, so both see the
+        same records and the cache never tears."""
+        import threading
+        led = ledger_mod.Ledger(str(tmp_path / "a"))
+        now = time.time()
+        for i in range(4):
+            _request(led, now - i)
+        fed = obs.FederatedLedger([led.store_root])
+        barrier = threading.Barrier(2)
+        outs = [None, None]
+
+        def read(i):
+            barrier.wait(timeout=5)
+            outs[i] = fed.records_for(fed.roots[0])
+
+        ts = [threading.Thread(target=read, args=(i,))
+              for i in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=10)
+        assert outs[0] is not None and outs[0] == outs[1]
+        assert len(outs[0]) == 4
+        sig, cached = fed._cache[fed.roots[0]]
+        assert sig == led.index_signature()
+        assert len(cached) == 4

@@ -660,3 +660,77 @@ class TestHTTP:
         resp = urllib.request.urlopen(base + "/slo", timeout=10)
         assert resp.status == 200
         assert b"service objectives" in resp.read()
+
+
+# --- concurrent lifecycle: the threadlint T005 regression corpus -----------
+
+class TestConcurrentLifecycle:
+    """Deterministic two-thread regressions for the races threadlint
+    surfaced (T005 on start/close): duplicate worker pools /
+    heartbeat threads from concurrent start(), and double-join /
+    join-under-lock deadlock from concurrent close()."""
+
+    def test_concurrent_start_claims_once(self, tmp_path):
+        svc = _service(tmp_path / "s", workers=2,
+                       heartbeat_every_s=3600.0)
+        barrier = threading.Barrier(2)
+
+        def go():
+            barrier.wait(timeout=5)
+            svc.start()
+
+        ts = [threading.Thread(target=go) for _ in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=10)
+        try:
+            # one worker pool, not two (the old unlocked check let
+            # both starters populate _threads)
+            assert len(svc._threads) == 2
+            # one heartbeat thread claimed, alive, and exactly one
+            hb = svc._hb_thread
+            assert hb is not None and hb.is_alive()
+        finally:
+            svc.close()
+
+    def test_concurrent_close_joins_once_and_returns(self, tmp_path):
+        """Two concurrent close() calls: both must RETURN (the old
+        code could join the heartbeat under the service lock — a
+        deadlock against the heartbeat's own lock take) and the
+        detach-under-lock means only one closer joins each thread."""
+        svc = _service(tmp_path / "s", workers=1,
+                       heartbeat_every_s=3600.0).start()
+        hb = svc._hb_thread
+        assert hb is not None
+        barrier = threading.Barrier(2)
+        done = []
+
+        def go():
+            barrier.wait(timeout=5)
+            svc.close(timeout=10)
+            done.append(True)
+
+        ts = [threading.Thread(target=go) for _ in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=30)
+        assert done == [True, True], "a close() call deadlocked"
+        assert svc._hb_thread is None and svc._autopilot is None
+        assert not hb.is_alive()
+        assert svc._threads == []
+
+    def test_start_close_start_restarts(self, tmp_path):
+        """close() must leave the claims reusable — a second start()
+        after close() brings the pool back."""
+        svc = _service(tmp_path / "s", workers=1)
+        svc.start()
+        svc.close()
+        svc.start()
+        try:
+            info = _wait(svc, _post(svc, [
+                op.to_dict() for op in _hist(40, seed=3)])["id"])
+            assert info["state"] == "done"
+        finally:
+            svc.close()

@@ -403,6 +403,9 @@ class TestDoctorRules:
         p = tmp_path / "d.jsonl"
         p.write_text(json.dumps(pt) + "\n")
         assert telemetry_lint.lint_jsonl_file(str(p)) == []
-        pt["rule"] = "D016"  # past the frozen catalog: drift
+        pt["rule"] = "D016"  # in the frozen catalog since plane 4
+        p.write_text(json.dumps(pt) + "\n")
+        assert telemetry_lint.lint_jsonl_file(str(p)) == []
+        pt["rule"] = "D017"  # past the frozen catalog: drift
         p.write_text(json.dumps(pt) + "\n")
         assert telemetry_lint.lint_jsonl_file(str(p)) != []

@@ -1,0 +1,506 @@
+"""Plane 4 — concurrency analysis (doc/STATIC_ANALYSIS.md).
+
+Three surfaces under test:
+
+  * threadlint — a fixture corpus that must trip each rule T001-T008
+    plus clean counterparts that must NOT (locked writes, one global
+    lock order, double-checked locking, daemon threads, default-arg
+    binding), the allowlist contract (inline ok / line-above /
+    ok-file), and the CI contract that the shipped host plane lints
+    clean (scripts/thread_lint.py exit codes, --rules scoping);
+  * lockwatch — the runtime witness: a seeded A→B/B→A inversion must
+    raise LockOrderViolation with the cycle recorded, clean nesting
+    and reentrant re-acquires stay silent, and with
+    JEPSEN_TPU_LOCKWATCH unset the factories return PLAIN
+    threading locks (type identity — zero wrapper in the lock path)
+    with zero events counted;
+  * schema lint — the `lockwatch` series and `kind="lockwatch"`
+    ledger records pass scripts/telemetry_lint.py when well-formed
+    and fail on seeded drift (bad event enum, stringified cycle,
+    missing per-lock percentiles).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import pytest
+
+from jepsen_tpu.analysis import gitscope, lockwatch, threadlint
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LINT_CLI = os.path.join(REPO, "scripts", "thread_lint.py")
+FIXTURES = os.path.join(REPO, "tests", "threadlint_fixtures")
+
+sys.path.insert(0, os.path.join(REPO, "scripts"))
+import telemetry_lint  # noqa: E402
+
+
+# ---------------------------------------------------------------------------
+# threadlint: the rule corpus
+# ---------------------------------------------------------------------------
+
+class TestThreadLintRules:
+    @pytest.mark.parametrize("rule", sorted(threadlint.RULES))
+    def test_fixture_trips_rule(self, rule):
+        path = os.path.join(FIXTURES, f"fixture_{rule.lower()}.py")
+        found = {f.rule for f in threadlint.lint_file(path)}
+        assert rule in found, (rule, found)
+
+    def test_locked_writes_not_flagged(self):
+        """The T001 fixture's race, fixed: both writes under the
+        class lock."""
+        src = (
+            "import threading\n"
+            "class Pool:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self.count = 0\n"
+            "    def start(self):\n"
+            "        t = threading.Thread(target=self._run,\n"
+            "                             daemon=True)\n"
+            "        t.start()\n"
+            "    def _run(self):\n"
+            "        with self._lock:\n"
+            "            self.count += 1\n"
+            "    def reset(self):\n"
+            "        with self._lock:\n"
+            "            self.count = 0\n")
+        assert threadlint.lint_source(src, "locked.py") == []
+
+    def test_consistent_lock_order_not_flagged(self):
+        src = (
+            "import threading\n"
+            "class TwoLocks:\n"
+            "    def __init__(self):\n"
+            "        self._a_lock = threading.Lock()\n"
+            "        self._b_lock = threading.Lock()\n"
+            "    def one(self):\n"
+            "        with self._a_lock:\n"
+            "            with self._b_lock:\n"
+            "                return 1\n"
+            "    def two(self):\n"
+            "        with self._a_lock:\n"
+            "            with self._b_lock:\n"
+            "                return 2\n")
+        assert threadlint.lint_source(src, "ordered.py") == []
+
+    def test_condition_alias_is_not_an_inversion(self):
+        """`with self._cv:` after `with self._lock:` is a REENTRANT
+        acquire of the same underlying lock, not an ordering edge."""
+        src = (
+            "import threading\n"
+            "class Svc:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.RLock()\n"
+            "        self._cv = threading.Condition(self._lock)\n"
+            "    def a(self):\n"
+            "        with self._lock:\n"
+            "            with self._cv:\n"
+            "                return 1\n"
+            "    def b(self):\n"
+            "        with self._cv:\n"
+            "            with self._lock:\n"
+            "                return 2\n")
+        found = {f.rule for f in
+                 threadlint.lint_source(src, "alias.py")}
+        assert "T002" not in found, found
+
+    def test_sleep_outside_lock_not_flagged(self):
+        src = (
+            "import threading, time\n"
+            "class Host:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "    def poll(self):\n"
+            "        with self._lock:\n"
+            "            n = 1\n"
+            "        time.sleep(0.5)\n"
+            "        return n\n")
+        assert threadlint.lint_source(src, "outside.py") == []
+
+    def test_condition_wait_under_lock_exempt(self):
+        """Condition.wait releases the lock — never a T003."""
+        src = (
+            "import threading\n"
+            "class Svc:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._cv = threading.Condition(self._lock)\n"
+            "    def take(self):\n"
+            "        with self._cv:\n"
+            "            self._cv.wait(1.0)\n")
+        found = {f.rule for f in threadlint.lint_source(src, "cv.py")}
+        assert "T003" not in found, found
+
+    def test_str_join_is_not_a_thread_join(self):
+        src = (
+            "import threading\n"
+            "class Fmt:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "    def render(self, parts):\n"
+            "        with self._lock:\n"
+            "            return ', '.join(parts)\n")
+        assert threadlint.lint_source(src, "strjoin.py") == []
+
+    def test_daemon_thread_not_flagged(self):
+        src = ("import threading\n"
+               "def kick(fn):\n"
+               "    threading.Thread(target=fn, daemon=True).start()\n")
+        assert threadlint.lint_source(src, "daemon.py") == []
+
+    def test_joined_thread_not_flagged(self):
+        src = ("import threading\n"
+               "def run(fn):\n"
+               "    t = threading.Thread(target=fn)\n"
+               "    t.start()\n"
+               "    t.join()\n")
+        assert threadlint.lint_source(src, "joined.py") == []
+
+    def test_double_checked_locking_passes_t005(self):
+        """Unlocked fast-path check + LOCKED re-check-and-write is
+        the sanctioned idiom, not a race."""
+        src = (
+            "import threading\n"
+            "class Cache:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._plan = None\n"
+            "    def ensure(self):\n"
+            "        if self._plan is None:\n"
+            "            with self._lock:\n"
+            "                if self._plan is None:\n"
+            "                    self._plan = object()\n"
+            "        return self._plan\n")
+        found = {f.rule for f in
+                 threadlint.lint_source(src, "dcl.py")}
+        assert "T005" not in found, found
+
+    def test_signature_before_read_not_flagged(self):
+        src = ("def poll(led, cache):\n"
+               "    sig = led.index_signature()\n"
+               "    recs = led.query(kind='service-request')\n"
+               "    cache[sig] = recs\n"
+               "    return recs\n")
+        assert threadlint.lint_source(src, "sigfirst.py") == []
+
+    def test_default_arg_binding_passes_t008(self):
+        src = (
+            "import threading\n"
+            "def fan_out(items, handle):\n"
+            "    ts = []\n"
+            "    for item in items:\n"
+            "        ts.append(threading.Thread(\n"
+            "            target=lambda item=item: handle(item),\n"
+            "            daemon=True))\n"
+            "    for t in ts:\n"
+            "        t.start()\n"
+            "    return ts\n")
+        found = {f.rule for f in
+                 threadlint.lint_source(src, "bound.py")}
+        assert "T008" not in found, found
+
+    def test_finding_str_has_path_line_rule(self):
+        path = os.path.join(FIXTURES, "fixture_t003.py")
+        f = threadlint.lint_file(path)[0]
+        s = str(f)
+        assert s.startswith(f"{path}:{f.line}:{f.col}: T003 ")
+        assert "[blocking-call-under-lock]" in s
+
+
+class TestThreadLintAllowlist:
+    def test_allowlist_suppresses(self):
+        path = os.path.join(FIXTURES, "fixture_allowlisted.py")
+        assert threadlint.lint_file(path) == []
+
+    def test_wrong_rule_id_does_not_suppress(self):
+        src = ("import threading, time\n"
+               "class H:\n"
+               "    def __init__(self):\n"
+               "        self._lock = threading.Lock()\n"
+               "    def s(self):\n"
+               "        with self._lock:\n"
+               "            time.sleep(1)  # threadlint: ok(T001)\n")
+        found = {f.rule for f in
+                 threadlint.lint_source(src, "wrong.py")}
+        assert "T003" in found
+
+    def test_bare_ok_suppresses_any_rule(self):
+        src = ("import threading, time\n"
+               "class H:\n"
+               "    def __init__(self):\n"
+               "        self._lock = threading.Lock()\n"
+               "    def s(self):\n"
+               "        with self._lock:\n"
+               "            time.sleep(1)  # threadlint: ok\n")
+        assert threadlint.lint_source(src, "bare.py") == []
+
+    def test_ok_file_outside_header_ignored(self):
+        """ok-file must sit in the first 20 lines — a buried banner
+        is not a reviewable decision."""
+        pad = "x = 1\n" * 25
+        src = (pad + "# threadlint: ok-file(T004)\n"
+               "import threading\n"
+               "def kick(fn):\n"
+               "    t = threading.Thread(target=fn)\n"
+               "    t.start()\n")
+        found = {f.rule for f in
+                 threadlint.lint_source(src, "buried.py")}
+        assert "T004" in found
+
+
+class TestThreadLintCLI:
+    def test_cli_exits_nonzero_on_fixture(self):
+        proc = subprocess.run(
+            [sys.executable, LINT_CLI,
+             os.path.join(FIXTURES, "fixture_t002.py")],
+            capture_output=True, text=True)
+        assert proc.returncode == 1
+        assert "T002" in proc.stderr
+
+    def test_rules_filter_scopes_findings(self):
+        """--rules T005 on the T002 fixture: nothing to report."""
+        proc = subprocess.run(
+            [sys.executable, LINT_CLI, "--rules", "T005",
+             os.path.join(FIXTURES, "fixture_t002.py")],
+            capture_output=True, text=True)
+        assert proc.returncode == 0, proc.stderr
+
+    def test_unknown_rule_is_usage_error(self):
+        proc = subprocess.run(
+            [sys.executable, LINT_CLI, "--rules", "T999"],
+            capture_output=True, text=True)
+        assert proc.returncode == 254
+
+    def test_shipped_tree_lints_clean(self):
+        """The CI contract (tier-1): the service host plane must stay
+        thread-safety clean — fix or allowlist every finding."""
+        proc = subprocess.run([sys.executable, LINT_CLI, "--check"],
+                              capture_output=True, text=True)
+        assert proc.returncode == 0, proc.stderr
+
+    def test_changed_only_scope_is_shared_with_jax_lint(self):
+        """One git-scope helper serves both linters — no forked
+        changed-file logic to drift apart."""
+        import importlib
+        jl = importlib.import_module("jax_lint")
+        tl = importlib.import_module("thread_lint")
+        assert jl.gitscope is tl.gitscope is gitscope
+        changed = gitscope.changed_files(REPO)
+        assert changed is None or isinstance(changed, list)
+        if changed is not None:
+            assert all(p.endswith(".py") and os.path.isabs(p)
+                       for p in changed)
+
+    def test_gitscope_under(self):
+        assert gitscope.under("/a/b/c.py", ["/a/b"])
+        assert not gitscope.under("/a/x/c.py", ["/a/b"])
+
+
+# ---------------------------------------------------------------------------
+# lockwatch: the runtime witness
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def armed_watch(monkeypatch):
+    monkeypatch.setenv(lockwatch.ENV, "1")
+    monkeypatch.setenv(lockwatch.STRICT_ENV, "1")
+    lockwatch.reset()
+    yield
+    lockwatch.reset()
+
+
+class TestLockwatch:
+    def test_seeded_inversion_detected(self, armed_watch):
+        a = lockwatch.lock("A")
+        b = lockwatch.lock("B")
+        with a:
+            with b:
+                pass
+        with pytest.raises(lockwatch.LockOrderViolation):
+            with b:
+                with a:
+                    pass
+        rep = lockwatch.report()
+        assert rep["cycle"] is True
+        assert ["A", "B"] in rep["edges"]
+        assert rep["cycles"][0]["locks"] == ["A", "B"]
+        # the raise released the inner lock: both reacquirable
+        assert a.acquire(timeout=1) and b.acquire(timeout=1)
+        a.release(), b.release()
+
+    def test_non_strict_records_without_raising(self, monkeypatch):
+        monkeypatch.setenv(lockwatch.ENV, "1")
+        monkeypatch.setenv(lockwatch.STRICT_ENV, "0")
+        lockwatch.reset()
+        a, b = lockwatch.lock("A"), lockwatch.lock("B")
+        with a:
+            with b:
+                pass
+        with b:
+            with a:
+                pass
+        assert lockwatch.report()["cycle"] is True
+        lockwatch.reset()
+
+    def test_clean_nesting_silent(self, armed_watch):
+        a = lockwatch.lock("A")
+        b = lockwatch.lock("B")
+        for _ in range(3):
+            with a:
+                with b:
+                    pass
+        rep = lockwatch.report()
+        assert rep["cycle"] is False and rep["cycles"] == []
+        assert rep["edges"] == [["A", "B"]]
+
+    def test_reentrant_rlock_adds_no_edges(self, armed_watch):
+        r = lockwatch.rlock("R")
+        with r:
+            with r:
+                pass
+        rep = lockwatch.report()
+        assert rep["edges"] == [] and rep["cycle"] is False
+
+    def test_condition_protocol_works(self, armed_watch):
+        r = lockwatch.rlock("svc")
+        cv = threading.Condition(r)
+        hits = []
+
+        def waiter():
+            with cv:
+                hits.append(cv.wait(timeout=5))
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        while not r.acquire(timeout=0.01):
+            pass
+        try:
+            cv.notify_all()
+        finally:
+            r.release()
+        t.join(timeout=5)
+        assert hits == [True]
+
+    def test_disabled_mode_is_zero_overhead(self, monkeypatch):
+        """JEPSEN_TPU_LOCKWATCH unset: the factories return PLAIN
+        threading primitives — no wrapper in the lock path at all —
+        and the witness counts zero events."""
+        monkeypatch.delenv(lockwatch.ENV, raising=False)
+        lockwatch.reset()
+        plain = lockwatch.lock("x")
+        assert type(plain) is type(threading.Lock())
+        plain_r = lockwatch.rlock("x")
+        assert type(plain_r) is type(threading.RLock())
+        for _ in range(100):
+            with plain:
+                pass
+        assert lockwatch.events() == 0
+        assert lockwatch.report()["locks"] == {}
+        assert lockwatch.bank() is None
+
+    def test_contention_stats_recorded(self, armed_watch):
+        lk = lockwatch.lock("hot")
+        started = threading.Event()
+
+        def holder():
+            with lk:
+                started.set()
+                import time
+                time.sleep(0.05)
+
+        t = threading.Thread(target=holder)
+        t.start()
+        started.wait(5)
+        with lk:
+            pass
+        t.join(5)
+        st = lockwatch.report()["locks"]["hot"]
+        assert st["acquires"] >= 2
+        assert st["contended"] >= 1
+        assert st["wait_max_s"] > 0
+
+    def test_bank_writes_lintable_record(self, armed_watch, tmp_path):
+        from jepsen_tpu import ledger as ledger_mod
+        a, b = lockwatch.lock("A"), lockwatch.lock("B")
+        with a:
+            with b:
+                pass
+        led = ledger_mod.Ledger(str(tmp_path))
+        rid = lockwatch.bank(led)
+        assert rid
+        errs = telemetry_lint.lint_ledger_file(led.record_path(rid))
+        assert errs == [], errs
+        rec = led.query(kind="lockwatch")[0]
+        assert rec["cycle"] is False
+        assert ["A", "B"] in rec["edges"]
+
+
+# ---------------------------------------------------------------------------
+# schema lint: lockwatch series + records, good and drifted
+# ---------------------------------------------------------------------------
+
+class TestLockwatchSchemaLint:
+    GOOD_POINT = {"type": "sample", "series": "lockwatch", "t": 1.0,
+                  "lock": "service", "event": "acquire",
+                  "hold_s": 0.0, "wait_s": 0.002}
+
+    def test_good_series_point_lints(self):
+        assert telemetry_lint.lint_line(dict(self.GOOD_POINT),
+                                        "w") == []
+
+    def test_drifted_event_enum_fails(self):
+        bad = dict(self.GOOD_POINT, event="lock")
+        errs = telemetry_lint.lint_line(bad, "w")
+        assert errs and "event" in errs[0]
+
+    def test_drifted_missing_wait_fails(self):
+        bad = dict(self.GOOD_POINT)
+        del bad["wait_s"]
+        errs = telemetry_lint.lint_line(bad, "w")
+        assert any("wait_s" in e for e in errs)
+
+    GOOD_RECORD = {
+        "schema": 1, "id": "lw-1", "kind": "lockwatch",
+        "name": "lockwatch:1", "t": 1.0,
+        "edges": [["A", "B"]], "cycle": False, "cycles": [],
+        "locks": {"A": {"acquires": 4, "contended": 1,
+                        "wait_p95_s": 0.001, "wait_max_s": 0.002,
+                        "hold_p95_s": 0.0005, "hold_max_s": 0.001}}}
+
+    def _lint_record(self, rec, tmp_path):
+        p = tmp_path / "rec.json"
+        p.write_text(json.dumps(rec))
+        return telemetry_lint.lint_ledger_file(str(p))
+
+    def test_good_record_lints(self, tmp_path):
+        assert self._lint_record(dict(self.GOOD_RECORD),
+                                 tmp_path) == []
+
+    def test_drifted_cycle_type_fails(self, tmp_path):
+        bad = dict(self.GOOD_RECORD, cycle="no")
+        errs = self._lint_record(bad, tmp_path)
+        assert any("cycle" in e for e in errs)
+
+    def test_drifted_edge_shape_fails(self, tmp_path):
+        bad = dict(self.GOOD_RECORD, edges=[["A", "B", "C"]])
+        errs = self._lint_record(bad, tmp_path)
+        assert any("edges[0]" in e for e in errs)
+
+    def test_drifted_missing_percentile_fails(self, tmp_path):
+        locks = {"A": {"acquires": 4, "contended": 1,
+                       "wait_p95_s": 0.001, "wait_max_s": 0.002,
+                       "hold_max_s": 0.001}}  # hold_p95_s dropped
+        bad = dict(self.GOOD_RECORD, locks=locks)
+        errs = self._lint_record(bad, tmp_path)
+        assert any("hold_p95_s" in e for e in errs)
+
+    def test_doctor_catalog_includes_d016(self):
+        from jepsen_tpu import doctor
+        assert "D016" in telemetry_lint.DOCTOR_RULE_IDS
+        assert set(doctor.RULES) == telemetry_lint.DOCTOR_RULE_IDS
+        assert "D016" in doctor.LOCAL_RULES
+        assert "lockwatch" in doctor.SERIES_OF_INTEREST

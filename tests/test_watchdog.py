@@ -364,3 +364,70 @@ class TestFanoutIntegration:
         finally:
             hung.set()
             w.stop()
+
+
+# --- concurrent declaration: the threadlint T001/T005 regressions ----------
+
+class TestConcurrentScan:
+    """Deterministic two-thread regressions for the races threadlint
+    surfaced in scan()/soft_cancel(): the stall check-and-set and the
+    cancel-flag writes now share ONE critical section, so concurrent
+    scanners declare each stall exactly once and a racing
+    soft_cancel() can never tear the reason."""
+
+    def test_two_concurrent_scans_declare_once(self):
+        w = watchdog.Watchdog(stall_s=0.05, poll_s=3600.0,
+                              escalation="cancel")
+        try:
+            with w.watch("dead") as src:
+                time.sleep(0.1)
+                barrier = threading.Barrier(2)
+                outs = [None, None]
+
+                def scan(i):
+                    barrier.wait(timeout=5)
+                    outs[i] = w.scan()
+
+                ts = [threading.Thread(target=scan, args=(i,))
+                      for i in range(2)]
+                for t in ts:
+                    t.start()
+                for t in ts:
+                    t.join(timeout=10)
+                # exactly ONE scanner won the declaration; the stall
+                # log holds one event, not two
+                assert len(w.stalls) == 1
+                assert sorted(len(o) for o in outs) == [0, 1]
+                assert src.stalled and src.cancel
+        finally:
+            w.stop()
+
+    def test_scan_racing_soft_cancel_keeps_one_reason(self):
+        w = watchdog.Watchdog(stall_s=0.05, poll_s=3600.0,
+                              escalation="cancel")
+        try:
+            with w.watch("dead"):
+                time.sleep(0.1)
+                barrier = threading.Barrier(2)
+
+                def scan():
+                    barrier.wait(timeout=5)
+                    w.scan()
+
+                def cancel():
+                    barrier.wait(timeout=5)
+                    w.soft_cancel("operator-stop")
+
+                ts = [threading.Thread(target=scan),
+                      threading.Thread(target=cancel)]
+                for t in ts:
+                    t.start()
+                for t in ts:
+                    t.join(timeout=10)
+                assert w.cancelled()
+                # the reason is ONE of the two writers' values,
+                # never a torn/None state while _cancel_all is set
+                assert w._cancel_reason in ("operator-stop",
+                                            "stalled: dead")
+        finally:
+            w.stop()

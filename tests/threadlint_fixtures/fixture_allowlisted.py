@@ -1,0 +1,27 @@
+"""Every violation below carries a reviewed allowlist marker — the
+file must lint clean. ok-file suppresses T004 module-wide; the
+others use inline ok(<rule>) on the line or the line above."""
+# threadlint: ok-file(T004)
+import threading
+import time
+
+
+def kick(fn):
+    t = threading.Thread(target=fn)  # suppressed by ok-file(T004)
+    t.start()
+
+
+class Host:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._plan = None
+
+    def slow(self):
+        with self._lock:
+            time.sleep(0.1)  # threadlint: ok(T003)
+
+    def ensure(self):
+        # single-writer by construction — # threadlint: ok(T005)
+        if self._plan is None:
+            self._plan = object()
+        return self._plan

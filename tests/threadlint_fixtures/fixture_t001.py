@@ -1,0 +1,19 @@
+"""T001 fires: self.count written unlocked from BOTH a thread-context
+method and a caller-context method — the Eraser condition."""
+import threading
+
+
+class Pool:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+
+    def start(self):
+        t = threading.Thread(target=self._run, daemon=True)
+        t.start()
+
+    def _run(self):
+        self.count += 1
+
+    def reset(self):
+        self.count = 0

@@ -1,0 +1,19 @@
+"""T002 fires: two paths acquire the same pair of locks in opposite
+orders — threads on opposite paths deadlock."""
+import threading
+
+
+class TwoLocks:
+    def __init__(self):
+        self._a_lock = threading.Lock()
+        self._b_lock = threading.Lock()
+
+    def forward(self):
+        with self._a_lock:
+            with self._b_lock:
+                return 1
+
+    def backward(self):
+        with self._b_lock:
+            with self._a_lock:
+                return 2

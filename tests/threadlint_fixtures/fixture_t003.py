@@ -1,0 +1,18 @@
+"""T003 fires: blocking calls inside `with self._lock:` bodies —
+every thread on the lock stalls for the full blocking call."""
+import threading
+import time
+
+
+class Host:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.ledger = None
+
+    def slow_poll(self):
+        with self._lock:
+            time.sleep(0.5)
+
+    def bank(self, rec):
+        with self._lock:
+            self.ledger.record(rec)

@@ -1,0 +1,8 @@
+"""T004 fires: a non-daemon Thread started with no join path and no
+daemon assignment — it leaks and blocks interpreter exit."""
+import threading
+
+
+def kick(fn):
+    t = threading.Thread(target=fn)
+    t.start()

@@ -1,0 +1,14 @@
+"""T005 fires: unlocked check (`is None`) then unlocked act (assign
+the same field) — another thread interleaves between them."""
+import threading
+
+
+class Cache:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._plan = None
+
+    def ensure(self):
+        if self._plan is None:
+            self._plan = object()
+        return self._plan

@@ -1,0 +1,13 @@
+"""T006 fires: a module global mutated from a thread-context function
+without a module lock — concurrent threads tear the update."""
+import threading
+
+_SEEN = set()
+
+
+def worker(item):
+    _SEEN.add(item)
+
+
+def start(item):
+    threading.Thread(target=worker, args=(item,), daemon=True).start()

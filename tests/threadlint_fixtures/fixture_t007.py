@@ -1,0 +1,10 @@
+"""T007 fires: index_signature() computed AFTER the ledger read it is
+meant to version — a concurrent append between read and signature
+aliases the stale read under the fresh signature forever."""
+
+
+def poll(led, cache):
+    recs = led.query(kind="service-request")
+    sig = led.index_signature()
+    cache[sig] = recs
+    return recs

@@ -1,0 +1,14 @@
+"""T008 fires: a closure created inside a loop captures the loop
+variable and is handed to a thread — every thread sees the LAST
+iteration's value."""
+import threading
+
+
+def fan_out(items, handle):
+    threads = []
+    for item in items:
+        threads.append(threading.Thread(
+            target=lambda: handle(item), daemon=True))
+    for t in threads:
+        t.start()
+    return threads
